@@ -1,0 +1,67 @@
+"""horovod_tpu: a TPU-native distributed training framework with Horovod's
+capabilities (reference: DelphianCalamity/horovod), rebuilt on jax/XLA.
+
+    import horovod_tpu as hvd
+    hvd.init()
+    step = hvd.spmd(train_step)   # shard_map over the communicator mesh
+    ...
+
+See SURVEY.md for the component inventory mapping every public symbol to its
+upstream equivalent.
+"""
+
+from horovod_tpu.core import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mesh, axis_name, build_info, in_spmd_context,
+)
+from horovod_tpu.collective import (  # noqa: F401
+    ReduceOp, Average, Sum, Min, Max, Product, Adasum,
+    allreduce, allreduce_, allreduce_async, grouped_allreduce,
+    allgather, broadcast, broadcast_, alltoall, reducescatter,
+    barrier, synchronize, poll, join, broadcast_object, allgather_object,
+)
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.optimizer import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTape, grad, value_and_grad,
+    allreduce_gradients, broadcast_parameters, broadcast_optimizer_state,
+    broadcast_variables,
+)
+from horovod_tpu.process_set import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from horovod_tpu.spmd import spmd, spmd_data_sharding  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim: no MPI on TPU (upstream ``hvd.mpi_threads_supported``)."""
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False

@@ -1,0 +1,459 @@
+"""Collective operations: allreduce / allgather / broadcast / alltoall /
+reducescatter / barrier / join.
+
+Rebuild of upstream ``horovod/common/ops/*_operations.cc`` plus the Python
+op layer (``horovod/tensorflow/mpi_ops.py``, ``horovod/torch/mpi_ops.py``).
+
+Architecture (TPU-first, see SURVEY §3): the reference routes every call
+through a background controller thread that negotiates tensor readiness
+across ranks and a fusion buffer manager before hitting NCCL/MPI. Under SPMD
+on TPU every device runs the same XLA program, so negotiation disappears:
+
+* **Inside jit/shard_map** (the training hot path) a collective lowers to a
+  single XLA op over the communicator mesh axis — ``lax.psum``,
+  ``lax.all_gather``, ``lax.all_to_all``, ``lax.psum_scatter`` — which XLA
+  schedules on the ICI fabric.
+* **Eager** (host) calls simulate all ranks at once: per-rank values are the
+  leading axis of the input (``tensor[r]`` is rank ``r``'s value), the op runs
+  as a cached ``jit(shard_map(...))`` over the global mesh, and the result is
+  returned stacked the same way. This keeps Horovod's one-call-per-rank
+  mental model testable from a single controller.
+
+Process sets lower to *masked* full-axis collectives (see ``process_set.py``):
+members contribute their value, non-members the op's neutral element, and
+non-members get their input back (or zeros where the output shape differs,
+as in allgather/reducescatter). Subset gathers use a psum-of-one-hot that is
+shape-uniform across all devices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import core
+from horovod_tpu import fusion as _fusion
+from horovod_tpu.adasum import adasum_allreduce, is_power_of_two
+from horovod_tpu.compression import Compression
+from horovod_tpu.process_set import ProcessSet, global_process_set
+
+__all__ = [
+    "ReduceOp", "Average", "Sum", "Min", "Max", "Product", "Adasum",
+    "allreduce", "allreduce_", "allreduce_async", "grouped_allreduce",
+    "allgather", "broadcast", "broadcast_", "alltoall", "reducescatter",
+    "barrier", "synchronize", "poll", "join",
+    "broadcast_object", "allgather_object",
+]
+
+
+class ReduceOp:
+    """Reduction op ids, matching ``horovod.common.Average/Sum/...``."""
+    Average = 0
+    Sum = 1
+    Min = 2
+    Max = 3
+    Product = 4
+    Adasum = 5
+
+
+Average = ReduceOp.Average
+Sum = ReduceOp.Sum
+Min = ReduceOp.Min
+Max = ReduceOp.Max
+Product = ReduceOp.Product
+Adasum = ReduceOp.Adasum
+
+_SCALING_OPS = (ReduceOp.Average, ReduceOp.Sum, ReduceOp.Adasum)
+
+
+def _resolve_ps(process_set: Optional[ProcessSet]) -> ProcessSet:
+    return process_set if process_set is not None else global_process_set()
+
+
+def _is_traced(tree: Any) -> bool:
+    return any(isinstance(x, jax.core.Tracer)
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def _member_and_setrank(ps: ProcessSet):
+    """Per-device (member?, rank-within-set) for a traced context."""
+    r = lax.axis_index(ps.axis)
+    world = core.size()
+    if ps.ranks is None:
+        return jnp.bool_(True), r
+    member = np.zeros(world, bool)
+    pos = np.zeros(world, np.int32)
+    for j, rk in enumerate(ps.ranks):
+        member[rk] = True
+        pos[rk] = j
+    return jnp.asarray(member)[r], jnp.asarray(pos)[r]
+
+
+def _set_gather(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
+    """Gather ``x`` from every member of ``ps`` into axis 0 (shape-uniform on
+    all devices; non-members receive zeros). psum-of-one-hot, so any subset
+    works — XLA's AllGather only handles uniform replica groups."""
+    k = ps.size()
+    member, setrank = _member_and_setrank(ps)
+    contrib = jnp.where(member, x, jnp.zeros_like(x))
+    buf = jnp.zeros((k,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_index_in_dim(buf, contrib[None], setrank, 0)
+    return lax.psum(buf, ps.axis)
+
+
+def _identity_for(op: int, x: jnp.ndarray) -> jnp.ndarray:
+    """Neutral element a non-member contributes to a masked reduction."""
+    if op in (ReduceOp.Sum, ReduceOp.Average):
+        return jnp.zeros_like(x)
+    if op == ReduceOp.Min:
+        v = jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).max
+        return jnp.full_like(x, v)
+    if op == ReduceOp.Max:
+        v = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return jnp.full_like(x, v)
+    raise ValueError(f"no identity for op {op}")
+
+
+# ---------------------------------------------------------------------------
+# in-trace (SPMD) implementations
+# ---------------------------------------------------------------------------
+
+def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
+    """Masked full-axis reduction: members contribute their value, non-members
+    the op's neutral element, and non-members get their input back. One XLA
+    collective over the whole axis regardless of the set — subgroup replica
+    groups are not expressible under shard_map, and a single full-axis op is
+    what the ICI fabric schedules best anyway."""
+    k = ps.size()
+    member, _ = _member_and_setrank(ps)
+    is_subset = ps.ranks is not None
+    x_in = x
+    if op in _SCALING_OPS and prescale != 1.0:
+        x = x * jnp.asarray(prescale, x.dtype)
+    masked = jnp.where(member, x, _identity_for(op, x)) if is_subset and \
+        op != ReduceOp.Adasum and op != ReduceOp.Product else x
+    if op == ReduceOp.Sum:
+        out = lax.psum(masked, ps.axis)
+    elif op == ReduceOp.Average:
+        out = lax.psum(masked, ps.axis)
+        out = out / jnp.asarray(k, out.dtype) if jnp.issubdtype(
+            out.dtype, jnp.floating) else out // k
+    elif op == ReduceOp.Min:
+        out = lax.pmin(masked, ps.axis)
+    elif op == ReduceOp.Max:
+        out = lax.pmax(masked, ps.axis)
+    elif op == ReduceOp.Product:
+        gathered = _set_gather(x, ps) if is_subset \
+            else lax.all_gather(x, ps.axis)
+        out = jnp.prod(gathered, axis=0)
+    elif op == ReduceOp.Adasum:
+        if ps.ranks is not None:
+            raise NotImplementedError(
+                "Adasum is supported on the global process set only")
+        if not is_power_of_two(k):
+            raise ValueError(
+                f"Adasum requires a power-of-two world size, got {k}")
+        out = adasum_allreduce(x, ps.axis, k)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    if op in _SCALING_OPS and postscale != 1.0:
+        out = out * jnp.asarray(postscale, out.dtype)
+    return jnp.where(member, out, x_in) if is_subset else out
+
+
+def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
+                    fusion_threshold):
+    if op not in _SCALING_OPS and (prescale != 1.0 or postscale != 1.0):
+        raise ValueError("prescale/postscale only apply to Sum/Average/Adasum")
+
+    def reduce_buffer(buf):
+        c, ctx = compression.compress(buf)
+        r = _allreduce_leaf(c, op, ps, prescale, postscale)
+        return compression.decompress(r, ctx)
+
+    return _fusion.fused_apply(reduce_buffer, tree, fusion_threshold)
+
+
+def _broadcast_leaf(x, root_rank, ps: ProcessSet):
+    member, _ = _member_and_setrank(ps)
+    r = lax.axis_index(ps.axis)
+    contrib = jnp.where(r == root_rank, x, jnp.zeros_like(x))
+    summed = lax.psum(contrib, ps.axis)
+    return jnp.where(member, summed, x)
+
+
+def _allgather_leaf(x, ps: ProcessSet):
+    if ps.ranks is None:
+        return lax.all_gather(x, ps.axis, tiled=True)
+    member, _ = _member_and_setrank(ps)
+    g = _set_gather(x, ps)  # (k, *x.shape)
+    out = g.reshape((-1,) + x.shape[1:]) if x.ndim else g
+    # Non-members must not observe the members' data; output shape is
+    # uniform across devices, so they get zeros.
+    return jnp.where(member, out, jnp.zeros_like(out))
+
+
+def _alltoall_leaf(x, ps: ProcessSet):
+    k = ps.size()
+    if x.shape[0] % k:
+        raise ValueError(
+            f"alltoall requires dim0 ({x.shape[0]}) divisible by set size {k}")
+    if ps.ranks is None:
+        return lax.all_to_all(x, ps.axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    # Subset fallback: full gather then select this rank's column.
+    chunk = x.shape[0] // k
+    g = _set_gather(x, ps)                      # (k, k*chunk, ...)
+    g = g.reshape((k, k, chunk) + x.shape[1:])  # (src, dst, chunk, ...)
+    member, setrank = _member_and_setrank(ps)
+    mine = lax.dynamic_index_in_dim(
+        jnp.swapaxes(g, 0, 1), setrank, 0, keepdims=False)  # (src, chunk,...)
+    mine = mine.reshape((k * chunk,) + x.shape[1:])
+    return jnp.where(member, mine, x)
+
+
+def _reducescatter_leaf(x, op, ps: ProcessSet):
+    if op not in (ReduceOp.Sum, ReduceOp.Average):
+        raise ValueError("reducescatter supports Sum and Average")
+    k = ps.size()
+    if x.shape[0] % k:
+        raise ValueError(
+            f"reducescatter requires dim0 ({x.shape[0]}) divisible by {k}")
+    chunk = x.shape[0] // k
+    if ps.ranks is None:
+        out = lax.psum_scatter(x, ps.axis, scatter_dimension=0, tiled=True)
+    else:
+        member, setrank = _member_and_setrank(ps)
+        full = lax.psum(jnp.where(member, x, jnp.zeros_like(x)), ps.axis)
+        out = lax.dynamic_slice_in_dim(full, setrank * chunk, chunk, 0)
+        out = jnp.where(member, out, jnp.zeros_like(out))
+    if op == ReduceOp.Average:
+        out = out / jnp.asarray(k, out.dtype)
+    return out
+
+
+_INTRACE = {
+    "allreduce": _allreduce_tree,
+    "broadcast": lambda t, root, ps: jax.tree_util.tree_map(
+        lambda x: _broadcast_leaf(x, root, ps), t),
+    "allgather": lambda t, ps: jax.tree_util.tree_map(
+        lambda x: _allgather_leaf(x, ps), t),
+    "alltoall": lambda t, ps: jax.tree_util.tree_map(
+        lambda x: _alltoall_leaf(x, ps), t),
+    "reducescatter": lambda t, op, ps: jax.tree_util.tree_map(
+        lambda x: _reducescatter_leaf(x, op, ps), t),
+}
+
+
+# ---------------------------------------------------------------------------
+# eager engine: simulate all ranks via jit(shard_map) over the global mesh
+# ---------------------------------------------------------------------------
+
+_EAGER_CACHE: dict = {}
+
+
+def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
+    m = core.mesh()
+    axis = core.axis_name()
+    n = core.size()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [jnp.asarray(x) for x in leaves]
+    for x in leaves:
+        if x.ndim == 0 or x.shape[0] != n:
+            raise ValueError(
+                f"eager collectives expect per-rank values stacked on axis 0 "
+                f"(leading dim {n}), got shape {x.shape}")
+    key = (kind, treedef, tuple((x.shape, str(x.dtype)) for x in leaves),
+           param_key, id(m))
+    fn = _EAGER_CACHE.get(key)
+    if fn is None:
+        def body(*shard_leaves):
+            t = jax.tree_util.tree_unflatten(
+                treedef, [l[0] for l in shard_leaves])
+            out = _INTRACE[kind](t, *params)
+            return tuple(o[None] for o in jax.tree_util.tree_leaves(out))
+
+        smapped = jax.shard_map(
+            body, mesh=m,
+            in_specs=tuple(P(axis) for _ in leaves),
+            out_specs=P(axis))
+        fn = jax.jit(smapped)
+        _EAGER_CACHE[key] = fn
+
+    sharding = NamedSharding(m, P(axis))
+    placed = [jax.device_put(x, sharding) for x in leaves]
+    out_leaves = fn(*placed)
+    return jax.tree_util.tree_unflatten(treedef, list(out_leaves))
+
+
+def _ps_key(ps: ProcessSet):
+    return (ps.process_set_id,
+            None if ps.ranks is None else tuple(ps.ranks))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none, name: Optional[str] = None,
+              fusion_threshold_bytes: int = _fusion.DEFAULT_FUSION_THRESHOLD_BYTES):
+    """Allreduce a tensor or pytree across the communicator (``hvd.allreduce``).
+
+    Inside jit/shard_map: lowers to XLA psum/pmin/pmax/ppermute over the mesh
+    axis. Eagerly: ``tensor[r]`` is rank ``r``'s value and the stacked result
+    is returned (identical rows for reductions).
+    """
+    ps = _resolve_ps(process_set)
+    args = (op, ps, float(prescale_factor), float(postscale_factor),
+            compression, int(fusion_threshold_bytes))
+    if _is_traced(tensor):
+        return _allreduce_tree(tensor, *args)
+    pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
+          compression.__name__, int(fusion_threshold_bytes))
+    return _eager_run("allreduce", tensor, args, pk)
+
+
+def allreduce_(tensor, **kwargs):
+    """In-place variant for API parity (jax arrays are immutable; returns the
+    reduced value like :func:`allreduce`)."""
+    return allreduce(tensor, **kwargs)
+
+
+def allreduce_async(tensor, **kwargs):
+    """Async allreduce: jax dispatch is asynchronous, so the returned array is
+    the handle (matches ``hvd.allreduce_async`` + ``hvd.synchronize``)."""
+    return allreduce(tensor, **kwargs)
+
+
+def grouped_allreduce(tensors: Sequence, op: int = Average, **kwargs) -> List:
+    """Allreduce a list of tensors as one fused operation
+    (``hvd.grouped_allreduce``)."""
+    out = allreduce(list(tensors), op=op, **kwargs)
+    return list(out)
+
+
+def broadcast(tensor, root_rank: int, process_set: Optional[ProcessSet] = None,
+              name: Optional[str] = None):
+    """Broadcast from ``root_rank`` (a global rank) to every member of the
+    process set (``hvd.broadcast``)."""
+    ps = _resolve_ps(process_set)
+    if ps.ranks is not None and root_rank not in ps.ranks:
+        raise ValueError(f"root rank {root_rank} not in process set {ps.ranks}")
+    if _is_traced(tensor):
+        return _INTRACE["broadcast"](tensor, root_rank, ps)
+    return _eager_run("broadcast", tensor, (int(root_rank), ps),
+                      (int(root_rank), _ps_key(ps)))
+
+
+def broadcast_(tensor, root_rank: int, **kwargs):
+    return broadcast(tensor, root_rank, **kwargs)
+
+
+def allgather(tensor, process_set: Optional[ProcessSet] = None,
+              name: Optional[str] = None):
+    """Concatenate every member's tensor along axis 0 (``hvd.allgather``).
+    TPU note: static shapes require equal per-rank shapes (the reference
+    allows ragged dim 0 and pays a size negotiation; pad to equal instead)."""
+    ps = _resolve_ps(process_set)
+    if _is_traced(tensor):
+        return _INTRACE["allgather"](tensor, ps)
+    return _eager_run("allgather", tensor, (ps,), (_ps_key(ps),))
+
+
+def alltoall(tensor, process_set: Optional[ProcessSet] = None,
+             name: Optional[str] = None):
+    """Scatter equal splits of axis 0 to every member and gather theirs
+    (``hvd.alltoall`` with uniform splits; TPU static shapes require equal
+    splits — the reference's ragged ``splits`` arg is unsupported)."""
+    ps = _resolve_ps(process_set)
+    if _is_traced(tensor):
+        return _INTRACE["alltoall"](tensor, ps)
+    return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),))
+
+
+def reducescatter(tensor, op: int = Average,
+                  process_set: Optional[ProcessSet] = None,
+                  name: Optional[str] = None):
+    """Reduce then scatter equal chunks of axis 0 (``hvd.reducescatter``)."""
+    ps = _resolve_ps(process_set)
+    if _is_traced(tensor):
+        return _INTRACE["reducescatter"](tensor, op, ps)
+    return _eager_run("reducescatter", tensor, (op, ps), (op, _ps_key(ps)))
+
+
+def synchronize(handle):
+    """Block until an async collective completes (``hvd.synchronize``)."""
+    return jax.block_until_ready(handle)
+
+
+def poll(handle) -> bool:
+    """True if an async collective has completed (``hvd.poll``)."""
+    try:
+        return all(x.is_ready() for x in jax.tree_util.tree_leaves(handle))
+    except AttributeError:
+        return True
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until all members reach the barrier (``hvd.barrier``)."""
+    ps = _resolve_ps(process_set)
+    if jax.process_count() > 1:
+        if ps.ranks is not None:
+            # sync_global_devices requires every process; a subset barrier
+            # would deadlock non-members. Horovod's subset barrier needs a
+            # host-side sub-rendezvous (planned with the C++ controller, see
+            # SURVEY §2 row 11).
+            raise NotImplementedError(
+                "barrier over a subset process set is not supported in "
+                "multi-process mode")
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("horovod_tpu_barrier")
+        return
+    token = jnp.zeros((core.size(),), jnp.float32)
+    jax.block_until_ready(_eager_run("allreduce", token,
+                                     (ReduceOp.Sum, ps, 1.0, 1.0,
+                                      Compression.none,
+                                      _fusion.DEFAULT_FUSION_THRESHOLD_BYTES),
+                                     ("barrier", _ps_key(ps))))
+
+
+def join() -> int:
+    """Join op for uneven data (``hvd.join``): signals this caller has no more
+    batches. In SPMD the equivalent mechanism is mask-based — see
+    ``horovod_tpu.optimizer.DistributedOptimizer(join=...)`` which psums an
+    alive mask with the gradients. Eagerly this is a barrier; returns the last
+    rank, matching the reference's return convention."""
+    barrier()
+    return core.size() - 1
+
+
+# ---------------------------------------------------------------------------
+# object collectives (host-side, mirror hvd.broadcast_object/allgather_object)
+# ---------------------------------------------------------------------------
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast an arbitrary picklable object from ``root_rank``."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(
+            obj, is_source=jax.process_index() == root_rank)
+    return obj
+
+
+def allgather_object(obj, name: Optional[str] = None) -> list:
+    """Gather one picklable object per process into a list."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(obj)
+        return list(gathered)
+    return [obj]

@@ -1,0 +1,70 @@
+"""Gradient compression for collectives.
+
+Rebuild of upstream ``horovod/tensorflow/compression.py`` /
+``horovod/torch/compression.py``. The reference halves NCCL bytes by casting
+fp32→fp16 before allreduce. On TPU the native half type is bfloat16 (same
+exponent range as fp32, MXU/ICI-friendly), so that is the default compressor;
+fp16 is kept for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
+           "Compression"]
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (compressed, ctx)``;
+    ``decompress(compressed, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    _wire_dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(ctx, jnp.floating) and ctx != cls._wire_dtype:
+            return tensor.astype(cls._wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    _wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    _wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression`` (upstream compression.py)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,200 @@
+"""Core runtime state for horovod_tpu.
+
+TPU-native rethink of Horovod's basics layer (upstream
+``horovod/common/basics.py`` + ``horovod/common/operations.cc:horovod_init``).
+Instead of spawning one process per accelerator and negotiating over MPI/Gloo,
+``init()`` builds a :class:`jax.sharding.Mesh` over the TPU slice: the mesh
+axis *is* the communicator, and XLA collectives over it ride the ICI fabric.
+
+Two execution styles are supported, mirroring how the reference is used:
+
+* **SPMD-under-jit** (the TPU-native path): user code runs inside
+  ``shard_map`` over the global mesh; ``rank()`` is ``lax.axis_index`` and
+  collectives lower to single XLA ops.
+* **Multi-process** (one process per TPU host, like Horovod's one process per
+  GPU): ``jax.distributed.initialize`` handles rendezvous; ``cross_rank`` /
+  ``cross_size`` map to process index/count exactly like Horovod's
+  cross-communicator (upstream ``horovod/common/basics.py:cross_rank``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_rank",
+    "local_size",
+    "cross_rank",
+    "cross_size",
+    "mesh",
+    "axis_name",
+    "build_info",
+]
+
+AXIS_NAME = "hvd"
+
+
+@dataclasses.dataclass
+class _Context:
+    mesh: Mesh
+    axis: str
+    devices: tuple
+    initialized: bool = True
+
+
+_LOCK = threading.Lock()
+_CTX: Optional[_Context] = None
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self):
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+def _ctx() -> _Context:
+    if _CTX is None:
+        raise NotInitializedError()
+    return _CTX
+
+
+def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
+         coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None) -> None:
+    """Initialize the global communicator.
+
+    Mirrors ``hvd.init()`` (upstream ``horovod/common/basics.py:init``). On a
+    multi-host TPU slice pass ``coordinator_address``/``num_processes``/
+    ``process_id`` (or rely on TPU-VM metadata auto-detection inside
+    ``jax.distributed.initialize``) to join the pod before the mesh is built.
+    """
+    global _CTX
+    with _LOCK:
+        if coordinator_address is not None or (
+                num_processes is not None and num_processes > 1):
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        devs = tuple(devices if devices is not None else jax.devices())
+        m = Mesh(np.asarray(devs, dtype=object), (axis_name,))
+        _CTX = _Context(mesh=m, axis=axis_name, devices=devs)
+        # Reset process sets to just the global one and drop compiled
+        # collectives bound to a previous mesh.
+        from horovod_tpu import collective as _coll
+        from horovod_tpu import process_set as _ps
+        _coll._EAGER_CACHE.clear()
+        _ps._reset_for_init(m, axis_name)
+
+
+def shutdown() -> None:
+    """Tear down runtime state (``hvd.shutdown``)."""
+    global _CTX
+    with _LOCK:
+        _CTX = None
+        from horovod_tpu import collective as _coll
+        from horovod_tpu import process_set as _ps
+        _coll._EAGER_CACHE.clear()
+        _ps._reset_for_shutdown()
+
+
+def is_initialized() -> bool:
+    return _CTX is not None
+
+
+def mesh() -> Mesh:
+    """The global 1-D communicator mesh."""
+    return _ctx().mesh
+
+
+def axis_name() -> str:
+    """Name of the global communicator mesh axis."""
+    return _ctx().axis
+
+
+def size() -> int:
+    """Total number of devices in the global communicator (``hvd.size``)."""
+    return len(_ctx().devices)
+
+
+def local_size() -> int:
+    """Devices attached to this process (``hvd.local_size``)."""
+    _ctx()
+    return jax.local_device_count()
+
+
+def cross_size() -> int:
+    """Number of host processes (``hvd.cross_size``)."""
+    _ctx()
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    """This host process's index (``hvd.cross_rank``)."""
+    _ctx()
+    return jax.process_index()
+
+
+def rank():
+    """Rank of the calling context.
+
+    Inside a ``shard_map`` over the communicator axis this returns the
+    per-device ``lax.axis_index`` (a traced value). On the host it returns the
+    rank of this process's first local device, matching Horovod's
+    process-level ``hvd.rank`` in the one-process-per-host TPU model.
+    """
+    ctx = _ctx()
+    try:
+        return jax.lax.axis_index(ctx.axis)
+    except NameError:
+        return jax.process_index() * jax.local_device_count()
+
+
+def local_rank():
+    """Local analogue of :func:`rank` (``hvd.local_rank``)."""
+    ctx = _ctx()
+    try:
+        return jax.lax.axis_index(ctx.axis) % jax.local_device_count()
+    except NameError:
+        return 0
+
+
+def in_spmd_context() -> bool:
+    """True when called under tracing with the communicator axis in scope."""
+    if _CTX is None:
+        return False
+    try:
+        jax.lax.axis_index(_CTX.axis)
+        return True
+    except NameError:
+        return False
+
+
+def build_info() -> dict:
+    """Capability flags (analogue of ``hvd.nccl_built``/``mpi_built`` etc.)."""
+    backend = jax.default_backend()
+    return {
+        "backend": backend,
+        "ici_built": backend == "tpu",
+        "dcn_built": jax.process_count() > 1,
+        "gloo_built": False,
+        "nccl_built": False,
+        "mpi_built": False,
+        "pallas_built": True,
+        "adasum_built": True,
+        "elastic_built": True,
+    }

@@ -1,0 +1,192 @@
+"""Distributed optimizer and gradient synchronization.
+
+Rebuild of upstream ``horovod/torch/optimizer.py`` (hook-based
+DistributedOptimizer) and ``horovod/tensorflow/__init__.py``
+(DistributedGradientTape / DistributedOptimizer). The reference intercepts
+gradients as they become ready and enqueues allreduces through the fusion
+pipeline; the optimizer step waits on the handles.
+
+TPU-native shape: gradients live in one pytree inside a jitted SPMD step, so
+"interception" is a gradient transformation: :func:`DistributedOptimizer`
+wraps any optax ``GradientTransformation`` so its ``update`` first
+fuse+compress+allreduces the gradient pytree over the communicator axis, then
+delegates. XLA overlaps the fused psums with the optimizer math — the manual
+ready-ordering/stream machinery of the reference is the compiler's job here.
+
+When the step is *not* running under ``shard_map`` (i.e. the user relies on
+``jit`` auto-sharding where XLA already inserts gradient psums), the wrapper
+is an identity on gradients, so the same training script works in both modes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu import collective as C
+from horovod_tpu import core
+from horovod_tpu import fusion as _fusion
+from horovod_tpu.compression import Compression
+from horovod_tpu.process_set import ProcessSet
+
+__all__ = [
+    "DistributedOptimizer", "DistributedGradientTape", "grad",
+    "value_and_grad", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
+]
+
+
+def allreduce_gradients(grads: Any, op: int = C.Average,
+                        process_set: Optional[ProcessSet] = None,
+                        compression=Compression.none,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        fusion_threshold_bytes: int =
+                        _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                        alive: Optional[jnp.ndarray] = None) -> Any:
+    """Fused allreduce of a gradient pytree (in-trace).
+
+    ``alive`` implements the Join op for uneven data (upstream
+    ``horovod/common/ops/../join``): pass a 0/1 scalar per device; dead
+    devices contribute zeros and the mean divides by the live count.
+    """
+    if not core.in_spmd_context():
+        # jit auto-sharding mode: XLA already reduced the grads.
+        return grads
+    if alive is not None:
+        if op not in (C.Average, C.Sum):
+            raise ValueError("join-style allreduce supports Sum/Average only")
+        alivef = jnp.asarray(alive, jnp.float32)
+        n_alive = C.allreduce(alivef, op=C.Sum, process_set=process_set)
+        n_alive = jnp.maximum(n_alive, 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g: g * alivef.astype(g.dtype), grads)
+        summed = C.allreduce(grads, op=C.Sum, process_set=process_set,
+                             compression=compression,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             fusion_threshold_bytes=fusion_threshold_bytes)
+        if op == C.Average:
+            summed = jax.tree_util.tree_map(
+                lambda g: g / n_alive.astype(g.dtype), summed)
+        return summed
+    return C.allreduce(grads, op=op, process_set=process_set,
+                       compression=compression,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor,
+                       fusion_threshold_bytes=fusion_threshold_bytes)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         op: int = C.Average,
+                         process_set: Optional[ProcessSet] = None,
+                         compression=Compression.none,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0,
+                         fusion_threshold_bytes: int =
+                         _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so gradients are synchronized before the update
+    (``hvd.DistributedOptimizer``).
+
+    Use inside the jitted, shard_mapped train step; with jit auto-sharding it
+    degrades to the inner optimizer unchanged.
+    """
+
+    def init(params):
+        return optimizer.init(params)
+
+    def update(grads, state, params=None, **extra):
+        grads = allreduce_gradients(
+            grads, op=op, process_set=process_set, compression=compression,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            alive=extra.pop("alive", None))
+        return optimizer.update(grads, state, params, **extra)
+
+    return optax.GradientTransformation(init, update)
+
+
+def grad(fun: Callable, argnums=0, op: int = C.Average,
+         process_set: Optional[ProcessSet] = None,
+         compression=Compression.none, **gradkw) -> Callable:
+    """Distributed ``jax.grad``: gradients are allreduced across the
+    communicator (the JAX-native ``hvd.DistributedGradientTape``)."""
+    gfun = jax.grad(fun, argnums=argnums, **gradkw)
+
+    def wrapped(*args, **kwargs):
+        g = gfun(*args, **kwargs)
+        return allreduce_gradients(g, op=op, process_set=process_set,
+                                   compression=compression)
+    return wrapped
+
+
+def value_and_grad(fun: Callable, argnums=0, op: int = C.Average,
+                   process_set: Optional[ProcessSet] = None,
+                   compression=Compression.none, **gradkw) -> Callable:
+    """Distributed ``jax.value_and_grad``; the value is also averaged so every
+    device reports the global loss (matches DistributedGradientTape +
+    MetricAverageCallback behaviour)."""
+    vgfun = jax.value_and_grad(fun, argnums=argnums, **gradkw)
+
+    def wrapped(*args, **kwargs):
+        v, g = vgfun(*args, **kwargs)
+        if core.in_spmd_context():
+            v = jax.tree_util.tree_map(
+                lambda x: C.allreduce(x, op=C.Average,
+                                      process_set=process_set), v)
+        g = allreduce_gradients(g, op=op, process_set=process_set,
+                                compression=compression)
+        return v, g
+    return wrapped
+
+
+class DistributedGradientTape:
+    """API-parity shim for TF2 users (upstream
+    ``horovod/tensorflow/__init__.py:DistributedGradientTape``): records a
+    loss function and returns synchronized gradients."""
+
+    def __init__(self, op: int = C.Average,
+                 process_set: Optional[ProcessSet] = None,
+                 compression=Compression.none):
+        self._op = op
+        self._ps = process_set
+        self._comp = compression
+
+    def gradient(self, fun: Callable, params, *args, **kwargs):
+        g = jax.grad(fun)(params, *args, **kwargs)
+        return allreduce_gradients(g, op=self._op, process_set=self._ps,
+                                   compression=self._comp)
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None) -> Any:
+    """Synchronize a parameter pytree from ``root_rank``
+    (``hvd.broadcast_parameters`` / ``broadcast_global_variables``).
+
+    In-trace this is a real psum-based broadcast; eagerly on a single
+    controller parameters are already globally consistent, so it is an
+    identity (multi-process eager uses the object broadcast path).
+    """
+    if any(isinstance(x, jax.core.Tracer)
+           for x in jax.tree_util.tree_leaves(params)):
+        return C.broadcast(params, root_rank, process_set=process_set)
+    if jax.process_count() > 1:
+        # root_rank is a global *device* rank; the host-side object broadcast
+        # sources from the process that owns that device.
+        root_proc = int(root_rank) // jax.local_device_count()
+        return C.broadcast_object(params, root_proc)
+    return params
+
+
+def broadcast_variables(variables: Any, root_rank: int = 0, **kw) -> Any:
+    return broadcast_parameters(variables, root_rank, **kw)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None) -> Any:
+    """``hvd.broadcast_optimizer_state`` for optax states."""
+    return broadcast_parameters(opt_state, root_rank, process_set=process_set)

@@ -1,0 +1,111 @@
+"""Process sets: collectives over subgroups of ranks.
+
+Rebuild of upstream ``horovod/common/process_set.cc`` +
+``horovod/common/basics.py:ProcessSet``. The reference creates extra
+MPI/NCCL sub-communicators; on TPU a process set carries no communicator
+state at all — collectives lower to *masked full-axis* XLA ops: members
+contribute their value, non-members the op's neutral element, and non-members
+get their own input back (``collective._allreduce_leaf``). One collective
+over the whole ICI axis is what the fabric schedules best, and it sidesteps
+XLA's uniform-replica-group restrictions under shard_map.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["ProcessSet", "global_process_set", "add_process_set",
+           "remove_process_set", "get_process_set_ids_and_ranks"]
+
+_LOCK = threading.Lock()
+_SETS: Dict[int, "ProcessSet"] = {}
+_NEXT_ID = 1
+
+
+class ProcessSet:
+    """A subgroup of global ranks participating in collectives together."""
+
+    def __init__(self, ranks: Optional[Sequence[int]], *, _id: int = 0,
+                 _world: int = 0, _axis: str = "hvd"):
+        self.ranks: Optional[List[int]] = (
+            sorted(int(r) for r in ranks) if ranks is not None else None)
+        self.process_set_id = _id
+        self._world = _world
+        self._axis = _axis
+
+    # -- identity ---------------------------------------------------------
+    def size(self) -> int:
+        return self._world if self.ranks is None else len(self.ranks)
+
+    def included(self, rank: int) -> bool:
+        return True if self.ranks is None else rank in self.ranks
+
+    def rank(self, global_rank: int) -> int:
+        """Rank within the set of a given global rank (reference:
+        ``ProcessSet.rank``)."""
+        if self.ranks is None:
+            return global_rank
+        return self.ranks.index(global_rank)
+
+    # -- lowering ---------------------------------------------------------
+    @property
+    def axis(self) -> str:
+        return self._axis
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={'global' if self.ranks is None else self.ranks})")
+
+
+def _reset_for_init(mesh, axis: str) -> None:
+    global _SETS, _NEXT_ID
+    with _LOCK:
+        world = mesh.devices.size
+        _SETS = {0: ProcessSet(None, _id=0, _world=world, _axis=axis)}
+        _NEXT_ID = 1
+
+
+def _reset_for_shutdown() -> None:
+    global _SETS
+    with _LOCK:
+        _SETS = {}
+
+
+def global_process_set() -> ProcessSet:
+    with _LOCK:
+        if 0 not in _SETS:
+            raise RuntimeError("horovod_tpu not initialized")
+        return _SETS[0]
+
+
+def add_process_set(ranks: Sequence[int]) -> ProcessSet:
+    """Register a new process set (``hvd.add_process_set``)."""
+    global _NEXT_ID
+    with _LOCK:
+        if 0 not in _SETS:
+            raise RuntimeError("horovod_tpu not initialized")
+        world = _SETS[0]._world
+        ranks = sorted(int(r) for r in ranks)
+        if not ranks or ranks[0] < 0 or ranks[-1] >= world:
+            raise ValueError(f"ranks out of range for world size {world}: {ranks}")
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"duplicate ranks: {ranks}")
+        ps = ProcessSet(ranks, _id=_NEXT_ID, _world=world, _axis=_SETS[0]._axis)
+        _SETS[_NEXT_ID] = ps
+        _NEXT_ID += 1
+        return ps
+
+
+def remove_process_set(ps: "ProcessSet") -> bool:
+    """Deregister (``hvd.remove_process_set``). The global set is permanent."""
+    with _LOCK:
+        if ps.process_set_id == 0:
+            return False
+        return _SETS.pop(ps.process_set_id, None) is not None
+
+
+def get_process_set_ids_and_ranks() -> Dict[int, Optional[List[int]]]:
+    with _LOCK:
+        return {i: (None if p.ranks is None else list(p.ranks))
+                for i, p in _SETS.items()}

@@ -1,0 +1,35 @@
+"""Test harness: virtual 8-device CPU mesh (SURVEY §4).
+
+Must set platform flags before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon baked in, so env vars alone are too late here.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _init_hvd():
+    hvd.init()
+    assert hvd.size() == 8, f"expected 8 virtual devices, got {hvd.size()}"
+    yield
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+    return np.random.default_rng(42)

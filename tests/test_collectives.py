@@ -1,0 +1,295 @@
+"""Collective parity tests vs numpy (mirrors upstream
+``test/parallel/test_tensorflow.py::test_horovod_allreduce*`` strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+N = 8
+
+
+def stacked(rng, shape=(4, 3), dtype=np.float32):
+    return rng.standard_normal((N,) + shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# eager (stacked) collectives
+# ---------------------------------------------------------------------------
+
+class TestEagerAllreduce:
+    def test_average(self, rng):
+        x = stacked(rng)
+        out = np.asarray(hvd.allreduce(x))
+        want = np.broadcast_to(x.mean(axis=0), x.shape)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_sum(self, rng):
+        x = stacked(rng)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), x.shape),
+                                   rtol=1e-5)
+
+    def test_min_max(self, rng):
+        x = stacked(rng)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, op=hvd.Min)),
+            np.broadcast_to(x.min(0), x.shape), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, op=hvd.Max)),
+            np.broadcast_to(x.max(0), x.shape), rtol=1e-6)
+
+    def test_product(self, rng):
+        x = stacked(rng, shape=(2, 2))
+        out = np.asarray(hvd.allreduce(x, op=hvd.Product))
+        np.testing.assert_allclose(out, np.broadcast_to(np.prod(x, 0), x.shape),
+                                   rtol=1e-4)
+
+    def test_prescale_postscale(self, rng):
+        x = stacked(rng)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                                       postscale_factor=3.0))
+        np.testing.assert_allclose(
+            out, np.broadcast_to(3.0 * (0.5 * x).sum(0), x.shape), rtol=1e-5)
+
+    def test_compression_fp16(self, rng):
+        x = stacked(rng).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, compression=hvd.Compression.fp16))
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, np.broadcast_to(x.mean(0), x.shape),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_int_dtype_sum(self, rng):
+        x = rng.integers(-5, 5, size=(N, 4)).astype(np.int32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        np.testing.assert_array_equal(out, np.broadcast_to(x.sum(0), x.shape))
+
+    def test_pytree(self, rng):
+        tree = {"a": stacked(rng), "b": [stacked(rng, (2,)), stacked(rng, (5, 1))]}
+        out = hvd.allreduce(tree, op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out["a"]),
+                                   np.broadcast_to(tree["a"].sum(0),
+                                                   tree["a"].shape), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["b"][1]),
+                                   np.broadcast_to(tree["b"][1].sum(0),
+                                                   tree["b"][1].shape),
+                                   rtol=1e-5)
+
+    def test_grouped(self, rng):
+        ts = [stacked(rng), stacked(rng, (7,))]
+        outs = hvd.grouped_allreduce(ts, op=hvd.Average)
+        assert len(outs) == 2
+        for t, o in zip(ts, outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       np.broadcast_to(t.mean(0), t.shape),
+                                       rtol=1e-5)
+
+    def test_adasum_two_rank_closed_form(self, rng):
+        ps = hvd.add_process_set([0, 1])  # adasum only global; use global n=8
+        hvd.remove_process_set(ps)
+        # 8-rank adasum: verify against host-side recursive doubling.
+        x = stacked(rng, (6,))
+        out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+
+        def combine(a, b):
+            dot, asq, bsq = a @ b, a @ a, b @ b
+            ca = 1 - dot / (2 * asq) if asq > 0 else 1.0
+            cb = 1 - dot / (2 * bsq) if bsq > 0 else 1.0
+            return ca * a + cb * b
+
+        ref = [x[i].astype(np.float64) for i in range(N)]
+        d = 1
+        while d < N:
+            ref = [combine(ref[i], ref[i ^ d]) for i in range(N)]
+            d *= 2
+        for i in range(N):
+            np.testing.assert_allclose(out[i], ref[i], rtol=1e-4, atol=1e-5)
+
+
+class TestEagerOtherCollectives:
+    def test_broadcast(self, rng):
+        x = stacked(rng)
+        out = np.asarray(hvd.broadcast(x, root_rank=3))
+        np.testing.assert_allclose(out, np.broadcast_to(x[3], x.shape),
+                                   rtol=1e-6)
+
+    def test_allgather(self, rng):
+        x = stacked(rng, (2, 3))
+        out = np.asarray(hvd.allgather(x))  # (N, N*2, 3)
+        want = x.reshape(N * 2, 3)
+        for r in range(N):
+            np.testing.assert_allclose(out[r], want, rtol=1e-6)
+
+    def test_alltoall(self, rng):
+        x = stacked(rng, (N, 5))  # rank r sends x[r, d] to rank d
+        out = np.asarray(hvd.alltoall(x))  # (N, N, 5)
+        for r in range(N):
+            np.testing.assert_allclose(out[r], x[:, r, :], rtol=1e-6)
+
+    def test_reducescatter(self, rng):
+        x = stacked(rng, (N * 2, 3))
+        out = np.asarray(hvd.reducescatter(x, op=hvd.Sum))  # (N, 2, 3)
+        full = x.sum(0)
+        for r in range(N):
+            np.testing.assert_allclose(out[r], full[r * 2:(r + 1) * 2],
+                                       rtol=1e-5)
+
+    def test_barrier_and_join(self):
+        hvd.barrier()
+        assert hvd.join() == N - 1
+
+    def test_async_synchronize(self, rng):
+        x = stacked(rng)
+        h = hvd.allreduce_async(x)
+        out = hvd.synchronize(h)
+        assert hvd.poll(h)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.broadcast_to(x.mean(0), x.shape),
+                                   rtol=1e-5)
+
+    def test_broadcast_object_single_process(self):
+        obj = {"lr": 0.1, "steps": [1, 2]}
+        assert hvd.broadcast_object(obj, 0) == obj
+        assert hvd.allgather_object(obj) == [obj]
+
+
+# ---------------------------------------------------------------------------
+# process sets
+# ---------------------------------------------------------------------------
+
+class TestProcessSets:
+    def test_allreduce_subset(self, rng):
+        ps = hvd.add_process_set([1, 3, 5])
+        try:
+            x = stacked(rng)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            want = x[[1, 3, 5]].sum(0)
+            for r in (1, 3, 5):
+                np.testing.assert_allclose(out[r], want, rtol=1e-5)
+            for r in (0, 2, 4, 6, 7):  # non-members keep their own value
+                np.testing.assert_allclose(out[r], x[r], rtol=1e-6)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_broadcast_subset(self, rng):
+        ps = hvd.add_process_set([0, 2, 4, 6])
+        try:
+            x = stacked(rng)
+            out = np.asarray(hvd.broadcast(x, root_rank=2, process_set=ps))
+            for r in (0, 2, 4, 6):
+                np.testing.assert_allclose(out[r], x[2], rtol=1e-6)
+            for r in (1, 3, 5, 7):
+                np.testing.assert_allclose(out[r], x[r], rtol=1e-6)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_allgather_subset(self, rng):
+        ps = hvd.add_process_set([2, 5])
+        try:
+            x = stacked(rng, (3,))
+            out = np.asarray(hvd.allgather(x, process_set=ps))
+            # Members get the members' values concatenated along axis 0.
+            want = x[[2, 5]].reshape(6)
+            assert out.shape == (N, 6)
+            for r in (2, 5):
+                np.testing.assert_allclose(out[r], want, rtol=1e-6)
+            # Non-members must not observe members' data: zeros.
+            for r in (0, 1, 3, 4, 6, 7):
+                np.testing.assert_array_equal(out[r], np.zeros(6))
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_reducescatter_subset(self, rng):
+        ps = hvd.add_process_set([0, 4])
+        try:
+            x = stacked(rng, (4, 3))
+            out = np.asarray(hvd.reducescatter(x, op=hvd.Sum, process_set=ps))
+            full = x[[0, 4]].sum(0)
+            np.testing.assert_allclose(out[0], full[:2], rtol=1e-5)
+            np.testing.assert_allclose(out[4], full[2:], rtol=1e-5)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_set_bookkeeping(self):
+        ps = hvd.add_process_set([1, 2])
+        assert ps.size() == 2 and ps.included(1) and not ps.included(0)
+        assert ps.rank(2) == 1
+        ids = hvd.process_set.get_process_set_ids_and_ranks() \
+            if hasattr(hvd, "process_set") else None
+        assert hvd.remove_process_set(ps)
+        assert not hvd.remove_process_set(hvd.global_process_set())
+
+    def test_invalid_sets(self):
+        with pytest.raises(ValueError):
+            hvd.add_process_set([0, 0])
+        with pytest.raises(ValueError):
+            hvd.add_process_set([99])
+
+
+# ---------------------------------------------------------------------------
+# in-trace (SPMD) collectives
+# ---------------------------------------------------------------------------
+
+class TestInTrace:
+    def test_allreduce_inside_spmd(self, rng):
+        x = stacked(rng)
+
+        def step(xs):
+            return hvd.allreduce(xs, op=hvd.Average)
+
+        fn = hvd.spmd(step, in_specs=jax.sharding.PartitionSpec("hvd"),
+                      out_specs=jax.sharding.PartitionSpec("hvd"))
+        out = np.asarray(fn(x))
+        np.testing.assert_allclose(out, np.broadcast_to(x.mean(0), x.shape),
+                                   rtol=1e-5)
+
+    def test_rank_inside_spmd(self):
+        def step(x):
+            return x * 0 + hvd.rank()
+
+        fn = hvd.spmd(step, in_specs=jax.sharding.PartitionSpec("hvd"),
+                      out_specs=jax.sharding.PartitionSpec("hvd"))
+        out = np.asarray(fn(jnp.zeros((N, 1), jnp.int32)))
+        np.testing.assert_array_equal(out[:, 0], np.arange(N))
+
+    def test_grad_sync_inside_spmd(self, rng):
+        w = jnp.asarray(rng.standard_normal(4).astype(np.float32))
+        data = stacked(rng, (4,))
+
+        def step(w, x):
+            g = hvd.grad(lambda w: jnp.sum((w * x) ** 2))(w)
+            return g
+
+        fn = hvd.spmd(step,
+                      in_specs=(jax.sharding.PartitionSpec(),
+                                jax.sharding.PartitionSpec("hvd")),
+                      out_specs=jax.sharding.PartitionSpec())
+        g = np.asarray(fn(w, data))
+        want = np.mean([2 * (np.asarray(w) * data[r] ** 2)
+                        for r in range(N)], axis=0)
+        np.testing.assert_allclose(g, want, rtol=1e-4)
+
+
+class TestFusion:
+    def test_fuse_roundtrip(self, rng):
+        from horovod_tpu import fusion
+        leaves = [rng.standard_normal((3, 2)).astype(np.float32),
+                  rng.integers(0, 5, (4,)).astype(np.int32),
+                  rng.standard_normal((1,)).astype(np.float32),
+                  rng.standard_normal((2, 2, 2)).astype(np.float32)]
+        buckets, unpack = fusion.fuse([jnp.asarray(x) for x in leaves])
+        # fp32 leaves fuse together; int leaf has its own bucket
+        assert len(buckets) == 2
+        out = unpack(buckets)
+        for a, b in zip(leaves, out):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+    def test_threshold_splits_buckets(self, rng):
+        from horovod_tpu import fusion
+        leaves = [jnp.ones((100,), jnp.float32) for _ in range(4)]
+        buckets, unpack = fusion.fuse(leaves, threshold_bytes=800)
+        assert len(buckets) == 2  # 2 x 100 floats = 800 bytes per bucket
+        out = unpack(buckets)
+        assert all(np.asarray(o).shape == (100,) for o in out)
