@@ -1,0 +1,89 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip
+(SURVEY §6; reference config "ResNet-50 ImageNet, examples/pytorch +
+DistributedOptimizer").
+
+Synthetic ImageNet-shaped data (no dataset in the image), bf16 compute,
+SGD+momentum, full fwd+bwd+allreduce+update step through
+hvd.DistributedOptimizer — the same path a user would run.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline divides by 600 img/s/chip — a typical Horovod ResNet-50 fp16
+V100 figure from the reference's own benchmark suite docs.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+
+BASELINE_IMG_PER_SEC = 600.0
+
+
+def main():
+    hvd.init()
+    from horovod_tpu.models import ResNet50
+    backend = jax.default_backend()
+    # Batch sized for one v5e chip in bf16; tiny on CPU so smoke runs finish.
+    batch = 128 if backend != "cpu" else 8
+    size = 224 if backend != "cpu" else 64
+    steps = 20 if backend != "cpu" else 3
+
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, size, size, 3)),
+        jnp.bfloat16)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 1000, (batch,)), jnp.int32)
+    variables = model.init(rng, images, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    # Warmup (compile) then timed steps.
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Training-loop callbacks for flax/optax loops.
+
+Rebuild of upstream ``horovod/keras/callbacks.py``:
+BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback, LearningRateScheduleCallback. The reference hooks
+Keras; here the callbacks are plain objects a jax train loop calls, plus an
+optax-native warmup schedule (the TPU-idiomatic way to express LR policy —
+inside the compiled update, not as a host-side callback).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import optax
+
+import horovod_tpu as hvd
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateWarmupCallback", "LearningRateScheduleCallback",
+    "warmup_schedule",
+]
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast initial params/opt_state from root at training start."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        if self._done:
+            return state
+        self._done = True
+        return {k: hvd.broadcast_parameters(v, self.root_rank)
+                for k, v in state.items()}
+
+
+class MetricAverageCallback:
+    """Average epoch metrics across the communicator
+    (upstream MetricAverageCallback: allreduce at epoch end)."""
+
+    def on_epoch_end(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in metrics.items():
+            arr = jax.numpy.asarray(v)
+            if hvd.in_spmd_context():
+                out[k] = hvd.allreduce(arr, op=hvd.Average)
+            elif jax.process_count() > 1:
+                vals = hvd.allgather_object(float(arr))
+                out[k] = sum(vals) / len(vals)
+            else:
+                out[k] = arr
+        return out
+
+
+def warmup_schedule(base_lr: float, warmup_epochs: float,
+                    steps_per_epoch: int, size: Optional[int] = None
+                    ) -> optax.Schedule:
+    """LR warmup for large effective batches: ramps from base_lr to
+    base_lr * size over warmup_epochs (the exact policy of upstream
+    LearningRateWarmupCallback, Goyal et al. 2017), as an optax schedule so
+    it compiles into the update."""
+    size = size if size is not None else hvd.size()
+    warmup_steps = max(int(warmup_epochs * steps_per_epoch), 1)
+    return optax.linear_schedule(base_lr, base_lr * size, warmup_steps)
+
+
+class LearningRateWarmupCallback:
+    """Host-side variant for loops that set LR imperatively."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: float = 5.0,
+                 steps_per_epoch: int = 1, verbose: bool = False):
+        self._sched = warmup_schedule(initial_lr, warmup_epochs,
+                                      steps_per_epoch)
+        self._warmup_steps = max(int(warmup_epochs * steps_per_epoch), 1)
+        self.verbose = verbose
+
+    def lr_at(self, step: int) -> float:
+        return float(self._sched(min(step, self._warmup_steps)))
+
+
+class LearningRateScheduleCallback:
+    """Piecewise LR multipliers by epoch (upstream
+    LearningRateScheduleCallback)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Callable[[int], float] | float,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self._mult = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+
+    def lr_at_epoch(self, epoch: int) -> Optional[float]:
+        if epoch < self.start_epoch:
+            return None
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return None
+        return self.initial_lr * self._mult(epoch)

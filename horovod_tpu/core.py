@@ -70,6 +70,14 @@ def _ctx() -> _Context:
     return _CTX
 
 
+def _distributed_initialized() -> bool:
+    try:
+        return jax.distributed.is_initialized()
+    except AttributeError:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+
+
 def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
          coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
@@ -82,14 +90,24 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
     ``jax.distributed.initialize``) to join the pod before the mesh is built.
     """
     global _CTX
+    import os
+    if coordinator_address is None and num_processes is None and \
+            os.environ.get("HVD_TPU_COORDINATOR"):
+        # Launched by horovod_tpu.runner: pick up the rendezvous contract.
+        coordinator_address = os.environ["HVD_TPU_COORDINATOR"]
+        num_processes = int(os.environ["HVD_TPU_NUM_PROCESSES"])
+        process_id = int(os.environ["HVD_TPU_PROCESS_ID"])
     with _LOCK:
         if coordinator_address is not None or (
                 num_processes is not None and num_processes > 1):
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+            # init() must stay reentrant (elastic re-init, shutdown/init
+            # cycles); jax.distributed may only be initialized once.
+            if not _distributed_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
         devs = tuple(devices if devices is not None else jax.devices())
         m = Mesh(np.asarray(devs, dtype=object), (axis_name,))
         _CTX = _Context(mesh=m, axis=axis_name, devices=devs)

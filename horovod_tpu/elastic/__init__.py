@@ -1,0 +1,21 @@
+"""Elastic training: fault tolerance + dynamic membership.
+
+Rebuild of upstream ``horovod/common/elastic.py`` (State / run decorator /
+commit-restore) and ``horovod/runner/elastic`` (discovery,
+WorkerNotificationManager). See SURVEY §2 row 15.
+
+TPU shape: the unit of failure is a *host* (TPU-VM preemption takes all its
+chips), and re-forming the collective is a re-``init`` with the surviving
+devices followed by re-jit — XLA programs are mesh-shaped, so "remove a rank
+from the ring" (the reference's NCCL path) becomes "rebuild the mesh and
+retrace". State lives in host memory between steps: ``commit()`` snapshots
+pytrees off-device; ``restore()`` puts them back on the (new) mesh.
+"""
+
+from horovod_tpu.elastic.state import State, JaxState  # noqa: F401
+from horovod_tpu.elastic.driver import (  # noqa: F401
+    run, HostsUpdatedInterrupt, WorkerNotificationManager,
+)
+from horovod_tpu.elastic.discovery import (  # noqa: F401
+    HostDiscovery, FixedHostDiscovery, ScriptHostDiscovery,
+)

@@ -1,0 +1,72 @@
+"""Host/device discovery for elastic training.
+
+Rebuild of upstream ``horovod/runner/elastic/discovery.py``
+(``HostDiscovery`` / ``HostDiscoveryScript``): the reference polls a
+user script for the current host list; here discovery returns the healthy
+device set (TPU-VM hosts disappear wholesale on preemption, taking their
+chips with them — BASELINE.json north star: "Elastic Horovod handles TPU-VM
+host discovery and preemption").
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["HostDiscovery", "FixedHostDiscovery", "ScriptHostDiscovery",
+           "DeviceDiscovery"]
+
+
+class HostDiscovery:
+    """Interface: ``find_available_hosts_and_slots() -> {host: slots}``."""
+
+    def find_available_hosts_and_slots(self) -> dict:
+        raise NotImplementedError
+
+
+class FixedHostDiscovery(HostDiscovery):
+    def __init__(self, hosts: dict):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> dict:
+        return dict(self._hosts)
+
+
+class ScriptHostDiscovery(HostDiscovery):
+    """Runs a user script printing ``hostname:slots`` per line (exact
+    upstream contract for ``--host-discovery-script``)."""
+
+    def __init__(self, script: str, timeout_s: float = 30.0):
+        self._script = script
+        self._timeout = timeout_s
+
+    def find_available_hosts_and_slots(self) -> dict:
+        out = subprocess.run(
+            self._script, shell=True, capture_output=True, text=True,
+            timeout=self._timeout, check=True).stdout
+        hosts = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                h, s = line.rsplit(":", 1)
+                hosts[h] = int(s)
+            else:
+                hosts[line] = 1
+        return hosts
+
+
+class DeviceDiscovery:
+    """Single-controller analogue: which devices are currently usable.
+
+    ``probe`` defaults to ``jax.devices()``; tests inject a fake to simulate
+    preemption of a host's chips.
+    """
+
+    def __init__(self, probe: Optional[Callable[[], Sequence]] = None):
+        import jax
+        self._probe = probe or jax.devices
+
+    def find_available_devices(self) -> List:
+        return list(self._probe())

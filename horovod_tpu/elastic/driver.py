@@ -1,0 +1,145 @@
+"""Elastic driver: run-with-retries around membership changes.
+
+Rebuild of upstream ``horovod/common/elastic.py:run_fn`` +
+``horovod/runner/elastic/driver.py`` (ElasticDriver) +
+``worker/WorkerNotificationManager``. The reference's flow:
+
+    @hvd.elastic.run
+    def train(state): ...
+    train(JaxState(params=..., epoch=0))
+
+On a membership change or worker failure the decorated function is
+re-entered after: re-discovering devices, re-``init`` of the communicator
+mesh, and ``state.sync()`` (restore last commit + broadcast). The jitted
+step functions retrace automatically because the mesh object changed.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Optional
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic.discovery import DeviceDiscovery
+
+__all__ = ["run", "HostsUpdatedInterrupt", "WorkerNotificationManager",
+           "notification_manager"]
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised at commit boundaries when the device/host set changed
+    (upstream ``horovod/common/exceptions.py:HostsUpdatedInterrupt``)."""
+
+
+class WorkerNotificationManager:
+    """Watches discovery in a background thread; flags membership changes.
+
+    Upstream runs an HTTP notification service pushed to by the rendezvous
+    server; single-controller TPU polls discovery directly (the metadata
+    server is the source of truth for preempted TPU-VM hosts).
+    """
+
+    def __init__(self, discovery: Optional[DeviceDiscovery] = None,
+                 poll_interval_s: float = 1.0):
+        self._discovery = discovery
+        self._interval = poll_interval_s
+        self._known = None
+        self._changed = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def init(self, discovery: Optional[DeviceDiscovery] = None) -> None:
+        if discovery is not None:
+            self._discovery = discovery
+        if self._discovery is None:
+            self._discovery = DeviceDiscovery()
+        self._known = self._snapshot()
+        self._changed.clear()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _snapshot(self):
+        return tuple(str(d) for d in self._discovery.find_available_devices())
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                now = self._snapshot()
+            except Exception:
+                continue
+            if now != self._known:
+                self._known = now
+                self._changed.set()
+
+    @property
+    def changed(self) -> bool:
+        return self._changed.is_set()
+
+    def acknowledge(self) -> None:
+        self._changed.clear()
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def _check_host_updates() -> None:
+    if notification_manager.changed:
+        raise HostsUpdatedInterrupt("device membership changed")
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: elastic retry loop (``hvd.elastic.run``).
+
+    The wrapped ``func(state, *args)`` is re-entered after membership
+    changes; ``reset_limit``/``min_size`` mirror the upstream knobs.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args, reset_limit: Optional[int] = None,
+                min_size: int = 1, discovery: Optional[DeviceDiscovery] = None,
+                **kwargs):
+        resets = 0
+        if notification_manager._thread is None:
+            notification_manager.init(discovery)
+        try:
+            while True:
+                try:
+                    return func(state, *args, **kwargs)
+                except HostsUpdatedInterrupt:
+                    resets += 1
+                    if reset_limit is not None and resets > reset_limit:
+                        raise RuntimeError(
+                            f"elastic reset limit ({reset_limit}) exceeded")
+                    notification_manager.acknowledge()
+                    _reinitialize(min_size, discovery)
+                    state.sync()
+        finally:
+            notification_manager.stop()
+
+    return wrapper
+
+
+def _reinitialize(min_size: int, discovery: Optional[DeviceDiscovery],
+                  max_wait_s: float = 600.0, poll_s: float = 1.0) -> None:
+    """Wait until >= min_size devices are healthy, then re-init the mesh."""
+    disco = discovery or DeviceDiscovery()
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        devs = disco.find_available_devices()
+        if len(devs) >= min_size:
+            hvd.init(devices=devs)
+            return
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"elastic: only {len(devs)} devices available after "
+                f"{max_wait_s}s (min_size={min_size})")
+        time.sleep(poll_s)

@@ -1,0 +1,106 @@
+"""Elastic state with commit/restore semantics (upstream
+``horovod/common/elastic.py:State`` / ``ObjectState``)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["State", "JaxState"]
+
+
+class State:
+    """Interface: ``commit`` snapshots, ``restore`` rolls back to the last
+    commit, ``sync`` re-broadcasts from the coordinator after a re-init."""
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if membership changed (wired up by the
+        elastic driver; standalone states never raise)."""
+        from horovod_tpu.elastic.driver import _check_host_updates
+        _check_host_updates()
+
+
+class JaxState(State):
+    """Elastic state for jax training: any number of named pytrees
+    (params, opt_state, ...) plus plain-python attributes (epoch, step).
+
+    The analogue of the reference's framework states (``TorchState``:
+    model+optimizer; ``TensorFlowKerasState``). Snapshots are host-side
+    numpy copies, so a commit survives device loss; ``restore`` re-places
+    them with the current mesh in effect.
+    """
+
+    def __init__(self, **kwargs: Any):
+        self._pytrees: Dict[str, Any] = {}
+        self._attrs: Dict[str, Any] = {}
+        self._saved_pytrees: Dict[str, Any] = {}
+        self._saved_attrs: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            if _is_pytree_of_arrays(v):
+                self._pytrees[k] = v
+            else:
+                self._attrs[k] = v
+        self.commit_count = 0
+        self.commit()
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        pytrees = object.__getattribute__(self, "_pytrees")
+        attrs = object.__getattribute__(self, "_attrs")
+        if name in pytrees:
+            return pytrees[name]
+        if name in attrs:
+            return attrs[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name == "commit_count":
+            object.__setattr__(self, name, value)
+        elif "_pytrees" in self.__dict__ and name in self._pytrees:
+            self._pytrees[name] = value
+        elif "_attrs" in self.__dict__ and name in self._attrs:
+            self._attrs[name] = value
+        elif _is_pytree_of_arrays(value) and "_pytrees" in self.__dict__:
+            self._pytrees[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def commit(self) -> None:
+        self._saved_pytrees = {
+            k: jax.tree_util.tree_map(lambda x: np.asarray(x), v)
+            for k, v in self._pytrees.items()}
+        self._saved_attrs = copy.deepcopy(self._attrs)
+        self.commit_count += 1
+
+    def restore(self) -> None:
+        self._pytrees = {
+            k: jax.tree_util.tree_map(jax.numpy.asarray, v)
+            for k, v in self._saved_pytrees.items()}
+        self._attrs = copy.deepcopy(self._saved_attrs)
+
+    def sync(self) -> None:
+        """After re-init: broadcast committed state from the coordinator so
+        joiners agree (multi-process), then restore locally."""
+        from horovod_tpu import collective as C
+        if jax.process_count() > 1:
+            self._saved_pytrees = C.broadcast_object(self._saved_pytrees, 0)
+            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+        self.restore()
+
+
+def _is_pytree_of_arrays(v: Any) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) for l in leaves)

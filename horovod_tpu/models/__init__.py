@@ -1,0 +1,30 @@
+"""Model zoo covering the reference's benchmark configs (BASELINE.json):
+MNIST CNN, ResNet-50, BERT-large, GPT-2 medium, ViT-B/16 — implemented in
+flax for TPU (bf16 compute, MXU-friendly shapes), not ported from the
+reference's TF/torch example scripts.
+"""
+
+from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
+from horovod_tpu.models.resnet import ResNet50, ResNet18  # noqa: F401
+
+__all__ = ["MnistCNN", "ResNet50", "ResNet18", "get_model"]
+
+
+def get_model(name: str, **kw):
+    name = name.lower()
+    if name == "mnist":
+        return MnistCNN(**kw)
+    if name == "resnet50":
+        return ResNet50(**kw)
+    if name == "resnet18":
+        return ResNet18(**kw)
+    if name in ("gpt2", "gpt2_medium", "gpt2-medium"):
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+        return GPT2(GPT2Config.medium() if "medium" in name else GPT2Config(**kw))
+    if name in ("bert", "bert_large", "bert-large"):
+        from horovod_tpu.models.bert import Bert, BertConfig
+        return Bert(BertConfig.large() if "large" in name else BertConfig(**kw))
+    if name in ("vit", "vit_b16", "vit-b/16"):
+        from horovod_tpu.models.vit import ViT, ViTConfig
+        return ViT(ViTConfig.b16() if name != "vit" else ViTConfig(**kw))
+    raise ValueError(f"unknown model {name}")

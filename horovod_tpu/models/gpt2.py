@@ -1,0 +1,148 @@
+"""GPT-2 (reference benchmark config: "GPT-2 medium, torch-xla backend,
+tensor-fusion stress") — flax implementation designed for dp x tp x sp
+sharding from the start.
+
+TPU-first choices: vocab padded to a multiple of 128 (MXU tiling), bf16
+matmuls with fp32 layernorm/softmax/logits, explicit qkv/out + fc/proj
+parameter names so ``partition_rules`` can shard them Megatron-style
+(column-parallel then row-parallel — XLA inserts the single psum per block
+that Megatron does by hand), optional ``jax.checkpoint`` per block to trade
+FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.sharding import PartitionRules
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304          # 50257 padded up to a 128 multiple
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+    use_ring_attention: bool = False  # sequence-parallel attention (ops/)
+
+    @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config(num_layers=24, num_heads=16, d_model=1024)
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        return GPT2Config(vocab_size=256, max_seq_len=128, num_layers=2,
+                          num_heads=4, d_model=64, **kw)
+
+
+class Attention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        B, T, D = x.shape
+        H = cfg.num_heads
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, T, H, D // H)
+        v = v.reshape(B, T, H, D // H)
+        if cfg.use_ring_attention:
+            from horovod_tpu.ops.ring_attention import ring_attention
+            o = ring_attention(q, k, v, axis_name="sp", causal=True)
+        else:
+            scale = (D // H) ** -0.5
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        o = o.reshape(B, T, D)
+        return nn.Dense(D, dtype=cfg.dtype, name="out")(o)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="fc")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(h)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(cfg, name="attn")(ln1, deterministic)
+        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + MLP(cfg, name="mlp")(ln2, deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        B, T = tokens.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"h{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied lm head in fp32 (logits precision matters for loss).
+        return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
+
+
+def partition_rules() -> PartitionRules:
+    """Megatron-style tp sharding + dp batch sharding (SURVEY §2 row 26).
+
+    Column-parallel qkv/fc (shard output features), row-parallel out/proj
+    (shard input features) — under GSPMD this yields exactly one psum per
+    attention/MLP pair, same comm volume as hand-written Megatron.
+    """
+    return PartitionRules([
+        (r"wte$", P("tp", None)),
+        (r"wpe$", P()),
+        (r"attn/qkv/kernel", P(None, "tp")),
+        (r"attn/out/kernel", P("tp", None)),
+        (r"mlp/fc/kernel", P(None, "tp")),
+        (r"mlp/proj/kernel", P("tp", None)),
+        (r"attn/qkv/bias", P("tp")),
+        (r"mlp/fc/bias", P("tp")),
+        (r"(ln1|ln2|ln_f)/(scale|bias)", P()),
+    ])
+
+
+def loss_fn(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -1,0 +1,88 @@
+"""ViT (reference benchmark config: "ViT-B/16 elastic training,
+preemptible v5e") — flax vision transformer.
+
+TPU-first: patchify as a single strided conv (one big MXU matmul), bf16
+blocks with fp32 layernorm and logits, learnable cls token + 1-D position
+embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def b16() -> "ViTConfig":
+        return ViTConfig()
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         num_layers=2, num_heads=4, d_model=64, mlp_dim=128)
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, D = x.shape
+        H = cfg.num_heads
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, T, H, D // H)
+        v = v.reshape(B, T, H, D // H)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D // H) ** -0.5
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(cfg.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+        x = x + nn.Dense(D, dtype=cfg.dtype, name="out")(att)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="fc")(y)
+        h = nn.gelu(h)
+        return x + nn.Dense(D, dtype=cfg.dtype, name="proj")(h)
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.cfg
+        B = images.shape[0]
+        x = nn.Conv(cfg.d_model, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, name="patchify")(
+            images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.d_model)
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, cfg.d_model), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (B, 1, cfg.d_model)), x],
+            axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], cfg.d_model), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0].astype(jnp.float32))

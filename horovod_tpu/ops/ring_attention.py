@@ -1,0 +1,85 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context is first-class in this framework (SURVEY §2 row 24; the
+reference reaches this scale via NCCL p2p in Megatron-style stacks on top of
+hvd). Design follows the blockwise-parallel / ring-attention construction
+(Liu et al. 2023, PAPERS.md lineage): each device holds a sequence shard of
+q/k/v; k/v blocks rotate around the ring axis via ``lax.ppermute`` (one ICI
+hop per step) while a numerically-stable online softmax accumulates partial
+results — compute on block ``i`` overlaps the transfer of block ``i+1``
+because XLA pipelines the ppermute with the einsums.
+
+Memory per device is O(T_local^2 / n) attention scores instead of O(T^2):
+sequences scale linearly with the ring size at constant HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact attention with q/k/v sharded on sequence across ``axis_name``.
+
+    Args:
+      q, k, v: (batch, t_local, heads, head_dim) — this device's sequence
+        shard. Global sequence order is rank-major: device r holds positions
+        [r*t_local, (r+1)*t_local).
+      axis_name: mesh axis the sequence is sharded over (inside shard_map).
+      causal: apply the global causal mask (correct across shards).
+      scale: logit scale; defaults to head_dim**-0.5.
+
+    Returns (batch, t_local, heads, head_dim) attention output for the local
+    query block.
+    """
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = D ** -0.5 if scale is None else scale
+
+    qf = (q * scale).astype(jnp.float32)
+    q_pos = rank * Tq + jnp.arange(Tq)
+
+    # Online-softmax accumulators.
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        o, m, l, k, v = carry
+        src = (rank - i) % n              # whose k/v block we hold this step
+        k_pos = src * Tk + jnp.arange(Tk)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # Guard: a fully-masked block keeps m at -inf; exp underflows to 0.
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        m = m_new
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (o, m, l, k, v), None
+
+    (o, m, l, k, v), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

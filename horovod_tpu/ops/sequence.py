@@ -1,0 +1,52 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second long-context mechanism (SURVEY §2 row 24): instead of rotating
+k/v blocks (ring), ``lax.all_to_all`` re-shards activations from
+sequence-sharded to head-sharded, runs full *local* attention over the whole
+sequence with a head subset, and swaps back. Two all-to-alls per attention
+instead of n-1 ppermutes — better when heads >> ring size and the full
+sequence fits one device's HBM for a head subset (DeepSpeed-Ulysses layout).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """Attention with q/k/v sequence-sharded on ``axis_name``
+    (shapes (B, t_local, H, D)); the axis size must divide the head count
+    (each device takes H/n heads after the swap)."""
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    scale = D ** -0.5 if scale is None else scale
+
+    def seq2head(x):
+        # (B, t_local, H, D) -> (B, T, H/n, D): trade sequence shards for
+        # head shards in one all-to-all.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # (B, T, H/n, D)
+    T = qh.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
+                        kh.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh.astype(jnp.float32))
+    return head2seq(out.astype(q.dtype))

@@ -1,0 +1,10 @@
+"""Multi-dimensional parallelism: meshes, sharding rules, sequence/context
+parallelism. TPU-native replacement for the reference's rank-topology layer
+(``horovod/runner`` host slots + NCCL communicator cliques): parallel axes are
+named mesh dimensions and XLA places the collectives.
+"""
+
+from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
+from horovod_tpu.parallel.sharding import (  # noqa: F401
+    PartitionRules, apply_rules, shard_pytree,
+)

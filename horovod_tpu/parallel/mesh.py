@@ -1,0 +1,54 @@
+"""Mesh construction over TPU slices.
+
+Replaces the reference's topology discovery (``horovod/runner/driver`` host
+slots + ``horovod/common/topology``-style rank maps): ``make_mesh`` builds an
+ICI-aware ``jax.sharding.Mesh`` whose named axes carry the parallelism
+strategy. Axis order matters on hardware: later axes map to faster (ICI)
+topology dimensions, so put data-parallel first (it tolerates DCN) and
+tensor/sequence parallel last (they need ICI bandwidth).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None,
+              allow_split_physical_axes: bool = True) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({"dp": 4, "tp": 2})``.
+
+    An axis size of ``-1`` is inferred from the device count (at most one).
+    On TPU, ``mesh_utils.create_device_mesh`` aligns logical axes with the
+    physical torus so contiguous axes ride ICI links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devs) % known:
+            raise ValueError(
+                f"cannot infer axis: {len(devs)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devs)}")
+    if devices is None and jax.default_backend() == "tpu":
+        try:
+            arr = mesh_utils.create_device_mesh(
+                tuple(sizes),
+                allow_split_physical_axes=allow_split_physical_axes)
+            return Mesh(arr, names)
+        except Exception:
+            pass  # fall through to the naive reshape
+    arr = np.asarray(devs, dtype=object).reshape(tuple(sizes))
+    return Mesh(arr, names)

@@ -1,0 +1,7 @@
+"""Launcher: process orchestration across TPU-VM hosts.
+
+Rebuild of upstream ``horovod/runner`` (horovodrun CLI, gloo_run/mpi_run,
+hostfile parsing, rendezvous). See SURVEY §2 row 14.
+"""
+
+from horovod_tpu.runner.launcher import run, parse_hosts, HostSpec  # noqa: F401

@@ -1,0 +1,3 @@
+from horovod_tpu.runner.launcher import main
+
+raise SystemExit(main())

@@ -1,0 +1,183 @@
+"""Process launcher for multi-host TPU training.
+
+Rebuild of upstream ``horovod/runner/launch.py`` + ``gloo_run.py``. The
+reference spawns ``np`` worker processes (ssh for remote hosts) and stands up
+a gloo rendezvous server. The TPU model is one process per host (each process
+drives all local chips), with ``jax.distributed`` as the rendezvous — the
+coordinator address plays the role of the reference's rendezvous server.
+
+Local mode (``hosts=None``): spawn ``np`` processes on this machine; the
+launcher defaults them to ``JAX_PLATFORMS=cpu`` (they cannot share one
+accelerator) — used for framework testing exactly like the reference's
+``horovodrun -np 4 -H localhost:4``.
+Remote mode emits per-host launch commands (ssh execution is environment
+policy; TPU pods normally launch via the cloud tooling, e.g. one command on
+every TPU-VM worker).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["HostSpec", "parse_hosts", "build_worker_env", "worker_commands",
+           "run"]
+
+DEFAULT_PORT = 29500
+
+
+@dataclasses.dataclass
+class HostSpec:
+    host: str
+    slots: int
+
+
+def parse_hosts(hosts: str) -> List[HostSpec]:
+    """Parse ``"host1:4,host2:4"`` (upstream ``parse_hosts``) or a hostfile
+    path with ``host slots=N`` lines (upstream ``parse_host_files``)."""
+    specs: List[HostSpec] = []
+    if os.path.isfile(hosts):
+        with open(hosts) as f:
+            for line in f:
+                line = line.split("#")[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                slots = 1
+                for p in parts[1:]:
+                    if p.startswith("slots="):
+                        slots = int(p.split("=", 1)[1])
+                specs.append(HostSpec(parts[0], slots))
+        return specs
+    for item in hosts.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" in item:
+            h, s = item.rsplit(":", 1)
+            specs.append(HostSpec(h, int(s)))
+        else:
+            specs.append(HostSpec(item, 1))
+    return specs
+
+
+def build_worker_env(process_id: int, num_processes: int,
+                     coordinator: str, base_env: Optional[Dict] = None) -> Dict:
+    """Environment for one worker process; horovod_tpu.init() picks these up
+    (mirrors the reference's HOROVOD_RANK/SIZE env contract)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "HVD_TPU_COORDINATOR": coordinator,
+        "HVD_TPU_NUM_PROCESSES": str(num_processes),
+        "HVD_TPU_PROCESS_ID": str(process_id),
+    })
+    return env
+
+
+def worker_commands(command: Sequence[str], hosts: List[HostSpec],
+                    coordinator_port: int = DEFAULT_PORT) -> List[str]:
+    """One launch command per host for remote mode (the user or cloud tooling
+    executes them; the reference would ssh)."""
+    coordinator = f"{hosts[0].host}:{coordinator_port}"
+    cmds = []
+    for pid, spec in enumerate(hosts):
+        env = (f"HVD_TPU_COORDINATOR={coordinator} "
+               f"HVD_TPU_NUM_PROCESSES={len(hosts)} "
+               f"HVD_TPU_PROCESS_ID={pid}")
+        cmds.append(f"{env} {' '.join(shlex.quote(c) for c in command)}")
+    return cmds
+
+
+def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
+        coordinator_port: int = DEFAULT_PORT, dry_run: bool = False,
+        extra_env: Optional[Dict[str, str]] = None):
+    """``horovodrun`` equivalent.
+
+    - ``hosts=None``: spawn ``np`` local worker processes and wait.
+    - ``hosts="h1:8,h2:8"``: print/return per-host commands (remote launch).
+    - ``dry_run``: return commands without executing.
+    """
+    if hosts is not None:
+        specs = parse_hosts(hosts)
+        cmds = worker_commands(command, specs, coordinator_port)
+        if not dry_run:
+            for c in cmds:
+                print(c)
+        return cmds
+
+    coordinator = f"127.0.0.1:{coordinator_port}"
+    if dry_run:
+        return [" ".join(command)] * np
+    procs = []
+    for pid in range(np):
+        env = build_worker_env(pid, np, coordinator,
+                               base_env=dict(os.environ))
+        # np local processes cannot share one accelerator; default to the
+        # CPU backend for the simulated cluster (override via extra_env).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(list(command), env=env))
+    # Any worker failing must take down its peers — otherwise survivors
+    # block forever in rendezvous waiting for the dead rank (the reference
+    # kills the job on first worker failure too).
+    import time
+    rc = 0
+    try:
+        pending = list(procs)
+        while pending and rc == 0:
+            for p in list(pending):
+                code = p.poll()
+                if code is None:
+                    continue
+                pending.remove(p)
+                if code:
+                    rc = code
+                    break
+            time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    if rc:
+        raise RuntimeError(f"worker exited with code {rc}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m horovod_tpu.runner -np 4 python train.py``."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="hvdrun-tpu",
+        description="Launch horovod_tpu workers (horovodrun equivalent)")
+    parser.add_argument("-np", "--num-proc", type=int, default=1)
+    parser.add_argument("-H", "--hosts", default=None,
+                        help='e.g. "host1:8,host2:8" or a hostfile path')
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        parser.error("no command given")
+    out = run(args.command, np=args.num_proc, hosts=args.hosts,
+              coordinator_port=args.port, dry_run=args.dry_run)
+    if args.dry_run and isinstance(out, list):
+        for c in out:
+            print(c)
+        return 0
+    return out if isinstance(out, int) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

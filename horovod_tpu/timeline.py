@@ -1,0 +1,116 @@
+"""Timeline: Chrome-trace JSON of framework activity.
+
+Rebuild of upstream ``horovod/common/timeline.cc`` (activated via
+``HOROVOD_TIMELINE=/path.json``): the reference logs NEGOTIATE / QUEUE /
+MEMCPY / NCCL phases per tensor from the controller thread.
+
+On TPU the phase structure is different — negotiation doesn't exist and XLA
+owns the device schedule — so the timeline records what the host actually
+controls (eager collective dispatch, compile, fetch, user markers) and
+defers intra-device visibility to ``jax.profiler`` (``start_profiler`` /
+``stop_profiler`` wrap XLA's own tracing, the TPU-native equivalent of the
+reference's per-kernel activity rows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["Timeline", "init_timeline", "get_timeline", "shutdown_timeline",
+           "start_timeline", "stop_timeline"]
+
+_LOCK = threading.Lock()
+_TIMELINE: Optional["Timeline"] = None
+
+
+class Timeline:
+    """Chrome-trace (``chrome://tracing`` / Perfetto) event writer."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._events = []
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def marker(self, name: str, category: str = "marker", **args) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "i",
+                "ts": self._now_us(), "pid": self._pid, "tid": 0,
+                "s": "g", "args": args})
+
+    @contextmanager
+    def activity(self, name: str, category: str = "collective", **args):
+        """Complete-event span, e.g. around an eager collective dispatch."""
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._events.append({
+                    "name": name, "cat": category, "ph": "X",
+                    "ts": t0, "dur": self._now_us() - t0,
+                    "pid": self._pid, "tid": threading.get_ident() % 1_000_000,
+                    "args": args})
+
+    def flush(self) -> None:
+        with self._lock:
+            with open(self.path, "w") as f:
+                json.dump({"traceEvents": self._events,
+                           "displayTimeUnit": "ms"}, f)
+
+
+def init_timeline(path: Optional[str] = None) -> Timeline:
+    """Enable the timeline (``HOROVOD_TIMELINE`` env var or explicit path)."""
+    global _TIMELINE
+    with _LOCK:
+        path = path or os.environ.get("HOROVOD_TIMELINE")
+        if not path:
+            raise ValueError(
+                "pass a path or set HOROVOD_TIMELINE=/path/timeline.json")
+        _TIMELINE = Timeline(path)
+        return _TIMELINE
+
+
+def get_timeline() -> Optional[Timeline]:
+    return _TIMELINE
+
+
+def shutdown_timeline() -> None:
+    global _TIMELINE
+    with _LOCK:
+        if _TIMELINE is not None:
+            _TIMELINE.flush()
+            _TIMELINE = None
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> None:
+    """``hvd.start_timeline`` parity (mark_cycles is a no-op: there is no
+    controller cycle on TPU)."""
+    init_timeline(path)
+
+
+def stop_timeline() -> None:
+    """``hvd.stop_timeline`` parity."""
+    shutdown_timeline()
+
+
+# jax.profiler passthroughs: device-side tracing, the XLA-native analogue of
+# the reference's per-op NCCL activity rows.
+def start_profiler(logdir: str) -> None:
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profiler() -> None:
+    import jax
+    jax.profiler.stop_trace()

@@ -1,0 +1,180 @@
+"""PyTorch frontend: API parity with ``horovod.torch``.
+
+Rebuild of upstream ``horovod/torch/__init__.py`` + ``optimizer.py`` +
+``sync_batch_norm.py`` surface. Tensors bridge torch<->jax via numpy (CPU
+torch only in this image; on a real TPU-VM the torch path is torch-xla, and
+the collective still lowers through the same jax engine).
+
+Process model: with ``horovod_tpu.runner`` each host process owns its torch
+replica and collectives run across processes; in a single process the
+communicator has size ``hvd.local ==`` device count but torch tensors are
+host-resident and replicated, so reductions are averages over identical
+values (exact by construction). The hook-based DistributedOptimizer
+preserves the reference's semantics: grads are allreduced before ``step()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu.collective import (
+    Average, Sum, Min, Max, Product, Adasum, ReduceOp,
+)
+from horovod_tpu.compression import Compression
+from horovod_tpu.core import (
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
+    "alltoall", "grouped_allreduce",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "DistributedOptimizer", "Compression",
+    "Average", "Sum", "Min", "Max", "Product", "Adasum", "ReduceOp",
+]
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _to_jax_stacked(t):
+    """torch tensor -> per-rank stacked array for the eager engine.
+
+    Single process: every simulated rank holds this process's value
+    (Horovod's invariant that each rank contributes its local tensor)."""
+    arr = t.detach().cpu().numpy()
+    return np.broadcast_to(arr, (size(),) + arr.shape).copy()
+
+
+def _from_stacked(out, like):
+    torch = _torch()
+    return torch.from_numpy(np.asarray(out[0]).copy()).to(like.dtype)
+
+
+def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+              compression=Compression.none, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0, process_set=None):
+    """``hvd.torch.allreduce``: returns a new reduced tensor."""
+    out = _hvd.allreduce(_to_jax_stacked(tensor), op=op,
+                         compression=compression,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor,
+                         process_set=process_set)
+    return _from_stacked(out, tensor)
+
+
+def allreduce_(tensor, **kwargs):
+    """In-place allreduce."""
+    result = allreduce(tensor, **kwargs)
+    tensor.copy_(result)
+    return tensor
+
+
+def grouped_allreduce(tensors: Iterable, op: int = Average, **kwargs):
+    """Fused: one collective for the whole list (rides the fusion buffer,
+    unlike a per-tensor loop)."""
+    tensors = list(tensors)
+    outs = _hvd.grouped_allreduce(
+        [_to_jax_stacked(t) for t in tensors], op=op, **kwargs)
+    return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    out = _hvd.allgather(_to_jax_stacked(tensor), process_set=process_set)
+    return _from_stacked(out, tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None, process_set=None):
+    out = _hvd.alltoall(_to_jax_stacked(tensor), process_set=process_set)
+    return _from_stacked(out, tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
+    out = _hvd.broadcast(_to_jax_stacked(tensor), root_rank,
+                         process_set=process_set)
+    return _from_stacked(out, tensor)
+
+
+def broadcast_(tensor, root_rank: int, **kwargs):
+    tensor.copy_(broadcast(tensor, root_rank, **kwargs))
+    return tensor
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """``hvd.broadcast_parameters(model.state_dict(), 0)``: in-place sync of
+    a state_dict or named_parameters iterable."""
+    if hasattr(params, "items"):
+        items = params.items()
+    else:
+        items = params
+    for _, p in items:
+        if p is not None and hasattr(p, "copy_"):
+            broadcast_(p.data if hasattr(p, "data") else p, root_rank)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """``hvd.broadcast_optimizer_state``: sync optimizer tensor state."""
+    torch = _torch()
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            st = optimizer.state.get(p, {})
+            for k, v in st.items():
+                if torch.is_tensor(v):
+                    broadcast_(v, root_rank)
+
+
+class _DistributedOptimizer:
+    """Hook-based gradient averaging around an inner torch optimizer
+    (upstream ``horovod/torch/optimizer.py:_DistributedOptimizer``)."""
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 op: int = Average, gradient_predivide_factor: float = 1.0,
+                 process_set=None):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_opt"), name)
+
+    def synchronize(self) -> None:
+        """Allreduce all gradients now (upstream ``synchronize``)."""
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    allreduce_(p.grad,
+                               op=self._op,
+                               compression=self._compression,
+                               prescale_factor=1.0 / self._predivide,
+                               postscale_factor=self._predivide,
+                               process_set=self._process_set)
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none, op: int = Average,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None, **_ignored):
+    """Wrap a torch optimizer so ``step()`` first averages gradients across
+    the communicator (``hvd.DistributedOptimizer``)."""
+    return _DistributedOptimizer(
+        optimizer, compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set)

@@ -1,0 +1,147 @@
+"""Timeline, autotune, runner, callbacks tests (SURVEY §5)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import timeline as tl
+from horovod_tpu.autotune import Autotuner, autotune_fusion_threshold
+from horovod_tpu.callbacks import (
+    BroadcastGlobalVariablesCallback, LearningRateScheduleCallback,
+    LearningRateWarmupCallback, MetricAverageCallback, warmup_schedule,
+)
+from horovod_tpu.runner.launcher import (
+    build_worker_env, parse_hosts, run as runner_run, worker_commands,
+)
+
+
+class TestTimeline:
+    def test_trace_file(self, tmp_path):
+        path = str(tmp_path / "tl.json")
+        t = tl.init_timeline(path)
+        t.marker("epoch_start", epoch=1)
+        with t.activity("allreduce", tensor="grads", bytes=1024):
+            pass
+        tl.shutdown_timeline()
+        with open(path) as f:
+            data = json.load(f)
+        events = data["traceEvents"]
+        assert {e["name"] for e in events} == {"epoch_start", "allreduce"}
+        span = [e for e in events if e["ph"] == "X"][0]
+        assert span["dur"] >= 0 and span["args"]["bytes"] == 1024
+
+    def test_env_var(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "t.json")
+        monkeypatch.setenv("HOROVOD_TIMELINE", p)
+        tl.init_timeline()
+        tl.get_timeline().marker("m")
+        tl.shutdown_timeline()
+        assert os.path.exists(p)
+
+    def test_requires_path(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+        with pytest.raises(ValueError):
+            tl.init_timeline()
+
+
+class TestAutotune:
+    def test_offline_picks_fastest(self):
+        import time
+
+        def factory(thr):
+            def step():
+                time.sleep(0.001 if thr == 4096 else 0.005)
+            return step
+
+        res = autotune_fusion_threshold(factory, [1024, 4096, 16384],
+                                        steps_per_trial=3, warmup_steps=1)
+        assert res.best_threshold_bytes == 4096
+        assert len(res.trials) == 3
+        assert "best fusion threshold" in res.summary()
+
+    def test_online_converges(self):
+        tuner = Autotuner(candidates_bytes=[100, 200], samples_per_candidate=2)
+        sim = {100: 0.01, 200: 0.002}
+        while not tuner.converged:
+            tuner.record(sim[tuner.current_threshold()])
+        assert tuner.current_threshold() == 200
+
+
+class TestRunner:
+    def test_parse_hosts_string(self):
+        specs = parse_hosts("h1:4,h2:2,h3")
+        assert [(s.host, s.slots) for s in specs] == [
+            ("h1", 4), ("h2", 2), ("h3", 1)]
+
+    def test_parse_hostfile(self, tmp_path):
+        f = tmp_path / "hostfile"
+        f.write_text("worker0 slots=8\nworker1 slots=8  # comment\n\n")
+        specs = parse_hosts(str(f))
+        assert [(s.host, s.slots) for s in specs] == [
+            ("worker0", 8), ("worker1", 8)]
+
+    def test_worker_env(self):
+        env = build_worker_env(2, 4, "c:29500", base_env={})
+        assert env == {"HVD_TPU_COORDINATOR": "c:29500",
+                       "HVD_TPU_NUM_PROCESSES": "4",
+                       "HVD_TPU_PROCESS_ID": "2"}
+
+    def test_worker_commands(self):
+        cmds = worker_commands(["python", "train.py"],
+                               parse_hosts("h1:8,h2:8"), 1234)
+        assert len(cmds) == 2
+        assert "HVD_TPU_COORDINATOR=h1:1234" in cmds[0]
+        assert "HVD_TPU_PROCESS_ID=1" in cmds[1]
+
+    def test_local_run_spawns(self):
+        rc = runner_run(["python", "-c", "import os; "
+                         "assert os.environ['HVD_TPU_NUM_PROCESSES']=='2'"],
+                        np=2)
+        assert rc == 0
+
+    def test_local_run_failure_raises(self):
+        with pytest.raises(RuntimeError):
+            runner_run(["python", "-c", "raise SystemExit(3)"], np=2)
+
+    def test_cli_dry_run(self, capsys):
+        from horovod_tpu.runner.launcher import main
+        rc = main(["-np", "2", "--dry-run", "--", "python", "x.py"])
+        assert rc == 0
+
+
+class TestCallbacks:
+    def test_broadcast_callback_idempotent(self):
+        cb = BroadcastGlobalVariablesCallback(0)
+        state = {"params": {"w": jnp.ones(3)}}
+        out = cb.on_train_begin(state)
+        out2 = cb.on_train_begin(out)
+        np.testing.assert_array_equal(np.asarray(out2["params"]["w"]),
+                                      np.ones(3))
+
+    def test_metric_average_single_process(self):
+        cb = MetricAverageCallback()
+        out = cb.on_epoch_end({"loss": 2.0})
+        assert float(out["loss"]) == 2.0
+
+    def test_warmup_schedule(self):
+        sched = warmup_schedule(0.1, warmup_epochs=2, steps_per_epoch=5,
+                                size=8)
+        assert float(sched(0)) == pytest.approx(0.1)
+        assert float(sched(10)) == pytest.approx(0.8)
+
+    def test_warmup_callback(self):
+        cb = LearningRateWarmupCallback(0.1, warmup_epochs=1,
+                                        steps_per_epoch=10)
+        assert cb.lr_at(0) == pytest.approx(0.1)
+        assert cb.lr_at(100) == pytest.approx(0.1 * hvd.size())
+
+    def test_schedule_callback(self):
+        cb = LearningRateScheduleCallback(0.1, multiplier=0.5,
+                                          start_epoch=2, end_epoch=4)
+        assert cb.lr_at_epoch(1) is None
+        assert cb.lr_at_epoch(2) == pytest.approx(0.05)
+        assert cb.lr_at_epoch(4) is None

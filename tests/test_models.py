@@ -1,0 +1,173 @@
+"""Model zoo tests (SURVEY §4: forward shapes + one step decreases loss)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MnistCNN, ResNet18, get_model
+from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+
+
+class TestMnist:
+    def test_forward_shape(self):
+        m = MnistCNN()
+        x = jnp.ones((4, 28, 28, 1))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (4, 10)
+        assert out.dtype == jnp.float32
+
+    def test_train_step_decreases_loss(self):
+        m = MnistCNN()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 28, 28, 1)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, (16,)), jnp.int32)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+        st = opt.init(v["params"])
+
+        def loss(p):
+            logits = m.apply({"params": p}, x, train=False)
+            return -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(logits), y[:, None], 1))
+
+        @jax.jit
+        def step(p, st):
+            l, g = jax.value_and_grad(loss)(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, l
+
+        p = v["params"]
+        losses = []
+        for _ in range(10):
+            p, st, l = step(p, st)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+
+class TestResNet:
+    def test_forward_shape_and_dtype(self):
+        m = ResNet18(num_classes=10)
+        x = jnp.ones((2, 32, 32, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32  # logits kept fp32
+        assert "batch_stats" in v
+
+    def test_batchstats_update(self):
+        m = ResNet18(num_classes=10)
+        x = jnp.ones((2, 32, 32, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=True)
+        _, upd = m.apply(v, x, train=True, mutable=["batch_stats"])
+        assert "batch_stats" in upd
+
+    def test_resnet50_constructs(self):
+        # full fwd is slow on CPU; shape-check via lazy init metadata
+        m = get_model("resnet50")
+        x = jnp.ones((1, 64, 64, 3))
+        v = jax.eval_shape(lambda: m.init(jax.random.PRNGKey(0), x,
+                                          train=False))
+        n_params = sum(np.prod(l.shape) for l in
+                       jax.tree_util.tree_leaves(v["params"]))
+        assert 25_000_000 < n_params < 26_000_000  # ~25.5M like the reference
+
+
+class TestGPT2:
+    def test_forward_and_loss_decreases(self):
+        cfg = GPT2Config.tiny()
+        m = GPT2(cfg)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+            jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+        logits = m.apply({"params": params}, toks)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+        opt = optax.adam(1e-2)
+        st = opt.init(params)
+
+        @jax.jit
+        def step(p, st):
+            l, g = jax.value_and_grad(
+                lambda p: loss_fn(m.apply({"params": p}, toks), toks))(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, l
+
+        losses = []
+        p = params
+        for _ in range(8):
+            p, st, l = step(p, st)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_gpt2_medium_config(self):
+        cfg = GPT2Config.medium()
+        assert (cfg.num_layers, cfg.num_heads, cfg.d_model) == (24, 16, 1024)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip_8(self):
+        import __graft_entry__ as g
+        g.dryrun_multichip(8)
+
+    def test_entry_shapes(self):
+        import __graft_entry__ as g
+        fn, args = g.entry()
+        out = jax.eval_shape(fn, *args)
+        assert out.shape == (2, 1000)
+
+
+class TestBert:
+    def test_forward_and_mlm_loss(self):
+        from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss
+        cfg = BertConfig.tiny()
+        m = Bert(cfg)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+            jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+        mlm, nsp = m.apply({"params": params}, toks)
+        assert mlm.shape == (2, 16, cfg.vocab_size)
+        assert nsp.shape == (2, 2)
+        mask = jnp.zeros((2, 16)).at[:, :3].set(1.0)
+        l = mlm_loss(mlm, toks, mask)
+        assert np.isfinite(float(l)) and float(l) > 0
+
+    def test_large_config(self):
+        from horovod_tpu.models.bert import BertConfig
+        cfg = BertConfig.large()
+        assert (cfg.num_layers, cfg.num_heads, cfg.d_model) == (24, 16, 1024)
+
+
+class TestViT:
+    def test_forward(self):
+        from horovod_tpu.models.vit import ViT, ViTConfig
+        cfg = ViTConfig.tiny()
+        m = ViT(cfg)
+        x = jnp.ones((2, 32, 32, 3))
+        params = m.init(jax.random.PRNGKey(0), x)["params"]
+        out = m.apply({"params": params}, x)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32
+
+    def test_b16_param_count(self):
+        from horovod_tpu.models.vit import ViT, ViTConfig
+        m = ViT(ViTConfig.b16())
+        x = jnp.ones((1, 224, 224, 3))
+        v = jax.eval_shape(lambda: m.init(jax.random.PRNGKey(0), x))
+        n = sum(int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(v["params"]))
+        assert 85_000_000 < n < 88_000_000  # ViT-B/16 ~86M
+
+
+class TestGetModel:
+    def test_registry_names(self):
+        from horovod_tpu.models import get_model
+        for name in ("mnist", "resnet18", "resnet50", "gpt2_medium",
+                     "bert_large", "vit_b16"):
+            assert get_model(name) is not None

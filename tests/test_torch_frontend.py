@@ -1,0 +1,88 @@
+"""Torch frontend tests (mirrors upstream ``test/parallel/test_torch.py``
+API coverage on the single-process bridge)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+
+
+class TestTorchCollectives:
+    def test_allreduce_identity_single_process(self):
+        t = torch.randn(4, 3)
+        out = hvd_torch.allreduce(t, op=hvd_torch.Average)
+        assert torch.allclose(out, t, atol=1e-6)
+
+    def test_allreduce_sum_scales_by_size(self):
+        t = torch.ones(2, 2)
+        out = hvd_torch.allreduce(t, op=hvd_torch.Sum)
+        assert torch.allclose(out, t * hvd_torch.size())
+
+    def test_allreduce_inplace(self):
+        t = torch.ones(3)
+        ret = hvd_torch.allreduce_(t, op=hvd_torch.Sum)
+        assert ret is t
+        assert torch.allclose(t, torch.full((3,), float(hvd_torch.size())))
+
+    def test_broadcast(self):
+        t = torch.randn(5)
+        out = hvd_torch.broadcast(t, root_rank=0)
+        assert torch.allclose(out, t, atol=1e-6)
+
+    def test_allgather(self):
+        t = torch.ones(2, 3)
+        out = hvd_torch.allgather(t)
+        assert out.shape == (2 * hvd_torch.size(), 3)
+
+    def test_compression(self):
+        t = torch.randn(8)
+        out = hvd_torch.allreduce(t, compression=hvd_torch.Compression.fp16)
+        assert out.dtype == t.dtype
+        assert torch.allclose(out, t, atol=1e-2)
+
+
+class TestTorchOptimizer:
+    def _train(self, steps=5):
+        model = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05))
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        x = torch.randn(32, 4)
+        y = x.sum(dim=1, keepdim=True)
+        losses = []
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        return losses, model, opt
+
+    def test_training_decreases_loss(self):
+        losses, _, _ = self._train(10)
+        assert losses[-1] < losses[0]
+
+    def test_synchronize_divides_gradients_correctly(self):
+        model = torch.nn.Linear(2, 1, bias=False)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0))
+        out = model(torch.ones(1, 2)).sum()
+        out.backward()
+        g_before = model.weight.grad.clone()
+        opt.synchronize()
+        # single process: every simulated rank holds the same grad -> average
+        # is the identity
+        assert torch.allclose(model.weight.grad, g_before, atol=1e-6)
+
+    def test_broadcast_optimizer_state(self):
+        losses, model, opt = self._train(3)
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+
+    def test_passthrough_attrs(self):
+        model = torch.nn.Linear(2, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1))
+        assert opt.param_groups[0]["lr"] == 0.1
