@@ -63,17 +63,20 @@ def main():
         params = optax.apply_updates(params, updates)
         return params, batch_stats, opt_state, loss
 
-    # Warmup (compile) then timed steps.
+    # Warmup (compile) then timed steps. Synchronize with a host fetch of the
+    # final loss (not just block_until_ready): the chained params dependency
+    # forces every step to have executed before the fetch returns, and a D2H
+    # fetch is reliable across PJRT transports.
     for _ in range(3):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * steps / dt

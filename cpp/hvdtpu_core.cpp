@@ -1,0 +1,302 @@
+// hvdtpu native runtime core.
+//
+// TPU-native rethink of the reference's C++ runtime layer
+// (horovod/common/controller.cc, fusion_buffer_manager.cc,
+// stall_inspector.cc, timeline.cc). On TPU the *device* schedule belongs to
+// XLA, so this library owns only what the host genuinely controls:
+//
+//   1. Coordinator  — deterministic cross-process op ordering for the
+//      multi-process eager path (bitvector readiness + rank-0 order, the
+//      negotiation contract of the reference without the background thread:
+//      the Python layer drives it synchronously at dispatch points).
+//   2. Response cache — memoizes negotiated responses keyed by op name
+//      (reference: response_cache.cc) so steady-state training skips
+//      re-negotiation entirely.
+//   3. Fusion planner — greedy bucket assignment under a byte threshold
+//      with tile alignment (reference: fusion buffer offsets; here buckets
+//      are concatenation plans handed back to XLA).
+//   4. Stall inspector — tracks submit timestamps per (op, rank) and
+//      reports ops missing ranks past a timeout (reference:
+//      stall_inspector.cc one-sided health check).
+//   5. Timeline appender — lock-protected chrome-trace JSON writer.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_us() {
+  using namespace std::chrono;
+  return duration_cast<duration<double, std::micro>>(
+             steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct OpState {
+  std::vector<uint8_t> ready;   // per-rank submission bit
+  std::vector<double> t_submit; // per-rank submit time (us), 0 = never
+  int order = -1;               // rank-0 submission order
+};
+
+struct Coordinator {
+  int world;
+  std::mutex mu;
+  std::unordered_map<std::string, OpState> ops;
+  int next_order = 0;
+  std::unordered_map<std::string, std::string> cache;  // response cache
+};
+
+struct TimelineW {
+  FILE* f = nullptr;
+  std::mutex mu;
+  bool first = true;
+  double t0 = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- coordinator
+void* hvd_coord_create(int world_size) {
+  auto* c = new Coordinator();
+  c->world = world_size;
+  return c;
+}
+
+void hvd_coord_destroy(void* h) { delete static_cast<Coordinator*>(h); }
+
+// Submit op `name` from `rank`. Returns 1 if the op became ready (all ranks
+// submitted), 0 otherwise, -1 on bad args.
+int hvd_coord_submit(void* h, int rank, const char* name) {
+  auto* c = static_cast<Coordinator*>(h);
+  if (!c || rank < 0 || rank >= c->world || !name) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  auto& op = c->ops[name];
+  if (op.ready.empty()) {
+    op.ready.assign(c->world, 0);
+    op.t_submit.assign(c->world, 0.0);
+  }
+  if (!op.ready[rank]) {
+    op.ready[rank] = 1;
+    op.t_submit[rank] = now_us();
+  }
+  if (rank == 0 && op.order < 0) op.order = c->next_order++;
+  int sum = 0;
+  for (auto b : op.ready) sum += b;
+  return sum == c->world ? 1 : 0;
+}
+
+// Pop the next ready op in rank-0 submission order (the reference's
+// determinism guarantee: every rank executes collectives in the same order).
+// Returns length written to buf, 0 if none ready, -1 on error. If the buffer
+// is too small the op is NOT popped and -(needed_len+1) is returned so the
+// caller can retry with a larger buffer.
+int hvd_coord_pop_ready(void* h, char* buf, int buflen) {
+  auto* c = static_cast<Coordinator*>(h);
+  if (!c || !buf || buflen <= 0) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  const std::string* best = nullptr;
+  int best_order = INT32_MAX;
+  for (auto& kv : c->ops) {
+    auto& op = kv.second;
+    if (op.order < 0) continue;  // rank 0 hasn't submitted: not ordered yet
+    int sum = 0;
+    for (auto b : op.ready) sum += b;
+    if (sum == c->world && op.order < best_order) {
+      best_order = op.order;
+      best = &kv.first;
+    }
+  }
+  if (!best) return 0;
+  if (best->size() + 1 > (size_t)buflen) return -(int)(best->size() + 1);
+  int n = (int)best->size();
+  std::memcpy(buf, best->c_str(), n);
+  buf[n] = 0;
+  c->ops.erase(*best);
+  return n;
+}
+
+// Count of ops submitted but not yet executed.
+int hvd_coord_pending(void* h) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return (int)c->ops.size();
+}
+
+// --------------------------------------------------------------- resp. cache
+void hvd_cache_put(void* h, const char* key, const char* value) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->cache[key] = value;
+}
+
+// Returns the FULL value length (0 = miss) and writes up to buflen-1 bytes.
+// A return >= buflen means the write was truncated: retry with a buffer of
+// returned_length+1.
+int hvd_cache_get(void* h, const char* key, char* buf, int buflen) {
+  auto* c = static_cast<Coordinator*>(h);
+  if (!c || !buf || buflen <= 0) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->cache.find(key);
+  if (it == c->cache.end()) return 0;
+  int n = (int)std::min((size_t)buflen - 1, it->second.size());
+  std::memcpy(buf, it->second.c_str(), n);
+  buf[n] = 0;
+  return (int)it->second.size();
+}
+
+int hvd_cache_size(void* h) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return (int)c->cache.size();
+}
+
+// -------------------------------------------------------------- fusion plan
+// Greedy assignment of tensors (by size in bytes, given order) into buckets
+// of at most threshold bytes, each tensor padded to `align` bytes (TPU lane
+// alignment). A tensor larger than the threshold gets its own bucket.
+// out_buckets[i] = bucket index of tensor i. Returns bucket count.
+int hvd_fusion_plan(const int64_t* sizes, int n, int64_t threshold,
+                    int64_t align, int32_t* out_buckets) {
+  if (!sizes || !out_buckets || n <= 0) return -1;
+  if (align <= 0) align = 1;
+  int64_t used = 0;
+  int bucket = -1;
+  for (int i = 0; i < n; i++) {
+    int64_t sz = (sizes[i] + align - 1) / align * align;
+    if (bucket < 0 || used + sz > threshold) {
+      bucket++;
+      used = 0;
+    }
+    out_buckets[i] = bucket;
+    used += sz;
+  }
+  return bucket + 1;
+}
+
+// ------------------------------------------------------------ stall inspect
+// Report ops stuck longer than timeout_us: an op is stuck if at least one
+// rank submitted and at least one hasn't, and the oldest submission is older
+// than the timeout. Writes "name:missing_count;..." into buf. Returns the
+// number of stuck ops, or -(needed_len+1) if the buffer is too small for the
+// full report (nothing useful is written in that case; retry larger).
+int hvd_stall_check(void* h, double timeout_us, char* buf, int buflen) {
+  auto* c = static_cast<Coordinator*>(h);
+  if (!c || !buf || buflen <= 0) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  double now = now_us();
+  std::string report;
+  int count = 0;
+  for (auto& kv : c->ops) {
+    auto& op = kv.second;
+    int sum = 0;
+    double oldest = 0;
+    for (int r = 0; r < c->world; r++) {
+      if (op.ready[r]) {
+        sum++;
+        if (oldest == 0 || op.t_submit[r] < oldest) oldest = op.t_submit[r];
+      }
+    }
+    if (sum > 0 && sum < c->world && now - oldest > timeout_us) {
+      count++;
+      report += kv.first + ":" + std::to_string(c->world - sum) + ";";
+    }
+  }
+  if (report.size() + 1 > (size_t)buflen) {
+    buf[0] = 0;
+    return -(int)(report.size() + 1);
+  }
+  std::memcpy(buf, report.c_str(), report.size());
+  buf[report.size()] = 0;
+  return count;
+}
+
+// ----------------------------------------------------------------- timeline
+void* hvd_timeline_open(const char* path) {
+  auto* t = new TimelineW();
+  t->f = std::fopen(path, "w");
+  if (!t->f) {
+    delete t;
+    return nullptr;
+  }
+  t->t0 = now_us();
+  std::fputs("{\"displayTimeUnit\":\"ms\",\"traceEvents\":[", t->f);
+  return t;
+}
+
+static std::string json_escape(const char* s) {
+  std::string out;
+  for (const char* p = s; *p; p++) {
+    unsigned char ch = (unsigned char)*p;
+    switch (ch) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (ch < 0x20) {
+          char esc[8];
+          std::snprintf(esc, sizeof esc, "\\u%04x", ch);
+          out += esc;
+        } else {
+          out += (char)ch;
+        }
+    }
+  }
+  return out;
+}
+
+// Append one event. ph is a single chrome-trace phase char ('X' complete,
+// 'i' instant). args_json, when non-null/non-empty, must be a valid JSON
+// object (the Python layer serializes it; only name/cat are escaped here).
+void hvd_timeline_event(void* h, const char* name, const char* cat, char ph,
+                        double ts_us, double dur_us, int pid, int tid,
+                        const char* args_json) {
+  auto* t = static_cast<TimelineW*>(h);
+  if (!t || !t->f || !name || !cat) return;
+  std::lock_guard<std::mutex> g(t->mu);
+  if (!t->first) std::fputc(',', t->f);
+  t->first = false;
+  std::fprintf(t->f, "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"%c\",\"ts\":%.3f",
+               json_escape(name).c_str(), json_escape(cat).c_str(), ph, ts_us);
+  if (ph == 'X') std::fprintf(t->f, ",\"dur\":%.3f", dur_us);
+  if (ph == 'i') std::fputs(",\"s\":\"g\"", t->f);
+  std::fprintf(t->f, ",\"pid\":%d,\"tid\":%d", pid, tid);
+  if (args_json && args_json[0]) std::fprintf(t->f, ",\"args\":%s", args_json);
+  std::fputc('}', t->f);
+}
+
+double hvd_timeline_now_us(void* h) {
+  auto* t = static_cast<TimelineW*>(h);
+  return t ? now_us() - t->t0 : 0.0;
+}
+
+void hvd_timeline_close(void* h) {
+  auto* t = static_cast<TimelineW*>(h);
+  if (!t) return;
+  {
+    std::lock_guard<std::mutex> g(t->mu);
+    if (t->f) {
+      std::fputs("]}", t->f);
+      std::fclose(t->f);
+      t->f = nullptr;
+    }
+  }
+  delete t;
+}
+
+}  // extern "C"

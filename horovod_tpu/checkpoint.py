@@ -1,0 +1,83 @@
+"""Checkpoint/resume on orbax (SURVEY §5).
+
+The reference delegates checkpointing to the frameworks (Keras callbacks /
+torch.save in the examples) plus Elastic state commits. Here checkpointing is
+first-class and TPU-correct: orbax handles multi-host coordinated writes
+(every process saves its shards, one barrier), async save keeps the step loop
+running, and restore re-places arrays with the current mesh sharding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
+           "CheckpointManager"]
+
+
+def _manager(directory: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(
+        directory, options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True))
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` with the
+    framework's state conventions (a dict of pytrees + scalars)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = _manager(self.directory, max_to_keep)
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Any] = None) -> Any:
+        import orbax.checkpoint as ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if template is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    max_to_keep: int = 3) -> None:
+    """One-shot save (blocks until durable)."""
+    m = CheckpointManager(directory, max_to_keep)
+    m.save(step, state, wait=True)
+    m.close()
+
+
+def restore_checkpoint(directory: str, template: Optional[Any] = None,
+                       step: Optional[int] = None) -> Any:
+    m = CheckpointManager(directory)
+    try:
+        return m.restore(step, template)
+    finally:
+        m.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    m = CheckpointManager(directory)
+    try:
+        return m.latest_step()
+    finally:
+        m.close()

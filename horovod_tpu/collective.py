@@ -289,8 +289,16 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
         _EAGER_CACHE[key] = fn
 
     sharding = NamedSharding(m, P(axis))
-    placed = [jax.device_put(x, sharding) for x in leaves]
-    out_leaves = fn(*placed)
+    from horovod_tpu import timeline as _tl
+    t = _tl.get_timeline()
+    if t is not None:
+        nbytes = sum(x.size * x.dtype.itemsize for x in leaves)
+        with t.activity(kind, tensors=len(leaves), bytes=int(nbytes)):
+            placed = [jax.device_put(x, sharding) for x in leaves]
+            out_leaves = fn(*placed)
+    else:
+        placed = [jax.device_put(x, sharding) for x in leaves]
+        out_leaves = fn(*placed)
     return jax.tree_util.tree_unflatten(treedef, list(out_leaves))
 
 

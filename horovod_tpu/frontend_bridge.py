@@ -1,0 +1,29 @@
+"""Shared host-tensor bridge for non-JAX frontends (torch, tensorflow).
+
+Horovod's invariant is "each rank contributes its local tensor". On a single
+controller the eager engine simulates all ranks at once (stacked leading
+axis, see ``collective._eager_run``); these helpers translate a framework
+host tensor to/from that convention so every frontend reduces through the
+same engine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu import core
+
+__all__ = ["to_stacked", "from_stacked"]
+
+
+def to_stacked(array_like) -> np.ndarray:
+    """Host array -> per-rank stacked array (every simulated rank holds this
+    process's value)."""
+    arr = np.asarray(array_like)
+    return np.broadcast_to(arr, (core.size(),) + arr.shape).copy()
+
+
+def from_stacked(stacked) -> np.ndarray:
+    """Stacked result -> this process's value (row 0; reductions make every
+    row identical)."""
+    return np.asarray(stacked[0]).copy()

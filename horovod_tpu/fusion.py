@@ -31,6 +31,32 @@ def _nbytes(leaf) -> int:
     return leaf.size * jnp.dtype(leaf.dtype).itemsize
 
 
+# Capacity accounting pads each tensor to the TPU lane-tile stride
+# (128 lanes x 4 B), matching how the reference pads entries in its fusion
+# buffer; bucket *contents* are still tightly concatenated.
+FUSION_ALIGN_BYTES = 512
+
+
+def _plan_buckets(sizes: Sequence[int], threshold_bytes: int) -> List[int]:
+    """Bucket index per tensor: native planner if built (first use may build
+    the .so with make, a one-time ~2s cost), else same greedy in Python. A
+    tensor larger than the threshold gets its own bucket."""
+    from horovod_tpu import native
+    assignment = native.fusion_plan(list(sizes), threshold_bytes,
+                                    align_bytes=FUSION_ALIGN_BYTES)
+    if assignment is not None:
+        return assignment
+    out, used, bucket = [], 0, -1
+    for sz in sizes:
+        sz = -(-sz // FUSION_ALIGN_BYTES) * FUSION_ALIGN_BYTES
+        if bucket < 0 or used + sz > threshold_bytes:
+            bucket += 1
+            used = 0
+        out.append(bucket)
+        used += sz
+    return out
+
+
 def fuse(leaves: Sequence[Any],
          threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
          ) -> Tuple[List[jnp.ndarray], Callable[[List[jnp.ndarray]], List[Any]]]:
@@ -43,20 +69,21 @@ def fuse(leaves: Sequence[Any],
     """
     leaves = [jnp.asarray(x) for x in leaves]
     # Stable greedy packing, grouped by dtype (a fused buffer must be
-    # homogeneous, as in the reference where the buffer is typed).
-    plan: List[List[int]] = []          # bucket -> leaf indices
-    cur: dict = {}                      # dtype -> (bucket_idx, bytes_used)
+    # homogeneous, as in the reference where the buffer is typed). The
+    # bucket assignment itself runs in the native planner when available
+    # (cpp/hvdtpu_core.cpp:hvd_fusion_plan), Python fallback otherwise.
+    by_dtype: dict = {}                 # dtype -> leaf indices (stable)
     for i, leaf in enumerate(leaves):
-        dt = jnp.dtype(leaf.dtype)
-        nb = _nbytes(leaf)
-        if dt in cur:
-            b, used = cur[dt]
-            if used + nb <= threshold_bytes:
-                plan[b].append(i)
-                cur[dt] = (b, used + nb)
-                continue
-        plan.append([i])
-        cur[dt] = (len(plan) - 1, nb)
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+
+    plan: List[List[int]] = []          # bucket -> leaf indices
+    for idxs in by_dtype.values():
+        sizes = [_nbytes(leaves[i]) for i in idxs]
+        assignment = _plan_buckets(sizes, threshold_bytes)
+        groups: dict = {}
+        for i, b in zip(idxs, assignment):
+            groups.setdefault(b, []).append(i)
+        plan.extend(groups[b] for b in sorted(groups))
 
     buckets = [
         leaves[idxs[0]].ravel() if len(idxs) == 1

@@ -1,0 +1,24 @@
+"""Ray integration surface (upstream ``horovod/ray``).
+
+API-parity stubs: ray is not in the TPU image. The equivalent capability —
+scheduling workers over a dynamic host set with elastic membership — is
+provided natively by ``horovod_tpu.runner`` + ``horovod_tpu.elastic``.
+"""
+
+from __future__ import annotations
+
+_MSG = ("horovod_tpu.ray requires the ray package, which is not in this "
+        "environment. Use horovod_tpu.runner for multi-host launch and "
+        "horovod_tpu.elastic for dynamic membership.")
+
+
+def _unavailable(*_a, **_k):
+    raise RuntimeError(_MSG)
+
+
+class RayExecutor:
+    def __init__(self, *a, **k):
+        _unavailable()
+
+
+run_remote = _unavailable

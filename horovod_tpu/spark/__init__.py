@@ -1,0 +1,32 @@
+"""Spark integration surface (upstream ``horovod/spark``).
+
+API-parity stubs: pyspark is not part of the TPU image, and the TPU-native
+launch story is ``horovod_tpu.runner`` over TPU-VM hosts (a Spark cluster
+does not schedule TPU slices). Importing this module works; calling into it
+raises with guidance, mirroring how upstream gates on ``pyspark`` presence.
+"""
+
+from __future__ import annotations
+
+_MSG = ("horovod_tpu.spark requires pyspark and a Spark cluster that can "
+        "schedule TPU hosts; neither exists in this environment. Use "
+        "horovod_tpu.runner (hvdrun-tpu) to launch across TPU-VM hosts, or "
+        "horovod_tpu.elastic for preemptible capacity.")
+
+
+def _unavailable(*_a, **_k):
+    raise RuntimeError(_MSG)
+
+
+run = _unavailable
+run_elastic = _unavailable
+
+
+class KerasEstimator:
+    def __init__(self, *a, **k):
+        _unavailable()
+
+
+class TorchEstimator:
+    def __init__(self, *a, **k):
+        _unavailable()

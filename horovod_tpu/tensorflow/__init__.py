@@ -1,0 +1,73 @@
+"""TensorFlow frontend surface (upstream ``horovod/tensorflow``).
+
+TensorFlow is not in the TPU image (the native frontend here is JAX — see
+``horovod_tpu.optimizer`` for DistributedOptimizer/DistributedGradientTape).
+If TF is present, thin wrappers route tensors through the same collective
+engine via numpy (capability parity, not a performance path — TF-on-TPU
+should use the JAX frontend or TF's own strategy). Without TF, importing
+this module works and every symbol raises with guidance, matching upstream's
+gating on framework presence.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as _tf
+    _HAVE_TF = True
+except ImportError:
+    _tf = None
+    _HAVE_TF = False
+
+from horovod_tpu.collective import (  # noqa: F401
+    Average, Sum, Min, Max, Product, Adasum,
+)
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.core import (  # noqa: F401
+    init, shutdown, rank, size, local_rank, local_size, cross_rank,
+    cross_size,
+)
+
+_MSG = ("tensorflow is not installed in this environment; use the JAX "
+        "frontend (horovod_tpu.DistributedOptimizer / "
+        "horovod_tpu.grad) — it is the native TPU path.")
+
+
+def _require_tf():
+    if not _HAVE_TF:
+        raise RuntimeError(_MSG)
+
+
+def allreduce(tensor, op: int = Average, **kwargs):
+    _require_tf()
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+    out = hvd.allreduce(to_stacked(tensor.numpy()), op=op, **kwargs)
+    return _tf.constant(from_stacked(out))
+
+
+def broadcast(tensor, root_rank: int = 0, **kwargs):
+    _require_tf()
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+    out = hvd.broadcast(to_stacked(tensor.numpy()), root_rank, **kwargs)
+    return _tf.constant(from_stacked(out))
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    _require_tf()
+    for v in variables:
+        v.assign(broadcast(v, root_rank))
+
+
+def DistributedGradientTape(tape, *a, **k):
+    _require_tf()
+    raise NotImplementedError(
+        "TF DistributedGradientTape wrapper lands with a TF-enabled image; "
+        "use horovod_tpu.DistributedGradientTape (JAX) on TPU.")
+
+
+def DistributedOptimizer(optimizer, *a, **k):
+    _require_tf()
+    raise NotImplementedError(
+        "TF DistributedOptimizer wrapper lands with a TF-enabled image; "
+        "use horovod_tpu.DistributedOptimizer (optax) on TPU.")

@@ -29,7 +29,12 @@ _TIMELINE: Optional["Timeline"] = None
 
 
 class Timeline:
-    """Chrome-trace (``chrome://tracing`` / Perfetto) event writer."""
+    """Chrome-trace (``chrome://tracing`` / Perfetto) event writer.
+
+    Events stream through the native C appender (``cpp/hvdtpu_core.cpp``,
+    the analogue of the reference's C++ timeline writer) when the library is
+    built; otherwise they buffer in Python and ``flush`` serializes them.
+    """
 
     def __init__(self, path: str):
         self.path = path
@@ -37,16 +42,35 @@ class Timeline:
         self._t0 = time.perf_counter()
         self._pid = os.getpid()
         self._lock = threading.Lock()
+        self._closed = False
+        from horovod_tpu import native
+        try:
+            self._nt = native.NativeTimeline(path) \
+                if native.native_available() else None
+        except (OSError, RuntimeError):
+            self._nt = None
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
-    def marker(self, name: str, category: str = "marker", **args) -> None:
+    def _emit(self, name, cat, ph, ts, dur, tid, args) -> None:
         with self._lock:
-            self._events.append({
-                "name": name, "cat": category, "ph": "i",
-                "ts": self._now_us(), "pid": self._pid, "tid": 0,
-                "s": "g", "args": args})
+            if self._closed:
+                return
+            if self._nt is not None:
+                self._nt.event(name, cat, ts, dur, pid=self._pid, tid=tid,
+                               ph=ph, args_json=json.dumps(args) if args else "")
+            else:
+                ev = {"name": name, "cat": cat, "ph": ph, "ts": ts,
+                      "pid": self._pid, "tid": tid, "args": args}
+                if ph == "X":
+                    ev["dur"] = dur
+                if ph == "i":
+                    ev["s"] = "g"
+                self._events.append(ev)
+
+    def marker(self, name: str, category: str = "marker", **args) -> None:
+        self._emit(name, category, "i", self._now_us(), 0.0, 0, args)
 
     @contextmanager
     def activity(self, name: str, category: str = "collective", **args):
@@ -55,18 +79,21 @@ class Timeline:
         try:
             yield
         finally:
-            with self._lock:
-                self._events.append({
-                    "name": name, "cat": category, "ph": "X",
-                    "ts": t0, "dur": self._now_us() - t0,
-                    "pid": self._pid, "tid": threading.get_ident() % 1_000_000,
-                    "args": args})
+            self._emit(name, category, "X", t0, self._now_us() - t0,
+                       threading.get_ident() % 1_000_000, args)
 
     def flush(self) -> None:
+        """Finalize the trace file (the timeline is closed afterwards)."""
         with self._lock:
-            with open(self.path, "w") as f:
-                json.dump({"traceEvents": self._events,
-                           "displayTimeUnit": "ms"}, f)
+            if self._closed:
+                return
+            self._closed = True
+            if self._nt is not None:
+                self._nt.close()
+            else:
+                with open(self.path, "w") as f:
+                    json.dump({"traceEvents": self._events,
+                               "displayTimeUnit": "ms"}, f)
 
 
 def init_timeline(path: Optional[str] = None) -> Timeline:

@@ -17,8 +17,6 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Optional
 
-import numpy as np
-
 import horovod_tpu as _hvd
 from horovod_tpu.collective import (
     Average, Sum, Min, Max, Product, Adasum, ReduceOp,
@@ -46,17 +44,15 @@ def _torch():
 
 
 def _to_jax_stacked(t):
-    """torch tensor -> per-rank stacked array for the eager engine.
-
-    Single process: every simulated rank holds this process's value
-    (Horovod's invariant that each rank contributes its local tensor)."""
-    arr = t.detach().cpu().numpy()
-    return np.broadcast_to(arr, (size(),) + arr.shape).copy()
+    """torch tensor -> per-rank stacked array (shared bridge convention)."""
+    from horovod_tpu.frontend_bridge import to_stacked
+    return to_stacked(t.detach().cpu().numpy())
 
 
 def _from_stacked(out, like):
+    from horovod_tpu.frontend_bridge import from_stacked
     torch = _torch()
-    return torch.from_numpy(np.asarray(out[0]).copy()).to(like.dtype)
+    return torch.from_numpy(from_stacked(out)).to(like.dtype)
 
 
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,

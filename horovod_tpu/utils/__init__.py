@@ -1,0 +1,4 @@
+"""Utilities: deterministic per-rank RNG, stall watchdog."""
+
+from horovod_tpu.utils.random import rank_fold_key, data_key  # noqa: F401
+from horovod_tpu.utils.stall import HealthWatchdog  # noqa: F401

@@ -1,0 +1,28 @@
+"""Deterministic distributed RNG (SURVEY §5).
+
+The reference seeds per-process (each rank seeds numpy/torch with
+seed+rank in the examples). TPU-native: fold the communicator rank into a
+``jax.random`` key so dropout/augmentation streams are independent per
+device *inside* the compiled step — no host-side per-process state.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from horovod_tpu import core
+
+
+def rank_fold_key(key, axis_name: str = None):
+    """Per-device key inside shard_map: fold in ``lax.axis_index``."""
+    axis = axis_name or core.axis_name()
+    return jax.random.fold_in(key, jax.lax.axis_index(axis))
+
+
+def data_key(seed: int, epoch: int, rank: int = None):
+    """Host-side key for data shuffling: (seed, epoch, process rank)."""
+    r = rank if rank is not None else (
+        jax.process_index() if core.is_initialized() else 0)
+    k = jax.random.PRNGKey(seed)
+    k = jax.random.fold_in(k, epoch)
+    return jax.random.fold_in(k, r)

@@ -1,0 +1,73 @@
+"""Health watchdog: detect a stalled training loop.
+
+Rebuild of upstream ``horovod/common/stall_inspector.cc`` semantics at the
+level TPU allows: cross-rank per-tensor stall detection lives in the native
+coordinator (``native.Coordinator.stall_check``); this module adds the
+host-side heartbeat watchdog (no step progress within ``timeout_s`` fires a
+warning callback — the analogue of the reference's
+HOROVOD_STALL_CHECK_TIME warnings).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = ["HealthWatchdog"]
+
+
+class HealthWatchdog:
+    """Call ``beat()`` every step; if no beat arrives within ``timeout_s``
+    the ``on_stall(seconds_since_beat)`` callback fires (once per stall)."""
+
+    def __init__(self, timeout_s: float = 60.0,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 poll_s: float = 1.0):
+        self.timeout_s = timeout_s
+        self._on_stall = on_stall or (lambda dt: logger.warning(
+            "horovod_tpu: no training progress for %.1fs — one or more "
+            "workers may be stalled or the input pipeline starved", dt))
+        self._poll_s = poll_s
+        self._last = time.monotonic()
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+
+    def start(self) -> "HealthWatchdog":
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self._fired = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            dt = time.monotonic() - self._last
+            if dt > self.timeout_s and not self._fired:
+                self._fired = True
+                self.stall_count += 1
+                try:
+                    self._on_stall(dt)
+                except Exception:
+                    logger.exception("stall callback failed")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -288,8 +288,22 @@ class TestFusion:
 
     def test_threshold_splits_buckets(self, rng):
         from horovod_tpu import fusion
+        # 100 fp32 = 400 B, padded to the 512 B tile stride for capacity
+        # accounting -> two per 1024 B bucket.
         leaves = [jnp.ones((100,), jnp.float32) for _ in range(4)]
-        buckets, unpack = fusion.fuse(leaves, threshold_bytes=800)
-        assert len(buckets) == 2  # 2 x 100 floats = 800 bytes per bucket
+        buckets, unpack = fusion.fuse(leaves, threshold_bytes=1024)
+        assert len(buckets) == 2
         out = unpack(buckets)
         assert all(np.asarray(o).shape == (100,) for o in out)
+
+    def test_python_fallback_matches_native_plan(self):
+        from horovod_tpu import fusion, native
+        if not native.native_available():
+            return
+        sizes = [100, 400, 900, 512, 513, 4096, 1, 511]
+        nat = native.fusion_plan(sizes, 2048,
+                                 align_bytes=fusion.FUSION_ALIGN_BYTES)
+        import unittest.mock as mock
+        with mock.patch.object(native, "fusion_plan", return_value=None):
+            py = fusion._plan_buckets(sizes, 2048)
+        assert nat == py
