@@ -14,6 +14,7 @@ V100 figure from the reference's own benchmark suite docs.
 import json
 import sys
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +56,9 @@ def main():
         loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
         return loss, updates["batch_stats"]
 
-    @jax.jit
+    # Donating params/batch_stats/opt_state lets XLA update them in place,
+    # halving HBM traffic for the weight tensors on the update path.
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, images, labels):
         (loss, batch_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, images, labels)

@@ -25,6 +25,9 @@ class BertConfig:
     type_vocab_size: int = 2
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # "dense" | "flash" (fused pallas kernel; the key-padding mask rides the
+    # kernel's key_bias input).
+    attention: str = "dense"
 
     @staticmethod
     def large() -> "BertConfig":
@@ -49,11 +52,10 @@ class EncoderLayer(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D // H) ** -0.5
-        logits = jnp.where(mask[:, None, None, :], logits.astype(jnp.float32),
-                           -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+        from horovod_tpu.ops.attention import multihead_attention
+        att = multihead_attention(q, k, v, impl=cfg.attention, causal=False,
+                                  key_mask=mask,
+                                  out_dtype=cfg.dtype).reshape(B, T, D)
         att = nn.Dense(D, dtype=cfg.dtype, name="out")(att)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + att)
         h = nn.Dense(4 * D, dtype=cfg.dtype, name="fc")(x)

@@ -35,6 +35,8 @@ class GPT2Config:
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     use_ring_attention: bool = False  # sequence-parallel attention (ops/)
+    # "dense" | "flash" (fused pallas kernel, single-device/dp layouts).
+    attention: str = "dense"
 
     @staticmethod
     def medium() -> "GPT2Config":
@@ -60,15 +62,16 @@ class Attention(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
+            if cfg.attention != "dense":
+                raise ValueError(
+                    "use_ring_attention=True overrides attention=; set "
+                    "attention='dense' (the ring path fuses its own blocks)")
             from horovod_tpu.ops.ring_attention import ring_attention
             o = ring_attention(q, k, v, axis_name="sp", causal=True)
         else:
-            scale = (D // H) ** -0.5
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            from horovod_tpu.ops.attention import multihead_attention
+            o = multihead_attention(q, k, v, impl=cfg.attention, causal=True,
+                                    out_dtype=cfg.dtype)
         o = o.reshape(B, T, D)
         return nn.Dense(D, dtype=cfg.dtype, name="out")(o)
 

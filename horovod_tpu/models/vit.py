@@ -25,6 +25,9 @@ class ViTConfig:
     d_model: int = 768
     mlp_dim: int = 3072
     dtype: jnp.dtype = jnp.bfloat16
+    # "dense" | "flash" (fused pallas kernel; the 197-token sequence runs as
+    # one full-sequence block).
+    attention: str = "dense"
 
     @staticmethod
     def b16() -> "ViTConfig":
@@ -50,10 +53,9 @@ class ViTBlock(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D // H) ** -0.5
-        probs = jax.nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(cfg.dtype)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+        from horovod_tpu.ops.attention import multihead_attention
+        att = multihead_attention(q, k, v, impl=cfg.attention, causal=False,
+                                  out_dtype=cfg.dtype).reshape(B, T, D)
         x = x + nn.Dense(D, dtype=cfg.dtype, name="out")(att)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="fc")(y)

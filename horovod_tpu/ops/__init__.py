@@ -1,5 +1,6 @@
 """TPU compute ops: ring attention, sequence-parallel attention, pallas
 kernels for hot paths."""
 
+from horovod_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from horovod_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from horovod_tpu.ops.sequence import ulysses_attention  # noqa: F401

@@ -1,0 +1,70 @@
+"""Shared multi-head attention dispatch for the model zoo.
+
+One definition of the dense-vs-flash choice (scale, masking constant, pallas
+kernel call) used by GPT-2, BERT and ViT, so the implementations cannot
+diverge. Mirrors how the reference funnels every frontend through one
+attention codepath (upstream frameworks' fused kernels); here the fused path
+is the pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["multihead_attention", "ATTENTION_IMPLS"]
+
+ATTENTION_IMPLS = ("dense", "flash")
+
+_NEG_INF = -1e30
+
+
+def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        *, impl: str, causal: bool,
+                        key_mask: Optional[jnp.ndarray] = None,
+                        out_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """softmax(q k^T / sqrt(d) [+ masks]) v over (B, T, H, D) tensors.
+
+    Args:
+      impl: "dense" (materialised scores, fp32 softmax) or "flash" (fused
+        pallas kernel). Anything else raises — a typo must not silently
+        train on the wrong path.
+      causal: autoregressive mask.
+      key_mask: optional (B, T_kv) bool; False keys are masked out
+        (key-padding).
+      out_dtype: dtype of the returned tensor (defaults to q.dtype).
+
+    Returns (B, T_q, H, D).
+    """
+    if impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected one of "
+            f"{ATTENTION_IMPLS}")
+    out_dtype = q.dtype if out_dtype is None else out_dtype
+    d = q.shape[-1]
+
+    if impl == "flash":
+        from horovod_tpu.ops.flash_attention import flash_attention
+        key_bias = None
+        if key_mask is not None:
+            key_bias = jnp.where(key_mask, 0.0, _NEG_INF).astype(jnp.float32)
+        return flash_attention(q, k, v, causal=causal,
+                               key_bias=key_bias).astype(out_dtype)
+
+    scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :], s, _NEG_INF)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(out_dtype)
+    if key_mask is not None:
+        # A row whose keys are all masked softmaxes to uniform garbage;
+        # return zeros instead, matching the flash kernel's contract.
+        any_visible = jnp.any(key_mask, axis=-1)[:, None, None, None]
+        p = jnp.where(any_visible, p, 0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,266 @@
+"""Flash attention (pallas kernel, interpret mode on CPU) == dense attention
+(SURVEY §4; kernels run the same code path Mosaic compiles on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import flash_attention
+
+
+def dense_attention(q, k, v, causal, scale=None):
+    d = q.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k).astype(np.float64) * scale
+    if causal:
+        t = q.shape[1]
+        mask = np.tril(np.ones((t, t), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+    logits = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(logits)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(rng, causal):
+    B, T, H, D = 2, 64, 2, 16
+    q, k, v = (rng.standard_normal((B, T, H, D)).astype(np.float32)
+               for _ in range(3))
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out),
+                               dense_attention(q, k, v, causal),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_dense(rng, causal):
+    B, T, H, D = 1, 32, 2, 8
+    q, k, v = (rng.standard_normal((B, T, H, D)).astype(np.float32)
+               for _ in range(3))
+    tgt = rng.standard_normal((B, T, H, D)).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        return jnp.mean((o - tgt) ** 2)
+
+    def loss_dense(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * d ** -0.5
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.mean((o - tgt) ** 2)
+
+    args = tuple(map(jnp.asarray, (q, k, v)))
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(*args)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(*args)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_non_divisible_seq_default_blocks(rng):
+    # T=17 with the default (256, 512) blocks clamps to one ragged block.
+    B, T, H, D = 1, 17, 2, 8
+    q, k, v = (rng.standard_normal((B, T, H, D)).astype(np.float32)
+               for _ in range(3))
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=False)
+    np.testing.assert_allclose(np.asarray(out),
+                               dense_attention(q, k, v, False),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_cross_attention_shapes(rng):
+    B, Tq, Tk, H, D = 1, 16, 32, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block_q=8, block_k=8)
+    assert out.shape == (B, Tq, H, D)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        dense_attention(np.asarray(q), np.asarray(k), np.asarray(v), False),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_unknown_attention_impl_raises(rng):
+    from horovod_tpu.ops.attention import multihead_attention
+    q = jnp.zeros((1, 8, 1, 4))
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        multihead_attention(q, q, q, impl="Flash", causal=False)
+    # ... including through a model config typo.
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(attention="pallas")
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
+
+
+def test_ring_attention_conflicts_with_flash():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(attention="flash", use_ring_attention=True)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="use_ring_attention"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
+
+
+def test_flash_causal_requires_square():
+    q = jnp.zeros((1, 16, 1, 8))
+    k = jnp.zeros((1, 32, 1, 8))
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v=k, causal=True)
+
+
+@pytest.mark.parametrize("tq,tk", [(17, 17), (40, 24)])
+def test_flash_ragged_blocks_match_dense(rng, tq, tk):
+    # Lengths that don't divide the block size exercise the cdiv grid +
+    # position-masked edge blocks (ViT's 197-token case).
+    B, H, D = 1, 2, 8
+    q = rng.standard_normal((B, tq, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, tk, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, tk, H, D)).astype(np.float32)
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=False, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out),
+                               dense_attention(q, k, v, False),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_ragged_grads_match_dense(rng):
+    B, T, H, D = 1, 20, 2, 8
+
+    def run(attn):
+        q, k, v = (jnp.asarray(rng2.standard_normal((B, T, H, D)),
+                               jnp.float32) for rng2 in
+                   (np.random.default_rng(i) for i in range(3)))
+
+        def loss(q, k, v):
+            return jnp.mean(attn(q, k, v) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    gf = run(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                             block_q=8, block_k=8))
+    gd = run(dense)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_key_bias_matches_masked_dense(rng):
+    # key_bias carries a BERT-style key-padding mask through the kernel.
+    B, T, H, D = 2, 32, 2, 8
+    q, k, v = (rng.standard_normal((B, T, H, D)).astype(np.float32)
+               for _ in range(3))
+    valid = np.ones((B, T), bool)
+    valid[0, 20:] = False
+    valid[1, 5:] = False
+    bias = np.where(valid, 0.0, -1e30).astype(np.float32)
+
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=False, key_bias=jnp.asarray(bias),
+                          block_q=8, block_k=8)
+    # Dense reference with the same additive bias.
+    s = (np.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5 +
+         bias[:, None, None, :])
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_key_bias_gradient_matches_dense(rng):
+    # key_bias is differentiable (ALiBi-style learned biases).
+    B, T, H, D = 2, 24, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    bias0 = jnp.asarray(rng.standard_normal((B, T)), jnp.float32)
+
+    def loss_flash(bias):
+        o = flash_attention(q, k, v, causal=False, key_bias=bias,
+                            block_q=8, block_k=8)
+        return jnp.mean(o ** 2)
+
+    def loss_dense(bias):
+        s = (jnp.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5 +
+             bias[:, None, None, :])
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.mean(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+
+    gf = jax.grad(loss_flash)(bias0)
+    gd = jax.grad(loss_dense)(bias0)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_dense_and_flash_agree_on_fully_masked_rows(rng):
+    # An all-padding batch item must yield zeros from both impls.
+    from horovod_tpu.ops.attention import multihead_attention
+    B, T, H, D = 2, 16, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(np.array([[True] * T, [False] * T]))
+    out_d = multihead_attention(q, k, v, impl="dense", causal=False,
+                                key_mask=mask)
+    out_f = multihead_attention(q, k, v, impl="flash", causal=False,
+                                key_mask=mask)
+    np.testing.assert_allclose(np.asarray(out_d[1]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_f[1]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_d[0]), np.asarray(out_f[0]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bert_flash_config_matches_dense(rng):
+    from horovod_tpu.models.bert import Bert, BertConfig
+    import dataclasses
+    cfg_d = dataclasses.replace(BertConfig.tiny(), dtype=jnp.float32)
+    cfg_f = dataclasses.replace(cfg_d, attention="flash")
+    tokens = jnp.asarray(rng.integers(0, 256, (2, 24)), jnp.int32)
+    types = jnp.zeros_like(tokens)
+    mask = jnp.asarray(np.arange(24)[None, :] <
+                       np.array([24, 13])[:, None])  # one padded row
+    params = Bert(cfg_d).init(jax.random.PRNGKey(0), tokens, types, mask)
+    out_d = Bert(cfg_d).apply(params, tokens, types, mask)
+    out_f = Bert(cfg_f).apply(params, tokens, types, mask)
+    for a, b in zip(jax.tree_util.tree_leaves(out_d),
+                    jax.tree_util.tree_leaves(out_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_vit_flash_config_matches_dense(rng):
+    from horovod_tpu.models.vit import ViT, ViTConfig
+    import dataclasses
+    cfg_d = dataclasses.replace(ViTConfig.tiny(), dtype=jnp.float32)
+    cfg_f = dataclasses.replace(cfg_d, attention="flash")
+    images = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    params = ViT(cfg_d).init(jax.random.PRNGKey(0), images)
+    out_d = ViT(cfg_d).apply(params, images)
+    out_f = ViT(cfg_f).apply(params, images)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_gpt2_flash_config_matches_dense(rng):
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg_d = GPT2Config.tiny(dtype=jnp.float32)
+    cfg_f = GPT2Config.tiny(dtype=jnp.float32, attention="flash")
+    tokens = jnp.asarray(rng.integers(0, 256, (2, 32)), jnp.int32)
+    params = GPT2(cfg_d).init(jax.random.PRNGKey(0), tokens)
+    out_d = GPT2(cfg_d).apply(params, tokens)
+    out_f = GPT2(cfg_f).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
+                               rtol=1e-3, atol=1e-3)
