@@ -37,6 +37,12 @@ class GPT2Config:
     use_ring_attention: bool = False  # sequence-parallel attention (ops/)
     # "dense" | "flash" (fused pallas kernel, single-device/dp layouts).
     attention: str = "dense"
+    # > 0 replaces every block's dense MLP with an expert-parallel MoE MLP
+    # (ops/moe.py); experts shard over the "ep" mesh axis. Aux load-balance
+    # losses are sown into the "losses" collection — train with
+    # mutable=["losses"] and add their mean (see examples / loss_fn_moe).
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
 
     @staticmethod
     def medium() -> "GPT2Config":
@@ -82,6 +88,13 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True):
         cfg = self.cfg
+        if cfg.num_experts > 0:
+            from horovod_tpu.ops.moe import MoEMLP
+            out, aux = MoEMLP(cfg.num_experts, 4 * cfg.d_model,
+                              cfg.expert_capacity_factor, cfg.dtype,
+                              name="moe")(x)
+            self.sow("losses", "moe_aux", aux)
+            return out
         h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="fc")(x)
         h = nn.gelu(h)
         return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(h)
@@ -138,6 +151,10 @@ def partition_rules() -> PartitionRules:
         (r"mlp/proj/kernel", P("tp", None)),
         (r"attn/qkv/bias", P("tp")),
         (r"mlp/fc/bias", P("tp")),
+        # MoE experts shard over ep (GShard-style); router stays replicated.
+        (r"moe/(w_in|w_out)$", P("ep", None, None)),
+        (r"moe/(b_in|b_out)$", P("ep", None)),
+        (r"moe/router/router$", P()),
         (r"(ln1|ln2|ln_f)/(scale|bias)", P()),
     ])
 
@@ -149,3 +166,16 @@ def loss_fn(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def loss_fn_moe(model: "GPT2", params, tokens: jnp.ndarray,
+                aux_weight: float = 1e-2) -> jnp.ndarray:
+    """Cross entropy + Switch aux load-balance loss for MoE configs."""
+    if model.cfg.num_experts <= 0:
+        raise ValueError("loss_fn_moe needs an MoE config "
+                         f"(num_experts={model.cfg.num_experts}); use "
+                         "loss_fn for dense models")
+    logits, state = model.apply({"params": params}, tokens,
+                                mutable=["losses"])
+    aux = jnp.mean(jnp.stack(jax.tree_util.tree_leaves(state["losses"])))
+    return loss_fn(logits, tokens) + aux_weight * aux

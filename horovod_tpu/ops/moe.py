@@ -1,0 +1,135 @@
+"""Mixture-of-Experts with expert parallelism (the ``ep`` mesh axis).
+
+The reference stack reaches MoE scale through NCCL all-to-all in
+Megatron/DeepSpeed layers built on top of hvd; here expert parallelism is a
+first-class mesh axis. TPU-first design (Switch Transformer / GShard lineage,
+PAPERS.md):
+
+- Routing is the classic one-hot dispatch/combine einsum formulation —
+  static shapes only (capacity-bounded), so the whole layer traces into one
+  XLA program. No gather/scatter with dynamic shapes.
+- Expert weights carry a leading ``num_experts`` dim sharded over ``ep``
+  (see ``models/gpt2.partition_rules``); the dispatch einsum then contracts a
+  token-sharded operand against an expert-sharded operand and GSPMD inserts
+  the all-to-all over ICI — the same comm pattern the reference gets from
+  NCCL alltoall, derived by the compiler instead of hand-written.
+- Router math in fp32 (logits/softmax are precision-sensitive), expert FFN
+  in bf16 on the MXU.
+- Auxiliary load-balance loss (Switch eq. 4) keeps routing uniform; it is
+  returned so the model can add it to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Top1Router", "MoEMLP", "switch_load_balance_loss"]
+
+
+def switch_load_balance_loss(router_probs: jnp.ndarray,
+                             expert_index: jnp.ndarray) -> jnp.ndarray:
+    """Switch Transformer aux loss: E * sum_e f_e * P_e.
+
+    f_e = fraction of tokens routed to expert e, P_e = mean router prob for
+    e. Minimised (= 1) at uniform routing.
+
+    Args:
+      router_probs: (N, E) fp32 softmax outputs.
+      expert_index: (N,) int32 argmax expert per token.
+    """
+    num_experts = router_probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(expert_index, num_experts, dtype=jnp.float32),
+                 axis=0)
+    p = jnp.mean(router_probs, axis=0)
+    return num_experts * jnp.sum(f * p)
+
+
+class Top1Router(nn.Module):
+    """Switch-style top-1 router with static capacity.
+
+    Produces one-hot dispatch/combine tensors of shape (N, E, C): token n
+    goes to slot c of expert e. Tokens over capacity are dropped (their
+    combine weights are zero → they pass through the residual unchanged),
+    exactly the Switch semantics.
+    """
+    num_experts: int
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        n, d = x.shape
+        e = self.num_experts
+        c = max(1, int(self.capacity_factor * n / e))
+
+        router = self.param("router", nn.initializers.normal(0.02), (d, e),
+                            jnp.float32)
+        logits = x.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_index = jnp.argmax(probs, axis=-1)
+        expert_gate = jnp.max(probs, axis=-1)
+
+        onehot = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)
+        # Position of each token within its expert's queue (0-based).
+        position_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        within_capacity = position_in_expert < c
+        onehot = onehot * within_capacity
+
+        # (N, E, C) one-hot over capacity slots.
+        slot = jax.nn.one_hot(
+            jnp.sum(position_in_expert, axis=-1).astype(jnp.int32), c,
+            dtype=jnp.float32)
+        dispatch = onehot[..., None] * slot[:, None, :]
+        combine = expert_gate[:, None, None] * dispatch
+
+        aux_loss = switch_load_balance_loss(probs, expert_index)
+        return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP block: drop-in for a transformer's dense FFN.
+
+    Returns ``(out, aux_loss)``; callers add ``aux_loss`` (scaled by
+    ``aux_loss_weight``, typically 1e-2) to the training objective.
+    """
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        b, t, d = x.shape
+        e, f = self.num_experts, self.d_ff
+        tokens = x.reshape(b * t, d)
+
+        dispatch, combine, aux_loss = Top1Router(
+            self.num_experts, self.capacity_factor, name="router")(tokens)
+
+        w_in = self.param("w_in", nn.initializers.lecun_normal(), (e, d, f),
+                          jnp.float32)
+        b_in = self.param("b_in", nn.initializers.zeros, (e, f), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(), (e, f, d),
+                           jnp.float32)
+        b_out = self.param("b_out", nn.initializers.zeros, (e, d),
+                           jnp.float32)
+
+        # Dispatch: (N, E, C) x (N, D) -> (E, C, D). Contracting the
+        # token-sharded axis against expert-sharded weights is where GSPMD
+        # inserts the ep all-to-all.
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
+                               tokens.astype(self.dtype))
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_in.astype(self.dtype)) + b_in[:, None].astype(
+                           self.dtype)
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                w_out.astype(self.dtype)) + b_out[
+                                    :, None].astype(self.dtype)
+        # Combine back to token order; dropped tokens get zeros.
+        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype),
+                         expert_out)
+        return out.reshape(b, t, d), aux_loss

@@ -1,0 +1,134 @@
+"""Mixture-of-Experts routing / expert-parallel layer (SURVEY §2 row 26 —
+ep joins dp/tp/sp/pp as a first-class mesh axis)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.moe import MoEMLP, Top1Router, switch_load_balance_loss
+
+
+def test_router_dispatch_is_one_hot_and_capacity_bounded(rng):
+    n, d, e = 32, 8, 4
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    router = Top1Router(num_experts=e, capacity_factor=1.0)
+    params = router.init(jax.random.PRNGKey(0), x)
+    dispatch, combine, aux = router.apply(params, x)
+    c = dispatch.shape[-1]
+    assert dispatch.shape == (n, e, c) and c == n // e
+
+    d_np = np.asarray(dispatch)
+    # Each token occupies at most one (expert, slot) pair.
+    assert np.all(d_np.reshape(n, -1).sum(-1) <= 1.0 + 1e-6)
+    # Each (expert, slot) holds at most one token.
+    assert np.all(d_np.reshape(n, -1).sum(0) <= 1.0 + 1e-6)
+    # Combine weights equal the router prob on dispatched slots.
+    comb = np.asarray(combine)
+    assert np.all(comb[d_np > 0] > 0)
+    assert float(aux) >= 1.0 - 1e-3  # E * sum f*p is minimised at 1
+
+
+def test_load_balance_loss_uniform_is_one():
+    n, e = 64, 8
+    probs = jnp.full((n, e), 1.0 / e)
+    idx = jnp.asarray(np.arange(n) % e, jnp.int32)
+    assert abs(float(switch_load_balance_loss(probs, idx)) - 1.0) < 1e-5
+
+
+def test_moe_identical_experts_matches_gated_dense(rng):
+    # With every expert holding the same weights and ample capacity, the MoE
+    # output equals gate_prob * dense_mlp(x) token-wise.
+    b, t, d, f, e = 2, 8, 8, 16, 4
+    x = jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+    layer = MoEMLP(num_experts=e, d_ff=f, capacity_factor=float(e),
+                   dtype=jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+
+    params["w_in"] = jnp.broadcast_to(params["w_in"][:1],
+                                      params["w_in"].shape)
+    params["w_out"] = jnp.broadcast_to(params["w_out"][:1],
+                                       params["w_out"].shape)
+
+    out, aux = layer.apply({"params": params}, x)
+
+    tokens = x.reshape(-1, d)
+    logits = tokens @ np.asarray(params["router"]["router"])
+    gate = jax.nn.softmax(logits, axis=-1).max(axis=-1)
+    h = jax.nn.gelu(tokens @ params["w_in"][0] + params["b_in"][0])
+    dense = h @ params["w_out"][0] + params["b_out"][0]
+    expected = (gate[:, None] * dense).reshape(b, t, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_gradients_flow_to_all_params(rng):
+    b, t, d, f, e = 2, 8, 8, 16, 4
+    x = jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+    layer = MoEMLP(num_experts=e, d_ff=f, dtype=jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p):
+        out, aux = layer.apply({"params": p}, x)
+        return jnp.mean(out ** 2) + 1e-2 * aux
+
+    grads = jax.grad(loss)(params)
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        assert float(jnp.max(jnp.abs(g))) > 0, path
+
+
+def test_moe_sharded_over_ep_matches_single_device(rng):
+    b, t, d, f, e = 2, 16, 8, 16, 4
+    x = jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+    layer = MoEMLP(num_experts=e, d_ff=f, capacity_factor=2.0,
+                   dtype=jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    ref, ref_aux = layer.apply({"params": params}, x)
+
+    from horovod_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    ep_sharded = {
+        "router": {"router": NamedSharding(mesh, P())},
+        "w_in": NamedSharding(mesh, P("ep")),
+        "b_in": NamedSharding(mesh, P("ep")),
+        "w_out": NamedSharding(mesh, P("ep")),
+        "b_out": NamedSharding(mesh, P("ep")),
+    }
+    params_s = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, s), params, ep_sharded,
+        is_leaf=lambda v: isinstance(v, jnp.ndarray))
+    x_s = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+    out, aux = jax.jit(lambda p, x: layer.apply({"params": p}, x))(params_s,
+                                                                   x_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_gpt2_moe_trains(rng):
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn_moe
+    import optax
+    cfg = GPT2Config.tiny(dtype=jnp.float32, num_experts=4)
+    model = GPT2(cfg)
+    tokens = jnp.asarray(rng.integers(0, 256, (2, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert "moe" in params["h0"]["mlp"], list(params["h0"]["mlp"])
+
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        l, g = jax.value_and_grad(
+            lambda p: loss_fn_moe(model, p, tokens))(params)
+        u, state2 = opt.update(g, state, params)
+        return optax.apply_updates(params, u), state2, l
+
+    losses = []
+    for _ in range(10):
+        params, state, l = step(params, state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
