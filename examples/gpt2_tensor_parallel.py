@@ -4,6 +4,10 @@ the collectives the reference's NCCL stack would issue by hand.
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # Force the platform via config: env-var-only selection can still try to

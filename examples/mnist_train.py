@@ -8,6 +8,10 @@ Virtual 8-dev CPU:    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # Force the platform via config: env-var-only selection can still try to

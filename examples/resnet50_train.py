@@ -4,6 +4,10 @@ checkpointing, timeline, and the health watchdog — synthetic ImageNet shapes.
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # Force the platform via config: env-var-only selection can still try to

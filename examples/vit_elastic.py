@@ -5,6 +5,10 @@ devices after a few steps) so the recovery path actually executes.
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # Force the platform via config: env-var-only selection can still try to

@@ -1,0 +1,30 @@
+"""PyTorch Lightning integration surface (upstream
+``horovod/spark/lightning`` + the Lightning ``HorovodStrategy``).
+
+API-parity stubs: pytorch-lightning is not in the TPU image. The equivalent
+capability — a trainer loop with distributed optimizer wrapping, metric
+averaging and checkpointing — is provided natively by
+``horovod_tpu.DistributedOptimizer`` + ``horovod_tpu.callbacks`` +
+``horovod_tpu.checkpoint``.
+"""
+
+from __future__ import annotations
+
+_MSG = ("horovod_tpu.lightning requires the pytorch-lightning package, "
+        "which is not in this environment. Use horovod_tpu.callbacks for "
+        "training-loop hooks, horovod_tpu.DistributedOptimizer for gradient "
+        "synchronisation, and horovod_tpu.checkpoint for checkpointing.")
+
+
+def _unavailable(*_a, **_k):
+    raise RuntimeError(_MSG)
+
+
+class TorchEstimator:
+    def __init__(self, *a, **k):
+        _unavailable()
+
+
+class HorovodStrategy:
+    def __init__(self, *a, **k):
+        _unavailable()

@@ -91,6 +91,13 @@ class TestStubs:
         with pytest.raises(RuntimeError, match="runner"):
             ray.RayExecutor()
 
+    def test_lightning_surface(self):
+        import horovod_tpu.lightning as hl
+        with pytest.raises(RuntimeError, match="DistributedOptimizer"):
+            hl.HorovodStrategy()
+        with pytest.raises(RuntimeError):
+            hl.TorchEstimator()
+
     def test_tensorflow_surface_without_tf(self):
         import horovod_tpu.tensorflow as hvd_tf
         assert hvd_tf.size() == hvd.size()
