@@ -68,12 +68,16 @@ class Attention(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
-            if cfg.attention != "dense":
+            if cfg.attention == "flash":
+                from horovod_tpu.ops.ring_flash import ring_flash_attention
+                o = ring_flash_attention(q, k, v, axis_name="sp", causal=True)
+            elif cfg.attention == "dense":
+                from horovod_tpu.ops.ring_attention import ring_attention
+                o = ring_attention(q, k, v, axis_name="sp", causal=True)
+            else:
                 raise ValueError(
-                    "use_ring_attention=True overrides attention=; set "
-                    "attention='dense' (the ring path fuses its own blocks)")
-            from horovod_tpu.ops.ring_attention import ring_attention
-            o = ring_attention(q, k, v, axis_name="sp", causal=True)
+                    f"unknown attention impl {cfg.attention!r} for the ring "
+                    "path; expected 'dense' or 'flash'")
         else:
             from horovod_tpu.ops.attention import multihead_attention
             o = multihead_attention(q, k, v, impl=cfg.attention, causal=True,
@@ -119,12 +123,23 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True):
         cfg = self.cfg
+        if cfg.use_ring_attention and cfg.attention not in ("dense",
+                                                            "flash"):
+            raise ValueError(
+                f"unknown attention impl {cfg.attention!r} for the ring "
+                "path; expected 'dense' or 'flash'")
         B, T = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        pos = jnp.arange(T)
+        if cfg.use_ring_attention:
+            # Sequence-parallel: this shard holds global positions
+            # [rank*T, (rank+1)*T) — rank-major, matching the ring's causal
+            # mask. wpe must be indexed with the global positions.
+            pos = pos + jax.lax.axis_index("sp") * T
+        x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))

@@ -4,4 +4,5 @@ kernels for hot paths."""
 from horovod_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from horovod_tpu.ops.moe import MoEMLP, Top1Router  # noqa: F401
 from horovod_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from horovod_tpu.ops.ring_flash import ring_flash_attention  # noqa: F401
 from horovod_tpu.ops.sequence import ulysses_attention  # noqa: F401

@@ -292,15 +292,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
             db_ref[0] = db_acc[:][:, None]
 
 
-def _bwd(h, scale, causal, block_q, block_k, res, do):
+def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None):
     q, k, v, bias, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
     bq, bk = _block_sizes(tq, tk, block_q, block_k)
 
-    # delta_i = sum_d dO_i . O_i — the softmax-normalisation term of dS.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                    keepdims=True)
+    if delta is None:
+        # delta_i = sum_d dO_i . O_i — the softmax-normalisation term of dS.
+        # Ring callers precompute it once (it is invariant across ring hops).
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   tq=tq, tk=tk)

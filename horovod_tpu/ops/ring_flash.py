@@ -1,0 +1,201 @@
+"""Ring flash attention: exact attention over device-sharded sequences with
+the pallas flash kernel as the per-block compute.
+
+`ring_attention.py` holds the jnp-level reference implementation (scores
+materialised per block, autodiff backward). This module is the production
+path for long context: each ring step runs the fused flash kernel
+(VMEM-tiled, MXU matmuls) on the resident K/V block, and the backward pass
+is a hand-written second ring that reuses the flash backward kernels —
+dK/dV partial sums travel around the ring with their blocks, so gradients
+for every block arrive back at its home device after n hops. (Liu et al.
+2023 blockwise ring attention; FlashAttention-2 block math. PAPERS.md
+lineage.)
+
+Causality across shards decomposes per (query-shard r, key-shard src) into
+three static kernel modes — full (src < r), local-causal (src == r), and
+skip (src > r) — selected at runtime with ``lax.switch``; global softmax
+normalisation uses the per-block logsumexp merged in log space.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Import from the module path directly: the package __init__ rebinds the
+# name `flash_attention` to the public function, shadowing the module.
+from horovod_tpu.ops.flash_attention import _bwd as _fa_bwd
+from horovod_tpu.ops.flash_attention import _fwd as _fa_fwd
+
+__all__ = ["ring_flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _pack(x):
+    # (B, T, H, D) -> (B*H, T, D)
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unpack(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _block_fwd(q, k, v, causal, scale, bq, bk):
+    """One flash forward on packed arrays → (o f32 (bh,t,d), lse (bh,t))."""
+    o, lse = _fa_fwd(q, k, v, None, 1, scale, causal, bq, bk)
+    return o.astype(jnp.float32), lse[..., 0]
+
+
+def _safe_merge(o_acc, lse_acc, o_b, lse_b):
+    """Log-space merge of two normalised partial attentions."""
+    lse_new = jnp.logaddexp(lse_acc, lse_b)
+    # exp(-1e30 - -1e30) would be 1; gate on the accumulator being live.
+    w_acc = jnp.where(lse_acc > _NEG_INF / 2,
+                      jnp.exp(lse_acc - lse_new), 0.0)
+    w_b = jnp.where(lse_b > _NEG_INF / 2, jnp.exp(lse_b - lse_new), 0.0)
+    o_new = o_acc * w_acc[..., None] + o_b * w_b[..., None]
+    return o_new, lse_new
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, scale, bq, bk):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk)
+    return o
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk):
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    bh, tq, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def full_b(q, k, v):
+        return _block_fwd(q, k, v, False, scale, bq, bk)
+
+    def causal_b(q, k, v):
+        return _block_fwd(q, k, v, True, scale, bq, bk)
+
+    def skip_b(q, k, v):
+        return (jnp.zeros((bh, tq, d), jnp.float32),
+                jnp.full((bh, tq), _NEG_INF, jnp.float32))
+
+    def step(carry, i):
+        o_acc, lse_acc, k, v = carry
+        src = (rank - i) % n
+        if causal:
+            mode = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+        else:
+            mode = 0
+        o_b, lse_b = lax.switch(mode, [full_b, causal_b, skip_b], q, k, v)
+        o_acc, lse_acc = _safe_merge(o_acc, lse_acc, o_b, lse_b)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (o_acc, lse_acc, k, v), None
+
+    o0 = jnp.zeros((bh, tq, d), jnp.float32)
+    lse0 = jnp.full((bh, tq), _NEG_INF, jnp.float32)
+    (o, lse, k, v), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, bq, bk):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, bq, bk, res, do):
+    q, k, v, o, lse = res
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    do = do.astype(q.dtype)
+    lse_in = lse[..., None]
+    # delta = dO.O is invariant across ring hops; compute once, not per step.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def grads_block(q, k, v, causal_mode):
+        # Reuse the flash backward kernels with the *global* lse and the
+        # precomputed global delta: p then equals the globally-normalised
+        # attention prob of this block.
+        dq, dk, dv, _ = _fa_bwd(
+            1, scale, causal_mode, bq, bk, (q, k, v, None, o, lse_in), do,
+            delta=delta)
+        return dq.astype(jnp.float32), dk.astype(jnp.float32), \
+            dv.astype(jnp.float32)
+
+    def full_b(q, k, v):
+        return grads_block(q, k, v, False)
+
+    def causal_b(q, k, v):
+        return grads_block(q, k, v, True)
+
+    def skip_b(q, k, v):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k.shape, jnp.float32),
+                jnp.zeros(v.shape, jnp.float32))
+
+    def step(carry, i):
+        dq_acc, k, v, dk_acc, dv_acc = carry
+        src = (rank - i) % n
+        if causal:
+            mode = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+        else:
+            mode = 0
+        dq_b, dk_b, dv_b = lax.switch(mode, [full_b, causal_b, skip_b],
+                                      q, k, v)
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        # dK/dV partial sums travel with their K/V block; after n hops the
+        # block (and its completed gradient) is home again.
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        return (dq_acc, k, v, dk_acc, dv_acc), None
+
+    z = jnp.zeros(q.shape, jnp.float32)
+    zk = jnp.zeros(k.shape, jnp.float32)
+    (dq, k, v, dk, dv), _ = lax.scan(
+        step, (z, k, v, zk, jnp.zeros_like(zk)), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None,
+                         block_q: int = 256,
+                         block_k: int = 512) -> jnp.ndarray:
+    """Exact attention with q/k/v sequence-sharded across ``axis_name``.
+
+    Same contract as ``ring_attention`` (rank-major global order, causal
+    across shards), but the per-block compute is the fused pallas flash
+    kernel and the backward pass is a second explicit ring. Use inside
+    ``shard_map``/``hvd.spmd``.
+
+    Args:
+      q, k, v: (batch, t_local, heads, head_dim) — this device's shard.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: global causal mask.
+      scale: logit scale; defaults to head_dim**-0.5.
+      block_q, block_k: flash kernel tile sizes.
+
+    Returns (batch, t_local, heads, head_dim), dtype of ``q``.
+    """
+    b, t, h, d = q.shape
+    scale = d ** -0.5 if scale is None else scale
+    o = _ring(_pack(q), _pack(k), _pack(v), axis_name, bool(causal),
+              float(scale), int(block_q), int(block_k))
+    return _unpack(o, b, h)

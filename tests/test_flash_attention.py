@@ -102,11 +102,49 @@ def test_unknown_attention_impl_raises(rng):
         GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
 
 
-def test_ring_attention_conflicts_with_flash():
+def test_gpt2_ring_flash_matches_ring_dense(rng):
+    # Sequence-parallel GPT-2: the ring-flash path must equal the jnp ring
+    # path on an sp-sharded mesh.
+    import horovod_tpu as hvd
+    from jax.sharding import PartitionSpec as P
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config
-    cfg = GPT2Config.tiny(attention="flash", use_ring_attention=True)
+    from horovod_tpu.parallel import make_mesh
+
+    tokens = jnp.asarray(rng.integers(0, 256, (2, 64)), jnp.int32)
+
+    # init outside shard_map must not trace the ring ops — use the dense
+    # single-device config (identical param structure).
+    params = GPT2(GPT2Config.tiny(dtype=jnp.float32)).init(
+        jax.random.PRNGKey(0), tokens[:, :8])
+
+    def run(attention):
+        cfg = GPT2Config.tiny(dtype=jnp.float32, use_ring_attention=True,
+                              attention=attention)
+        model = GPT2(cfg)
+        hvd.init(axis_name="sp")
+        try:
+            fwd = hvd.spmd(lambda p, t: model.apply(p, t),
+                           in_specs=(P(), P(None, "sp")),
+                           out_specs=P(None, "sp"))
+            return np.asarray(fwd(params, tokens))
+        finally:
+            hvd.init()  # restore the default communicator for other tests
+
+    # Both ring variants must equal the single-device full-sequence model —
+    # not merely each other (a shared defect, e.g. local-position embedding
+    # under sp, would slip a pairwise check).
+    ref_model = GPT2(GPT2Config.tiny(dtype=jnp.float32))
+    want = np.asarray(ref_model.apply(params, tokens))
+    got_flash, got_dense = run("flash"), run("dense")
+    np.testing.assert_allclose(got_dense, want, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(got_flash, want, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_path_rejects_unknown_impl():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(attention="sparse", use_ring_attention=True)
     tokens = jnp.zeros((1, 8), jnp.int32)
-    with pytest.raises(ValueError, match="use_ring_attention"):
+    with pytest.raises(ValueError, match="ring path"):
         GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
 
 
