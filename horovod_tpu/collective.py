@@ -259,6 +259,45 @@ _INTRACE = {
 
 _EAGER_CACHE: dict = {}
 
+# Monotonic eager-op counter; part of every negotiated signature.
+_OP_SEQ = 0
+
+
+def _reset_negotiation() -> None:
+    """Restart the op sequence (re-init / elastic re-mesh: membership
+    changed, so the submission history starts over — upstream resets its
+    controller state on topology change)."""
+    global _OP_SEQ
+    _OP_SEQ = 0
+
+
+def _negotiate(kind: str, sig_key: tuple) -> None:
+    """Multi-process eager negotiation (upstream ``controller.cc``).
+
+    Every process must issue the same eager collectives in the same order —
+    a mismatch would execute different global programs and hang the slice.
+    Each call is cross-checked with a host-side allgather of
+    ``(sequence_number, op, shapes, params)``; the sequence number catches
+    reordering, not just differing ops. There is deliberately no cached
+    fast path: a cache hit on one process while another diverges would turn
+    the error into a silent distributed hang — and on TPU the hot path
+    (collectives inside jit) never negotiates at all, so per-eager-call
+    negotiation costs nothing that matters. (The reference can cache
+    because its controller thread still synchronises every cycle.)
+    """
+    global _OP_SEQ
+    if jax.process_count() <= 1:
+        return
+    _OP_SEQ += 1
+    sig = f"{_OP_SEQ}|{kind}|{sig_key!r}"
+    sigs = allgather_object(sig)
+    if any(s != sig for s in sigs):
+        table = "\n".join(f"  process {i}: {s}" for i, s in enumerate(sigs))
+        raise RuntimeError(
+            "eager collective mismatch across processes — every process "
+            "must issue the same collectives in the same order "
+            f"(reference: controller.cc negotiation).\n{table}")
+
 
 def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
     m = core.mesh()
@@ -271,8 +310,9 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
             raise ValueError(
                 f"eager collectives expect per-rank values stacked on axis 0 "
                 f"(leading dim {n}), got shape {x.shape}")
-    key = (kind, treedef, tuple((x.shape, str(x.dtype)) for x in leaves),
-           param_key, id(m))
+    shapes = tuple((x.shape, str(x.dtype)) for x in leaves)
+    _negotiate(kind, (shapes, param_key))
+    key = (kind, treedef, shapes, param_key, id(m))
     fn = _EAGER_CACHE.get(key)
     if fn is None:
         def body(*shard_leaves):
@@ -450,18 +490,46 @@ def join() -> int:
 # ---------------------------------------------------------------------------
 
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
-    """Broadcast an arbitrary picklable object from ``root_rank``."""
+    """Broadcast an arbitrary picklable object from ``root_rank``.
+
+    Wire format (multihost): ``multihost_utils.broadcast_one_to_all``
+    requires every process to supply identically-shaped inputs, so the
+    object is pickled on the root and shipped as (length, padded uint8
+    buffer) in two fixed-shape rounds — the same length-prefixed framing the
+    reference uses over MPI (``horovod/common/gloo/..``).
+    """
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        return multihost_utils.broadcast_one_to_all(
-            obj, is_source=jax.process_index() == root_rank)
+        import pickle
+        from jax.experimental import multihost_utils as mhu
+        source = jax.process_index() == root_rank
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8) if source \
+            else np.zeros(0, np.uint8)
+        n = int(mhu.broadcast_one_to_all(
+            np.asarray([payload.size], np.int64), is_source=source)[0])
+        buf = np.zeros(n, np.uint8)
+        if source:
+            buf[:] = payload
+        out = mhu.broadcast_one_to_all(buf, is_source=source)
+        return pickle.loads(np.asarray(out).tobytes())
     return obj
 
 
 def allgather_object(obj, name: Optional[str] = None) -> list:
-    """Gather one picklable object per process into a list."""
+    """Gather one picklable object per process into a list.
+
+    Pickles locally, allgathers the per-process lengths, then allgathers a
+    max-length padded uint8 buffer (``process_allgather`` needs uniform
+    shapes across processes).
+    """
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(obj)
-        return list(gathered)
+        import pickle
+        from jax.experimental import multihost_utils as mhu
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        lens = np.asarray(mhu.process_allgather(
+            np.asarray([payload.size], np.int64))).reshape(-1)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:payload.size] = payload
+        gathered = np.asarray(mhu.process_allgather(buf))
+        return [pickle.loads(gathered[i, :lens[i]].tobytes())
+                for i in range(len(lens))]
     return [obj]

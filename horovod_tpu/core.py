@@ -116,6 +116,7 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         from horovod_tpu import collective as _coll
         from horovod_tpu import process_set as _ps
         _coll._EAGER_CACHE.clear()
+        _coll._reset_negotiation()
         _ps._reset_for_init(m, axis_name)
 
 
@@ -127,6 +128,7 @@ def shutdown() -> None:
         from horovod_tpu import collective as _coll
         from horovod_tpu import process_set as _ps
         _coll._EAGER_CACHE.clear()
+        _coll._reset_negotiation()
         _ps._reset_for_shutdown()
 
 

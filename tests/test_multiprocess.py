@@ -1,0 +1,74 @@
+"""True 2-process integration: jax.distributed rendezvous, length-prefixed
+object collectives, and cross-process eager negotiation (SURVEY §2 rows 11 +
+25). Spawns two real CPU processes over gloo."""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    sys.path.insert(0, {repo!r})
+    import horovod_tpu as hvd
+    hvd.init(coordinator_address=f"127.0.0.1:{{port}}", num_processes=2,
+             process_id=pid)
+    assert jax.process_count() == 2
+    out = hvd.broadcast_object({{"cfg": [1, 2, pid * 0]}} if pid == 0
+                               else None, root_rank=0)
+    assert out == {{"cfg": [1, 2, 0]}}, out
+    gathered = hvd.allgather_object("p%d" % pid * (pid + 1))  # ragged sizes
+    assert gathered == ["p0", "p1p1"], gathered
+    from horovod_tpu import collective as C
+    if mode == "match":
+        C._negotiate("allreduce", (("sig",), (0,)))
+        C._negotiate("allreduce", (("sig",), (0,)))  # cache hit
+        print(f"proc {{pid}} OK", flush=True)
+    else:
+        try:
+            C._negotiate("allreduce", (("sig", pid), (0,)))
+        except RuntimeError as e:
+            assert "mismatch across processes" in str(e)
+            print(f"proc {{pid}} MISMATCH-CAUGHT", flush=True)
+        else:
+            raise AssertionError("mismatch not detected")
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(mode: str):
+    import pathlib
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = _WORKER.format(repo=repo)
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(pid), str(port), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=None) for pid in range(2)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    return [(p.returncode, o) for p, o in zip(procs, outs)]
+
+
+@pytest.mark.slow
+def test_two_process_object_collectives_and_negotiation():
+    for rc, out in _run_pair("match"):
+        assert rc == 0, out
+        assert "OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_negotiation_mismatch_detected():
+    for rc, out in _run_pair("mismatch"):
+        assert rc == 0, out
+        assert "MISMATCH-CAUGHT" in out

@@ -1,0 +1,88 @@
+"""Multi-process eager negotiation (SURVEY §2 row 11 — the reference's
+controller.cc readiness check, rebuilt as an ordered per-call signature
+allgather)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import collective as C
+
+
+@pytest.fixture(autouse=True)
+def _fresh_negotiation_state():
+    C._reset_negotiation()
+    yield
+    C._reset_negotiation()
+
+
+def test_single_process_skips_negotiation(monkeypatch, rng):
+    calls = []
+    monkeypatch.setattr(C, "allgather_object",
+                        lambda obj, name=None: calls.append(obj) or [obj])
+    hvd.allreduce(rng.standard_normal((8, 4)).astype(np.float32))
+    assert not calls  # process_count == 1 → no negotiation traffic
+
+
+def test_every_call_negotiates_with_sequence_number(monkeypatch, rng):
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+    calls = []
+
+    def fake_allgather(obj, name=None):
+        calls.append(obj)
+        return [obj, obj]  # both processes submitted the same op
+
+    monkeypatch.setattr(C, "allgather_object", fake_allgather)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    hvd.allreduce(x)
+    hvd.allreduce(x + 1)
+    # No cached fast path: a cache hit on one process while another diverges
+    # would hang instead of raising. Signatures carry the op sequence.
+    assert len(calls) == 2
+    assert calls[0].startswith("1|") and calls[1].startswith("2|")
+
+
+def test_mismatched_signatures_raise(monkeypatch, rng):
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+
+    def fake_allgather(obj, name=None):
+        return [obj, "1|allgather|other-op"]  # the peer diverged
+
+    monkeypatch.setattr(C, "allgather_object", fake_allgather)
+    with pytest.raises(RuntimeError, match="mismatch across processes"):
+        hvd.allreduce(rng.standard_normal((8, 3)).astype(np.float32))
+
+
+def test_reordered_ops_raise(monkeypatch, rng):
+    # Same op set, different order: the sequence number in the signature
+    # catches it.
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+
+    def fake_allgather(obj, name=None):
+        peer = obj.replace("1|", "2|") if obj.startswith("1|") else obj
+        return [obj, peer]
+
+    monkeypatch.setattr(C, "allgather_object", fake_allgather)
+    with pytest.raises(RuntimeError, match="mismatch across processes"):
+        hvd.allreduce(rng.standard_normal((8, 4)).astype(np.float32))
+
+
+def test_reinit_restarts_sequence(monkeypatch, rng):
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+    calls = []
+    monkeypatch.setattr(C, "allgather_object",
+                        lambda obj, name=None: calls.append(obj) or [obj,
+                                                                     obj])
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    hvd.allreduce(x)
+    hvd.init()  # elastic re-mesh: submission history starts over
+    hvd.allreduce(x)
+    assert calls[0].startswith("1|") and calls[1].startswith("1|")
+
+
+def test_mismatch_error_lists_per_process_table(monkeypatch, rng):
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(C, "allgather_object",
+                        lambda obj, name=None: [obj, "1|broadcast|x"])
+    with pytest.raises(RuntimeError, match="process 1: 1\\|broadcast"):
+        hvd.allreduce(rng.standard_normal((8, 5)).astype(np.float32))
