@@ -22,9 +22,9 @@ from horovod_tpu.collective import (  # noqa: F401
 )
 from horovod_tpu.compression import Compression  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
-    DistributedOptimizer, DistributedGradientTape, grad, value_and_grad,
-    allreduce_gradients, broadcast_parameters, broadcast_optimizer_state,
-    broadcast_variables,
+    DistributedOptimizer, DistributedGradientTape, accumulation_has_updated,
+    grad, value_and_grad, allreduce_gradients, broadcast_parameters,
+    broadcast_optimizer_state, broadcast_variables,
 )
 from horovod_tpu.process_set import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,

@@ -18,7 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["AutotuneResult", "autotune_fusion_threshold", "Autotuner"]
+__all__ = ["AutotuneResult", "autotune_fusion_threshold", "Autotuner",
+           "autotune_flash_blocks"]
 
 _MB = 1024 * 1024
 
@@ -58,6 +59,67 @@ def autotune_fusion_threshold(
         trials[thr] = (time.perf_counter() - t0) / steps_per_trial
     best = min(trials, key=trials.get)
     return AutotuneResult(best_threshold_bytes=best, trials=trials)
+
+
+def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
+                          candidates: Optional[List[tuple]] = None,
+                          steps_per_trial: int = 5,
+                          include_backward: bool = True):
+    """Measure flash-attention (block_q, block_k) tilings on this device.
+
+    The best tiles depend on head_dim, sequence length and VMEM pressure
+    from the backward kernels (e.g. 512x512 Q-blocks spill on v5e while
+    256x512 is fastest). Returns ``((block_q, block_k), trials_dict)`` where
+    ``trials_dict`` maps each candidate to measured seconds/step.
+
+    Args:
+      q_shape: (batch, seq, heads, head_dim) to tune for.
+      dtype: array dtype for the probe tensors.
+      causal: tune the causal or full-attention variant.
+      candidates: (block_q, block_k) pairs; defaults to a v5e-shaped grid.
+      include_backward: time fwd+bwd (the training shape) vs fwd only.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    if candidates is None:
+        candidates = [(128, 128), (128, 512), (256, 256), (256, 512),
+                      (256, 1024), (512, 512), (512, 1024)]
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal(q_shape), dtype)
+               for _ in range(3))
+
+    trials: Dict[tuple, float] = {}
+    last_error: Optional[Exception] = None
+    for bq, bk in candidates:
+        if include_backward:
+            fn = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                    flash_attention(q, k, v, causal=causal, block_q=bq,
+                                    block_k=bk).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))
+        else:
+            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk))
+        try:
+            out = fn(q, k, v)
+            jax.block_until_ready(out)
+        except Exception as e:  # tiling not compilable for this shape
+            last_error = e
+            continue
+        t0 = time.perf_counter()
+        for _ in range(steps_per_trial):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        trials[(bq, bk)] = (time.perf_counter() - t0) / steps_per_trial
+    if not trials:
+        raise RuntimeError(
+            f"no flash tiling compiled for shape {q_shape}") from last_error
+    best = min(trials, key=trials.get)
+    return best, trials
 
 
 class Autotuner:

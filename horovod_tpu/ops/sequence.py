@@ -21,12 +21,19 @@ __all__ = ["ulysses_attention"]
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = True,
-                      scale: Optional[float] = None) -> jnp.ndarray:
+                      scale: Optional[float] = None,
+                      impl: str = "dense", block_q: int = 256,
+                      block_k: int = 512) -> jnp.ndarray:
     """Attention with q/k/v sequence-sharded on ``axis_name``
     (shapes (B, t_local, H, D)); the axis size must divide the head count
-    (each device takes H/n heads after the swap)."""
-    n = lax.psum(1, axis_name)
-    rank = lax.axis_index(axis_name)
+    (each device takes H/n heads after the swap).
+
+    ``impl="flash"`` runs the local full-sequence attention through the
+    fused pallas kernel — after the all-to-all this is ordinary single-
+    device attention, so the kernel drops straight in (and its custom VJP
+    composes with the all-to-alls' autodiff). ``block_q``/``block_k`` feed
+    the kernel tiles (see ``autotune.autotune_flash_blocks``).
+    """
     B, Tq, H, D = q.shape
     scale = D ** -0.5 if scale is None else scale
 
@@ -41,6 +48,14 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               tiled=True)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # (B, T, H/n, D)
+    if impl == "flash":
+        from horovod_tpu.ops.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k)
+        return head2seq(out)
+    if impl != "dense":
+        raise ValueError(f"unknown attention impl {impl!r}; expected "
+                         "'dense' or 'flash'")
     T = qh.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
                         kh.astype(jnp.float32)) * scale

@@ -88,12 +88,24 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          postscale_factor: float = 1.0,
                          fusion_threshold_bytes: int =
                          _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                         backward_passes_per_step: int = 1,
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are synchronized before the update
     (``hvd.DistributedOptimizer``).
 
     Use inside the jitted, shard_mapped train step; with jit auto-sharding it
     degrades to the inner optimizer unchanged.
+
+    ``backward_passes_per_step=k`` mirrors the upstream argument (local
+    gradient accumulation: one allreduce per k backward passes, the
+    accumulated gradients *summed* before synchronisation, exactly
+    upstream's semantics — same LR transfers). The JAX shape is
+    ``optax.MultiSteps`` around the synchronized transform (with a
+    rescale-by-k to turn its running mean back into the upstream sum) —
+    ``update`` returns zero updates on the k-1 accumulation steps and the
+    synced update on every k-th; everything stays jit-compatible (counter +
+    accumulator live in the optimizer state; probe the k-boundary with
+    ``accumulation_has_updated(opt_state)``).
     """
 
     def init(params):
@@ -107,7 +119,26 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             alive=extra.pop("alive", None))
         return optimizer.update(grads, state, params, **extra)
 
-    return optax.GradientTransformation(init, update)
+    tx = optax.GradientTransformation(init, update)
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1, got "
+                         f"{backward_passes_per_step}")
+    if backward_passes_per_step > 1:
+        # MultiSteps feeds the *mean* of the k accumulated gradients to its
+        # inner transform; upstream sums before the allreduce. Scale by k so
+        # a learning rate tuned on upstream transfers unchanged.
+        k = float(backward_passes_per_step)
+        tx = optax.chain(optax.scale(k), tx)
+        ms = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
+        tx = optax.GradientTransformation(ms.init, ms.update)
+    return tx
+
+
+def accumulation_has_updated(opt_state) -> "jnp.ndarray":
+    """True when the last ``update`` on a ``backward_passes_per_step > 1``
+    optimizer applied a real step (the k-th pass) rather than accumulating.
+    Use to gate LR-schedule advances or per-step logging."""
+    return optax.MultiSteps(optax.identity(), 1).has_updated(opt_state)
 
 
 def grad(fun: Callable, argnums=0, op: int = C.Average,

@@ -110,3 +110,11 @@ class TestUlyssesAttention:
         out = _run_sharded(ulysses_attention, q, k, v, causal)
         want = dense_attention(q, k, v, causal)
         np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_impl_matches_dense(self, qkv, causal):
+        q, k, v = qkv
+        fn = lambda *a, **kw: ulysses_attention(*a, impl="flash", **kw)
+        out = _run_sharded(fn, q, k, v, causal)
+        want = dense_attention(q, k, v, causal)
+        np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-4)

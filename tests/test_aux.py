@@ -63,6 +63,15 @@ class TestAutotune:
         assert len(res.trials) == 3
         assert "best fusion threshold" in res.summary()
 
+    def test_flash_block_autotune_small_shape(self):
+        from horovod_tpu.autotune import autotune_flash_blocks
+        best, trials = autotune_flash_blocks(
+            (1, 64, 2, 8), dtype="float32", causal=True,
+            candidates=[(16, 16), (32, 32), (64, 64)],
+            steps_per_trial=1, include_backward=False)
+        assert best in trials and len(trials) == 3
+        assert all(s > 0 for s in trials.values())
+
     def test_online_converges(self):
         tuner = Autotuner(candidates_bytes=[100, 200], samples_per_candidate=2)
         sim = {100: 0.01, 200: 0.002}

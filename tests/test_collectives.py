@@ -307,3 +307,57 @@ class TestFusion:
         with mock.patch.object(native, "fusion_plan", return_value=None):
             py = fusion._plan_buckets(sizes, 2048)
         assert nat == py
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation (backward_passes_per_step)
+# ---------------------------------------------------------------------------
+
+class TestBackwardPassesPerStep:
+    def test_accumulates_then_applies_synced_average(self, rng):
+        import optax
+        params = {"w": jnp.asarray(rng.standard_normal(4), jnp.float32)}
+        g1 = {"w": jnp.asarray(rng.standard_normal(4), jnp.float32)}
+        g2 = {"w": jnp.asarray(rng.standard_normal(4), jnp.float32)}
+
+        opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                       backward_passes_per_step=2)
+        state = opt.init(params)
+
+        u1, state = opt.update(g1, state, params)
+        # Accumulation step: no update applied yet.
+        np.testing.assert_allclose(np.asarray(u1["w"]), 0.0)
+        assert not bool(hvd.accumulation_has_updated(state))
+        u2, state = opt.update(g2, state, params)
+        # k-th step: sgd(1.0) update = -(g1 + g2) — upstream sums the k
+        # accumulated passes before the (single-rank) allreduce.
+        want = -(np.asarray(g1["w"]) + np.asarray(g2["w"]))
+        np.testing.assert_allclose(np.asarray(u2["w"]), want, rtol=1e-6)
+        assert bool(hvd.accumulation_has_updated(state))
+
+    def test_invalid_k_raises(self):
+        import optax
+        with pytest.raises(ValueError, match="backward_passes_per_step"):
+            hvd.DistributedOptimizer(optax.sgd(0.1),
+                                     backward_passes_per_step=0)
+
+    def test_works_inside_spmd(self, rng):
+        import optax
+        params = jnp.zeros((4,), jnp.float32)
+        data = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+        opt = hvd.DistributedOptimizer(optax.sgd(0.5),
+                                       backward_passes_per_step=2)
+        state = opt.init(params)
+
+        def step(params, state, x):
+            g = hvd.grad(lambda p: jnp.mean((x @ p - 1.0) ** 2))(params)
+            u, state = opt.update(g, state, params)
+            return optax.apply_updates(params, u), state
+
+        from jax.sharding import PartitionSpec as P
+        sstep = hvd.spmd(step, in_specs=(P(), P(), P("hvd")),
+                         out_specs=(P(), P()))
+        p1, state = sstep(params, state, data)
+        np.testing.assert_allclose(np.asarray(p1), 0.0)  # accumulating
+        p2, state = sstep(p1, state, data)
+        assert float(jnp.max(jnp.abs(p2))) > 0  # k-th step applied
