@@ -4,4 +4,5 @@ Rebuild of upstream ``horovod/runner`` (horovodrun CLI, gloo_run/mpi_run,
 hostfile parsing, rendezvous). See SURVEY §2 row 14.
 """
 
-from horovod_tpu.runner.launcher import run, parse_hosts, HostSpec  # noqa: F401
+from horovod_tpu.runner.launcher import (  # noqa: F401
+    HostSpec, parse_hosts, run, run_func)

@@ -25,7 +25,7 @@ import sys
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["HostSpec", "parse_hosts", "build_worker_env", "worker_commands",
-           "run"]
+           "run", "run_func"]
 
 DEFAULT_PORT = 29500
 
@@ -94,12 +94,17 @@ def worker_commands(command: Sequence[str], hosts: List[HostSpec],
 
 def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
         coordinator_port: int = DEFAULT_PORT, dry_run: bool = False,
-        extra_env: Optional[Dict[str, str]] = None):
+        extra_env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None):
     """``horovodrun`` equivalent.
 
     - ``hosts=None``: spawn ``np`` local worker processes and wait.
     - ``hosts="h1:8,h2:8"``: print/return per-host commands (remote launch).
     - ``dry_run``: return commands without executing.
+    - ``timeout``: kill the job and raise if workers are still running after
+      this many seconds (upstream ``--start-timeout``'s role: a wedged
+      rendezvous or accelerator runtime turns into an error, not a silent
+      infinite hang).
     """
     if hosts is not None:
         specs = parse_hosts(hosts)
@@ -127,6 +132,8 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
     # kills the job on first worker failure too).
     import time
     rc = 0
+    timed_out = False
+    deadline = None if timeout is None else time.monotonic() + timeout
     try:
         pending = list(procs)
         while pending and rc == 0:
@@ -138,6 +145,10 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
                 if code:
                     rc = code
                     break
+            if pending and rc == 0 and deadline is not None and \
+                    time.monotonic() > deadline:
+                timed_out = True
+                break
             time.sleep(0.05)
     finally:
         for p in procs:
@@ -148,9 +159,66 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    if timed_out:
+        raise TimeoutError(
+            f"workers still running after {timeout}s; job killed")
     if rc:
         raise RuntimeError(f"worker exited with code {rc}")
     return 0
+
+
+_FUNC_WORKER = """\
+import os, sys
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Env-var-only platform selection can still initialize an accelerator
+    # plugin registered at interpreter startup; re-assert via config.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import cloudpickle
+with open(sys.argv[1], "rb") as f:
+    fn, args, kwargs = cloudpickle.loads(f.read())
+import horovod_tpu as hvd
+hvd.init()   # picks up the HVD_TPU_* rendezvous contract from the env
+result = fn(*args, **kwargs)
+rank = os.environ["HVD_TPU_PROCESS_ID"]
+with open(os.path.join(sys.argv[2], "result_" + rank + ".pkl"), "wb") as f:
+    cloudpickle.dump(result, f)
+"""
+
+
+def run_func(fn, args: tuple = (), kwargs: Optional[Dict] = None,
+             np: int = 1, coordinator_port: int = DEFAULT_PORT,
+             extra_env: Optional[Dict[str, str]] = None,
+             timeout: Optional[float] = None) -> list:
+    """Programmatic launcher (upstream ``horovod.run``): execute ``fn`` on
+    ``np`` worker processes and return ``[fn's result per rank]``.
+
+    Workers rendezvous through ``jax.distributed`` (each calls
+    ``hvd.init()`` on entry, exactly as a script launched by ``run`` would);
+    ``fn`` is shipped with cloudpickle so closures and lambdas work. Local
+    workers default to the CPU backend — they cannot share one accelerator.
+    """
+    import tempfile
+
+    import cloudpickle
+
+    with tempfile.TemporaryDirectory(prefix="hvd_tpu_runfunc_") as td:
+        fn_path = os.path.join(td, "fn.pkl")
+        with open(fn_path, "wb") as f:
+            f.write(cloudpickle.dumps((fn, args, kwargs or {})))
+        command = [sys.executable, "-c", _FUNC_WORKER, fn_path, td]
+        run(command, np=np, coordinator_port=coordinator_port,
+            extra_env=extra_env, timeout=timeout)
+        results = []
+        for rank in range(np):
+            path = os.path.join(td, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"worker {rank} produced no result (crashed after "
+                    "rendezvous?)")
+            with open(path, "rb") as f:
+                results.append(cloudpickle.load(f))
+        return results
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -163,6 +231,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("-H", "--hosts", default=None,
                         help='e.g. "host1:8,host2:8" or a hostfile path')
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--start-timeout", type=float, default=None,
+                        help="kill the job if workers are still running "
+                             "after this many seconds")
     parser.add_argument("--dry-run", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -171,7 +242,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.command:
         parser.error("no command given")
     out = run(args.command, np=args.num_proc, hosts=args.hosts,
-              coordinator_port=args.port, dry_run=args.dry_run)
+              coordinator_port=args.port, dry_run=args.dry_run,
+              timeout=args.start_timeout)
     if args.dry_run and isinstance(out, list):
         for c in out:
             print(c)

@@ -154,3 +154,38 @@ class TestCallbacks:
         assert cb.lr_at_epoch(1) is None
         assert cb.lr_at_epoch(2) == pytest.approx(0.05)
         assert cb.lr_at_epoch(4) is None
+
+
+class TestRunFunc:
+    def test_run_func_two_processes(self):
+        # Programmatic launcher (upstream horovod.run): closures ship via
+        # cloudpickle; each worker rendezvouses and returns its result.
+        from horovod_tpu.runner import run_func
+        base = 100
+
+        def work(offset):
+            import jax
+            import horovod_tpu as hvd
+            out = hvd.allgather_object(jax.process_index())
+            return base + offset + sum(out)
+
+        results = run_func(work, args=(7,), np=2)
+        assert results == [108, 108]  # 100 + 7 + (0 + 1) on both ranks
+
+    def test_run_func_worker_failure_raises(self):
+        from horovod_tpu.runner import run_func
+
+        def boom():
+            raise RuntimeError("worker exploded")
+
+        with pytest.raises(RuntimeError):
+            run_func(boom, np=1)
+
+    def test_run_timeout_kills_wedged_workers(self):
+        from horovod_tpu.runner.launcher import run
+        import time
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="still running"):
+            run(["python", "-c", "import time; time.sleep(60)"], np=2,
+                timeout=2.0)
+        assert time.monotonic() - t0 < 30  # killed promptly, not after 60s
