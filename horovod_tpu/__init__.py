@@ -17,6 +17,7 @@ from horovod_tpu.core import (  # noqa: F401
 from horovod_tpu.collective import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
     allreduce, allreduce_, allreduce_async, grouped_allreduce,
+    grouped_allgather, grouped_reducescatter,
     allgather, broadcast, broadcast_, alltoall, reducescatter,
     barrier, synchronize, poll, join, broadcast_object, allgather_object,
 )

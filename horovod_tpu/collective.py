@@ -46,6 +46,7 @@ from horovod_tpu.process_set import ProcessSet, global_process_set
 __all__ = [
     "ReduceOp", "Average", "Sum", "Min", "Max", "Product", "Adasum",
     "allreduce", "allreduce_", "allreduce_async", "grouped_allreduce",
+    "grouped_allgather", "grouped_reducescatter",
     "allgather", "broadcast", "broadcast_", "alltoall", "reducescatter",
     "barrier", "synchronize", "poll", "join",
     "broadcast_object", "allgather_object",
@@ -388,6 +389,22 @@ def grouped_allreduce(tensors: Sequence, op: int = Average, **kwargs) -> List:
     (``hvd.grouped_allreduce``)."""
     out = allreduce(list(tensors), op=op, **kwargs)
     return list(out)
+
+
+def grouped_allgather(tensors: Sequence, **kwargs) -> List:
+    """Allgather a list of tensors in one call (``hvd.grouped_allgather``).
+
+    Pytree collectives already batch into one compiled program, so grouping
+    is free — the wrapper exists for upstream API parity.
+    """
+    return list(allgather(list(tensors), **kwargs))
+
+
+def grouped_reducescatter(tensors: Sequence, op: int = Average,
+                          **kwargs) -> List:
+    """Reduce-scatter a list of tensors in one call
+    (``hvd.grouped_reducescatter``)."""
+    return list(reducescatter(list(tensors), op=op, **kwargs))
 
 
 def broadcast(tensor, root_rank: int, process_set: Optional[ProcessSet] = None,

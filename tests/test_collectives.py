@@ -361,3 +361,26 @@ class TestBackwardPassesPerStep:
         np.testing.assert_allclose(np.asarray(p1), 0.0)  # accumulating
         p2, state = sstep(p1, state, data)
         assert float(jnp.max(jnp.abs(p2))) > 0  # k-th step applied
+
+
+class TestGroupedVariants:
+    def test_grouped_allgather(self, rng):
+        xs = [rng.standard_normal((8, 3)).astype(np.float32),
+              rng.standard_normal((8, 2, 2)).astype(np.float32)]
+        outs = hvd.grouped_allgather(xs)
+        assert len(outs) == 2
+        for x, out in zip(xs, outs):
+            # Each rank's row r gathers all ranks' rows -> (8, 8*rest...).
+            want = np.stack([np.concatenate([x[i] for i in range(8)], 0)] * 8)
+            np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    def test_grouped_reducescatter(self, rng):
+        xs = [rng.standard_normal((8, 8, 2)).astype(np.float32)]
+        outs = hvd.grouped_reducescatter(xs, op=hvd.Sum)
+        (out,) = outs
+        # Rank r receives the summed chunk r of axis 0 (8 rows / 8 ranks =
+        # a (1, 2) chunk each).
+        summed = np.asarray(xs[0]).sum(0)
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(out[r]), summed[r:r + 1],
+                                       rtol=1e-5)
