@@ -18,7 +18,8 @@ from horovod_tpu.collective import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
     allreduce, allreduce_, allreduce_async, grouped_allreduce,
     grouped_allgather, grouped_reducescatter,
-    allgather, broadcast, broadcast_, alltoall, reducescatter,
+    allgather, ragged_allgather, broadcast, broadcast_, alltoall,
+    reducescatter,
     barrier, synchronize, poll, join, broadcast_object, allgather_object,
 )
 from horovod_tpu.compression import Compression  # noqa: F401

@@ -47,8 +47,8 @@ __all__ = [
     "ReduceOp", "Average", "Sum", "Min", "Max", "Product", "Adasum",
     "allreduce", "allreduce_", "allreduce_async", "grouped_allreduce",
     "grouped_allgather", "grouped_reducescatter",
-    "allgather", "broadcast", "broadcast_", "alltoall", "reducescatter",
-    "barrier", "synchronize", "poll", "join",
+    "allgather", "ragged_allgather", "broadcast", "broadcast_", "alltoall",
+    "reducescatter", "barrier", "synchronize", "poll", "join",
     "broadcast_object", "allgather_object",
 ]
 
@@ -221,6 +221,46 @@ def _alltoall_leaf(x, ps: ProcessSet):
     return jnp.where(member, mine, x)
 
 
+def _ragged_allgather_leaf(x, num_valid, ps: ProcessSet):
+    """In-jit ragged allgather: ``x`` is this rank's (max_m, ...) buffer with
+    the first ``num_valid`` rows live (static max, dynamic count — the TPU
+    equivalent of upstream's dim-0 size negotiation in ``controller.cc``).
+    Returns ``((k, max_m, ...) gathered buffers, (k,) counts)``; pad rows are
+    zeroed so results are deterministic."""
+    T = x.shape[0]
+    mask = (jnp.arange(T) < num_valid).reshape((T,) + (1,) * (x.ndim - 1))
+    x = jnp.where(mask, x, jnp.zeros_like(x))
+    counts = _allgather_leaf(jnp.asarray(num_valid, jnp.int32)[None], ps)
+    g = _allgather_leaf(x, ps).reshape((-1, T) + x.shape[1:])
+    return g, counts
+
+
+def _ragged_alltoall_leaf(x, splits, ps: ProcessSet):
+    """In-jit alltoall with per-destination row counts (upstream
+    ``hvd.alltoall(tensor, splits)``). ``x`` is (T, ...) with the rows for
+    destination ``j`` at offset ``cumsum(splits)[:j]``; ``splits`` is a (k,)
+    int vector summing to <= T. Returns ``((k, T, ...) received buffers,
+    (k,) recv_splits)`` — received rows from source ``j`` are
+    ``out[j, :recv_splits[j]]``, pad rows are zero. Static worst-case T per
+    peer is the price of ragged under XLA's static shapes."""
+    if ps.ranks is not None:
+        raise NotImplementedError(
+            "alltoall(splits=...) supports the global process set only")
+    T = x.shape[0]
+    splits = jnp.asarray(splits, jnp.int32)
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(splits)[:-1]])
+    idx = jnp.clip(offs[:, None] + jnp.arange(T)[None, :], 0, T - 1)
+    send = jnp.take(x, idx, axis=0)                       # (k, T, ...)
+    mask = (jnp.arange(T)[None, :] < splits[:, None]).reshape(
+        splits.shape[0], T, *([1] * (x.ndim - 1)))
+    send = jnp.where(mask, send, jnp.zeros_like(send))
+    recv = lax.all_to_all(send, ps.axis, split_axis=0, concat_axis=0)
+    recv_splits = lax.all_to_all(splits, ps.axis, split_axis=0,
+                                 concat_axis=0, tiled=True)
+    return recv, recv_splits
+
+
 def _reducescatter_leaf(x, op, ps: ProcessSet):
     if op not in (ReduceOp.Sum, ReduceOp.Average):
         raise ValueError("reducescatter supports Sum and Average")
@@ -249,6 +289,7 @@ _INTRACE = {
         lambda x: _allgather_leaf(x, ps), t),
     "alltoall": lambda t, ps: jax.tree_util.tree_map(
         lambda x: _alltoall_leaf(x, ps), t),
+    "ragged_alltoall": lambda t, ps: _ragged_alltoall_leaf(t[0], t[1], ps),
     "reducescatter": lambda t, op, ps: jax.tree_util.tree_map(
         lambda x: _reducescatter_leaf(x, op, ps), t),
 }
@@ -300,7 +341,13 @@ def _negotiate(kind: str, sig_key: tuple) -> None:
             f"(reference: controller.cc negotiation).\n{table}")
 
 
-def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
+def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
+               negotiate_key: tuple = ()):
+    """Run an eager collective. ``param_key`` keys the compile cache (static
+    facts the compiled program depends on); ``negotiate_key`` carries extra
+    per-call values (e.g. ragged sizes/splits) that must *match* across
+    processes but travel as device inputs — they join the negotiation
+    signature without fragmenting the compile cache."""
     m = core.mesh()
     axis = core.axis_name()
     n = core.size()
@@ -312,7 +359,7 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple):
                 f"eager collectives expect per-rank values stacked on axis 0 "
                 f"(leading dim {n}), got shape {x.shape}")
     shapes = tuple((x.shape, str(x.dtype)) for x in leaves)
-    _negotiate(kind, (shapes, param_key))
+    _negotiate(kind, (shapes, param_key, negotiate_key))
     key = (kind, treedef, shapes, param_key, id(m))
     fn = _EAGER_CACHE.get(key)
     if fn is None:
@@ -426,24 +473,141 @@ def broadcast_(tensor, root_rank: int, **kwargs):
 
 def allgather(tensor, process_set: Optional[ProcessSet] = None,
               name: Optional[str] = None):
-    """Concatenate every member's tensor along axis 0 (``hvd.allgather``).
-    TPU note: static shapes require equal per-rank shapes (the reference
-    allows ragged dim 0 and pays a size negotiation; pad to equal instead)."""
+    """Concatenate every member's tensor along axis 0 (``hvd.allgather``)
+    with equal per-rank shapes. For the reference's ragged dim-0 mode
+    (upstream size negotiation in ``controller.cc``) use
+    :func:`ragged_allgather`."""
     ps = _resolve_ps(process_set)
     if _is_traced(tensor):
         return _INTRACE["allgather"](tensor, ps)
     return _eager_run("allgather", tensor, (ps,), (_ps_key(ps),))
 
 
-def alltoall(tensor, process_set: Optional[ProcessSet] = None,
-             name: Optional[str] = None):
-    """Scatter equal splits of axis 0 to every member and gather theirs
-    (``hvd.alltoall`` with uniform splits; TPU static shapes require equal
-    splits — the reference's ragged ``splits`` arg is unsupported)."""
+def ragged_allgather(tensor, num_valid=None,
+                     process_set: Optional[ProcessSet] = None,
+                     name: Optional[str] = None):
+    """Allgather with per-rank dim-0 sizes (upstream allgather's ragged mode,
+    ``controller.cc`` size negotiation rebuilt for static shapes).
+
+    * **In-jit**: ``tensor`` is this rank's (max_m, ...) buffer with the
+      first ``num_valid`` rows live (``num_valid`` may be traced). Returns
+      ``((k, max_m, ...) gathered buffers, (k,) counts)`` — rank ``j``'s
+      rows are ``out[j, :counts[j]]``, pad rows zero. The static max is the
+      TPU price of raggedness; sizes travel with the data instead of a
+      host negotiation round.
+    * **Eager**: ``tensor`` is a length-n sequence (entry r = rank r's
+      array, trailing dims equal, dim 0 free); ``num_valid`` must be None.
+      Returns the concatenation of all members' rows (identical on every
+      rank), exactly upstream's return.
+    """
     ps = _resolve_ps(process_set)
-    if _is_traced(tensor):
-        return _INTRACE["alltoall"](tensor, ps)
-    return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),))
+    if _is_traced(tensor) or _is_traced(num_valid):
+        if num_valid is None:
+            raise ValueError("in-jit ragged_allgather requires num_valid")
+        return _ragged_allgather_leaf(tensor, num_valid, ps)
+    if num_valid is not None:
+        raise ValueError("eager ragged_allgather takes a per-rank list, "
+                         "not num_valid")
+    return _ragged_allgather_eager(tensor, ps)
+
+
+def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
+             name: Optional[str] = None):
+    """Scatter splits of axis 0 to every member and gather theirs
+    (``hvd.alltoall``).
+
+    Without ``splits``: equal splits (dim 0 divisible by the set size).
+
+    With ``splits`` (the reference's ragged mode, upstream
+    ``hvd.alltoall(tensor, splits)``):
+
+    * **In-jit**: ``tensor`` is this rank's (T, ...) array (rows for
+      destination ``j`` contiguous at ``cumsum(splits)[:j]``), ``splits`` a
+      (k,) int vector. Returns ``((k, T, ...) received, (k,) recv_splits)``
+      — rows from source ``j`` are ``out[j, :recv_splits[j]]``; pad rows
+      zero. Static shapes force the worst-case T per peer.
+    * **Eager**: ``tensor`` is a length-n sequence (entry r = rank r's
+      array), ``splits`` an (n, n) matrix (row r = rank r's send counts).
+      Returns the per-rank list of concatenated received rows, exactly
+      upstream's semantics.
+    """
+    ps = _resolve_ps(process_set)
+    if splits is None:
+        if _is_traced(tensor):
+            return _INTRACE["alltoall"](tensor, ps)
+        return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),))
+    if _is_traced(tensor) or _is_traced(splits):
+        return _ragged_alltoall_leaf(tensor, splits, ps)
+    return _ragged_alltoall_eager(tensor, splits, ps)
+
+
+def _pad0(a: jnp.ndarray, m: int) -> jnp.ndarray:
+    if a.shape[0] == m:
+        return a
+    return jnp.concatenate(
+        [a, jnp.zeros((m - a.shape[0],) + a.shape[1:], a.dtype)])
+
+
+def _check_ragged_list(tensors, n: int):
+    if not isinstance(tensors, (list, tuple)) or len(tensors) != n:
+        raise ValueError(
+            f"eager ragged collectives expect a sequence of {n} per-rank "
+            f"arrays, got {type(tensors).__name__} of length "
+            f"{len(tensors) if hasattr(tensors, '__len__') else '?'}")
+    arrs = [jnp.asarray(t) for t in tensors]
+    for a in arrs:
+        if a.ndim == 0:
+            raise ValueError("ragged collectives need at least 1-D tensors")
+        if a.shape[1:] != arrs[0].shape[1:] or a.dtype != arrs[0].dtype:
+            raise ValueError(
+                "ragged collectives require equal trailing dims and dtype; "
+                f"got {[(x.shape, str(x.dtype)) for x in arrs]}")
+    return arrs
+
+
+def _ragged_allgather_eager(tensors, ps: ProcessSet):
+    n = core.size()
+    arrs = _check_ragged_list(tensors, n)
+    sizes = [int(a.shape[0]) for a in arrs]
+    members = list(range(n)) if ps.ranks is None else list(ps.ranks)
+    T = max([sizes[r] for r in members] + [1])
+    # Non-member entries are ignored by the masked gather; truncate them to
+    # the member max so every row pads to the same static shape.
+    stacked = jnp.stack([_pad0(a[:T], T) for a in arrs])
+    out = _eager_run("allgather", stacked, (ps,), (_ps_key(ps),),
+                     negotiate_key=("ragged", tuple(sizes)))
+    buf = out[members[0]]                       # (k*T, ...) on a member row
+    segs = [buf[j * T: j * T + sizes[r]] for j, r in enumerate(members)]
+    return jnp.concatenate(segs) if segs else buf[:0]
+
+
+def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
+    if ps.ranks is not None:
+        raise NotImplementedError(
+            "alltoall(splits=...) supports the global process set only")
+    n = core.size()
+    arrs = _check_ragged_list(tensors, n)
+    sp = np.asarray(splits, np.int64)
+    if sp.shape != (n, n):
+        raise ValueError(f"splits must be ({n}, {n}) (row r = rank r's send "
+                         f"counts), got {sp.shape}")
+    for r, a in enumerate(arrs):
+        if int(sp[r].sum()) != a.shape[0]:
+            raise ValueError(
+                f"rank {r}: splits row sums to {int(sp[r].sum())} but tensor "
+                f"has {a.shape[0]} rows")
+    T = max(max((a.shape[0] for a in arrs), default=1), 1)
+    stacked = jnp.stack([_pad0(a, T) for a in arrs])
+    sp_dev = jnp.asarray(sp, jnp.int32)
+    recv, rsplits = _eager_run(
+        "ragged_alltoall", (stacked, sp_dev), (ps,), (_ps_key(ps),),
+        negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))))
+    rsplits = np.asarray(rsplits)               # (n, n)
+    outs = []
+    for r in range(n):
+        segs = [recv[r, j, : int(rsplits[r, j])] for j in range(n)]
+        outs.append(jnp.concatenate(segs) if segs else stacked[r, :0])
+    return outs
 
 
 def reducescatter(tensor, op: int = Average,
