@@ -71,13 +71,24 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # Mesh axis for cross-replica (sync) batch norm: when set, batch moments
+    # are pmean-ed over this axis (upstream horovod/torch/sync_batch_norm.py
+    # semantics) — use inside shard_map with the axis bound. None = local BN.
+    bn_cross_replica_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32)
+        if self.bn_cross_replica_axis is not None:
+            from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm
+            norm = partial(SyncBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32,
+                           axis_name=self.bn_cross_replica_axis)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)

@@ -1,0 +1,31 @@
+"""Cross-replica (synchronized) batch normalization.
+
+Rebuild of upstream ``horovod/torch/sync_batch_norm.py`` for the TPU data-
+parallel path: batch moments are averaged over the ``dp`` mesh axis inside
+the same XLA program (the reference allreduces mean/var over NCCL
+mid-forward), so BN statistics see the *global* batch even when the per-chip
+batch is small.
+
+The implementation is ``flax.linen.BatchNorm`` itself — its ``axis_name``
+field pmean-s E[x] and E[x^2] over the named mesh axis, which under GSPMD
+lowers to the single fused psum pair the reference needs two NCCL rounds
+for. Subclassing (rather than re-deriving the moment math) keeps the
+params/batch_stats layout and numerics identical to local BN, so flipping a
+model between local and sync BN is checkpoint-compatible by construction.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+__all__ = ["SyncBatchNorm"]
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """``flax.linen.BatchNorm`` with cross-replica statistics.
+
+    Set ``axis_name`` to the data-parallel mesh axis (e.g. ``"hvd"`` or
+    ``"dp"``) and call inside ``shard_map``/``pjit`` with that axis bound;
+    with ``axis_name=None`` it degrades to plain local BN. All other args
+    are inherited from ``flax.linen.BatchNorm``.
+    """

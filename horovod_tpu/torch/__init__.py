@@ -33,9 +33,18 @@ __all__ = [
     "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
     "alltoall", "grouped_allreduce",
     "broadcast_parameters", "broadcast_optimizer_state",
-    "DistributedOptimizer", "Compression",
+    "DistributedOptimizer", "Compression", "SyncBatchNorm",
     "Average", "Sum", "Min", "Max", "Product", "Adasum", "ReduceOp",
 ]
+
+
+def __getattr__(name):
+    # Lazy: keep `import horovod_tpu.torch` working without importing torch
+    # until the shim is actually used (upstream hvd.torch.SyncBatchNorm).
+    if name == "SyncBatchNorm":
+        from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
+        return SyncBatchNorm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def _torch():

@@ -1,0 +1,108 @@
+"""torch SyncBatchNorm shim: cross-replica batch norm for the torch frontend.
+
+Rebuild of upstream ``horovod/torch/sync_batch_norm.py``: in training mode
+the per-channel sum / sum-of-squares / count are allreduced (Sum) across the
+communicator mid-forward, and the backward allreduces the gradient sums the
+same way, so gradients are exact for the *global-batch* normalization.
+Weight/bias gradients stay local (the reference does the same — the
+DistributedOptimizer allreduces parameter grads afterwards).
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch.nn.modules.batchnorm import _BatchNorm
+
+import horovod_tpu as _hvd
+from horovod_tpu.collective import Sum
+from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+
+__all__ = ["SyncBatchNorm"]
+
+
+def _allreduce_sum_np(vec: torch.Tensor) -> torch.Tensor:
+    """Sum-allreduce a small fp32 stats vector through the shared engine."""
+    out = _hvd.allreduce(to_stacked(vec.detach().cpu().numpy()), op=Sum)
+    return torch.from_numpy(from_stacked(out)).to(vec.dtype)
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, eps):
+        C = x.shape[1]
+        dims = [0] + list(range(2, x.dim()))
+        count = x.numel() // C
+        local = torch.cat([
+            x.sum(dims, dtype=torch.float32),
+            (x * x).sum(dims, dtype=torch.float32),
+            torch.full((1,), float(count), dtype=torch.float32),
+        ])
+        tot = _allreduce_sum_np(local)
+        n = tot[-1]
+        mean = tot[:C] / n
+        var = tot[C:2 * C] / n - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        shape = [1, C] + [1] * (x.dim() - 2)
+        xhat = (x.to(torch.float32) - mean.view(shape)) * invstd.view(shape)
+        out = xhat * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(xhat, weight, invstd, n)
+        return out.to(x.dtype), mean, var, n
+
+    @staticmethod
+    def backward(ctx, grad_out, _gm, _gv, _gn):
+        xhat, weight, invstd, n = ctx.saved_tensors
+        C = grad_out.shape[1]
+        dims = [0] + list(range(2, grad_out.dim()))
+        dy = grad_out.to(torch.float32)
+
+        sum_dy = dy.sum(dims)
+        sum_dy_xhat = (dy * xhat).sum(dims)
+        # Local grads for the affine params (optimizer allreduces them).
+        grad_weight = sum_dy_xhat
+        grad_bias = sum_dy
+        # Global sums for the input grad (the cross-replica coupling).
+        tot = _allreduce_sum_np(torch.cat([sum_dy, sum_dy_xhat]))
+        g_sum_dy, g_sum_dy_xhat = tot[:C], tot[C:]
+
+        shape = [1, C] + [1] * (grad_out.dim() - 2)
+        grad_x = (invstd * weight).view(shape) * (
+            dy - (g_sum_dy.view(shape)
+                  + xhat * g_sum_dy_xhat.view(shape)) / n)
+        return grad_x.to(grad_out.dtype), grad_weight, grad_bias, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in for ``torch.nn.BatchNormNd`` with cross-replica statistics
+    (``hvd.SyncBatchNorm``). Eval mode uses running stats locally; training
+    mode normalizes by global-batch moments and updates running stats with
+    the unbiased global variance, matching upstream."""
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {x.dim()}D")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        if not self.training and self.track_running_stats:
+            return F.batch_norm(x, self.running_mean, self.running_var,
+                                self.weight, self.bias, False, 0.0, self.eps)
+        weight = self.weight if self.affine else x.new_ones(
+            x.shape[1], dtype=torch.float32)
+        bias = self.bias if self.affine else x.new_zeros(
+            x.shape[1], dtype=torch.float32)
+        out, mean, var, n = _SyncBatchNormFn.apply(x, weight, bias, self.eps)
+        if self.track_running_stats:
+            with torch.no_grad():
+                if self.num_batches_tracked is not None:
+                    self.num_batches_tracked.add_(1)
+                if self.momentum is None:
+                    # torch semantics: cumulative moving average.
+                    m = 1.0 / float(self.num_batches_tracked)
+                else:
+                    m = self.momentum
+                unbiased = var * (n / (n - 1).clamp(min=1.0))
+                self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+        return out
