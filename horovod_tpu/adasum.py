@@ -1,29 +1,44 @@
 """Adasum: adaptive summation reduction.
 
-Rebuild of upstream ``horovod/common/ops/adasum/adasum.h`` (CPU/MPI
-implementation, recursive vector-halving-distance-doubling). Adasum combines
-two gradients so the result is no larger than either projection would allow,
-stabilising large-batch training:
+Rebuild of upstream ``horovod/common/ops/adasum/adasum.h`` (recursive
+vector-halving-distance-doubling over MPI). Adasum combines two gradients so
+the result is no larger than either projection would allow, stabilising
+large-batch training:
 
     adasum(a, b) = (1 - a.b / (2 |a|^2)) a  +  (1 - a.b / (2 |b|^2)) b
 
-The formula is symmetric, so on TPU we use plain recursive doubling: at round
-``k`` each device exchanges its full buffer with the partner at distance
-``2^k`` via ``lax.ppermute`` (one ICI hop pattern per round) and both compute
-the identical combined value. After ``log2(n)`` rounds every device holds the
-Adasum of all ``n`` contributions. The reference's explicit send/recv MPI code
-and per-level buffer management collapse into ``log2(n)`` ppermute+VPU steps
-that XLA pipelines.
+Algorithm (matches the reference's structure, so results are bit-comparable
+across world sizes):
 
-Unlike the reference (which halves vectors per level to save bandwidth), we
-exchange full buffers: ICI bandwidth is high and XLA fuses the arithmetic;
-a halving variant is a future optimisation noted in SURVEY §7.
+1. **Pre-pairing** (any ``k``): with ``p = 2^floor(log2 k)`` and
+   ``r = k - p``, members ``p..k-1`` send their vector to partner ``i - p``,
+   which absorbs it with one Adasum combine; the senders go passive
+   (upstream handles non-power-of-two the same way before its recursive
+   phase).
+2. **VHDD reduce** among the ``p`` actives: at round ``d`` each partner pair
+   (XOR distance ``d``) exchanges *halves* of their current piece, computes
+   partial dot/norms on its half, psums the three scalars across the pair,
+   and applies the shared coefficients — after ``log2 p`` rounds each active
+   holds a disjoint ``1/p`` piece of the full Adasum. Bandwidth is
+   ``~|x|`` instead of full-buffer recursive doubling's ``|x| log p``
+   (the reference's halving optimisation, ``adasum.h:FusedAllreduce``).
+3. **Reconstruction**: ``all_gather`` of the pieces + per-rank offsets, then
+   a static unrolled scatter rebuilds the full vector on every active.
+4. **Post-broadcast**: passive members receive the result from their
+   pre-pairing partner via the reverse ``ppermute``.
+
+Everything is masked SPMD: every device executes the same XLA program; set
+membership and active/passive roles are ``where``-selects, and the ppermute
+tables are built statically from the process-set ranks.
 """
 
 from __future__ import annotations
 
+from typing import Optional, Sequence
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = ["adasum_combine", "adasum_allreduce"]
@@ -46,24 +61,124 @@ def adasum_combine(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return (ca * af + cb * bf).astype(a.dtype)
 
 
-def adasum_allreduce(x: jnp.ndarray, axis: str, world_size: int) -> jnp.ndarray:
+def _coeffs(dot, asq, bsq):
+    ca = jnp.where(asq > 0, 1.0 - dot / (2.0 * jnp.where(asq > 0, asq, 1.0)),
+                   1.0)
+    cb = jnp.where(bsq > 0, 1.0 - dot / (2.0 * jnp.where(bsq > 0, bsq, 1.0)),
+                   1.0)
+    return ca, cb
+
+
+def adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
+                     ranks: Optional[Sequence[int]] = None) -> jnp.ndarray:
     """Adasum-allreduce ``x`` across ``axis`` (inside shard_map).
 
-    ``world_size`` must be a power of two (the reference has the same
-    restriction for its recursive structure; upstream falls back to ring for
-    the remainder — we raise instead and let the caller fall back to mean).
+    ``axis_size`` is the static mesh-axis length; ``ranks`` the member
+    global ranks in process-set order (``None`` = the full axis). Any member
+    count >= 1 is supported. Non-members get ``x`` back unchanged.
     """
-    if world_size & (world_size - 1):
-        raise ValueError(
-            f"adasum_allreduce requires a power-of-two world size, got {world_size}")
-    rounds = world_size.bit_length() - 1
-    for k in range(rounds):
-        d = 1 << k
-        perm = [(i, i ^ d) for i in range(world_size)]
-        partner = lax.ppermute(x, axis, perm)
-        x = adasum_combine(x, partner)
-    return x
+    members = list(range(axis_size)) if ranks is None else list(ranks)
+    k = len(members)
+    if k == 1:
+        return x
 
+    # Per-device: member? and setrank (position in `members`), via static
+    # lookup tables indexed by the global axis index.
+    gid = lax.axis_index(axis)
+    member_np = np.zeros(axis_size, bool)
+    setrank_np = np.zeros(axis_size, np.int32)
+    for j, rk in enumerate(members):
+        member_np[rk] = True
+        setrank_np[rk] = j
+    member = jnp.asarray(member_np)[gid]
+    setrank = jnp.asarray(setrank_np)[gid]
 
-def is_power_of_two(n: int) -> bool:
-    return n > 0 and not (n & (n - 1))
+    p = 1 << (k.bit_length() - 1)   # largest power of two <= k
+    r = k - p
+    active = member & (setrank < p)
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).ravel()
+    L0 = flat.shape[0]
+    # Pad so every halving round splits evenly and the final piece length is
+    # integral.
+    Lp = int(-(-L0 // p) * p)
+    flat = jnp.pad(flat, (0, Lp - L0))
+
+    # --- Phase 1: pre-pairing (k -> p actives) -----------------------------
+    if r > 0:
+        perm = [(members[p + i], members[i]) for i in range(r)]
+        recv = lax.ppermute(flat, axis, perm)
+        has_partner = member & (setrank < r)
+        dot = jnp.vdot(flat, recv)
+        asq = jnp.vdot(flat, flat)
+        bsq = jnp.vdot(recv, recv)
+        ca, cb = _coeffs(dot, asq, bsq)
+        combined = ca * flat + cb * recv
+        flat = jnp.where(has_partner, combined, flat)
+
+    # --- Phase 2: VHDD reduce among the p actives --------------------------
+    cur = flat
+    length = Lp
+    rounds = p.bit_length() - 1
+    for t in range(rounds):
+        d = 1 << t
+        half = length // 2
+        # Exchange only the live piece with the XOR partner (this is the
+        # halving: wire traffic sums to ~|x|, not |x| log p).
+        perm = [(members[i], members[i ^ d]) for i in range(p)]
+        recv = lax.ppermute(cur[:length], axis, perm)
+        # Keep low half if my `d` bit is unset, else high half.
+        keep_high = (setrank & d) != 0
+        mine_lo, mine_hi = cur[:half], cur[half:length]
+        theirs_lo, theirs_hi = recv[:half], recv[half:length]
+        a_piece = jnp.where(keep_high, mine_hi, mine_lo)
+        b_piece = jnp.where(keep_high, theirs_hi, theirs_lo)
+        # The subtree vectors L (pair member with the bit unset) and R are
+        # distributed over 2d ranks, so the dot/norm partials must be summed
+        # over the whole recursion group — upstream's per-level group
+        # allreduce (adasum.h DispatchComputeDotAndNormSqrds). Normalize
+        # roles (a = L on bit-unset ranks) and butterfly-sum 3 scalars.
+        pd = jnp.stack([jnp.vdot(a_piece, b_piece),
+                        jnp.vdot(a_piece, a_piece),
+                        jnp.vdot(b_piece, b_piece)])
+        q = jnp.where(keep_high, pd[jnp.asarray([0, 2, 1])], pd)
+        for s in range(t + 1):
+            e = 1 << s
+            perm_s = [(members[i], members[i ^ e]) for i in range(p)]
+            q = q + lax.ppermute(q, axis, perm_s)
+        dot, lsq, rsq = q[0], q[1], q[2]
+        cl, cr = _coeffs(dot, lsq, rsq)
+        ca = jnp.where(keep_high, cr, cl)   # coefficient for my piece
+        cb = jnp.where(keep_high, cl, cr)   # coefficient for partner piece
+        new_piece = ca * a_piece + cb * b_piece
+        # Inactive devices carry their buffer along unchanged (masked).
+        cur = jnp.where(active, jnp.pad(new_piece, (0, Lp - half)),
+                        cur)
+        length = half
+
+    # --- Phase 3: reconstruction -------------------------------------------
+    # Active setrank j ends holding the piece at offset
+    # sum_t bit_t(j) * Lp/2^(t+1)  =  bitreverse(j, rounds) * piece_len —
+    # a pure function of the static rank tables, so the gathered pieces
+    # reassemble with a static concatenation (no dynamic scatters).
+    if rounds > 0:
+        piece = cur[:length]
+        pieces = lax.all_gather(
+            jnp.where(active, piece, jnp.zeros_like(piece)), axis)
+        def bitrev(j):
+            return int(f"{j:0{rounds}b}"[::-1], 2)
+        order = [members[bitrev(slot)] for slot in range(p)]
+        result = jnp.concatenate([pieces[g] for g in order])
+    else:
+        result = cur
+
+    # --- Phase 4: post-broadcast to passive members ------------------------
+    if r > 0:
+        perm = [(members[i], members[p + i]) for i in range(r)]
+        recv = lax.ppermute(result, axis, perm)
+        passive = member & (setrank >= p)
+        result = jnp.where(passive, recv, result)
+
+    result = result[:L0].reshape(orig_shape).astype(orig_dtype)
+    return jnp.where(member, result, x)

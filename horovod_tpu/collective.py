@@ -39,7 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import core
 from horovod_tpu import fusion as _fusion
-from horovod_tpu.adasum import adasum_allreduce, is_power_of_two
+from horovod_tpu.adasum import adasum_allreduce
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet, global_process_set
 
@@ -156,13 +156,7 @@ def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
             else lax.all_gather(x, ps.axis)
         out = jnp.prod(gathered, axis=0)
     elif op == ReduceOp.Adasum:
-        if ps.ranks is not None:
-            raise NotImplementedError(
-                "Adasum is supported on the global process set only")
-        if not is_power_of_two(k):
-            raise ValueError(
-                f"Adasum requires a power-of-two world size, got {k}")
-        out = adasum_allreduce(x, ps.axis, k)
+        out = adasum_allreduce(x, ps.axis, core.size(), ps.ranks)
     else:
         raise ValueError(f"unknown reduce op {op}")
     if op in _SCALING_OPS and postscale != 1.0:
@@ -301,44 +295,143 @@ _INTRACE = {
 
 _EAGER_CACHE: dict = {}
 
-# Monotonic eager-op counter; part of every negotiated signature.
+# Negotiation state: monotonic op counter, rolling signature hash, response
+# cache (native Coordinator when available), and round statistics.
 _OP_SEQ = 0
+_NEG_HASH = b"\x00" * 16
+_NEG_COORD = None          # native.Coordinator | None
+_NEG_CACHE: set = set()    # python fallback response cache
+_NEG_STATS = {"full": 0, "fast": 0}
 
 
 def _reset_negotiation() -> None:
-    """Restart the op sequence (re-init / elastic re-mesh: membership
-    changed, so the submission history starts over — upstream resets its
-    controller state on topology change)."""
-    global _OP_SEQ
+    """Restart the op sequence and response cache (re-init / elastic
+    re-mesh: membership changed, so the submission history starts over —
+    upstream resets its controller state on topology change)."""
+    global _OP_SEQ, _NEG_HASH, _NEG_COORD
     _OP_SEQ = 0
+    _NEG_HASH = b"\x00" * 16
+    _NEG_COORD = None
+    _NEG_CACHE.clear()
+    _NEG_STATS["full"] = _NEG_STATS["fast"] = 0
+
+
+def _neg_coordinator():
+    """The native coordination core (cpp/hvdtpu_core.cpp) backing the
+    response cache and the pending-op table the stall inspector reads;
+    None if the toolchain is unavailable (python fallback)."""
+    global _NEG_COORD
+    if _NEG_COORD is None:
+        from horovod_tpu import native
+        if native.native_available():
+            _NEG_COORD = native.Coordinator(jax.process_count())
+    return _NEG_COORD
+
+
+def _cache_seen(key: str) -> bool:
+    coord = _neg_coordinator()
+    if coord is not None:
+        return coord.cache_get(key) is not None
+    return key in _NEG_CACHE
+
+
+def _cache_add(key: str) -> None:
+    coord = _neg_coordinator()
+    if coord is not None:
+        coord.cache_put(key, "1")
+    else:
+        _NEG_CACHE.add(key)
+
+
+def _host_allgather_i32(vec: np.ndarray) -> np.ndarray:
+    """One fixed-shape host round: allgather a small int32 vector across
+    processes (shape-uniform, so fast and slow negotiation paths can never
+    land on mismatched host collectives; int32 because jax's default x32
+    mode would silently truncate int64 payloads)."""
+    from jax.experimental import multihost_utils as mhu
+    return np.asarray(mhu.process_allgather(np.asarray(vec, np.int32)))
+
+
+def negotiation_stall_report(timeout_s: float = 60.0):
+    """[(op_signature, missing_rank_count)] for negotiations stuck longer
+    than ``timeout_s`` (native stall inspector, upstream
+    ``stall_inspector.cc``). Empty when the native core is unavailable."""
+    coord = _NEG_COORD
+    return coord.stall_check(timeout_s) if coord is not None else []
 
 
 def _negotiate(kind: str, sig_key: tuple) -> None:
-    """Multi-process eager negotiation (upstream ``controller.cc``).
+    """Multi-process eager negotiation (upstream ``controller.cc`` +
+    ``response_cache.cc``, rebuilt host-side).
 
     Every process must issue the same eager collectives in the same order —
     a mismatch would execute different global programs and hang the slice.
-    Each call is cross-checked with a host-side allgather of
-    ``(sequence_number, op, shapes, params)``; the sequence number catches
-    reordering, not just differing ops. There is deliberately no cached
-    fast path: a cache hit on one process while another diverges would turn
-    the error into a silent distributed hang — and on TPU the hot path
-    (collectives inside jit) never negotiates at all, so per-eager-call
-    negotiation costs nothing that matters. (The reference can cache
-    because its controller thread still synchronises every cycle.)
+
+    Protocol (one fixed-shape round steady-state):
+
+    1. Fold ``(sequence_number, op, shapes, params)`` into a rolling
+       128-bit signature hash; allgather ``[hash_0..hash_3, need_full]``
+       (5 int32 — ONE host round). The rolling hash covers the entire op
+       history, so any reorder/skip/divergence makes hashes differ at the
+       next call and every process raises *before* touching the device.
+    2. If any process flags ``need_full`` (signature not in its response
+       cache), everyone runs the full signature allgather (two more
+       rounds), verifies equality, and caches it — the reference's
+       response-cache warmup. Both paths start with the same fixed-shape
+       round, so a cache hit on one process and a miss on another can
+       never deadlock on mismatched host collectives.
+
+    The native Coordinator (cpp/hvdtpu_core.cpp) backs the response cache
+    and tracks the op as pending until negotiation completes, which is what
+    ``negotiation_stall_report`` / the stall inspector reads when a peer
+    stops responding.
     """
-    global _OP_SEQ
+    global _OP_SEQ, _NEG_HASH
     if jax.process_count() <= 1:
         return
+    import hashlib
     _OP_SEQ += 1
-    sig = f"{_OP_SEQ}|{kind}|{sig_key!r}"
-    sigs = allgather_object(sig)
-    if any(s != sig for s in sigs):
-        table = "\n".join(f"  process {i}: {s}" for i, s in enumerate(sigs))
-        raise RuntimeError(
-            "eager collective mismatch across processes — every process "
-            "must issue the same collectives in the same order "
-            f"(reference: controller.cc negotiation).\n{table}")
+    cache_key = f"{kind}|{sig_key!r}"
+    sig = f"{_OP_SEQ}|{cache_key}"
+    _NEG_HASH = hashlib.sha256(_NEG_HASH + sig.encode()).digest()[:16]
+    h = np.frombuffer(_NEG_HASH, np.int32)  # 4 x int32 = 128-bit hash
+
+    coord = _neg_coordinator()
+    me = jax.process_index()
+    if coord is not None:
+        coord.submit(me, sig)  # pending until negotiation completes
+
+    need_full = 0 if _cache_seen(cache_key) else 1
+    rows = _host_allgather_i32(
+        np.concatenate([h, [need_full]]).astype(np.int32))
+
+    if rows[:, 4].any():
+        _NEG_STATS["full"] += 1
+        sigs = allgather_object(sig)
+        if any(s != sig for s in sigs):
+            table = "\n".join(f"  process {i}: {s}"
+                              for i, s in enumerate(sigs))
+            raise RuntimeError(
+                "eager collective mismatch across processes — every process "
+                "must issue the same collectives in the same order "
+                f"(reference: controller.cc negotiation).\n{table}")
+        _cache_add(cache_key)
+    else:
+        _NEG_STATS["fast"] += 1
+        if not (rows[:, :4] == h).all():
+            bad = [i for i in range(rows.shape[0])
+                   if not (rows[i, :4] == h).all()]
+            raise RuntimeError(
+                "eager collective mismatch across processes — signature "
+                f"hash diverged at op #{_OP_SEQ} (processes {bad} disagree "
+                f"with local history; local op: {sig}). Every process must "
+                "issue the same collectives in the same order (reference: "
+                "controller.cc negotiation + response_cache.cc).")
+    if coord is not None:
+        for r in range(jax.process_count()):
+            if r != me:
+                coord.submit(r, sig)
+        coord.pop_ready()
 
 
 def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,

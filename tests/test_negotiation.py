@@ -1,6 +1,6 @@
 """Multi-process eager negotiation (SURVEY §2 row 11 — the reference's
-controller.cc readiness check, rebuilt as an ordered per-call signature
-allgather)."""
+controller.cc readiness check + response_cache.cc, rebuilt as an ordered
+rolling-hash round with a cached-signature fast path)."""
 
 import numpy as np
 import pytest
@@ -16,73 +16,134 @@ def _fresh_negotiation_state():
     C._reset_negotiation()
 
 
+def _patch_two_process(monkeypatch, hash_rows=None, peer_sigs=None):
+    """Simulate a 2-process world: the i32 hash round returns [mine, peer]
+    (peer row from hash_rows or identical), the object round returns
+    [mine, peer_sig]."""
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(C.jax, "process_index", lambda: 0)
+    i32_calls = []
+    obj_calls = []
+
+    def fake_i32(vec):
+        i32_calls.append(np.asarray(vec).copy())
+        peer = hash_rows.pop(0) if hash_rows else np.asarray(vec)
+        return np.stack([np.asarray(vec), np.asarray(peer)])
+
+    def fake_obj(obj, name=None):
+        obj_calls.append(obj)
+        peer = peer_sigs.pop(0) if peer_sigs else obj
+        return [obj, peer]
+
+    monkeypatch.setattr(C, "_host_allgather_i32", fake_i32)
+    monkeypatch.setattr(C, "allgather_object", fake_obj)
+    return i32_calls, obj_calls
+
+
 def test_single_process_skips_negotiation(monkeypatch, rng):
     calls = []
-    monkeypatch.setattr(C, "allgather_object",
-                        lambda obj, name=None: calls.append(obj) or [obj])
+    monkeypatch.setattr(C, "_host_allgather_i32",
+                        lambda v: calls.append(v) or np.asarray([v]))
     hvd.allreduce(rng.standard_normal((8, 4)).astype(np.float32))
     assert not calls  # process_count == 1 → no negotiation traffic
 
 
-def test_every_call_negotiates_with_sequence_number(monkeypatch, rng):
-    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
-    calls = []
+def test_first_sighting_full_then_cached_fast_path(monkeypatch, rng):
+    i32_calls, obj_calls = _patch_two_process(monkeypatch)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    hvd.allreduce(x)          # cache miss → full content round
+    hvd.allreduce(x + 1)      # same signature → fast path (1 host round)
+    hvd.allreduce(x - 1)
+    assert C._NEG_STATS == {"full": 1, "fast": 2}
+    assert len(i32_calls) == 3          # every call does the hash round
+    assert len(obj_calls) == 1          # only the first does content
+    assert obj_calls[0].startswith("1|")
 
-    def fake_allgather(obj, name=None):
-        calls.append(obj)
-        return [obj, obj]  # both processes submitted the same op
 
-    monkeypatch.setattr(C, "allgather_object", fake_allgather)
+def test_distinct_signatures_each_do_full_once(monkeypatch, rng):
+    _, obj_calls = _patch_two_process(monkeypatch)
     x = rng.standard_normal((8, 4)).astype(np.float32)
     hvd.allreduce(x)
-    hvd.allreduce(x + 1)
-    # No cached fast path: a cache hit on one process while another diverges
-    # would hang instead of raising. Signatures carry the op sequence.
-    assert len(calls) == 2
-    assert calls[0].startswith("1|") and calls[1].startswith("2|")
+    hvd.allreduce(np.concatenate([x, x], 1))  # different shape → new sig
+    hvd.allreduce(x)                          # cached again
+    assert C._NEG_STATS == {"full": 2, "fast": 1}
+    assert len(obj_calls) == 2
+
+
+def test_peer_needs_full_forces_content_round(monkeypatch, rng):
+    # Peer flags need_full even though our cache is warm: everyone must do
+    # the content round (that is what makes hit/miss mixes deadlock-free).
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    i32_calls, obj_calls = _patch_two_process(monkeypatch)
+    hvd.allreduce(x)      # warm local cache (full round #1)
+
+    def fake_i32(vec):
+        peer = np.asarray(vec).copy()
+        peer[4] = 1       # peer cache miss
+        return np.stack([np.asarray(vec), peer])
+
+    monkeypatch.setattr(C, "_host_allgather_i32", fake_i32)
+    hvd.allreduce(x)
+    assert C._NEG_STATS["full"] == 2
 
 
 def test_mismatched_signatures_raise(monkeypatch, rng):
-    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
-
-    def fake_allgather(obj, name=None):
-        return [obj, "1|allgather|other-op"]  # the peer diverged
-
-    monkeypatch.setattr(C, "allgather_object", fake_allgather)
+    _patch_two_process(monkeypatch, peer_sigs=["1|allgather|other-op"])
     with pytest.raises(RuntimeError, match="mismatch across processes"):
         hvd.allreduce(rng.standard_normal((8, 3)).astype(np.float32))
 
 
-def test_reordered_ops_raise(monkeypatch, rng):
-    # Same op set, different order: the sequence number in the signature
-    # catches it.
-    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+def test_cached_divergence_caught_by_hash_round(monkeypatch, rng):
+    """Both signatures cached but the peer issues them in another order:
+    the rolling hash differs at the very next call and raises before any
+    device collective runs."""
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    _patch_two_process(monkeypatch)
+    hvd.allreduce(x)                          # warm cache sig A
+    hvd.allreduce(np.concatenate([x, x], 1))  # warm cache sig B
 
-    def fake_allgather(obj, name=None):
-        peer = obj.replace("1|", "2|") if obj.startswith("1|") else obj
-        return [obj, peer]
+    def fake_i32(vec):
+        peer = np.asarray(vec).copy()
+        peer[0] ^= 0x5A5A                     # peer history hash differs
+        return np.stack([np.asarray(vec), peer])
 
-    monkeypatch.setattr(C, "allgather_object", fake_allgather)
-    with pytest.raises(RuntimeError, match="mismatch across processes"):
-        hvd.allreduce(rng.standard_normal((8, 4)).astype(np.float32))
+    monkeypatch.setattr(C, "_host_allgather_i32", fake_i32)
+    with pytest.raises(RuntimeError, match="hash diverged at op #3"):
+        hvd.allreduce(x)
 
 
 def test_reinit_restarts_sequence(monkeypatch, rng):
-    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
-    calls = []
-    monkeypatch.setattr(C, "allgather_object",
-                        lambda obj, name=None: calls.append(obj) or [obj,
-                                                                     obj])
+    _, obj_calls = _patch_two_process(monkeypatch)
     x = rng.standard_normal((8, 4)).astype(np.float32)
     hvd.allreduce(x)
-    hvd.init()  # elastic re-mesh: submission history starts over
+    hvd.init()  # elastic re-mesh: history and response cache start over
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
     hvd.allreduce(x)
-    assert calls[0].startswith("1|") and calls[1].startswith("1|")
+    assert len(obj_calls) == 2                  # cache was reset → full again
+    assert obj_calls[0].startswith("1|") and obj_calls[1].startswith("1|")
 
 
 def test_mismatch_error_lists_per_process_table(monkeypatch, rng):
-    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
-    monkeypatch.setattr(C, "allgather_object",
-                        lambda obj, name=None: [obj, "1|broadcast|x"])
+    _patch_two_process(monkeypatch, peer_sigs=["1|broadcast|x"])
     with pytest.raises(RuntimeError, match="process 1: 1\\|broadcast"):
         hvd.allreduce(rng.standard_normal((8, 5)).astype(np.float32))
+
+
+def test_native_coordinator_tracks_pending_ops(monkeypatch, rng):
+    from horovod_tpu import native
+    if not native.native_available():
+        pytest.skip("native core unavailable")
+    _patch_two_process(monkeypatch)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    hvd.allreduce(x)
+    coord = C._NEG_COORD
+    assert coord is not None
+    assert coord.pending() == 0            # completed ops were popped
+    assert coord.cache_size() >= 1         # response cache warm
+    # A stuck negotiation (submit without completion) shows up in the
+    # stall report the watchdog reads.
+    coord.submit(0, "9|allreduce|stuck-op")
+    import time
+    time.sleep(0.01)
+    report = C.negotiation_stall_report(timeout_s=0.0)
+    assert ("9|allreduce|stuck-op", 1) in report
