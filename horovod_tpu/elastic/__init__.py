@@ -12,7 +12,22 @@ retrace". State lives in host memory between steps: ``commit()`` snapshots
 pytrees off-device; ``restore()`` puts them back on the (new) mesh.
 """
 
+import os as _os
+
 from horovod_tpu.elastic.state import State, JaxState  # noqa: F401
+
+
+def state_dir():
+    """Shared directory for elastic commit persistence, set by
+    ``runner.run_elastic`` (``HVD_TPU_ELASTIC_STATE_DIR``); None outside an
+    elastic job."""
+    return _os.environ.get("HVD_TPU_ELASTIC_STATE_DIR")
+
+
+def restart_count() -> int:
+    """How many times this job has been relaunched after worker loss
+    (``HVD_TPU_ELASTIC_RESTART``); 0 on the first attempt."""
+    return int(_os.environ.get("HVD_TPU_ELASTIC_RESTART", "0"))
 from horovod_tpu.elastic.driver import (  # noqa: F401
     run, HostsUpdatedInterrupt, WorkerNotificationManager,
 )

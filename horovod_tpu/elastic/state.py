@@ -99,6 +99,34 @@ class JaxState(State):
             self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
         self.restore()
 
+    def save(self, path: str) -> None:
+        """Persist the last commit to disk (atomic write). The multi-process
+        elastic driver relaunches *every* worker after a host loss (a new
+        jax.distributed world cannot be re-formed in-process), so the last
+        commit must survive process death — the coordinator saves it, the
+        restarted job restores + ``sync()``s it (upstream keeps state in
+        surviving workers' memory; process restart is the TPU equivalent)."""
+        import pickle
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"pytrees": self._saved_pytrees,
+                         "attrs": self._saved_attrs,
+                         "commit_count": self.commit_count}, f)
+        import os
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        """Load a saved commit (see :meth:`save`) and restore it."""
+        import pickle
+
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._saved_pytrees = blob["pytrees"]
+        self._saved_attrs = blob["attrs"]
+        self.commit_count = blob["commit_count"]
+        self.restore()
+
 
 def _is_pytree_of_arrays(v: Any) -> bool:
     leaves = jax.tree_util.tree_leaves(v)

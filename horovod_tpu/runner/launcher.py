@@ -25,7 +25,7 @@ import sys
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["HostSpec", "parse_hosts", "build_worker_env", "worker_commands",
-           "run", "run_func"]
+           "run", "run_func", "run_elastic"]
 
 DEFAULT_PORT = 29500
 
@@ -165,6 +165,94 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
     if rc:
         raise RuntimeError(f"worker exited with code {rc}")
     return 0
+
+
+def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
+                max_restarts: int = 3,
+                coordinator_port: int = DEFAULT_PORT,
+                state_dir: Optional[str] = None,
+                extra_env: Optional[Dict[str, str]] = None,
+                timeout: Optional[float] = None) -> int:
+    """Fault-tolerant multi-process launch (upstream
+    ``horovod/runner/elastic/driver.py``).
+
+    Spawns ``np`` workers; when one dies, the whole job is torn down and
+    relaunched over the survivors (world shrinks by the number of failed
+    workers) with a fresh coordinator — a new ``jax.distributed`` world
+    cannot be re-formed inside a live process, so process restart IS the
+    recovery mechanism on TPU (host preemption kills every process on the
+    host anyway). Workers persist their last ``JaxState`` commit via
+    ``state.save(path)`` under ``state_dir`` (exported as
+    ``HVD_TPU_ELASTIC_STATE_DIR``) and restore + ``sync()`` it on entry;
+    ``HVD_TPU_ELASTIC_RESTART`` carries the attempt number.
+
+    Stops when a relaunch would drop below ``min_np`` or after
+    ``max_restarts`` attempts; returns the number of restarts on success.
+    """
+    import tempfile
+    import time
+
+    if state_dir is None:
+        state_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
+    world = np
+    restarts = 0
+    while True:
+        coordinator = f"127.0.0.1:{coordinator_port + restarts}"
+        procs = []
+        for pid in range(world):
+            env = build_worker_env(pid, world, coordinator,
+                                   base_env=dict(os.environ))
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
+            env["HVD_TPU_ELASTIC_RESTART"] = str(restarts)
+            if extra_env:
+                env.update(extra_env)
+            procs.append(subprocess.Popen(list(command), env=env))
+
+        failed = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(procs)
+        while pending and not failed:
+            for p in list(pending):
+                code = p.poll()
+                if code is None:
+                    continue
+                pending.remove(p)
+                if code:
+                    failed += 1
+            if pending and deadline is not None and \
+                    time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise TimeoutError(
+                    f"elastic workers still running after {timeout}s")
+            time.sleep(0.05)
+
+        if not failed:
+            return restarts
+
+        # A worker died: tear the job down (survivors are blocked on the
+        # dead rank's collectives) and relaunch over the remaining world.
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        # Only organically-failed workers (nonzero exit before teardown)
+        # count as lost hosts; survivors we terminated relaunch.
+        world = world - failed
+        restarts += 1
+        if world < min_np:
+            raise RuntimeError(
+                f"elastic job below min_np: {world} < {min_np} after "
+                f"{restarts} restart(s)")
+        if restarts > max_restarts:
+            raise RuntimeError(
+                f"elastic job exceeded max_restarts={max_restarts}")
 
 
 _FUNC_WORKER = """\
