@@ -1,16 +1,22 @@
-"""Headline benchmark: ResNet-50 training throughput, images/sec/chip
-(SURVEY §6; reference config "ResNet-50 ImageNet, examples/pytorch +
-DistributedOptimizer").
+"""Benchmarks for the five reference configs (BASELINE.json):
 
-Synthetic ImageNet-shaped data (no dataset in the image), bf16 compute,
-SGD+momentum, full fwd+bwd+allreduce+update step through
-hvd.DistributedOptimizer — the same path a user would run.
+    python bench.py                    # headline: ResNet-50, ONE JSON line
+    python bench.py --model gpt2       # GPT-2 medium, tokens/s + MFU
+    python bench.py --model all        # every config (headline printed last)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline divides by 600 img/s/chip — a typical Horovod ResNet-50 fp16
-V100 figure from the reference's own benchmark suite docs.
+Each line reports throughput, step time, and MFU = achieved TFLOP/s divided
+by the chip's peak bf16 TFLOP/s, where achieved FLOPs come from XLA's own
+compiled-program cost analysis (fwd+bwd+update, matmul FMA counted as 2
+FLOPs — the same accounting as the peak, so MFU is honest; see ROOFLINE.md
+for why analytic "GFLOPs/image" figures understate this by ~2x).
+
+vs_baseline for the headline divides by 600 img/s/chip — a typical Horovod
+ResNet-50/V100 fp16 figure from the reference's own benchmark suite docs.
+All models run the full user path: fwd + bwd + hvd.DistributedOptimizer
+update under one jit with donated state.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -25,26 +31,73 @@ import horovod_tpu as hvd
 
 BASELINE_IMG_PER_SEC = 600.0
 
+# bf16 peak TFLOP/s by device kind substring.
+_PEAKS = {"TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v4": 275.0,
+          "TPU v5p": 459.0, "TPU v6": 918.0}
 
-def main():
-    hvd.init()
+
+def _peak_tflops():
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    for k, v in _PEAKS.items():
+        if k in kind:
+            return v
+    return None
+
+
+def _sync(x):
+    """Host fetch (block_until_ready is unreliable over some PJRT
+    transports); the device queue serializes programs, so fetching the last
+    result bounds them all."""
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0])).ravel()[:1]
+
+
+def _measure(step, state, extra, steps):
+    lowered = step.lower(*state, *extra)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0)) if cost else 0.0
+
+    state = step(*state, *extra)          # warm the cache with the compiled fn
+    state = step(*state, *extra)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(*state, *extra)
+    _sync(state)
+    dt = (time.perf_counter() - t0) / steps
+    return dt, flops
+
+
+def _report(metric, unit, per_sec, dt, flops, vs_baseline=None):
+    peak = _peak_tflops()
+    rec = {
+        "metric": metric,
+        "value": round(per_sec, 2),
+        "unit": unit,
+        "vs_baseline": (round(vs_baseline, 3) if vs_baseline is not None
+                        else None),
+        "step_ms": round(dt * 1e3, 2),
+        "achieved_tflops": round(flops / dt / 1e12, 1),
+    }
+    if peak:
+        rec["mfu"] = round(flops / dt / 1e12 / peak, 3)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def bench_resnet50(on_tpu):
     from horovod_tpu.models import ResNet50
-    backend = jax.default_backend()
-    # Batch sized for one v5e chip in bf16; tiny on CPU so smoke runs finish.
-    batch = 128 if backend != "cpu" else 8
-    size = 224 if backend != "cpu" else 64
-    steps = 20 if backend != "cpu" else 3
-
+    batch, size, steps = (128, 224, 30) if on_tpu else (8, 64, 3)
     model = ResNet50(num_classes=1000)
-    rng = jax.random.PRNGKey(0)
     images = jnp.asarray(
         np.random.default_rng(0).standard_normal((batch, size, size, 3)),
         jnp.bfloat16)
     labels = jnp.asarray(
         np.random.default_rng(1).integers(0, 1000, (batch,)), jnp.int32)
-    variables = model.init(rng, images, train=True)
+    variables = model.init(jax.random.PRNGKey(0), images, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
-
     opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
     opt_state = opt.init(params)
 
@@ -56,39 +109,161 @@ def main():
         loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
         return loss, updates["batch_stats"]
 
-    # Donating params/batch_stats/opt_state lets XLA update them in place,
-    # halving HBM traffic for the weight tensors on the update path.
     @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(params, batch_stats, opt_state, images, labels):
-        (loss, batch_stats), grads = jax.value_and_grad(
+    def step(params, batch_stats, opt_state, images, labels):
+        (_, batch_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, images, labels)
         updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, batch_stats, opt_state, loss
+        return optax.apply_updates(params, updates), batch_stats, opt_state
 
-    # Warmup (compile) then timed steps. Synchronize with a host fetch of the
-    # final loss (not just block_until_ready): the chained params dependency
-    # forces every step to have executed before the fetch returns, and a D2H
-    # fetch is reliable across PJRT transports.
-    for _ in range(3):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    float(loss)
+    dt, flops = _measure(step, (params, batch_stats, opt_state),
+                         (images, labels), steps)
+    return _report("resnet50_images_per_sec_per_chip", "images/sec/chip",
+                   batch / dt, dt, flops,
+                   vs_baseline=batch / dt / BASELINE_IMG_PER_SEC)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    float(loss)
-    dt = time.perf_counter() - t0
 
-    img_per_sec = batch * steps / dt
-    print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(img_per_sec, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-    }))
+def _bench_lm(params, tokens, loss_fn, steps, metric):
+    """loss_fn closes over its token batch (synthetic data is constant
+    across steps); only the train state threads through the jit."""
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-4))
+    opt_state = opt.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        _, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    dt, flops = _measure(step, (params, opt_state), (), steps)
+    n_tokens = tokens.shape[0] * tokens.shape[1]
+    return _report(metric, "tokens/sec/chip", n_tokens / dt, dt, flops)
+
+
+def bench_gpt2(on_tpu):
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+    if on_tpu:
+        import dataclasses
+        cfg = dataclasses.replace(GPT2Config.medium(), attention="flash",
+                                  remat=True)
+        B, T, steps = 8, 1024, 10
+    else:
+        cfg = GPT2Config.tiny()
+        B, T, steps = 2, 64, 3
+    model = GPT2(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return _bench_lm(
+        params, tokens,
+        lambda p: loss_fn(model.apply({"params": p}, tokens), tokens),
+        steps, "gpt2_medium_tokens_per_sec_per_chip")
+
+
+def bench_bert(on_tpu):
+    from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss
+    if on_tpu:
+        import dataclasses
+        cfg = dataclasses.replace(BertConfig.large(), attention="flash",
+                                  remat=True)
+        B, T, steps = 8, 512, 10
+    else:
+        cfg = BertConfig.tiny()
+        B, T, steps = 2, 64, 3
+    model = Bert(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    mask_pos = jnp.asarray(rng.random((B, T)) < 0.15, jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss(p):
+        mlm, _ = model.apply({"params": p}, tokens)
+        return mlm_loss(mlm, tokens, mask_pos)
+
+    return _bench_lm(params, tokens, loss, steps,
+                     "bert_large_tokens_per_sec_per_chip")
+
+
+def bench_vit(on_tpu):
+    from horovod_tpu.models.vit import ViT, ViTConfig
+    cfg = ViTConfig.b16() if on_tpu else ViTConfig.tiny()
+    batch, steps = (128, 20) if on_tpu else (8, 3)
+    model = ViT(cfg)
+    size = cfg.image_size
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, size, size, 3)),
+        jnp.bfloat16)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.num_classes, (batch,)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), images, train=True)["params"]
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-4))
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, images, train=True)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        _, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    dt, flops = _measure(step, (params, opt_state), (), steps)
+    return _report("vit_b16_images_per_sec_per_chip", "images/sec/chip",
+                   batch / dt, dt, flops)
+
+
+def bench_mnist(on_tpu):
+    from horovod_tpu.models import MnistCNN
+    batch, steps = (512, 30) if on_tpu else (64, 3)
+    model = MnistCNN()
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, 28, 28, 1)),
+        jnp.float32)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 10, (batch,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), images)["params"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, images,
+                             rngs={"dropout": jax.random.PRNGKey(1)})
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        _, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    dt, flops = _measure(step, (params, opt_state), (), steps)
+    return _report("mnist_images_per_sec_per_chip", "images/sec/chip",
+                   batch / dt, dt, flops)
+
+
+_BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
+            "bert": bench_bert, "vit": bench_vit, "mnist": bench_mnist}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=list(_BENCHES) + ["all"])
+    args = p.parse_args()
+    hvd.init()
+    on_tpu = jax.default_backend() != "cpu"
+    if args.model == "all":
+        # headline (resnet50) last so single-line parsers read it.
+        for name in ("mnist", "vit", "bert", "gpt2", "resnet50"):
+            _BENCHES[name](on_tpu)
+    else:
+        _BENCHES[args.model](on_tpu)
 
 
 if __name__ == "__main__":

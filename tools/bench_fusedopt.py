@@ -1,0 +1,81 @@
+"""Measure: optax.sgd per-leaf update vs fused flat-buffer SGD+momentum."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np, optax
+
+def sync(x):
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0])).ravel()[:1]
+
+def timeit_state(fn, state, extra, steps=30):
+    state = fn(*state, *extra); sync(state)
+    t0 = time.perf_counter()
+    for _ in range(steps): state = fn(*state, *extra)
+    sync(state)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+def main():
+    from horovod_tpu.models import ResNet50
+    batch = 128
+    images = jnp.asarray(np.random.default_rng(0).standard_normal((batch,224,224,3)), jnp.bfloat16)
+    labels = jnp.asarray(np.random.default_rng(1).integers(0,1000,(batch,)), jnp.int32)
+    model = ResNet50(num_classes=1000)
+    v = model.init(jax.random.PRNGKey(0), images, train=True)
+    params, bstats = v["params"], v["batch_stats"]
+
+    def loss_fn(params, bstats, images, labels):
+        logits, upd = model.apply({"params": params, "batch_stats": bstats}, images, train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:,None],1)), upd["batch_stats"]
+
+    # A: optax per-leaf
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    @partial(jax.jit, donate_argnums=(0,1,2))
+    def step_a(params, bstats, opt_state, images, labels):
+        (l, bstats), g = jax.value_and_grad(loss_fn, has_aux=True)(params, bstats, images, labels)
+        u, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, u), bstats, opt_state
+    ms = timeit_state(step_a, (params, bstats, opt_state), (images, labels))
+    print(f"optax sgd+mom per-leaf: {ms:7.2f} ms  img/s={batch/ms*1e3:7.1f}", flush=True)
+
+    # B: fused flat-buffer SGD+momentum
+    v = model.init(jax.random.PRNGKey(0), images, train=True)
+    params, bstats = v["params"], v["batch_stats"]
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offs = np.cumsum([0] + sizes)
+    flat = jnp.concatenate([l.ravel() for l in leaves])
+    mom = jnp.zeros_like(flat)
+
+    def unflatten(flat):
+        return jax.tree_util.tree_unflatten(
+            treedef, [jax.lax.dynamic_slice(flat, (int(o),), (s,)).reshape(sh)
+                      for o, s, sh in zip(offs[:-1], sizes, shapes)])
+
+    @partial(jax.jit, donate_argnums=(0,1,2))
+    def step_b(flat, mom, bstats, images, labels):
+        params = unflatten(flat)
+        (l, bstats), g = jax.value_and_grad(loss_fn, has_aux=True)(params, bstats, images, labels)
+        gflat = jnp.concatenate([x.ravel() for x in jax.tree_util.tree_leaves(g)])
+        mom = 0.9 * mom + gflat
+        flat = flat - 0.1 * mom
+        return flat, mom, bstats
+    ms = timeit_state(step_b, (flat, mom, bstats), (images, labels))
+    print(f"fused flat sgd+mom:     {ms:7.2f} ms  img/s={batch/ms*1e3:7.1f}", flush=True)
+
+    # C: flat without momentum — bounds the optimizer-state traffic cost
+    v = model.init(jax.random.PRNGKey(0), images, train=True)
+    bstats = v["batch_stats"]
+    flat2 = jnp.concatenate([l.ravel() for l in jax.tree_util.tree_leaves(v["params"])])
+    @partial(jax.jit, donate_argnums=(0,1))
+    def step_c(flat, bstats, images, labels):
+        params = unflatten(flat)
+        (l, bstats), g = jax.value_and_grad(loss_fn, has_aux=True)(params, bstats, images, labels)
+        gflat = jnp.concatenate([x.ravel() for x in jax.tree_util.tree_leaves(g)])
+        return flat - 0.1 * gflat, bstats
+    ms = timeit_state(step_c, (flat2, bstats), (images, labels))
+    print(f"fused flat sgd (nomom): {ms:7.2f} ms  img/s={batch/ms*1e3:7.1f}", flush=True)
+
+main()

@@ -1,0 +1,108 @@
+"""Perf sweep for the ResNet-50 headline bench: try batch sizes / variants,
+print img/s + achieved TFLOP/s + MFU for each. Run on the real chip.
+
+Usage: python tools/bench_sweep.py [--batches 128,256,512]
+"""
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5e": 197.0}
+
+
+def peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in PEAK_TFLOPS.items():
+        if k in kind:
+            return v
+    return 197.0
+
+
+def run_one(batch, steps=30, size=224):
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, size, size, 3)),
+        jnp.bfloat16)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 1000, (batch,)), jnp.int32)
+    variables = model.init(rng, images, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+        return loss, updates["batch_stats"]
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    lowered = train_step.lower(params, batch_stats, opt_state, images, labels)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    flops_per_step = cost.get("flops", 0.0) if cost else 0.0
+
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    step_ms = dt / steps * 1e3
+    achieved_tflops = flops_per_step * steps / dt / 1e12
+    peak = peak_for(jax.devices()[0])
+    # analytic: ~12.3 GFLOP/image fwd+bwd for ResNet-50 @224
+    analytic_tflops = img_s * 12.3e9 / 1e12
+    print(f"batch={batch:4d} step={step_ms:8.2f}ms img/s={img_s:9.1f} "
+          f"xla_flops/step={flops_per_step/1e9:8.1f}G "
+          f"achieved={achieved_tflops:6.1f} TF/s (xla) "
+          f"analytic={analytic_tflops:6.1f} TF/s "
+          f"MFU={100*analytic_tflops/peak:5.1f}%", flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", default="128,256,512")
+    p.add_argument("--steps", type=int, default=30)
+    args = p.parse_args()
+    hvd.init()
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    for b in [int(x) for x in args.batches.split(",")]:
+        run_one(b, steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np, optax
+
+def main():
+    from horovod_tpu.models import ResNet50
+    batch = 128
+    images = jnp.asarray(np.random.default_rng(0).standard_normal((batch,224,224,3)), jnp.bfloat16)
+    labels = jnp.asarray(np.random.default_rng(1).integers(0,1000,(batch,)), jnp.int32)
+    model = ResNet50(num_classes=1000)
+    v = model.init(jax.random.PRNGKey(0), images, train=True)
+    params, bs = v["params"], v["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    def loss_fn(params, bs, images, labels):
+        logits, upd = model.apply({"params": params, "batch_stats": bs}, images, train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:,None],1)), upd["batch_stats"]
+    @partial(jax.jit, donate_argnums=(0,1,2))
+    def step(params, bs, opt_state, images, labels):
+        (l, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(params, bs, images, labels)
+        u, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, u), bs, opt_state, l
+    for _ in range(3):
+        params, bs, opt_state, l = step(params, bs, opt_state, images, labels)
+    float(l)
+    with jax.profiler.trace("/tmp/rn50_trace"):
+        for _ in range(5):
+            params, bs, opt_state, l = step(params, bs, opt_state, images, labels)
+        float(l)
+    print("trace done")
+
+main()
