@@ -1,0 +1,75 @@
+"""Injected cluster interface for the Spark/Ray integration layers.
+
+Upstream couples its estimators to concrete schedulers (``horovod/ray/
+runner.py`` holds ray actor handles; ``horovod/spark/__init__.py`` drives
+Spark barrier tasks). Here the scheduling surface is one small interface —
+``ClusterBackend.run(fn, ...) -> per-rank results`` — so the estimator and
+executor state machines are testable with local processes and portable to
+any scheduler (a Ray backend binds when ray is importable; a TPU-VM pod
+backend is ``horovod_tpu.runner`` itself).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ClusterBackend", "LocalProcessBackend", "InlineBackend"]
+
+
+class ClusterBackend:
+    """Minimal scheduler contract: place ``num_workers`` rendezvoused
+    workers, execute a function on every worker, tear down."""
+
+    num_workers: int
+
+    def start(self) -> None:
+        """Acquire resources / placement (idempotent)."""
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict] = None,
+            env: Optional[Dict[str, str]] = None) -> List[Any]:
+        """Execute ``fn(*args, **kwargs)`` on every worker with the
+        communicator initialized (``hvd.init()`` done); returns the
+        per-rank results, rank order."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        """Release resources (idempotent)."""
+
+
+class LocalProcessBackend(ClusterBackend):
+    """Workers are local processes rendezvousing over jax.distributed
+    (``runner.run_func``) — the fake-cluster used by tests and the
+    single-host fallback when no scheduler package is installed."""
+
+    def __init__(self, num_workers: int, coordinator_port: int = 29700,
+                 timeout: Optional[float] = 300.0):
+        self.num_workers = num_workers
+        self._port = coordinator_port
+        self._timeout = timeout
+        self._runs = 0
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        from horovod_tpu.runner.launcher import run_func
+        # A fresh port per run: each run_func is a new jax.distributed
+        # world, and immediate rebinds can hit lingering sockets. One CPU
+        # device per worker — a parent test harness may export a virtual
+        # multi-device XLA_FLAGS that must not leak into the fake cluster.
+        self._runs += 1
+        worker_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        worker_env.update(env or {})
+        return run_func(fn, args=args, kwargs=kwargs or {},
+                        np=self.num_workers,
+                        coordinator_port=self._port + self._runs,
+                        extra_env=worker_env, timeout=self._timeout)
+
+
+class InlineBackend(ClusterBackend):
+    """Single in-process 'worker' using the already-initialized local
+    communicator — unit-tests the estimator/executor state machines without
+    process spawning (hvd must be initialized by the caller)."""
+
+    num_workers = 1
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        return [fn(*args, **(kwargs or {}))]

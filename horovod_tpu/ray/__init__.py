@@ -1,24 +1,176 @@
-"""Ray integration surface (upstream ``horovod/ray``).
+"""Ray integration (upstream ``horovod/ray/runner.py:RayExecutor``).
 
-API-parity stubs: ray is not in the TPU image. The equivalent capability —
-scheduling workers over a dynamic host set with elastic membership — is
-provided natively by ``horovod_tpu.runner`` + ``horovod_tpu.elastic``.
+The executor state machine — place N rendezvoused workers, run functions on
+all of them, collect per-rank results, tear down — is implemented against
+the injected :class:`horovod_tpu.cluster.ClusterBackend`, so it works (and
+is tested) without the ray package: the default backend is
+``LocalProcessBackend`` (real processes + jax.distributed rendezvous). When
+ray *is* importable, ``RayBackend`` schedules the same contract over ray
+tasks; on a TPU pod the natural backend is one worker per TPU-VM host.
 """
 
 from __future__ import annotations
 
-_MSG = ("horovod_tpu.ray requires the ray package, which is not in this "
-        "environment. Use horovod_tpu.runner for multi-host launch and "
-        "horovod_tpu.elastic for dynamic membership.")
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
+
+__all__ = ["RayExecutor", "RayBackend", "ray_available", "run_remote"]
 
 
-def _unavailable(*_a, **_k):
-    raise RuntimeError(_MSG)
+def run_remote(*_a, **_k):
+    """Upstream module-level ``horovod.ray.run_remote`` surface — here the
+    async path is a method: ``RayExecutor(...).run_remote(fn)``."""
+    raise RuntimeError(
+        "horovod_tpu.ray.run_remote: construct a RayExecutor and call "
+        "executor.run_remote(fn) (returns a Future; .result() replaces "
+        "ray.get)")
+
+
+def ray_available() -> bool:
+    try:
+        import ray  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class RayBackend(ClusterBackend):
+    """ClusterBackend over ray remote tasks (requires the ray package).
+
+    Each worker is a ray task pinned by ``resources_per_worker``; the
+    rendezvous env (coordinator address + rank) is injected exactly as
+    ``runner.run_func`` does locally.
+    """
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict] = None,
+                 coordinator_port: int = 29800):
+        if not ray_available():
+            raise RuntimeError(
+                "RayBackend requires the ray package; inject "
+                "LocalProcessBackend (or any ClusterBackend) instead on "
+                "environments without ray")
+        self.num_workers = num_workers
+        self._resources = resources_per_worker or {}
+        self._port = coordinator_port
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        import ray
+
+        n = self.num_workers
+        port = self._port
+
+        # Rank 0 binds the jax.distributed coordinator, so its address must
+        # be *rank 0's node*, not the driver's: rank 0 runs inside an actor
+        # whose routable IP is queried first, then everyone (actor included)
+        # rendezvouses against it (upstream RayExecutor resolves the nics of
+        # its actor group the same way).
+        @ray.remote
+        class _Rank0:
+            def ip(self):
+                from horovod_tpu.runner.launcher import local_ip
+                return local_ip()
+
+            def work(self, coordinator):
+                _enter(coordinator, 0)
+                return fn(*args, **(kwargs or {}))
+
+        def _enter(coordinator, pid):
+            import os
+            os.environ.update(env or {})
+            os.environ["HVD_TPU_COORDINATOR"] = coordinator
+            os.environ["HVD_TPU_NUM_PROCESSES"] = str(n)
+            os.environ["HVD_TPU_PROCESS_ID"] = str(pid)
+            import horovod_tpu as hvd
+            hvd.init()
+
+        @ray.remote
+        def _worker(coordinator, pid: int):
+            _enter(coordinator, pid)
+            return fn(*args, **(kwargs or {}))
+
+        opts = {"resources": self._resources} if self._resources else {}
+        rank0 = _Rank0.options(**opts).remote()
+        coordinator = f"{ray.get(rank0.ip.remote())}:{port}"
+        futs = [rank0.work.remote(coordinator)]
+        worker = _worker.options(**opts)
+        futs += [worker.remote(coordinator, pid) for pid in range(1, n)]
+        return ray.get(futs)
 
 
 class RayExecutor:
-    def __init__(self, *a, **k):
-        _unavailable()
+    """``horovod.ray.RayExecutor`` parity: start N workers, run functions
+    on all of them, collect per-rank results.
 
+    Differences from upstream are TPU-model driven: workers are processes
+    that rendezvous through jax.distributed (not long-lived ray actors
+    holding NCCL comms), so each ``run`` forms a fresh world — which is
+    also what makes the executor elastic-friendly (see
+    ``runner.run_elastic``).
+    """
 
-run_remote = _unavailable
+    def __init__(self, settings: Optional[Any] = None,
+                 num_workers: Optional[int] = None,
+                 cpus_per_worker: int = 1, use_gpu: bool = False,
+                 gpus_per_worker: int = 0,
+                 backend: Optional[ClusterBackend] = None):
+        if backend is None:
+            n = num_workers or 1
+            backend = RayBackend(n) if ray_available() \
+                else LocalProcessBackend(n)
+        self.backend = backend
+        self.num_workers = backend.num_workers
+        self.settings = settings
+        self._started = False
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def start(self, extras: Optional[Dict] = None) -> None:
+        self.backend.start()
+        self._started = True
+
+    def _require_started(self):
+        if not self._started:
+            raise RuntimeError(
+                "RayExecutor.start() must be called before run/execute "
+                "(upstream contract)")
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict] = None) -> List[Any]:
+        """Run ``fn`` on every worker (hvd initialized); per-rank results."""
+        self._require_started()
+        return self.backend.run(fn, args=args, kwargs=kwargs)
+
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[Dict] = None) -> Future:
+        """Async variant: a Future resolving to the per-rank results
+        (upstream returns ray ObjectRefs; a Future is the scheduler-neutral
+        equivalent — ``.result()`` replaces ``ray.get``)."""
+        self._require_started()
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        return self._pool.submit(self.backend.run, fn, args, kwargs)
+
+    def execute(self, fn: Callable) -> List[Any]:
+        """Run a zero-arg callable on every worker (upstream
+        ``RayExecutor.execute``)."""
+        return self.run(fn)
+
+    def execute_single(self, fn: Callable) -> Any:
+        """Run on rank 0 only and return its result (upstream
+        ``execute_single``): every worker joins the rendezvous, only rank
+        0 evaluates the callable."""
+
+        def on_rank0():
+            import jax
+            return fn() if jax.process_index() == 0 else None
+
+        return self.run(on_rank0)[0]
+
+    def shutdown(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        self.backend.shutdown()
+        self._started = False

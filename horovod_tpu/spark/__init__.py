@@ -1,32 +1,140 @@
-"""Spark integration surface (upstream ``horovod/spark``).
+"""Spark integration (upstream ``horovod/spark/__init__.py``).
 
-API-parity stubs: pyspark is not part of the TPU image, and the TPU-native
-launch story is ``horovod_tpu.runner`` over TPU-VM hosts (a Spark cluster
-does not schedule TPU slices). Importing this module works; calling into it
-raises with guidance, mirroring how upstream gates on ``pyspark`` presence.
+``horovod.spark.run(fn, num_proc)`` and the estimator fit/transform state
+machine are rebuilt against the injected
+:class:`horovod_tpu.cluster.ClusterBackend`: the orchestration logic
+(worker placement, data partitioning, rendezvous, per-rank result
+collection) is real and tested with local processes; a Spark cluster is
+just one possible backend. When pyspark is importable, ``SparkBackend``
+schedules the same contract as barrier tasks on the executors — on TPU
+pods the natural scheduler is ``horovod_tpu.runner`` over TPU-VM hosts,
+which Spark clusters cannot allocate.
 """
 
 from __future__ import annotations
 
-_MSG = ("horovod_tpu.spark requires pyspark and a Spark cluster that can "
-        "schedule TPU hosts; neither exists in this environment. Use "
-        "horovod_tpu.runner (hvdrun-tpu) to launch across TPU-VM hosts, or "
-        "horovod_tpu.elastic for preemptible capacity.")
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
+from horovod_tpu.spark.estimator import JaxEstimator, JaxModel  # noqa: F401
+
+__all__ = ["run", "run_elastic", "JaxEstimator", "JaxModel", "SparkBackend",
+           "spark_available", "KerasEstimator", "TorchEstimator"]
 
 
-def _unavailable(*_a, **_k):
-    raise RuntimeError(_MSG)
+def run_elastic(*_a, **_k):
+    """Upstream ``horovod.spark.run_elastic`` surface. Elastic scheduling
+    on TPU is host-relaunch based — use
+    ``horovod_tpu.runner.run_elastic`` (worker relaunch over survivors +
+    ``JaxState.save/load``); a Spark cluster cannot reform a TPU slice."""
+    raise RuntimeError(
+        "horovod_tpu.spark.run_elastic: use horovod_tpu.runner.run_elastic "
+        "— elastic recovery on TPU relaunches worker processes over the "
+        "surviving hosts and restores the last JaxState commit")
 
 
-run = _unavailable
-run_elastic = _unavailable
+def spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class SparkBackend(ClusterBackend):
+    """ClusterBackend over Spark barrier tasks (requires pyspark).
+
+    Mirrors upstream ``horovod.spark.run``: ``num_proc`` barrier tasks, the
+    rendezvous env injected per task, results collected to the driver.
+    """
+
+    def __init__(self, num_workers: int, spark_context=None,
+                 coordinator_port: int = 29900):
+        if not spark_available():
+            raise RuntimeError(
+                "SparkBackend requires pyspark; inject LocalProcessBackend "
+                "(or any ClusterBackend) on environments without it")
+        self.num_workers = num_workers
+        self._sc = spark_context
+        self._port = coordinator_port
+
+    def run(self, fn, args=(), kwargs=None, env=None):
+        from pyspark.sql import SparkSession
+
+        sc = self._sc or SparkSession.builder.getOrCreate().sparkContext
+        n = self.num_workers
+        port = self._port
+
+        def task(it):
+            import os
+            from pyspark import BarrierTaskContext
+            ctx = BarrierTaskContext.get()
+            pid = ctx.partitionId()
+            # Rank 0 binds the coordinator, so its address must be rank
+            # 0's executor: the barrier context's task table gives every
+            # task's host (the pattern upstream horovod.spark uses).
+            host0 = ctx.getTaskInfos()[0].address.split(":")[0]
+            os.environ.update(env or {})
+            os.environ["HVD_TPU_COORDINATOR"] = f"{host0}:{port}"
+            os.environ["HVD_TPU_NUM_PROCESSES"] = str(n)
+            os.environ["HVD_TPU_PROCESS_ID"] = str(pid)
+            import horovod_tpu as hvd
+            hvd.init()
+            yield pid, fn(*args, **(kwargs or {}))
+
+        rdd = sc.parallelize(range(n), n).barrier()
+        results = dict(rdd.mapPartitions(task).collect())
+        return [results[r] for r in range(n)]
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[Dict] = None,
+        num_proc: Optional[int] = None,
+        backend: Optional[ClusterBackend] = None,
+        extra_env: Optional[Dict[str, str]] = None) -> List[Any]:
+    """``horovod.spark.run`` parity: execute ``fn`` on ``num_proc``
+    rendezvoused workers, return per-rank results (rank order)."""
+    if backend is None:
+        n = num_proc or 2
+        backend = SparkBackend(n) if spark_available() \
+            else LocalProcessBackend(n)
+    backend.start()
+    try:
+        return backend.run(fn, args=args, kwargs=kwargs, env=extra_env)
+    finally:
+        backend.shutdown()
+
+
+_GATED_MSG = (
+    "horovod_tpu.spark.{name} wraps a {framework} model and needs the "
+    "{framework} package. The estimator state machine itself is "
+    "framework-neutral — use JaxEstimator (native), or inject a "
+    "ClusterBackend and train any framework through horovod_tpu.spark.run.")
 
 
 class KerasEstimator:
+    """Upstream ``horovod.spark.keras.KerasEstimator`` surface; needs TF.
+    Use :class:`JaxEstimator` for the native path."""
+
     def __init__(self, *a, **k):
-        _unavailable()
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError:
+            raise RuntimeError(_GATED_MSG.format(
+                name="KerasEstimator", framework="tensorflow")) from None
+        raise NotImplementedError(
+            "KerasEstimator: wrap your keras model's train step with "
+            "horovod_tpu.tensorflow and run it via horovod_tpu.spark.run; "
+            "the packaged estimator only ships for flax (JaxEstimator)")
 
 
 class TorchEstimator:
+    """Upstream ``horovod.spark.torch.TorchEstimator`` surface.
+    Use :class:`JaxEstimator` for the native path, or
+    ``horovod_tpu.torch`` + ``spark.run`` for torch modules."""
+
     def __init__(self, *a, **k):
-        _unavailable()
+        raise NotImplementedError(
+            "TorchEstimator: train torch modules with horovod_tpu.torch's "
+            "DistributedOptimizer inside a function launched by "
+            "horovod_tpu.spark.run; the packaged estimator only ships for "
+            "flax (JaxEstimator)")

@@ -80,16 +80,19 @@ class TestWatchdog:
 
 class TestStubs:
     def test_spark_surface(self):
+        # Real orchestration now (see test_cluster_integrations.py); the
+        # framework-specific estimator wrappers stay gated.
         import horovod_tpu.spark as spark
-        with pytest.raises(RuntimeError, match="runner"):
-            spark.run(lambda: None)
-        with pytest.raises(RuntimeError):
+        assert callable(spark.run)
+        assert spark.JaxEstimator is not None
+        with pytest.raises((RuntimeError, NotImplementedError)):
             spark.TorchEstimator()
 
     def test_ray_surface(self):
         import horovod_tpu.ray as ray
-        with pytest.raises(RuntimeError, match="runner"):
-            ray.RayExecutor()
+        ex = ray.RayExecutor(num_workers=2)  # constructs without ray
+        with pytest.raises(RuntimeError, match="start"):
+            ex.run(lambda: 1)
 
     def test_lightning_surface(self):
         import horovod_tpu.lightning as hl
