@@ -1,12 +1,13 @@
-"""TensorFlow frontend surface (upstream ``horovod/tensorflow``).
+"""TensorFlow frontend (upstream ``horovod/tensorflow``).
 
-TensorFlow is not in the TPU image (the native frontend here is JAX — see
-``horovod_tpu.optimizer`` for DistributedOptimizer/DistributedGradientTape).
-If TF is present, thin wrappers route tensors through the same collective
-engine via numpy (capability parity, not a performance path — TF-on-TPU
+The native frontend here is JAX (``horovod_tpu.optimizer``); when TF is
+importable these wrappers route tensors through the same collective engine
+via numpy — capability parity so upstream TF2 scripts
+(``DistributedGradientTape`` / ``DistributedOptimizer`` /
+``broadcast_variables``) run unchanged, not a performance path (TF-on-TPU
 should use the JAX frontend or TF's own strategy). Without TF, importing
-this module works and every symbol raises with guidance, matching upstream's
-gating on framework presence.
+this module works and every symbol raises with guidance, matching
+upstream's gating on framework presence.
 """
 
 from __future__ import annotations
@@ -59,15 +60,137 @@ def broadcast_variables(variables, root_rank: int = 0):
         v.assign(broadcast(v, root_rank))
 
 
-def DistributedGradientTape(tape, *a, **k):
-    _require_tf()
-    raise NotImplementedError(
-        "TF DistributedGradientTape wrapper lands with a TF-enabled image; "
-        "use horovod_tpu.DistributedGradientTape (JAX) on TPU.")
+def _allreduce_tf_list(tensors, op, compression, prescale_factor,
+                       postscale_factor, process_set=None):
+    """Grouped allreduce of a list of tf tensors (None entries pass
+    through). ``tf.IndexedSlices`` (embedding grads) are densified first —
+    upstream's ``sparse_as_dense`` behavior. Under ``@tf.function`` the
+    reduction crosses into the shared engine via ``tf.py_function``, so
+    graph-traced training steps work too (the reduction itself runs
+    host-side either way — this frontend is a capability bridge, not the
+    TPU performance path)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+
+    idx = [i for i, t in enumerate(tensors) if t is not None]
+    if not idx:
+        return list(tensors)
+    dense = [_tf.convert_to_tensor(tensors[i]) for i in idx]
+
+    def _reduce_numpy(arrays):
+        outs = hvd.grouped_allreduce(
+            [to_stacked(a) for a in arrays], op=op, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
+        return [from_stacked(o) for o in outs]
+
+    if _tf.executing_eagerly():
+        reduced = [_tf.constant(o, dtype=t.dtype) for o, t in
+                   zip(_reduce_numpy([t.numpy() for t in dense]), dense)]
+    else:
+        def _bridge(*ts):
+            return _reduce_numpy([t.numpy() for t in ts])
+
+        reduced = _tf.py_function(
+            _bridge, inp=dense, Tout=[t.dtype for t in dense])
+        if not isinstance(reduced, (list, tuple)):
+            reduced = [reduced]
+        reduced = list(reduced)
+        for r, t in zip(reduced, dense):
+            r.set_shape(t.shape)
+    result = list(tensors)
+    for i, r in zip(idx, reduced):
+        result[i] = r
+    return result
 
 
-def DistributedOptimizer(optimizer, *a, **k):
+class _DistributedGradientTape:
+    """``hvd.DistributedGradientTape`` (upstream
+    ``horovod/tensorflow/__init__.py:DistributedGradientTape``): wraps a
+    ``tf.GradientTape`` so ``gradient()`` returns allreduced gradients —
+    one fused collective for the whole list, through the shared engine."""
+
+    def __init__(self, tape, op=Average, compression=Compression.none,
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 process_set=None):
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._process_set = process_set
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        flat = list(grads) if isinstance(grads, (list, tuple)) else [grads]
+        reduced = _allreduce_tf_list(flat, self._op, self._compression,
+                                     self._prescale, self._postscale,
+                                     self._process_set)
+        if isinstance(grads, (list, tuple)):
+            return type(grads)(reduced)
+        return reduced[0]
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+
+def DistributedGradientTape(tape, op=Average, compression=Compression.none,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=None, **_ignored):
     _require_tf()
-    raise NotImplementedError(
-        "TF DistributedOptimizer wrapper lands with a TF-enabled image; "
-        "use horovod_tpu.DistributedOptimizer (optax) on TPU.")
+    return _DistributedGradientTape(tape, op, compression, prescale_factor,
+                                    postscale_factor, process_set)
+
+
+class _DistributedOptimizer:
+    """``hvd.DistributedOptimizer`` for TF/keras optimizers: allreduce the
+    gradients (one fused collective), then delegate ``apply_gradients`` to
+    the wrapped optimizer. Attribute access forwards, so it drops into
+    keras ``model.compile``-free custom loops unchanged."""
+
+    def __init__(self, optimizer, op=Average, compression=Compression.none,
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 process_set=None):
+        self._opt = optimizer
+        self._op = op
+        self._compression = compression
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._process_set = process_set
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        grads = _allreduce_tf_list(
+            [g for g, _ in gv], self._op, self._compression,
+            self._prescale, self._postscale, self._process_set)
+        return self._opt.apply_gradients(
+            zip(grads, [v for _, v in gv]), **kwargs)
+
+    def minimize(self, loss, var_list, tape=None, **kwargs):
+        if tape is None and callable(loss):
+            with _tf.GradientTape() as tape:
+                value = loss()
+            grads = tape.gradient(value, var_list)
+        else:
+            grads = tape.gradient(loss, var_list)
+        return self.apply_gradients(zip(grads, var_list), **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+
+def DistributedOptimizer(optimizer, op=Average,
+                         compression=Compression.none,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         process_set=None, **_ignored):
+    _require_tf()
+    return _DistributedOptimizer(optimizer, op, compression,
+                                 prescale_factor, postscale_factor,
+                                 process_set)
