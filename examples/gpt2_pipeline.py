@@ -1,0 +1,90 @@
+"""GPT-2 trained with pipeline parallelism (GPipe schedule over a ``pp``
+mesh axis): transformer blocks staged across devices, microbatches streamed
+through ``ppermute`` hops, loss masked to the last stage inside
+``pipeline_loss`` so gradients need no caller-side scaling.
+
+Run (virtual 8-device CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/gpt2_pipeline.py --stages 8 --microbatches 8
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Force the platform via config: env-var-only selection can still try to
+    # initialize an accelerator plugin registered at interpreter startup.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+from horovod_tpu.models.gpt2_pipeline import (gpt2_pp_loss_and_grad,
+                                              stack_block_params)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", type=int, default=None,
+                    help="pipeline stages (default: all devices)")
+    ap.add_argument("--layers-per-stage", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--microbatch-size", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    hvd.init(axis_name="pp")
+    S = args.stages or hvd.size()
+    if hvd.size() != S:
+        hvd.init(devices=jax.devices()[:S], axis_name="pp")
+
+    cfg = GPT2Config(vocab_size=256, max_seq_len=args.seq,
+                     num_layers=S * args.layers_per_stage, num_heads=4,
+                     d_model=args.d_model, dtype=jnp.float32)
+    M, mb, T = args.microbatches, args.microbatch_size, args.seq
+    # GPipe bubble = (S-1)/(M+S-1): report it so the flag choice is visible.
+    bubble = (S - 1) / (M + S - 1)
+    print(f"stages={S} layers/stage={args.layers_per_stage} "
+          f"microbatches={M} -> bubble {bubble:.1%}")
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (M, mb, T)),
+                         jnp.int32)
+    params = GPT2(cfg).init(jax.random.PRNGKey(0),
+                            tokens.reshape(M * mb, T))["params"]
+    blocks, rest = stack_block_params(params, S)
+
+    grad_step = gpt2_pp_loss_and_grad(cfg, axis_name="pp")
+
+    def train_step(blocks, rest, tokens):
+        loss, g_blocks, g_rest = grad_step(blocks, rest, tokens)
+        blocks = jax.tree_util.tree_map(
+            lambda p, g: p - args.lr * g, blocks, g_blocks)
+        rest = jax.tree_util.tree_map(
+            lambda p, g: p - args.lr * g, rest, g_rest)
+        return loss, blocks, rest
+
+    fn = hvd.spmd(train_step,
+                  in_specs=(P("pp"), P(), P()),
+                  out_specs=(P(), P("pp"), P()))
+    for step in range(args.steps):
+        loss, blocks, rest = fn(blocks, rest, tokens)
+        print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,7 +99,16 @@ def _member_and_setrank(ps: ProcessSet):
 def _set_gather(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
     """Gather ``x`` from every member of ``ps`` into axis 0 (shape-uniform on
     all devices; non-members receive zeros). psum-of-one-hot, so any subset
-    works — XLA's AllGather only handles uniform replica groups."""
+    works — XLA's AllGather only handles uniform replica groups.
+
+    Cost note: the psum moves a (k, |x|) buffer over the FULL axis, i.e.
+    O(k*|x|) traffic per device regardless of membership — fine for the
+    small-subset/small-tensor uses process sets exist for (metric groups,
+    per-pipeline-stage sync), quadratic for large subsets of large tensors.
+    For those, prefer the global set (plain all_gather) or a dedicated
+    sub-mesh via ``horovod_tpu.parallel.make_mesh`` and collectives over
+    its axis; a ppermute ring for mid-size subsets is a possible future
+    optimisation."""
     k = ps.size()
     member, setrank = _member_and_setrank(ps)
     contrib = jnp.where(member, x, jnp.zeros_like(x))
@@ -470,15 +479,30 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
         _EAGER_CACHE[key] = fn
 
     sharding = NamedSharding(m, P(axis))
+
+    def place(x):
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        # Multi-process: rows for other processes' devices are not known
+        # here (each process supplies its own ranks' values), so the global
+        # array must be assembled from the process-local rows — device_put
+        # of a full array would assert cross-process equality.
+        devs = list(m.devices.ravel())
+        pidx = jax.process_index()
+        mine = [i for i, d in enumerate(devs) if d.process_index == pidx]
+        local = np.asarray(x)[mine]
+        return jax.make_array_from_process_local_data(sharding, local,
+                                                      x.shape)
+
     from horovod_tpu import timeline as _tl
     t = _tl.get_timeline()
     if t is not None:
         nbytes = sum(x.size * x.dtype.itemsize for x in leaves)
         with t.activity(kind, tensors=len(leaves), bytes=int(nbytes)):
-            placed = [jax.device_put(x, sharding) for x in leaves]
+            placed = [place(x) for x in leaves]
             out_leaves = fn(*placed)
     else:
-        placed = [jax.device_put(x, sharding) for x in leaves]
+        placed = [place(x) for x in leaves]
         out_leaves = fn(*placed)
     return jax.tree_util.tree_unflatten(treedef, list(out_leaves))
 
@@ -750,11 +774,31 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
 
 
 def join() -> int:
-    """Join op for uneven data (``hvd.join``): signals this caller has no more
-    batches. In SPMD the equivalent mechanism is mask-based — see
-    ``horovod_tpu.optimizer.DistributedOptimizer(join=...)`` which psums an
-    alive mask with the gradients. Eagerly this is a barrier; returns the last
-    rank, matching the reference's return convention."""
+    """Join op for uneven data (``hvd.join``): signals this caller has no
+    more batches; blocks until every process joins and returns the rank of
+    the **last** process to join (upstream ``horovod/common/ops/../join``).
+
+    Multi-process: every process blocks in an allgather until all have
+    joined; each then measures how long it waited on its own *monotonic*
+    clock — the last joiner waited least — and a second allgather elects
+    argmin(wait) with ties to the higher rank. Wall clocks never cross
+    hosts, so NTP skew cannot flip the election (only network jitter on
+    the rendezvous release, which is milliseconds against join-scale
+    gaps). A device barrier then flushes outstanding collectives. Ranks
+    are process-granular, matching the one-process-per-host TPU model.
+    In SPMD-under-jit the equivalent mechanism is mask-based — see
+    ``horovod_tpu.optimizer.DistributedOptimizer(join=...)`` which psums
+    an alive mask with the gradients. Single-controller eager: a barrier;
+    returns the last rank."""
+    if jax.process_count() > 1:
+        import time
+        t0 = time.monotonic()
+        allgather_object("join")            # blocks until everyone joins
+        waited = time.monotonic() - t0
+        table = allgather_object((waited, -jax.process_index()))
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("horovod_tpu_join")
+        return -min(table)[1]
     barrier()
     return core.size() - 1
 

@@ -25,8 +25,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       impl: str = "dense", block_q: int = 256,
                       block_k: int = 512) -> jnp.ndarray:
     """Attention with q/k/v sequence-sharded on ``axis_name``
-    (shapes (B, t_local, H, D)); the axis size must divide the head count
-    (each device takes H/n heads after the swap).
+    (shapes (B, t_local, H, D)). When the axis size does not divide the
+    head count, heads are zero-padded up to the next multiple (the padded
+    heads ride the all-to-alls and are sliced off the output — a small
+    compute tax instead of a hard constraint).
 
     ``impl="flash"`` runs the local full-sequence attention through the
     fused pallas kernel — after the all-to-all this is ordinary single-
@@ -36,6 +38,13 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     B, Tq, H, D = q.shape
     scale = D ** -0.5 if scale is None else scale
+    n = lax.psum(1, axis_name)
+    pad_h = (-H) % n
+    if pad_h:
+        zpad = jnp.zeros((B, Tq, pad_h, D), q.dtype)
+        q = jnp.concatenate([q, zpad], axis=2)
+        k = jnp.concatenate([k, zpad], axis=2)
+        v = jnp.concatenate([v, zpad], axis=2)
 
     def seq2head(x):
         # (B, t_local, H, D) -> (B, T, H/n, D): trade sequence shards for
@@ -47,12 +56,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # (B, T, H/n, D)
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # (B, T, H'/n, D)
     if impl == "flash":
         from horovod_tpu.ops.flash_attention import flash_attention
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
                               block_q=block_q, block_k=block_k)
-        return head2seq(out)
+        return head2seq(out)[:, :, :H]
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}; expected "
                          "'dense' or 'flash'")
@@ -64,4 +73,4 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh.astype(jnp.float32))
-    return head2seq(out.astype(q.dtype))
+    return head2seq(out.astype(q.dtype))[:, :, :H]

@@ -79,57 +79,52 @@ def build_worker_env(process_id: int, num_processes: int,
 
 
 def worker_commands(command: Sequence[str], hosts: List[HostSpec],
-                    coordinator_port: int = DEFAULT_PORT) -> List[str]:
+                    coordinator_port: int = DEFAULT_PORT,
+                    extra_env: Optional[Dict[str, str]] = None) -> List[str]:
     """One launch command per host for remote mode (the user or cloud tooling
-    executes them; the reference would ssh)."""
+    executes them; the reference would ssh). ``extra_env`` rides the env
+    prefix of every line."""
     coordinator = f"{hosts[0].host}:{coordinator_port}"
+    extras = "".join(f"{k}={shlex.quote(v)} "
+                     for k, v in (extra_env or {}).items())
     cmds = []
     for pid, spec in enumerate(hosts):
-        env = (f"HVD_TPU_COORDINATOR={coordinator} "
+        env = (f"{extras}HVD_TPU_COORDINATOR={coordinator} "
                f"HVD_TPU_NUM_PROCESSES={len(hosts)} "
                f"HVD_TPU_PROCESS_ID={pid}")
         cmds.append(f"{env} {' '.join(shlex.quote(c) for c in command)}")
     return cmds
 
 
-def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
-        coordinator_port: int = DEFAULT_PORT, dry_run: bool = False,
-        extra_env: Optional[Dict[str, str]] = None,
-        timeout: Optional[float] = None):
-    """``horovodrun`` equivalent.
+def local_ip() -> str:
+    """Best-effort address other hosts can reach this machine on (upstream
+    ``horovod/runner/driver/driver_service.py`` interface discovery): the
+    UDP-connect trick finds the interface with a default route; falls back
+    to the hostname's address."""
+    import socket
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
 
-    - ``hosts=None``: spawn ``np`` local worker processes and wait.
-    - ``hosts="h1:8,h2:8"``: print/return per-host commands (remote launch).
-    - ``dry_run``: return commands without executing.
-    - ``timeout``: kill the job and raise if workers are still running after
-      this many seconds (upstream ``--start-timeout``'s role: a wedged
-      rendezvous or accelerator runtime turns into an error, not a silent
-      infinite hang).
-    """
-    if hosts is not None:
-        specs = parse_hosts(hosts)
-        cmds = worker_commands(command, specs, coordinator_port)
-        if not dry_run:
-            for c in cmds:
-                print(c)
-        return cmds
 
-    coordinator = f"127.0.0.1:{coordinator_port}"
-    if dry_run:
-        return [" ".join(command)] * np
-    procs = []
-    for pid in range(np):
-        env = build_worker_env(pid, np, coordinator,
-                               base_env=dict(os.environ))
-        # np local processes cannot share one accelerator; default to the
-        # CPU backend for the simulated cluster (override via extra_env).
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        if extra_env:
-            env.update(extra_env)
-        procs.append(subprocess.Popen(list(command), env=env))
-    # Any worker failing must take down its peers — otherwise survivors
-    # block forever in rendezvous waiting for the dead rank (the reference
-    # kills the job on first worker failure too).
+def _ssh_argv(host: str, line: str) -> List[str]:
+    """argv to execute ``line`` on ``host`` (upstream gloo_run's ssh
+    execution; BatchMode so a missing key fails instead of prompting)."""
+    return ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+            host, line]
+
+
+def _supervise(procs: List[subprocess.Popen],
+               timeout: Optional[float]) -> int:
+    """Wait for workers; any worker failing must take down its peers —
+    otherwise survivors block forever in rendezvous waiting for the dead
+    rank (the reference kills the job on first worker failure too)."""
     import time
     rc = 0
     timed_out = False
@@ -165,6 +160,55 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
     if rc:
         raise RuntimeError(f"worker exited with code {rc}")
     return 0
+
+
+def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
+        coordinator_port: int = DEFAULT_PORT, dry_run: bool = False,
+        extra_env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None, ssh: bool = False):
+    """``horovodrun`` equivalent.
+
+    - ``hosts=None``: spawn ``np`` local worker processes and wait.
+    - ``hosts="h1:8,h2:8"``: per-host launch. With ``ssh=True`` the
+      launcher executes one command per host over ssh and supervises them
+      (upstream ``gloo_run``); otherwise it prints/returns the commands for
+      the user or cloud tooling to run (TPU pods normally launch via the
+      provider's one-command-per-VM tooling).
+    - ``dry_run``: return commands without executing.
+    - ``timeout``: kill the job and raise if workers are still running after
+      this many seconds (upstream ``--start-timeout``'s role: a wedged
+      rendezvous or accelerator runtime turns into an error, not a silent
+      infinite hang).
+    """
+    if hosts is not None:
+        specs = parse_hosts(hosts)
+        cmds = worker_commands(command, specs, coordinator_port,
+                               extra_env=extra_env)
+        if dry_run:
+            return cmds
+        if not ssh:
+            for c in cmds:
+                print(c)
+            return cmds
+        procs = [subprocess.Popen(_ssh_argv(spec.host, line))
+                 for spec, line in zip(specs, cmds)]
+        return _supervise(procs, timeout)
+
+    coordinator = f"127.0.0.1:{coordinator_port}"
+    if dry_run:
+        return [" ".join(command)] * np
+    procs = []
+    for pid in range(np):
+        env = build_worker_env(pid, np, coordinator,
+                               base_env=dict(os.environ))
+        # np local processes cannot share one accelerator: force the CPU
+        # backend for the simulated cluster (the ambient env often pins an
+        # accelerator platform — override via extra_env to opt out).
+        env["JAX_PLATFORMS"] = "cpu"
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(list(command), env=env))
+    return _supervise(procs, timeout)
 
 
 def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
@@ -322,6 +366,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--start-timeout", type=float, default=None,
                         help="kill the job if workers are still running "
                              "after this many seconds")
+    parser.add_argument("--ssh", action="store_true",
+                        help="execute the per-host commands over ssh and "
+                             "supervise them (upstream gloo_run)")
     parser.add_argument("--dry-run", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -331,7 +378,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("no command given")
     out = run(args.command, np=args.num_proc, hosts=args.hosts,
               coordinator_port=args.port, dry_run=args.dry_run,
-              timeout=args.start_timeout)
+              timeout=args.start_timeout, ssh=args.ssh)
     if args.dry_run and isinstance(out, list):
         for c in out:
             print(c)

@@ -1,0 +1,49 @@
+"""GPT-2 medium throughput sweep: batch size x remat x attention impl."""
+import os, sys, time, dataclasses
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np, optax
+
+def sync(x):
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0])).ravel()[:1]
+
+def run_one(B, T, remat, attention, steps=8):
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+    cfg = dataclasses.replace(GPT2Config.medium(), attention=attention, remat=remat)
+    model = GPT2(cfg)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        _, g = jax.value_and_grad(
+            lambda p: loss_fn(model.apply({"params": p}, tokens), tokens))(params)
+        u, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, u), opt_state
+
+    try:
+        c = step.lower(params, opt_state).compile().cost_analysis()
+        if isinstance(c, list): c = c[0]
+        fl = float(c.get("flops", 0.0))
+        state = (params, opt_state)
+        state = step(*state); state = step(*state); sync(state)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step(*state)
+        sync(state)
+        dt = (time.perf_counter() - t0) / steps
+        print(f"B={B:3d} T={T} remat={int(remat)} {attention:6s} "
+              f"step={dt*1e3:8.1f}ms tok/s={B*T/dt:9.0f} "
+              f"TF/s={fl/dt/1e12:6.1f} MFU={fl/dt/1e12/197*100:5.1f}%",
+              flush=True)
+    except Exception as e:
+        print(f"B={B:3d} T={T} remat={int(remat)} {attention}: FAILED "
+              f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+if __name__ == "__main__":
+    for B, remat, att in [(8, True, "flash"), (16, True, "flash"),
+                          (32, True, "flash"), (16, False, "flash"),
+                          (16, True, "dense"), (32, False, "flash")]:
+        run_one(B, 1024, remat, att)
