@@ -19,7 +19,8 @@ from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
 from horovod_tpu.spark.estimator import JaxEstimator, JaxModel  # noqa: F401
 
 __all__ = ["run", "run_elastic", "JaxEstimator", "JaxModel", "SparkBackend",
-           "spark_available", "KerasEstimator", "TorchEstimator"]
+           "spark_available", "KerasEstimator", "TorchEstimator",
+           "TorchModel"]
 
 
 def run_elastic(*_a, **_k):
@@ -104,37 +105,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[Dict] = None,
         backend.shutdown()
 
 
-_GATED_MSG = (
-    "horovod_tpu.spark.{name} wraps a {framework} model and needs the "
-    "{framework} package. The estimator state machine itself is "
-    "framework-neutral — use JaxEstimator (native), or inject a "
-    "ClusterBackend and train any framework through horovod_tpu.spark.run.")
-
-
-class KerasEstimator:
-    """Upstream ``horovod.spark.keras.KerasEstimator`` surface; needs TF.
-    Use :class:`JaxEstimator` for the native path."""
-
-    def __init__(self, *a, **k):
-        try:
-            import tensorflow  # noqa: F401
-        except ImportError:
-            raise RuntimeError(_GATED_MSG.format(
-                name="KerasEstimator", framework="tensorflow")) from None
-        raise NotImplementedError(
-            "KerasEstimator: wrap your keras model's train step with "
-            "horovod_tpu.tensorflow and run it via horovod_tpu.spark.run; "
-            "the packaged estimator only ships for flax (JaxEstimator)")
-
-
-class TorchEstimator:
-    """Upstream ``horovod.spark.torch.TorchEstimator`` surface.
-    Use :class:`JaxEstimator` for the native path, or
-    ``horovod_tpu.torch`` + ``spark.run`` for torch modules."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "TorchEstimator: train torch modules with horovod_tpu.torch's "
-            "DistributedOptimizer inside a function launched by "
-            "horovod_tpu.spark.run; the packaged estimator only ships for "
-            "flax (JaxEstimator)")
+from horovod_tpu.spark.estimator_keras import (  # noqa: E402,F401
+    KerasEstimator, KerasModel,
+)
+from horovod_tpu.spark.estimator_torch import (  # noqa: E402,F401
+    TorchEstimator, TorchModel,
+)

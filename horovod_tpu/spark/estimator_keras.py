@@ -1,0 +1,134 @@
+"""KerasEstimator: upstream ``horovod/spark/keras/estimator.py`` state
+machine on the injected cluster backend, trained through the
+``horovod_tpu.tensorflow`` frontend (DistributedGradientTape +
+broadcast_variables). Same contract as the Jax/Torch estimators: per-worker
+data partitions, synced gradients, rank-0 weight collection,
+``KerasModel.transform``."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
+from horovod_tpu.spark.estimator import _shard, _to_columns
+
+__all__ = ["KerasEstimator", "KerasModel"]
+
+
+def _fit_worker_keras(model_bytes: bytes, columns: Dict[str, np.ndarray],
+                      feature_col: str, label_col: str,
+                      lr: float, epochs: int, batch_size: int, seed: int):
+    """Runs on every worker with hvd initialized (backend contract)."""
+    import cloudpickle
+    import jax
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    model, loss_fn = cloudpickle.loads(model_bytes)
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    feats = columns[feature_col]
+    labels = columns[label_col]
+    lo, hi = _shard(len(feats), rank, world)
+    feats = tf.constant(feats[lo:hi])
+    labels = tf.constant(labels[lo:hi])
+
+    opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.Adam(lr))
+    # The pickled model carries identical weights; broadcast is the
+    # upstream contract (and guards factory randomness).
+    hvd_tf.broadcast_variables(model.trainable_variables, root_rank=0)
+
+    n = int(feats.shape[0])
+    bs = min(batch_size, n)
+    history = []
+    for epoch in range(epochs):
+        order = np.random.default_rng(seed + epoch).permutation(n)
+        losses = []
+        for i in range(0, n - bs + 1, bs):
+            idx = tf.constant(order[i:i + bs])
+            xb = tf.gather(feats, idx)
+            yb = tf.gather(labels, idx)
+            with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+                loss = loss_fn(model(xb, training=True), yb)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            losses.append(float(loss))
+        history.append(float(np.mean(losses)) if losses else float("nan"))
+
+    weights = [w.astype(np.float32) if hasattr(w, "astype") else w
+               for w in model.get_weights()]
+    return {"rank": rank, "world": world, "weights": weights,
+            "history": history}
+
+
+class KerasModel:
+    """Trained-model transformer (upstream ``KerasModel``)."""
+
+    def __init__(self, model: Any, weights, feature_col: str,
+                 output_col: str = "prediction"):
+        self.model = model
+        self.model.set_weights(weights)
+        self.feature_col = feature_col
+        self.output_col = output_col
+
+    def predict(self, features) -> np.ndarray:
+        out = self.model(np.asarray(features), training=False)
+        return np.asarray(out)
+
+    def transform(self, df: Any) -> Dict[str, np.ndarray]:
+        columns = dict(_to_columns(df))
+        columns[self.output_col] = self.predict(columns[self.feature_col])
+        return columns
+
+
+class KerasEstimator:
+    """``horovod.spark.keras.KerasEstimator`` parity: a keras model + loss
+    trained data-parallel on the cluster backend (requires tensorflow;
+    raises with guidance otherwise)."""
+
+    def __init__(self, model: Any = None, loss: Optional[Callable] = None,
+                 lr: float = 1e-2, epochs: int = 1, batch_size: int = 32,
+                 num_proc: int = 2,
+                 backend: Optional[ClusterBackend] = None,
+                 feature_col: str = "features", label_col: str = "label",
+                 seed: int = 0, **_compat):
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "KerasEstimator requires the tensorflow package; use "
+                "JaxEstimator (flax-native) on TF-less images") from None
+        if model is None or loss is None:
+            raise ValueError("KerasEstimator requires model= and loss=")
+        self.model = model
+        self.loss = loss
+        self.lr = lr
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.backend = backend or LocalProcessBackend(num_proc)
+        self.feature_col = feature_col
+        self.label_col = label_col
+        self.seed = seed
+        self.last_fit_results: Optional[list] = None
+
+    def fit(self, df: Any) -> KerasModel:
+        import cloudpickle
+
+        columns = _to_columns(df)
+        if self.feature_col not in columns or self.label_col not in columns:
+            raise KeyError(
+                f"dataset must contain {self.feature_col!r} and "
+                f"{self.label_col!r}; has {sorted(columns)}")
+        model_bytes = cloudpickle.dumps((self.model, self.loss))
+        self.backend.start()
+        results = self.backend.run(
+            _fit_worker_keras,
+            args=(model_bytes, columns, self.feature_col, self.label_col,
+                  self.lr, self.epochs, self.batch_size, self.seed))
+        self.last_fit_results = results
+        weights = next(r["weights"] for r in results if r["rank"] == 0)
+        return KerasModel(self.model, weights, self.feature_col)

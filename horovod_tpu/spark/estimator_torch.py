@@ -1,0 +1,143 @@
+"""TorchEstimator: the upstream ``horovod/spark/torch/estimator.py`` state
+machine on the injected cluster backend, trained through the
+``horovod_tpu.torch`` frontend (hook-based DistributedOptimizer + parameter
+broadcast). Same contract as :class:`~horovod_tpu.spark.estimator.JaxEstimator`:
+``fit(columns) -> TorchModel`` with per-worker data partitions and rank-0
+weight collection."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
+from horovod_tpu.spark.estimator import _shard, _to_columns
+
+__all__ = ["TorchEstimator", "TorchModel"]
+
+
+def _fit_worker_torch(model_bytes: bytes, columns: Dict[str, np.ndarray],
+                      feature_col: str, label_col: str,
+                      lr: float, epochs: int, batch_size: int, seed: int):
+    """Runs on every worker with hvd initialized (backend contract)."""
+    import cloudpickle
+    import jax
+    import torch
+
+    import horovod_tpu.torch as hvt
+
+    model, loss_fn = cloudpickle.loads(model_bytes)
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    feats = columns[feature_col]
+    labels = columns[label_col]
+    lo, hi = _shard(len(feats), rank, world)
+    feats = torch.from_numpy(np.ascontiguousarray(feats[lo:hi]))
+    labels = torch.from_numpy(np.ascontiguousarray(labels[lo:hi]))
+
+    opt = hvt.DistributedOptimizer(
+        torch.optim.Adam(model.parameters(), lr=lr))
+    # The pickled model already carries identical weights everywhere, but
+    # broadcast anyway — upstream's contract (and the guard against a
+    # factory that randomizes per process).
+    hvt.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    n = len(feats)
+    bs = min(batch_size, n)
+    history = []
+    for epoch in range(epochs):
+        order = np.random.default_rng(seed + epoch).permutation(n)
+        losses = []
+        for i in range(0, n - bs + 1, bs):
+            idx = torch.from_numpy(order[i:i + bs].copy())
+            opt.zero_grad()
+            loss = loss_fn(model(feats[idx]), labels[idx])
+            loss.backward()
+            opt.step()          # allreduces grads, then inner step
+            losses.append(float(loss.detach()))
+        history.append(float(np.mean(losses)) if losses else float("nan"))
+
+    state = {k: v.detach().cpu().numpy()
+             for k, v in model.state_dict().items()}
+    return {"rank": rank, "world": world, "state_dict": state,
+            "history": history}
+
+
+class TorchModel:
+    """Trained-model transformer (upstream ``TorchModel``): holds the
+    module + trained state_dict, applies it to new data."""
+
+    def __init__(self, model: Any, state_dict: Dict[str, np.ndarray],
+                 feature_col: str, output_col: str = "prediction"):
+        import torch
+
+        self.model = model
+        self.model.load_state_dict(
+            {k: torch.from_numpy(np.asarray(v))
+             for k, v in state_dict.items()})
+        self.model.eval()
+        self.feature_col = feature_col
+        self.output_col = output_col
+
+    def predict(self, features) -> np.ndarray:
+        import torch
+
+        with torch.no_grad():
+            out = self.model(torch.from_numpy(np.asarray(features)))
+        return out.cpu().numpy()
+
+    def transform(self, df: Any) -> Dict[str, np.ndarray]:
+        columns = dict(_to_columns(df))
+        columns[self.output_col] = self.predict(columns[self.feature_col])
+        return columns
+
+
+class TorchEstimator:
+    """``horovod.spark.torch.TorchEstimator`` parity.
+
+    Args:
+      model: a ``torch.nn.Module`` (cloudpickled to workers with its
+        initial weights).
+      loss: ``(predictions, labels) -> scalar torch loss``.
+      lr / epochs / batch_size / num_proc / backend / columns: as
+      :class:`~horovod_tpu.spark.estimator.JaxEstimator`.
+    """
+
+    def __init__(self, model: Any = None, loss: Optional[Callable] = None,
+                 lr: float = 1e-2, epochs: int = 1, batch_size: int = 32,
+                 num_proc: int = 2,
+                 backend: Optional[ClusterBackend] = None,
+                 feature_col: str = "features", label_col: str = "label",
+                 seed: int = 0, **_compat):
+        if model is None or loss is None:
+            raise ValueError("TorchEstimator requires model= and loss=")
+        self.model = model
+        self.loss = loss
+        self.lr = lr
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.backend = backend or LocalProcessBackend(num_proc)
+        self.feature_col = feature_col
+        self.label_col = label_col
+        self.seed = seed
+        self.last_fit_results: Optional[list] = None
+
+    def fit(self, df: Any) -> TorchModel:
+        import cloudpickle
+
+        columns = _to_columns(df)
+        if self.feature_col not in columns or self.label_col not in columns:
+            raise KeyError(
+                f"dataset must contain {self.feature_col!r} and "
+                f"{self.label_col!r}; has {sorted(columns)}")
+        model_bytes = cloudpickle.dumps((self.model, self.loss))
+        self.backend.start()
+        results = self.backend.run(
+            _fit_worker_torch,
+            args=(model_bytes, columns, self.feature_col, self.label_col,
+                  self.lr, self.epochs, self.batch_size, self.seed))
+        self.last_fit_results = results
+        state = next(r["state_dict"] for r in results if r["rank"] == 0)
+        return TorchModel(self.model, state, self.feature_col)

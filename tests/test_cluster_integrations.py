@@ -145,3 +145,79 @@ def test_spark_run_contract():
     out = hspark.run(fn, args=(100,),
                      backend=LocalProcessBackend(2, coordinator_port=29750))
     assert out == [100, 101]
+
+
+class TestTorchEstimator:
+    def _data(self):
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([0.5, -1.0, 2.0], np.float32)).astype(np.float32)
+        return {"features": X, "label": y}
+
+    def test_fit_transform_inline(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.spark import TorchEstimator
+
+        model = torch.nn.Sequential(torch.nn.Linear(3, 1),
+                                    torch.nn.Flatten(0))
+        est = TorchEstimator(model=model,
+                             loss=torch.nn.functional.mse_loss,
+                             lr=0.05, epochs=30, batch_size=16,
+                             backend=InlineBackend())
+        data = self._data()
+        fitted = est.fit(data)
+        hist = est.last_fit_results[0]["history"]
+        assert hist[-1] < 0.1 * hist[0], hist
+        out = fitted.transform(data)
+        assert out["prediction"].shape == (64,)
+
+    @pytest.mark.slow
+    def test_two_worker_fit(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.spark import TorchEstimator
+
+        def make():
+            import torch as t
+            m = t.nn.Sequential(t.nn.Linear(3, 1), t.nn.Flatten(0))
+            return m
+
+        model = make()
+        est = TorchEstimator(model=model,
+                             loss=torch.nn.functional.mse_loss,
+                             lr=0.05, epochs=10, batch_size=8,
+                             backend=LocalProcessBackend(
+                                 2, coordinator_port=29790))
+        fitted = est.fit(self._data())
+        results = est.last_fit_results
+        assert all(r["world"] == 2 for r in results)
+        # allreduced grads keep both replicas' weights identical
+        for k in results[0]["state_dict"]:
+            np.testing.assert_allclose(results[0]["state_dict"][k],
+                                       results[1]["state_dict"][k],
+                                       rtol=1e-5, atol=1e-6)
+        assert fitted.predict(self._data()["features"]).shape == (64,)
+
+
+class TestKerasEstimator:
+    def test_fit_transform_inline(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.spark import KerasEstimator
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1),
+                                     tf.keras.layers.Flatten()])
+        model.build((None, 3))
+
+        def mse(pred, label):
+            return tf.reduce_mean(tf.square(tf.squeeze(pred, -1) - label))
+
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, 0.5, -1.0], np.float32)).astype(np.float32)
+
+        est = KerasEstimator(model=model, loss=mse, lr=0.1, epochs=25,
+                             batch_size=16, backend=InlineBackend())
+        fitted = est.fit({"features": X, "label": y})
+        hist = est.last_fit_results[0]["history"]
+        assert hist[-1] < 0.1 * hist[0], hist
+        out = fitted.transform({"features": X, "label": y})
+        assert out["prediction"].shape[0] == 64
