@@ -43,6 +43,7 @@ class GPT2Config:
     # mutable=["losses"] and add their mean (see examples / loss_fn_moe).
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
+    moe_router: str = "top1"   # "top1" (Switch) | "top2" (GShard)
 
     @staticmethod
     def medium() -> "GPT2Config":
@@ -96,7 +97,7 @@ class MLP(nn.Module):
             from horovod_tpu.ops.moe import MoEMLP
             out, aux = MoEMLP(cfg.num_experts, 4 * cfg.d_model,
                               cfg.expert_capacity_factor, cfg.dtype,
-                              name="moe")(x)
+                              router_type=cfg.moe_router, name="moe")(x)
             self.sow("losses", "moe_aux", aux)
             return out
         h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="fc")(x)

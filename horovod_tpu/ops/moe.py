@@ -27,7 +27,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Top1Router", "MoEMLP", "switch_load_balance_loss"]
+__all__ = ["Top1Router", "Top2Router", "MoEMLP",
+           "switch_load_balance_loss"]
 
 
 def switch_load_balance_loss(router_probs: jnp.ndarray,
@@ -89,6 +90,64 @@ class Top1Router(nn.Module):
         return dispatch, combine, aux_loss
 
 
+class Top2Router(nn.Module):
+    """GShard-style top-2 router with static capacity.
+
+    Each token is sent to its two highest-probability experts with gates
+    renormalized over the pair (``g1/(g1+g2)``, ``g2/(g1+g2)``). Capacity
+    slots are assigned top-1 choices first, then top-2 choices fill the
+    remainder (GShard's ordering, so second choices are the ones dropped
+    under pressure). Returns the same ``(dispatch, combine, aux)``
+    contract as :class:`Top1Router` — (N, E, C) tensors — so ``MoEMLP``
+    uses either router unchanged.
+    """
+    num_experts: int
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        n, d = x.shape
+        e = self.num_experts
+        # GShard sizes capacity for two assignments per token.
+        c = max(1, int(self.capacity_factor * 2 * n / e))
+
+        router = self.param("router", nn.initializers.normal(0.02), (d, e),
+                            jnp.float32)
+        logits = x.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        idx1 = jnp.argmax(probs, axis=-1)
+        gate1 = jnp.max(probs, axis=-1)
+        probs2 = probs * (1.0 - jax.nn.one_hot(idx1, e, dtype=jnp.float32))
+        idx2 = jnp.argmax(probs2, axis=-1)
+        gate2 = jnp.max(probs2, axis=-1)
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        gate1, gate2 = gate1 / denom, gate2 / denom
+
+        one1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+        one2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        # Slot positions: top-1 queue first, top-2 continues the counts.
+        pos1 = (jnp.cumsum(one1, axis=0) - 1.0) * one1
+        count1 = jnp.sum(one1, axis=0)                     # (E,)
+        pos2 = ((jnp.cumsum(one2, axis=0) - 1.0) + count1[None]) * one2
+        one1 = one1 * (pos1 < c)
+        one2 = one2 * (pos2 < c)
+
+        def slots(onehot, pos):
+            s = jax.nn.one_hot(
+                jnp.sum(pos, axis=-1).astype(jnp.int32), c,
+                dtype=jnp.float32)
+            return onehot[..., None] * s[:, None, :]
+
+        d1 = slots(one1, pos1)
+        d2 = slots(one2, pos2)
+        dispatch = d1 + d2
+        combine = gate1[:, None, None] * d1 + gate2[:, None, None] * d2
+
+        aux_loss = switch_load_balance_loss(probs, idx1)
+        return dispatch, combine, aux_loss
+
+
 class MoEMLP(nn.Module):
     """Expert-parallel MLP block: drop-in for a transformer's dense FFN.
 
@@ -99,6 +158,8 @@ class MoEMLP(nn.Module):
     d_ff: int
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    # "top1" (Switch) or "top2" (GShard); same dispatch/combine contract.
+    router_type: str = "top1"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -106,7 +167,14 @@ class MoEMLP(nn.Module):
         e, f = self.num_experts, self.d_ff
         tokens = x.reshape(b * t, d)
 
-        dispatch, combine, aux_loss = Top1Router(
+        if self.router_type == "top1":
+            router_cls = Top1Router
+        elif self.router_type == "top2":
+            router_cls = Top2Router
+        else:
+            raise ValueError(f"unknown router_type {self.router_type!r}; "
+                             "expected 'top1' or 'top2'")
+        dispatch, combine, aux_loss = router_cls(
             self.num_experts, self.capacity_factor, name="router")(tokens)
 
         w_in = self.param("w_in", nn.initializers.lecun_normal(), (e, d, f),

@@ -132,3 +132,61 @@ def test_gpt2_moe_trains(rng):
         params, state, l = step(params, state)
         losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+class TestTop2Router:
+    """GShard top-2 routing: two experts per token, renormalized gates,
+    top-1 slots assigned before top-2 under capacity pressure."""
+
+    def _route(self, n=32, e=4, d=8, cf=2.0, seed=0):
+        from horovod_tpu.ops.moe import Top2Router
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        r = Top2Router(e, cf)
+        v = r.init(jax.random.PRNGKey(0), x)
+        return r.apply(v, x)
+
+    def test_two_assignments_and_normalized_gates(self):
+        dispatch, combine, aux = self._route()
+        dispatch = np.asarray(dispatch)
+        combine = np.asarray(combine)
+        per_token = dispatch.sum(axis=(1, 2))
+        assert ((per_token > 0) & (per_token <= 2)).all()
+        # Un-dropped tokens' combine weights sum to ~1 (renormalized pair).
+        full = per_token == 2
+        np.testing.assert_allclose(combine.sum(axis=(1, 2))[full], 1.0,
+                                   rtol=1e-5)
+        # each (expert, slot) holds at most one token
+        assert (dispatch.sum(axis=0) <= 1.0 + 1e-6).all()
+        assert float(aux) > 0
+
+    def test_capacity_drops_second_choices_first(self):
+        # Tiny capacity: top-1 queue fills first, so every expert's slots
+        # are dominated by first choices.
+        dispatch, combine, aux = self._route(n=64, e=2, cf=0.25)
+        dispatch = np.asarray(dispatch)
+        assert dispatch.sum() > 0
+        assert (dispatch.sum(axis=0) <= 1.0 + 1e-6).all()
+
+    def test_moemlp_top2_trains(self):
+        from horovod_tpu.ops.moe import MoEMLP
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+        m = MoEMLP(4, 16, router_type="top2", dtype=jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+
+        def loss(params):
+            out, aux = m.apply(params, x)
+            return jnp.mean(out ** 2) + 1e-2 * aux
+
+        l, g = jax.value_and_grad(loss)(v)
+        assert np.isfinite(float(l))
+        assert all(np.isfinite(np.asarray(t)).all()
+                   for t in jax.tree_util.tree_leaves(g))
+
+    def test_unknown_router_raises(self):
+        from horovod_tpu.ops.moe import MoEMLP
+        x = jnp.zeros((1, 4, 8))
+        m = MoEMLP(2, 8, router_type="topk")
+        with pytest.raises(ValueError, match="router_type"):
+            m.init(jax.random.PRNGKey(0), x)
