@@ -49,6 +49,10 @@ def main():
 
     hvd.init(axis_name="pp")
     S = args.stages or hvd.size()
+    if S > len(jax.devices()):
+        raise SystemExit(
+            f"--stages {S} exceeds the {len(jax.devices())} available "
+            "devices")
     if hvd.size() != S:
         hvd.init(devices=jax.devices()[:S], axis_name="pp")
 

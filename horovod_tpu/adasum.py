@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["adasum_combine", "adasum_allreduce"]
+__all__ = ["adasum_combine", "adasum_allreduce",
+           "hierarchical_adasum_allreduce"]
 
 
 def adasum_combine(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -182,3 +183,40 @@ def adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
 
     result = result[:L0].reshape(orig_shape).astype(orig_dtype)
     return jnp.where(member, result, x)
+
+
+def hierarchical_adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
+                                  groups) -> jnp.ndarray:
+    """Hierarchical Adasum (upstream ``HOROVOD_HIERARCHICAL_ALLREDUCE`` +
+    Adasum): average within each local group (one host's chips — cheap
+    intra-host bandwidth), Adasum across the group leaders (the scale-
+    sensitive inter-host combine), then broadcast each leader's result back
+    to its group.
+
+    ``groups`` partitions the axis ranks into equal-size lists (e.g. one
+    list per process/host). Group size 1 degrades to plain Adasum; a single
+    group degrades to a plain average — exactly upstream's semantics.
+    """
+    groups = [list(g) for g in groups]
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"hierarchical adasum requires equal group sizes, got "
+            f"{sorted(len(g) for g in groups)}")
+    gsize = sizes.pop()
+    if len(groups) == 1:
+        # One host: the hierarchy degenerates to the local average (XLA
+        # also rejects axis_index_groups that span the whole axis here).
+        return lax.pmean(x, axis)
+    if gsize > 1:
+        x = lax.psum(x, axis, axis_index_groups=groups) / gsize
+    leaders = [g[0] for g in groups]
+    out = adasum_allreduce(x, axis, axis_size, ranks=leaders)
+    if gsize > 1:
+        is_leader = np.zeros(axis_size, bool)
+        for r in leaders:
+            is_leader[r] = True
+        lead = jnp.asarray(is_leader)[lax.axis_index(axis)]
+        out = lax.psum(jnp.where(lead, out, jnp.zeros_like(out)), axis,
+                       axis_index_groups=groups)
+    return out

@@ -39,7 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import core
 from horovod_tpu import fusion as _fusion
-from horovod_tpu.adasum import adasum_allreduce
+from horovod_tpu.adasum import adasum_allreduce, hierarchical_adasum_allreduce
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet, global_process_set
 
@@ -117,6 +117,27 @@ def _set_gather(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
     return lax.psum(buf, ps.axis)
 
 
+def _hierarchical_adasum_groups(ps: ProcessSet):
+    """Local-average groups for hierarchical Adasum (upstream
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE``): when the env flag is set, devices
+    group by owning process (one group per host); None disables. Global
+    process set only — a subset would need subgroup leader election that
+    upstream doesn't define either."""
+    import os
+    if os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "").lower() \
+            not in ("1", "true", "yes"):
+        return None
+    if ps.ranks is not None:
+        raise NotImplementedError(
+            "hierarchical Adasum supports the global process set only")
+    devs = list(core.mesh().devices.ravel())
+    by_proc: dict = {}
+    for i, d in enumerate(devs):
+        by_proc.setdefault(d.process_index, []).append(i)
+    groups = list(by_proc.values())
+    return groups if len(groups) >= 1 else None
+
+
 def _identity_for(op: int, x: jnp.ndarray) -> jnp.ndarray:
     """Neutral element a non-member contributes to a masked reduction."""
     if op in (ReduceOp.Sum, ReduceOp.Average):
@@ -165,7 +186,12 @@ def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
             else lax.all_gather(x, ps.axis)
         out = jnp.prod(gathered, axis=0)
     elif op == ReduceOp.Adasum:
-        out = adasum_allreduce(x, ps.axis, core.size(), ps.ranks)
+        groups = _hierarchical_adasum_groups(ps)
+        if groups is not None:
+            out = hierarchical_adasum_allreduce(x, ps.axis, core.size(),
+                                                groups)
+        else:
+            out = adasum_allreduce(x, ps.axis, core.size(), ps.ranks)
     else:
         raise ValueError(f"unknown reduce op {op}")
     if op in _SCALING_OPS and postscale != 1.0:
@@ -533,6 +559,11 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         return _allreduce_tree(tensor, *args)
     pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
           compression.__name__, int(fusion_threshold_bytes))
+    if op == ReduceOp.Adasum:
+        # Hierarchical mode changes the compiled program; key it.
+        groups = _hierarchical_adasum_groups(ps)
+        pk = pk + (None if groups is None
+                   else tuple(tuple(g) for g in groups),)
     return _eager_run("allreduce", tensor, args, pk)
 
 
@@ -777,6 +808,16 @@ def join() -> int:
     """Join op for uneven data (``hvd.join``): signals this caller has no
     more batches; blocks until every process joins and returns the rank of
     the **last** process to join (upstream ``horovod/common/ops/../join``).
+
+    Restriction vs upstream: every process must have finished issuing
+    eager collectives before any process calls ``join()`` — the ordered
+    negotiation protocol treats a join racing a peer's allreduce as
+    divergence and raises (upstream's controller instead keeps servicing
+    the stragglers with the joined rank contributing zeros). For genuinely
+    uneven per-rank data, run the step loop to the *max* step count with
+    the mask-based join (``DistributedOptimizer(...)`` + ``alive=``),
+    which reproduces upstream's zero-contribution semantics inside jit;
+    use eager ``join()`` as the end-of-training election it is here.
 
     Multi-process: every process blocks in an allgather until all have
     joined; each then measures how long it waited on its own *monotonic*

@@ -49,7 +49,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "pipeline_loss"]
+__all__ = ["pipeline_apply", "pipeline_loss", "pipeline_loss_interleaved"]
+
+
+def _graft_last_stage_loss(local, is_last, axis_name):
+    """Forward: replicate the last stage's loss via psum. Backward: a
+    psum's transpose would re-psum every stage's unit cotangent (an S×
+    factor), so the replicated value is grafted on with stop_gradient and
+    only the masked per-stage copy is differentiated — the last stage
+    seeds the backward pipeline, earlier stages receive their cotangents
+    through the transposed ppermute hops."""
+    masked = jnp.where(is_last, local, jnp.zeros_like(local))
+    return masked + lax.stop_gradient(lax.psum(masked, axis_name) - masked)
 
 
 def _run_pipeline(stage_fn: Callable, stage_params: Any,
@@ -143,12 +154,71 @@ def pipeline_loss(stage_fn: Callable, stage_params: Any,
     """
     outputs, stage, S = _run_pipeline(stage_fn, stage_params, microbatches,
                                       axis_name)
-    local = loss_fn(outputs)
-    masked = jnp.where(stage == S - 1, local, jnp.zeros_like(local))
-    # Forward: replicate the last stage's loss via psum. Backward: a psum's
-    # transpose would re-psum every stage's unit cotangent (an S× factor), so
-    # the replicated value is grafted on with stop_gradient and only the
-    # masked per-stage copy is differentiated — the last stage seeds the
-    # backward pipeline, earlier stages receive their cotangents through the
-    # transposed ppermute hops.
-    return masked + lax.stop_gradient(lax.psum(masked, axis_name) - masked)
+    return _graft_last_stage_loss(loss_fn(outputs), stage == S - 1,
+                                  axis_name)
+
+
+def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
+                              microbatches: jnp.ndarray, loss_fn: Callable,
+                              axis_name: str) -> jnp.ndarray:
+    """Interleaved (circular) pipeline schedule + loss (Megatron's
+    interleaved 1F1B layout, expressed as one scan).
+
+    Device ``d`` holds ``R`` *virtual stages* — rounds ``r = 0..R-1`` of the
+    depth-``R*S`` pipeline, virtual stage ``sigma = r*S + d`` — as the
+    leading axis of ``stage_params`` (shape ``(R, ...)`` per device).
+    Activations hop device-to-device on a wrapped ring: after stage
+    ``r*S + S-1`` the microbatch re-enters device 0 at round ``r+1``.
+
+    Why: the bubble is ``1 - R*M / (M + R*S - 1)``; at ``M = S`` that is
+    ``~1/(R+1)`` — e.g. 20 % at R=4 with only S microbatches in flight,
+    where plain GPipe needs ``M = 4*(S-1)`` microbatches (4x the activation
+    memory) for the same bubble. Constraint: ``M <= S`` (more microbatches
+    than stages would collide on the ring; chunk the batch and accumulate
+    instead).
+
+    ``loss_fn(outputs) -> scalar`` is evaluated on (M, mb, ...) outputs,
+    masked to the final virtual stage's device exactly like
+    :func:`pipeline_loss`.
+    """
+    S = lax.psum(1, axis_name)
+    d = lax.axis_index(axis_name)
+    R = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    M = microbatches.shape[0]
+    if M > S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) <= stages ({S});"
+            " chunk the batch and accumulate gradients instead")
+    T = M + R * S - 1
+    mb_shape = microbatches.shape[1:]
+
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        act_in, outputs = carry
+        rel = t - d
+        r = jnp.clip(jnp.where(rel >= 0, rel // S, 0), 0, R - 1)
+        active = (rel >= 0) & (rel < R * S) & ((rel % S) < M)
+        # Device 0, round 0 feeds microbatch m = t (while t < M).
+        feed_idx = jnp.clip(t, 0, M - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, feed_idx, 0,
+                                        keepdims=False)
+        x = jnp.where((d == 0) & (rel < M), feed, act_in)
+        params_r = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, r, 0, keepdims=False),
+            stage_params)
+        y = stage_fn(params_r, x)
+        # Final virtual stage (device S-1, round R-1) emits m = t-(R*S-1).
+        out_idx = jnp.clip(t - (R * S - 1), 0, M - 1)
+        emit = active & (d == S - 1) & (rel // S == R - 1)
+        cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(emit, y, cur), out_idx, 0)
+        act_next = lax.ppermute(y, axis_name, ring)
+        return (act_next, outputs), None
+
+    act0 = jnp.zeros(mb_shape, microbatches.dtype)
+    out0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(T))
+
+    return _graft_last_stage_loss(loss_fn(outputs), d == S - 1, axis_name)

@@ -115,9 +115,12 @@ def local_ip() -> str:
 
 def _ssh_argv(host: str, line: str) -> List[str]:
     """argv to execute ``line`` on ``host`` (upstream gloo_run's ssh
-    execution; BatchMode so a missing key fails instead of prompting)."""
-    return ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-            host, line]
+    execution). BatchMode so a missing key fails instead of prompting;
+    ``-tt`` forces a pty so terminating the local ssh client HUPs the
+    remote process group — without it fail-fast teardown would orphan
+    remote workers blocked in rendezvous."""
+    return ["ssh", "-tt", "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=no", host, line]
 
 
 def _supervise(procs: List[subprocess.Popen],
@@ -201,10 +204,14 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
     for pid in range(np):
         env = build_worker_env(pid, np, coordinator,
                                base_env=dict(os.environ))
-        # np local processes cannot share one accelerator: force the CPU
-        # backend for the simulated cluster (the ambient env often pins an
-        # accelerator platform — override via extra_env to opt out).
-        env["JAX_PLATFORMS"] = "cpu"
+        # Multiple local processes cannot share one accelerator: force the
+        # CPU backend (the ambient env often pins an accelerator platform;
+        # override via extra_env to opt out). A single worker keeps the
+        # ambient platform — nothing to share.
+        if np > 1:
+            env["JAX_PLATFORMS"] = "cpu"
+        else:
+            env.setdefault("JAX_PLATFORMS", "cpu")
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen(list(command), env=env))
@@ -246,7 +253,12 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
         for pid in range(world):
             env = build_worker_env(pid, world, coordinator,
                                    base_env=dict(os.environ))
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Same platform policy as run(): multiple local workers cannot
+            # share one accelerator; a single survivor keeps the ambient.
+            if world > 1:
+                env["JAX_PLATFORMS"] = "cpu"
+            else:
+                env.setdefault("JAX_PLATFORMS", "cpu")
             env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
             env["HVD_TPU_ELASTIC_RESTART"] = str(restarts)
             if extra_env:

@@ -115,3 +115,61 @@ class TestAdasumGeneral:
         finally:
             hvd.remove_process_set(ps)
         np.testing.assert_allclose(out[0], a + b, rtol=1e-5, atol=1e-6)
+
+
+class TestHierarchicalAdasum:
+    """Local-group average then cross-group Adasum (upstream
+    HOROVOD_HIERARCHICAL_ALLREDUCE + Adasum)."""
+
+    def test_two_groups_matches_reference(self, rng):
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.adasum import hierarchical_adasum_allreduce
+
+        x = rng.standard_normal((N, 17)).astype(np.float32)
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+        def body(xs):
+            return hierarchical_adasum_allreduce(xs[0], "hvd", N, groups)[None]
+
+        out = np.asarray(hvd.spmd(body, in_specs=P("hvd"),
+                                  out_specs=P("hvd"))(jnp.asarray(x)))
+        m0 = x[:4].astype(np.float64).mean(0)
+        m1 = x[4:].astype(np.float64).mean(0)
+        want = combine(m0, m1)
+        for i in range(N):
+            np.testing.assert_allclose(out[i], want, rtol=1e-4, atol=1e-5)
+
+    def test_single_group_is_plain_average(self, rng):
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.adasum import hierarchical_adasum_allreduce
+
+        x = rng.standard_normal((N, 9)).astype(np.float32)
+
+        def body(xs):
+            return hierarchical_adasum_allreduce(
+                xs[0], "hvd", N, [list(range(N))])[None]
+
+        out = np.asarray(hvd.spmd(body, in_specs=P("hvd"),
+                                  out_specs=P("hvd"))(jnp.asarray(x)))
+        np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5, atol=1e-6)
+
+    def test_env_flag_routes_allreduce(self, rng, monkeypatch):
+        # Single process => one group of all devices => plain average.
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+        x = rng.standard_normal((N, 6)).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5, atol=1e-6)
+
+    def test_unequal_groups_raise(self, rng):
+        from horovod_tpu.adasum import hierarchical_adasum_allreduce
+        from jax.sharding import PartitionSpec as P
+
+        x = rng.standard_normal((N, 4)).astype(np.float32)
+
+        def body(xs):
+            return hierarchical_adasum_allreduce(
+                xs[0], "hvd", N, [[0, 1, 2], [3, 4, 5, 6, 7]])[None]
+
+        with pytest.raises(ValueError, match="equal group sizes"):
+            hvd.spmd(body, in_specs=P("hvd"), out_specs=P("hvd"))(
+                jnp.asarray(x))
