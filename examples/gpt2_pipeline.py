@@ -38,6 +38,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stages", type=int, default=None,
                     help="pipeline stages (default: all devices)")
+    ap.add_argument("--interleave", type=int, default=0, metavar="R",
+                    help="use the circular schedule with R rounds per "
+                         "device (model depth = stages*R*layers-per-stage; "
+                         "requires microbatches <= stages)")
     ap.add_argument("--layers-per-stage", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=8)
     ap.add_argument("--microbatch-size", type=int, default=2)
@@ -56,23 +60,39 @@ def main():
     if hvd.size() != S:
         hvd.init(devices=jax.devices()[:S], axis_name="pp")
 
+    R = max(args.interleave, 0)
+    layers = S * args.layers_per_stage * (R or 1)
     cfg = GPT2Config(vocab_size=256, max_seq_len=args.seq,
-                     num_layers=S * args.layers_per_stage, num_heads=4,
+                     num_layers=layers, num_heads=4,
                      d_model=args.d_model, dtype=jnp.float32)
     M, mb, T = args.microbatches, args.microbatch_size, args.seq
-    # GPipe bubble = (S-1)/(M+S-1): report it so the flag choice is visible.
-    bubble = (S - 1) / (M + S - 1)
-    print(f"stages={S} layers/stage={args.layers_per_stage} "
-          f"microbatches={M} -> bubble {bubble:.1%}")
+    if R:
+        if M > S:
+            raise SystemExit(
+                f"--interleave requires --microbatches ({M}) <= stages "
+                f"({S}); chunk the batch and accumulate gradients instead")
+        bubble = 1 - R * M / (M + R * S - 1)
+        print(f"stages={S} rounds={R} layers={layers} microbatches={M} "
+              f"-> bubble {bubble:.1%} (circular)")
+    else:
+        bubble = (S - 1) / (M + S - 1)
+        print(f"stages={S} layers/stage={args.layers_per_stage} "
+              f"microbatches={M} -> bubble {bubble:.1%} (GPipe)")
 
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (M, mb, T)),
                          jnp.int32)
     params = GPT2(cfg).init(jax.random.PRNGKey(0),
                             tokens.reshape(M * mb, T))["params"]
-    blocks, rest = stack_block_params(params, S)
-
-    grad_step = gpt2_pp_loss_and_grad(cfg, axis_name="pp")
+    if R:
+        from horovod_tpu.models.gpt2_pipeline import (
+            stack_block_params_interleaved,
+            gpt2_pp_loss_and_grad_interleaved)
+        blocks, rest = stack_block_params_interleaved(params, S, R)
+        grad_step = gpt2_pp_loss_and_grad_interleaved(cfg, axis_name="pp")
+    else:
+        blocks, rest = stack_block_params(params, S)
+        grad_step = gpt2_pp_loss_and_grad(cfg, axis_name="pp")
 
     def train_step(blocks, rest, tokens):
         loss, g_blocks, g_rest = grad_step(blocks, rest, tokens)

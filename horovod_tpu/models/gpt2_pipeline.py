@@ -28,7 +28,9 @@ from jax import lax
 
 from horovod_tpu.models.gpt2 import GPT2Config, Block, loss_fn
 
-__all__ = ["stack_block_params", "gpt2_pp_loss", "gpt2_pp_loss_and_grad"]
+__all__ = ["stack_block_params", "stack_block_params_interleaved",
+           "gpt2_pp_loss", "gpt2_pp_loss_interleaved",
+           "gpt2_pp_loss_and_grad", "gpt2_pp_loss_and_grad_interleaved"]
 
 
 def stack_block_params(params: dict, num_stages: int) -> Tuple[Any, dict]:
@@ -48,6 +50,38 @@ def stack_block_params(params: dict, num_stages: int) -> Tuple[Any, dict]:
                                     *[params[k] for k in layers])
     blocks = jax.tree_util.tree_map(
         lambda x: x.reshape((num_stages, K) + x.shape[1:]), blocks)
+    rest = {k: v for k, v in params.items() if not k.startswith("h")}
+    return blocks, rest
+
+
+def stack_block_params_interleaved(params: dict, num_stages: int,
+                                   rounds: int) -> Tuple[Any, dict]:
+    """Split a ``GPT2`` param dict for the interleaved (circular) schedule.
+
+    With ``L = S * R * K`` layers, virtual stage ``sigma = r*S + d`` holds
+    layers ``[sigma*K, (sigma+1)*K)``; device ``d``'s stack is
+    ``(S, R, K, ...)[d]``. Returns ``(blocks, rest)`` with ``blocks``
+    shaped ``(S, R, K, ...)`` (shard axis 0 over ``pp``).
+    """
+    layers = sorted((k for k in params if k.startswith("h")),
+                    key=lambda k: int(k[1:]))
+    L = len(layers)
+    S, R = num_stages, rounds
+    if L % (S * R):
+        raise ValueError(
+            f"num_layers {L} not divisible by stages*rounds {S}*{R}")
+    K = L // (S * R)
+
+    def gather(d):
+        # device d's layers, round-major: [(r*S + d)*K + k]
+        idx = [(r * S + d) * K + k for r in range(R) for k in range(K)]
+        sub = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *[params[layers[i]] for i in idx])
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((R, K) + x.shape[1:]), sub)
+
+    per_dev = [gather(d) for d in range(S)]
+    blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_dev)
     rest = {k: v for k, v in params.items() if not k.startswith("h")}
     return blocks, rest
 
@@ -83,7 +117,13 @@ def gpt2_pp_loss(cfg: GPT2Config, blocks: Any, rest: dict,
     ``rest`` grads over ``axis_name``.
     """
     from horovod_tpu.parallel.pipeline import pipeline_loss
+    return _pp_loss(cfg, blocks, rest, tokens, axis_name, pipeline_loss)
 
+
+def _pp_loss(cfg: GPT2Config, blocks: Any, rest: dict, tokens: jnp.ndarray,
+             axis_name: str, pipeline_fn) -> jnp.ndarray:
+    """Shared embedding → pipeline → LN + tied-head loss assembly; the
+    schedule is the injected ``pipeline_fn`` (GPipe or interleaved)."""
     blocks = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, axis=0), blocks)
 
     M, mb, T = tokens.shape
@@ -99,8 +139,37 @@ def gpt2_pp_loss(cfg: GPT2Config, blocks: Any, rest: dict,
         logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32), wte)
         return loss_fn(logits, tokens.reshape(M * mb, T))
 
-    return pipeline_loss(_stage_fn(cfg), blocks, x, loss_from_outputs,
-                         axis_name)
+    return pipeline_fn(_stage_fn(cfg), blocks, x, loss_from_outputs,
+                       axis_name)
+
+
+def gpt2_pp_loss_interleaved(cfg: GPT2Config, blocks: Any, rest: dict,
+                             tokens: jnp.ndarray,
+                             axis_name: str = "pp") -> jnp.ndarray:
+    """Pipelined GPT-2 LM loss on the interleaved (circular) schedule;
+    call inside ``shard_map`` with ``blocks`` the local ``(1, R, K, ...)``
+    shard from :func:`stack_block_params_interleaved` and ``M <= S``
+    microbatches (see ``pipeline_loss_interleaved``)."""
+    from horovod_tpu.parallel.pipeline import pipeline_loss_interleaved
+    return _pp_loss(cfg, blocks, rest, tokens, axis_name,
+                    pipeline_loss_interleaved)
+
+
+def gpt2_pp_loss_and_grad_interleaved(cfg: GPT2Config,
+                                      axis_name: str = "pp"):
+    """Interleaved analogue of :func:`gpt2_pp_loss_and_grad`."""
+
+    def step(blocks, rest, tokens):
+        def loss(blocks, rest):
+            return gpt2_pp_loss_interleaved(cfg, blocks, rest, tokens,
+                                            axis_name)
+
+        l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
+            blocks, rest)
+        g_rest = lax.psum(g_rest, axis_name)
+        return l, g_blocks, g_rest
+
+    return step
 
 
 def gpt2_pp_loss_and_grad(cfg: GPT2Config, axis_name: str = "pp"):

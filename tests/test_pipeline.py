@@ -201,3 +201,49 @@ class TestInterleavedPipeline:
         with pytest.raises(ValueError, match="microbatches"):
             hvd.spmd(body, in_specs=(P("hvd"), P("hvd"), P()),
                      out_specs=P())(Wd, bd, x)
+
+
+class TestGPT2InterleavedPipeline:
+    """GPT-2 on the circular schedule (R=2 rounds, 2N layers): loss and
+    grads match the single-device model."""
+
+    def test_matches_single_device(self):
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+        from horovod_tpu.models.gpt2_pipeline import (
+            stack_block_params_interleaved,
+            gpt2_pp_loss_and_grad_interleaved)
+
+        R = 2
+        cfg = GPT2Config(vocab_size=128, max_seq_len=32, num_layers=R * N,
+                         num_heads=2, d_model=32, dtype=jnp.float32)
+        M, mb, T = N, 1, 16   # M == S (interleaved constraint M <= S)
+        rng = np.random.default_rng(11)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (M, mb, T)), jnp.int32)
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            tokens.reshape(M * mb, T))["params"]
+
+        blocks, rest = stack_block_params_interleaved(params, N, R)
+        step = gpt2_pp_loss_and_grad_interleaved(cfg, axis_name="hvd")
+        fn = hvd.spmd(step, in_specs=(P("hvd"), P(), P()),
+                      out_specs=(P(), P("hvd"), P()))
+        loss, g_blocks, g_rest = fn(blocks, rest, tokens)
+
+        def ref(params):
+            logits = model.apply({"params": params},
+                                 tokens.reshape(M * mb, T))
+            return loss_fn(logits, tokens.reshape(M * mb, T))
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params)
+        np.testing.assert_allclose(float(loss), float(ref_l),
+                                   rtol=1e-5, atol=1e-6)
+        ref_blocks, ref_rest = stack_block_params_interleaved(ref_g, N, R)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+            g_blocks, ref_blocks)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+            g_rest, ref_rest)
