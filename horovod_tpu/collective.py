@@ -421,9 +421,18 @@ def _negotiate(kind: str, sig_key: tuple) -> None:
     ``negotiation_stall_report`` / the stall inspector reads when a peer
     stops responding.
     """
-    global _OP_SEQ, _NEG_HASH
     if jax.process_count() <= 1:
         return
+    from horovod_tpu import timeline as _tl
+    t = _tl.get_timeline()
+    if t is not None:
+        with t.activity(f"negotiate:{kind}", category="negotiation"):
+            return _negotiate_inner(kind, sig_key)
+    return _negotiate_inner(kind, sig_key)
+
+
+def _negotiate_inner(kind: str, sig_key: tuple) -> None:
+    global _OP_SEQ, _NEG_HASH
     import hashlib
     _OP_SEQ += 1
     cache_key = f"{kind}|{sig_key!r}"

@@ -55,9 +55,33 @@ def broadcast(tensor, root_rank: int = 0, **kwargs):
 
 
 def broadcast_variables(variables, root_rank: int = 0):
+    """Sync a list of ``tf.Variable`` from ``root_rank`` — works eagerly
+    and inside ``@tf.function`` (upstream scripts call it from the first
+    traced training step), crossing graph mode via ``tf.py_function``."""
     _require_tf()
-    for v in variables:
-        v.assign(broadcast(v, root_rank))
+    variables = list(variables)
+    if not variables:
+        return
+    if _tf.executing_eagerly():
+        for v in variables:
+            v.assign(broadcast(v, root_rank))
+        return
+
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+
+    def _bcast(*vals):
+        return [from_stacked(hvd.broadcast(to_stacked(v.numpy()),
+                                           root_rank)) for v in vals]
+
+    outs = _tf.py_function(
+        _bcast, inp=[_tf.convert_to_tensor(v) for v in variables],
+        Tout=[v.dtype for v in variables])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for v, o in zip(variables, outs):
+        o.set_shape(v.shape)
+        v.assign(o)
 
 
 def _allreduce_tf_list(tensors, op, compression, prescale_factor,
