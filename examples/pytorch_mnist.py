@@ -61,9 +61,7 @@ def main():
 
     # Upstream partitions with torch's DistributedSampler(rank, size);
     # same wrap-pad semantics here.
-    rank = hvd.rank() if isinstance(hvd.rank(), int) else 0
-    sampler = DistributedSampler(n, rank=rank % hvd.size(),
-                                 size=hvd.size())
+    sampler = DistributedSampler(n, rank=hvd.rank(), size=hvd.size())
 
     optimizer = torch.optim.SGD(model.parameters(),
                                 lr=args.lr * hvd.size(), momentum=0.5)
@@ -74,9 +72,9 @@ def main():
     first = None
     step = 0
     while step < args.steps:
-        for idx in np.array_split(list(iter(sampler)),
-                                  max(1, len(list(iter(sampler)))
-                                      // args.batch)):
+        indices = list(iter(sampler))
+        for idx in np.array_split(indices,
+                                  max(1, len(indices) // args.batch)):
             data, target = images[idx], labels[idx]
             optimizer.zero_grad()
             output = model(data)

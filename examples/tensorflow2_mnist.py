@@ -37,8 +37,7 @@ def main():
     # --- the upstream script body, unchanged in structure ------------------
     hvd.init()
 
-    rng = np.random.default_rng(hvd.rank() if isinstance(hvd.rank(), int)
-                                else 0)
+    rng = np.random.default_rng(hvd.rank())
     images = rng.standard_normal(
         (args.batch * 4, 28, 28, 1)).astype(np.float32)
     labels = rng.integers(0, 10, (args.batch * 4,)).astype(np.int64)
@@ -68,6 +67,9 @@ def main():
             # Upstream broadcasts initial state after the first step so the
             # optimizer slots exist.
             hvd.broadcast_variables(mnist_model.variables, root_rank=0)
+            opt_vars = opt.variables() if callable(opt.variables) \
+                else opt.variables
+            hvd.broadcast_variables(opt_vars, root_rank=0)
         return loss_value
 
     first = None

@@ -1,0 +1,59 @@
+"""Every example script runs end-to-end on the virtual CPU mesh (the
+examples are the migration story — a broken one is a broken claim)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def _run(script, *args, timeout=420, devices=8):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    out = subprocess.run(
+        [sys.executable, str(EXAMPLES / script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert out.returncode == 0, f"{script} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+@pytest.mark.slow
+class TestExamples:
+    def test_mnist_train(self):
+        _run("mnist_train.py", "--steps", "4")
+
+    def test_gpt2_tensor_parallel(self):
+        _run("gpt2_tensor_parallel.py", "--steps", "2")
+
+    def test_gpt2_pipeline_gpipe(self):
+        out = _run("gpt2_pipeline.py", "--steps", "2")
+        assert "GPipe" in out
+
+    def test_gpt2_pipeline_interleaved(self):
+        out = _run("gpt2_pipeline.py", "--steps", "2", "--interleave", "2")
+        assert "circular" in out
+
+    def test_pytorch_mnist(self):
+        out = _run("pytorch_mnist.py", "--steps", "25")
+        assert "loss" in out
+
+    def test_tensorflow2_mnist(self):
+        out = _run("tensorflow2_mnist.py", "--steps", "60", timeout=600)
+        assert "loss" in out
+
+    def test_estimator_cluster(self):
+        out = _run("estimator_cluster.py", "--workers", "2", "--epochs", "3",
+                   devices=2, timeout=600)
+        assert "worker:" in out
+
+    def test_resnet50_train(self):
+        _run("resnet50_train.py", "--steps", "2", "--batch-per-chip", "2",
+             "--image-size", "64")
+
+    def test_vit_elastic(self):
+        _run("vit_elastic.py", timeout=600)
