@@ -28,6 +28,9 @@ from horovod_tpu.optimizer import (  # noqa: F401
     grad, value_and_grad, allreduce_gradients, broadcast_parameters,
     broadcast_optimizer_state, broadcast_variables,
 )
+from horovod_tpu.optimizer_sharded import (  # noqa: F401
+    ShardedAdamWState, sharded_adamw,
+)
 from horovod_tpu.process_set import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,
 )

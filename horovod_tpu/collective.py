@@ -204,6 +204,45 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
     if op not in _SCALING_OPS and (prescale != 1.0 or postscale != 1.0):
         raise ValueError("prescale/postscale only apply to Sum/Average/Adasum")
 
+    if compression is Compression.int8:
+        # Quantized allreduce restructures the reduction itself (EQuARX
+        # two-phase); see ops/quantized.py. The fusion buffer is packed
+        # with every leaf padded to a whole number of quantization blocks,
+        # so one leaf's magnitude can never set another leaf's scale.
+        if op not in (ReduceOp.Sum, ReduceOp.Average):
+            raise ValueError(
+                "int8 quantized allreduce supports Sum and Average")
+        if ps.ranks is not None:
+            raise NotImplementedError(
+                "int8 quantized allreduce supports the global process set "
+                "only")
+        from horovod_tpu.ops.quantized import BLOCK, quantized_allreduce
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        live = [(i, l) for i, l in enumerate(leaves) if l.size]
+        if not live:
+            return tree
+        padded, spans = [], []
+        off = 0
+        for _, l in live:
+            flat = l.ravel().astype(jnp.float32)
+            if prescale != 1.0:
+                flat = flat * prescale
+            m = -(-flat.shape[0] // BLOCK) * BLOCK
+            padded.append(jnp.pad(flat, (0, m - flat.shape[0])))
+            spans.append((off, flat.shape[0]))
+            off += m
+        buf = jnp.concatenate(padded)
+        out = quantized_allreduce(buf, ps.axis, core.size(),
+                                  average=(op == ReduceOp.Average))
+        if postscale != 1.0:
+            out = out * postscale
+        new_leaves = list(leaves)
+        for (i, l), (start, ln) in zip(live, spans):
+            new_leaves[i] = lax.dynamic_slice(out, (start,), (ln,)) \
+                .reshape(l.shape).astype(l.dtype)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
     def reduce_buffer(buf):
         c, ctx = compression.compress(buf)
         r = _allreduce_leaf(c, op, ps, prescale, postscale)

@@ -63,8 +63,31 @@ class BF16Compressor(_CastCompressor):
     _wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Marker for the int8 quantized allreduce (EQuARX-style).
+
+    A cast compressor cannot express int8 correctly — summing quantized
+    values overflows and mixes scales — so the collective layer routes
+    this marker to ``ops.quantized.quantized_allreduce``, which
+    restructures the reduction (quantize → all_to_all → fp32 reduce →
+    re-quantize → all_gather). Sum/Average over the global set only.
+    ``compress``/``decompress`` are identity so any accidental use outside
+    allreduce degrades to uncompressed, never to wrong numbers.
+    """
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
 class Compression:
-    """Namespace matching ``hvd.Compression`` (upstream compression.py)."""
+    """Namespace matching ``hvd.Compression`` (upstream compression.py),
+    plus TPU-native bf16 and the quantized-allreduce int8 marker."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

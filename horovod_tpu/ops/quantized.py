@@ -1,0 +1,82 @@
+"""Quantized allreduce: int8 wire format with per-block scales.
+
+EQuARX-style (PAPERS.md: "Efficient Quantized AllReduce in XLA"): a plain
+cast-to-int8 compressor would be numerically wrong — the *sum* would
+overflow and mix scales — so the reduction is restructured into the
+two-phase form where dequantization happens at every reduction point:
+
+1. **reduce-scatter phase**: each device splits its buffer into one chunk
+   per peer, quantizes with a scale per fixed-size *block* (``BLOCK``
+   elements — fine-grained, so a large-magnitude layer sharing a fused
+   bucket with a small-magnitude layer cannot flush the latter to zero),
+   ships int8 + scales with a single ``all_to_all``, dequantizes the
+   received contributions in fp32 and reduces its owned chunk exactly.
+2. **allgather phase**: the reduced chunk is re-quantized (fresh per-block
+   scales) and ``all_gather`` reassembles the full result everywhere.
+
+Wire traffic is ~1/4 of fp32 (~1/2 of bf16) plus one fp32 scale per
+``BLOCK`` int8 values (1.6 % overhead at the default 256); the error is
+bounded by half an int8 step of each *block's* max-abs. Exposed through
+``hvd.allreduce(..., compression=Compression.int8)`` /
+``DistributedOptimizer(compression=Compression.int8)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["quantized_allreduce", "BLOCK"]
+
+# Elements sharing one quantization scale. Must divide the padded chunk.
+BLOCK = 256
+
+
+def _quantize_blocks(x: jnp.ndarray):
+    """(..., L) with L % BLOCK == 0 -> (int8 (..., L), scales (..., L/BLOCK))
+    using symmetric per-block max-abs scales."""
+    shape = x.shape
+    blocks = x.reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[..., None]), -127,
+                 127).astype(jnp.int8)
+    return q.reshape(shape), scale
+
+
+def _dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    shape = q.shape
+    blocks = q.astype(jnp.float32).reshape(
+        shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+    return (blocks * scale[..., None]).reshape(shape)
+
+
+def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
+                        average: bool = True) -> jnp.ndarray:
+    """Allreduce ``x`` (any shape) across ``axis_name`` with int8 wire
+    format; call inside shard_map over the full axis."""
+    n = axis_size
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).ravel()
+    L = flat.shape[0]
+    if L == 0:
+        return x
+    c = -(-L // (n * BLOCK)) * BLOCK    # chunk length, BLOCK-aligned
+    flat = jnp.pad(flat, (0, n * c - L))
+    chunks = flat.reshape(n, c)
+
+    # Phase 1: quantize per destination chunk (per-block scales),
+    # all_to_all, exact fp32 reduction of the owned chunk.
+    q, scale = _quantize_blocks(chunks)            # (n, c), (n, c/BLOCK)
+    q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s_recv = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
+    part = jnp.sum(_dequantize_blocks(q_recv, s_recv), axis=0)    # (c,)
+    if average:
+        part = part / n
+
+    # Phase 2: re-quantize the owned reduced chunk, allgather everywhere.
+    q2, s2 = _quantize_blocks(part)
+    qg = lax.all_gather(q2, axis_name)                       # (n, c)
+    sg = lax.all_gather(s2, axis_name)                       # (n, c/BLOCK)
+    out = _dequantize_blocks(qg, sg).reshape(n * c)[:L]
+    return out.reshape(orig_shape).astype(orig_dtype)
