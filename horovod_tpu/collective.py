@@ -219,9 +219,18 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
         from horovod_tpu.ops.quantized import BLOCK, quantized_allreduce
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        live = [(i, l) for i, l in enumerate(leaves) if l.size]
+        # Non-float leaves (step counters, masks) must round-trip exactly —
+        # quantizing them would corrupt values the cast compressors
+        # preserve; they take the ordinary exact reduction.
+        live = [(i, l) for i, l in enumerate(leaves)
+                if l.size and jnp.issubdtype(l.dtype, jnp.floating)]
+        exact = [(i, l) for i, l in enumerate(leaves)
+                 if l.size and not jnp.issubdtype(l.dtype, jnp.floating)]
+        new_leaves = list(leaves)
+        for i, l in exact:
+            new_leaves[i] = _allreduce_leaf(l, op, ps, prescale, postscale)
         if not live:
-            return tree
+            return jax.tree_util.tree_unflatten(treedef, new_leaves)
         padded, spans = [], []
         off = 0
         for _, l in live:
@@ -233,11 +242,17 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
             spans.append((off, flat.shape[0]))
             off += m
         buf = jnp.concatenate(padded)
-        out = quantized_allreduce(buf, ps.axis, core.size(),
-                                  average=(op == ReduceOp.Average))
+        # Honor the fusion threshold: quantize + reduce in BLOCK-aligned
+        # pieces so peak staging stays bounded like the fused fp path.
+        seg = max(BLOCK, (int(fusion_threshold) // 4) // BLOCK * BLOCK)
+        pieces = [
+            quantized_allreduce(buf[s:s + seg], ps.axis, core.size(),
+                                average=(op == ReduceOp.Average))
+            for s in range(0, buf.shape[0], seg)
+        ]
+        out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
         if postscale != 1.0:
             out = out * postscale
-        new_leaves = list(leaves)
         for (i, l), (start, ln) in zip(live, spans):
             new_leaves[i] = lax.dynamic_slice(out, (start,), (ln,)) \
                 .reshape(l.shape).astype(l.dtype)

@@ -54,11 +54,14 @@ def _block_sizes(tq: int, tk: int, block_q: int, block_k: int):
 
 
 def _mask_scores(s, q_blk, kv_blk, *, block_q, block_k, tq, tk, causal,
-                 bias=None):
+                 offset=0, bias=None):
     """Apply causal / ragged-edge / key-bias masking to a score block.
 
     Shared by the forward and both backward kernels so the mask definition
     cannot diverge between passes. ``s`` is (block_q, block_k) fp32.
+    ``offset`` shifts the causal diagonal: visible iff
+    ``q_pos + offset >= k_pos`` (offset -1 = strict causal — what striped
+    ring layouts need for the src > rank blocks).
     """
     need_pos = causal or tq % block_q or tk % block_k
     if bias is not None:
@@ -70,7 +73,7 @@ def _mask_scores(s, q_blk, kv_blk, *, block_q, block_k, tq, tk, causal,
                  jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
         ok = jnp.logical_and(q_pos < tq, k_pos < tk)
         if causal:
-            ok = jnp.logical_and(ok, q_pos >= k_pos)
+            ok = jnp.logical_and(ok, q_pos + offset >= k_pos)
         s = jnp.where(ok, s, _NEG_INF)
     return s
 
@@ -88,11 +91,12 @@ def _zero_oob_rows(x, blk, block: int, t: int):
     return jnp.where(rows < t, x, 0.0)
 
 
-def _causal_skip(causal: bool, q_blk, kv_idx, block_q: int, block_k: int):
+def _causal_skip(causal: bool, q_blk, kv_idx, block_q: int, block_k: int,
+                 offset: int = 0):
     """True when this (q, kv) block pair has any visible entries."""
     return jnp.logical_or(
         jnp.logical_not(causal),
-        kv_idx * block_k < (q_blk + 1) * block_q)
+        kv_idx * block_k < (q_blk + 1) * block_q + offset)
 
 
 # ---------------------------------------------------------------------------
@@ -101,7 +105,7 @@ def _causal_skip(causal: bool, q_blk, kv_idx, block_q: int, block_k: int):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int, tq: int, tk: int):
+                offset: int, block_q: int, block_k: int, tq: int, tk: int):
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
 
@@ -113,7 +117,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
     q_blk = pl.program_id(1)
 
-    @pl.when(_causal_skip(causal, q_blk, kv_idx, block_q, block_k))
+    @pl.when(_causal_skip(causal, q_blk, kv_idx, block_q, block_k, offset))
     def _():
         q = _zero_oob_rows(q_ref[0].astype(jnp.float32) * scale,
                            q_blk, block_q, tq)
@@ -121,7 +125,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
         s = _mask_scores(s, q_blk, kv_idx, block_q=block_q, block_k=block_k,
-                         tq=tq, tk=tk, causal=causal, bias=bias)
+                         tq=tq, tk=tk, causal=causal, offset=offset,
+                         bias=bias)
 
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -153,15 +158,15 @@ def _bias_spec(h: int, bk: int):
     return pl.BlockSpec((1, bk, 1), lambda b, i, j, h=h: (b // h, j, 0))
 
 
-def _fwd(q, k, v, bias, h, scale, causal, block_q, block_k):
+def _fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset=0):
     bh, tq, d = q.shape
     tk = k.shape[1]
     bq, bk = _block_sizes(tq, tk, block_q, block_k)
     grid = (bh, pl.cdiv(tq, bq), pl.cdiv(tk, bk))
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        tq=tq, tk=tk)
+        _fwd_kernel, scale=scale, causal=causal, offset=offset, block_q=bq,
+        block_k=bk, tq=tq, tk=tk)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
@@ -210,7 +215,8 @@ def _drop_bias(kernel):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, acc_ref, *, scale: float, causal: bool,
-                   block_q: int, block_k: int, tq: int, tk: int):
+                   offset: int, block_q: int, block_k: int, tq: int,
+                   tk: int):
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
 
@@ -220,7 +226,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     q_blk = pl.program_id(1)
 
-    @pl.when(_causal_skip(causal, q_blk, kv_idx, block_q, block_k))
+    @pl.when(_causal_skip(causal, q_blk, kv_idx, block_q, block_k, offset))
     def _():
         q = _zero_oob_rows(q_ref[0].astype(jnp.float32) * scale,
                            q_blk, block_q, tq)
@@ -228,7 +234,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
         s = _mask_scores(s, q_blk, kv_idx, block_q=block_q, block_k=block_k,
-                         tq=tq, tk=tk, causal=causal, bias=bias)
+                         tq=tq, tk=tk, causal=causal, offset=offset,
+                         bias=bias)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         do = _zero_oob_rows(do_ref[0].astype(jnp.float32), q_blk, block_q, tq)
@@ -246,8 +253,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, db_ref, dk_acc, dv_acc, db_acc, *,
-                    scale: float, causal: bool, block_q: int, block_k: int,
-                    tq: int, tk: int):
+                    scale: float, causal: bool, offset: int, block_q: int,
+                    block_k: int, tq: int, tk: int):
     q_idx = pl.program_id(2)
     num_q = pl.num_programs(2)
 
@@ -260,7 +267,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     kv_blk = pl.program_id(1)
 
-    @pl.when(_causal_skip(causal, q_idx, kv_blk, block_q, block_k))
+    @pl.when(_causal_skip(causal, q_idx, kv_blk, block_q, block_k, offset))
     def _():
         q = _zero_oob_rows(q_ref[0].astype(jnp.float32) * scale,
                            q_idx, block_q, tq)
@@ -268,7 +275,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
         s = _mask_scores(s, q_idx, kv_blk, block_q=block_q, block_k=block_k,
-                         tq=tq, tk=tk, causal=causal, bias=bias)
+                         tq=tq, tk=tk, causal=causal, offset=offset,
+                         bias=bias)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         do = _zero_oob_rows(do_ref[0].astype(jnp.float32), q_idx, block_q, tq)
@@ -292,7 +300,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
             db_ref[0] = db_acc[:][:, None]
 
 
-def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None):
+def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
     q, k, v, bias, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -304,8 +312,8 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None):
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)
 
-    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-                  tq=tq, tk=tk)
+    common = dict(scale=scale, causal=causal, offset=offset, block_q=bq,
+                  block_k=bk, tq=tq, tk=tk)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, **common)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, **common)
@@ -403,19 +411,21 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, h, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, h, scale, causal, block_q, block_k, offset):
+    o, _ = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k,
+                offset=offset)
     return o
 
 
-def _flash_fwd(q, k, v, bias, h, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset):
+    o, lse = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k,
+                  offset=offset)
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_bwd(h, scale, causal, block_q, block_k, res, do):
-    return _bwd(h, scale, causal, block_q, block_k, res, do)
+def _flash_bwd(h, scale, causal, block_q, block_k, offset, res, do):
+    return _bwd(h, scale, causal, block_q, block_k, res, do, offset=offset)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -424,7 +434,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, scale: Optional[float] = None,
                     key_bias: Optional[jnp.ndarray] = None,
-                    block_q: int = 256, block_k: int = 512) -> jnp.ndarray:
+                    block_q: int = 256, block_k: int = 512,
+                    causal_offset: int = 0) -> jnp.ndarray:
     """Fused attention ``softmax(q k^T * scale + key_bias [+ mask]) v``.
 
     Args:
@@ -437,6 +448,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         heads and queries — key-padding masks are ``where(pad, -1e30, 0)``,
         ALiBi-style learned biases also fit. Differentiated (the dK/dV
         kernel accumulates ``dbias_k = sum_q dS``).
+      causal_offset: shifts the causal diagonal — visible iff
+        ``i + causal_offset >= j`` (−1 = strict causal; used by striped
+        ring layouts). Only meaningful with ``causal=True``.
       block_q, block_k: tile sizes (clamped to the sequence lengths). The
         (256, 512) defaults were measured fastest on v5e for fwd+bwd —
         128-tiles drown in per-step grid overhead, and 512x512 Q-blocks
@@ -464,5 +478,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         key_bias = key_bias.astype(jnp.float32).reshape(b, tk, 1)
 
     o = _flash(pack(q), pack(k), pack(v), key_bias, h, float(scale),
-               bool(causal), int(block_q), int(block_k))
+               bool(causal), int(block_q), int(block_k),
+               int(causal_offset))
     return o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

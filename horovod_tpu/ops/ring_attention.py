@@ -29,20 +29,35 @@ _NEG_INF = -1e30
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   layout: str = "contiguous") -> jnp.ndarray:
     """Exact attention with q/k/v sharded on sequence across ``axis_name``.
 
     Args:
       q, k, v: (batch, t_local, heads, head_dim) — this device's sequence
-        shard. Global sequence order is rank-major: device r holds positions
-        [r*t_local, (r+1)*t_local).
+        shard.
       axis_name: mesh axis the sequence is sharded over (inside shard_map).
       causal: apply the global causal mask (correct across shards).
       scale: logit scale; defaults to head_dim**-0.5.
+      layout: how local row ``j`` maps to a global position —
+
+        * ``"contiguous"`` (rank-major): device r holds
+          ``[r*t_local, (r+1)*t_local)``. With ``causal`` the blocks a
+          device receives late in the ring are almost fully masked.
+        * ``"striped"`` (Striped Attention, Brandon et al. 2023): device r
+          holds positions ``r, r+n, r+2n, ...``. Every (q-shard, kv-shard)
+          pair then carries ~half the causal triangle, so a kernel that
+          prunes masked tiles (the flash path) does balanced work on every
+          ring step instead of idling on fully-masked ones. The dense path
+          computes full blocks either way — the layout is offered for
+          numerics parity and as the sharding to feed such kernels.
 
     Returns (batch, t_local, heads, head_dim) attention output for the local
-    query block.
+    query block (same layout as the inputs).
     """
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown layout {layout!r}; expected "
+                         "'contiguous' or 'striped'")
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -50,7 +65,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = D ** -0.5 if scale is None else scale
 
     qf = (q * scale).astype(jnp.float32)
-    q_pos = rank * Tq + jnp.arange(Tq)
+    if layout == "striped":
+        q_pos = rank + n * jnp.arange(Tq)
+    else:
+        q_pos = rank * Tq + jnp.arange(Tq)
 
     # Online-softmax accumulators.
     o = jnp.zeros((B, Tq, H, D), jnp.float32)
@@ -62,7 +80,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def step(carry, i):
         o, m, l, k, v = carry
         src = (rank - i) % n              # whose k/v block we hold this step
-        k_pos = src * Tk + jnp.arange(Tk)
+        if layout == "striped":
+            k_pos = src + n * jnp.arange(Tk)
+        else:
+            k_pos = src * Tk + jnp.arange(Tk)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)

@@ -156,3 +156,22 @@ class TestQuantizedBlockScales:
         out = hvd.allreduce(np.zeros((N, 0), np.float32),
                             compression=hvd.Compression.int8)
         assert np.asarray(out).shape == (N, 0)
+
+
+class TestQuantizedEdges:
+    def test_integer_leaves_stay_exact(self):
+        counts = np.full((N, 3), 9999, np.int32)
+        grads = np.full((N, 300), 0.5, np.float32)
+        out_c, out_g = hvd.allreduce([counts, grads], op=hvd.Sum,
+                                     compression=hvd.Compression.int8)
+        np.testing.assert_array_equal(np.asarray(out_c)[0], 9999 * N)
+        np.testing.assert_allclose(np.asarray(out_g)[0], 0.5 * N, rtol=2e-2)
+
+    def test_threshold_chunks_match_single_pass(self, rng):
+        x = rng.standard_normal((N, 3000)).astype(np.float32)
+        small = np.asarray(hvd.allreduce(
+            x, compression=hvd.Compression.int8,
+            fusion_threshold_bytes=4096))    # forces multiple segments
+        want = x.mean(0)
+        bound = 2.5 * np.abs(x).max() / 127
+        assert np.abs(small[0] - want).max() < bound
