@@ -18,6 +18,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from jax.sharding import PartitionSpec as P
 
@@ -35,6 +36,11 @@ class GPT2Config:
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     use_ring_attention: bool = False  # sequence-parallel attention (ops/)
+    # "contiguous" | "striped": how sequence positions map to sp shards.
+    # Striped (Striped Attention) balances causal ring work and lets
+    # striped_lm_loss cover every token pair exactly; feed tokens striped:
+    # shard r holds positions r, r+n, r+2n, ...
+    ring_layout: str = "contiguous"
     # "dense" | "flash" (fused pallas kernel, single-device/dp layouts).
     attention: str = "dense"
     # > 0 replaces every block's dense MLP with an expert-parallel MoE MLP
@@ -71,10 +77,12 @@ class Attention(nn.Module):
         if cfg.use_ring_attention:
             if cfg.attention == "flash":
                 from horovod_tpu.ops.ring_flash import ring_flash_attention
-                o = ring_flash_attention(q, k, v, axis_name="sp", causal=True)
+                o = ring_flash_attention(q, k, v, axis_name="sp", causal=True,
+                                         layout=cfg.ring_layout)
             elif cfg.attention == "dense":
                 from horovod_tpu.ops.ring_attention import ring_attention
-                o = ring_attention(q, k, v, axis_name="sp", causal=True)
+                o = ring_attention(q, k, v, axis_name="sp", causal=True,
+                                   layout=cfg.ring_layout)
             else:
                 raise ValueError(
                     f"unknown attention impl {cfg.attention!r} for the ring "
@@ -136,10 +144,14 @@ class GPT2(nn.Module):
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
         pos = jnp.arange(T)
         if cfg.use_ring_attention:
-            # Sequence-parallel: this shard holds global positions
-            # [rank*T, (rank+1)*T) — rank-major, matching the ring's causal
-            # mask. wpe must be indexed with the global positions.
-            pos = pos + jax.lax.axis_index("sp") * T
+            # Sequence-parallel: wpe must be indexed with this shard's
+            # *global* positions — rank-major for the contiguous layout,
+            # rank-offset stride-n for the striped one.
+            if cfg.ring_layout == "striped":
+                n = jax.lax.psum(1, "sp")
+                pos = jax.lax.axis_index("sp") + n * pos
+            else:
+                pos = pos + jax.lax.axis_index("sp") * T
         x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
         block = Block
         if cfg.remat:
@@ -182,6 +194,38 @@ def loss_fn(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def striped_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+                    axis_name: str = "sp") -> jnp.ndarray:
+    """Next-token cross entropy for the striped sp layout — **exact** over
+    the full sequence (call inside shard_map).
+
+    With striping, local position ``j`` on shard ``r`` is global position
+    ``r + n*j``, whose target (global ``r + n*j + 1``) lives at local ``j``
+    of shard ``r+1`` — except the last shard, whose targets are shard 0's
+    tokens shifted one step. One ``ppermute`` therefore fetches every
+    cross-shard target, and all ``T_global - 1`` prediction pairs are
+    covered — the contiguous per-shard shift drops the shard-boundary
+    pairs. Returns the replicated global mean loss.
+    """
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    B, T = tokens.shape
+    recv = lax.ppermute(tokens, axis_name,
+                        [(i, (i - 1) % n) for i in range(n)])
+    shifted = jnp.concatenate([recv[:, 1:], recv[:, :1]], axis=1)
+    targets = jnp.where(r == n - 1, shifted, recv)
+    # The final global position (last shard, last local slot) predicts
+    # nothing.
+    valid = jnp.where(r == n - 1,
+                      (jnp.arange(T) < T - 1)[None, :],
+                      jnp.ones((1, T), bool))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    s = jnp.sum(jnp.where(valid, ll, 0.0))
+    c = jnp.sum(jnp.where(valid, jnp.ones_like(ll), 0.0))
+    return -lax.psum(s, axis_name) / lax.psum(c, axis_name)
 
 
 def loss_fn_moe(model: "GPT2", params, tokens: jnp.ndarray,

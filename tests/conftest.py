@@ -33,3 +33,22 @@ def _init_hvd():
 def rng():
     import numpy as np
     return np.random.default_rng(42)
+
+
+def stripe_seq(x, n=8):
+    """Reorder axis 1 so shard_map's contiguous split hands device r the
+    striped subset (positions r, r+n, r+2n, ...) — the striped ring layout
+    convention shared by the attention/gpt2 tests."""
+    import numpy as np
+    x = np.asarray(x)
+    return np.concatenate([x[:, r::n] for r in range(n)], axis=1)
+
+
+def unstripe_seq(y, n=8):
+    import numpy as np
+    y = np.asarray(y)
+    out = np.empty_like(y)
+    t = y.shape[1] // n
+    for r in range(n):
+        out[:, r::n] = y[:, r * t:(r + 1) * t]
+    return out

@@ -152,21 +152,8 @@ class TestStripedRingAttention:
 
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_dense(self, qkv, causal):
+        from conftest import stripe_seq as stripe, unstripe_seq as unstripe
         q, k, v = qkv
-        # stripe the global sequence: local row j of device r = global
-        # position r + N*j
-        def stripe(x):
-            # (B, T, H, D) -> rows reordered so shard_map's contiguous
-            # split hands device r the striped subset
-            return np.concatenate(
-                [x[:, r::N] for r in range(N)], axis=1)
-
-        def unstripe(y):
-            out = np.empty_like(y)
-            t = y.shape[1] // N
-            for r in range(N):
-                out[:, r::N] = y[:, r * t:(r + 1) * t]
-            return out
 
         def body(q, k, v):
             return ring_attention(q, k, v, axis_name="hvd", causal=causal,
@@ -196,14 +183,12 @@ class TestStripedRingFlash:
     strict-causal (causal_offset=-1) kernel mode; numerics == dense."""
 
     def _stripe(self, x):
-        return np.concatenate([x[:, r::N] for r in range(N)], axis=1)
+        from conftest import stripe_seq
+        return stripe_seq(x, N)
 
     def _unstripe(self, y):
-        out = np.empty_like(y)
-        t = y.shape[1] // N
-        for r in range(N):
-            out[:, r::N] = y[:, r * t:(r + 1) * t]
-        return out
+        from conftest import unstripe_seq
+        return unstripe_seq(y, N)
 
     def test_matches_dense_causal(self, qkv):
         q, k, v = qkv
