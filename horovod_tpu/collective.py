@@ -441,6 +441,13 @@ def _host_allgather_i32(vec: np.ndarray) -> np.ndarray:
     return np.asarray(mhu.process_allgather(np.asarray(vec, np.int32)))
 
 
+def negotiation_stats() -> dict:
+    """{'full': n, 'fast': n} — content-negotiation rounds vs cached
+    hash-only rounds since init (observability for the response-cache fast
+    path; upstream exposes similar counters through its timeline)."""
+    return dict(_NEG_STATS)
+
+
 def negotiation_stall_report(timeout_s: float = 60.0):
     """[(op_signature, missing_rank_count)] for negotiations stuck longer
     than ``timeout_s`` (native stall inspector, upstream

@@ -35,7 +35,7 @@ def rng():
     return np.random.default_rng(42)
 
 
-def stripe_seq(x, n=8):
+def stripe_seq(x, n):
     """Reorder axis 1 so shard_map's contiguous split hands device r the
     striped subset (positions r, r+n, r+2n, ...) — the striped ring layout
     convention shared by the attention/gpt2 tests."""
@@ -44,7 +44,7 @@ def stripe_seq(x, n=8):
     return np.concatenate([x[:, r::n] for r in range(n)], axis=1)
 
 
-def unstripe_seq(y, n=8):
+def unstripe_seq(y, n):
     import numpy as np
     y = np.asarray(y)
     out = np.empty_like(y)

@@ -152,7 +152,9 @@ class TestStripedRingAttention:
 
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_dense(self, qkv, causal):
-        from conftest import stripe_seq as stripe, unstripe_seq as unstripe
+        from conftest import stripe_seq, unstripe_seq
+        stripe = lambda x: stripe_seq(x, N)
+        unstripe = lambda y: unstripe_seq(y, N)
         q, k, v = qkv
 
         def body(q, k, v):

@@ -57,3 +57,7 @@ class TestExamples:
 
     def test_vit_elastic(self):
         _run("vit_elastic.py", timeout=600)
+
+    def test_uneven_data_join(self):
+        out = _run("uneven_data_join.py")
+        assert "final |W - true|" in out

@@ -44,6 +44,28 @@ _WORKER = textwrap.dedent("""
         g = hvt.allgather(torch.full((2, 2), float(pid)))
         assert g.shape == (4, 2) and g[0, 0] == 0.0 and g[3, 0] == 1.0, g
         print(f"proc {{pid}} TORCH-OK", flush=True)
+    elif mode == "stall":
+        # End-to-end stall inspection: rank 1 delays its collective; rank
+        # 0's watchdog thread reads the pending-op table mid-negotiation.
+        import threading, time
+        from horovod_tpu import native
+        report_holder = {{}}
+        if pid == 0 and native.native_available():
+            def watch():
+                time.sleep(1.5)
+                report_holder["report"] = C.negotiation_stall_report(0.5)
+            t = threading.Thread(target=watch)
+            t.start()
+        if pid == 1:
+            time.sleep(3.0)
+        C._negotiate("allreduce", (("stallsig",), (0,)))
+        if pid == 0 and native.native_available():
+            t.join()
+            rep = report_holder.get("report", [])
+            assert any("stallsig" in name for name, _ in rep), rep
+            print(f"proc {{pid}} STALL-SEEN", flush=True)
+        else:
+            print(f"proc {{pid}} STALL-OK", flush=True)
     elif mode == "join":
         import time
         if pid == 1:
@@ -54,6 +76,8 @@ _WORKER = textwrap.dedent("""
     elif mode == "match":
         C._negotiate("allreduce", (("sig",), (0,)))
         C._negotiate("allreduce", (("sig",), (0,)))  # cache hit
+        stats = C.negotiation_stats()
+        assert stats == {{"full": 1, "fast": 1}}, stats
         print(f"proc {{pid}} OK", flush=True)
     else:
         try:
@@ -97,6 +121,19 @@ def test_two_process_negotiation_mismatch_detected():
     for rc, out in _run_pair("mismatch"):
         assert rc == 0, out
         assert "MISMATCH-CAUGHT" in out
+
+
+@pytest.mark.slow
+def test_two_process_stall_inspector_sees_pending_negotiation():
+    """The native stall inspector reports an op stuck in negotiation while
+    a peer lags (upstream stall_inspector.cc semantics, live path)."""
+    outs = _run_pair("stall")
+    assert all(rc == 0 for rc, _ in outs), outs
+    combined = "".join(o for _, o in outs)
+    from horovod_tpu import native
+    if native.native_available():
+        assert "STALL-SEEN" in combined, combined
+    assert "STALL-OK" in combined
 
 
 @pytest.mark.slow
