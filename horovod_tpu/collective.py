@@ -96,20 +96,47 @@ def _member_and_setrank(ps: ProcessSet):
     return jnp.asarray(member)[r], jnp.asarray(pos)[r]
 
 
+# Above this many bytes per member tensor, subset gathers ride the member
+# ring (traffic (k-1)*|x| among members only) instead of the one-hot psum
+# (a (k, |x|) buffer over the FULL axis). Below it, the psum's single
+# collective wins on latency.
+RING_GATHER_THRESHOLD_BYTES = 64 * 1024
+
+
+def _set_gather_ring(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
+    """Member-ring allgather: the block hops member-to-member k-1 times via
+    ``ppermute`` (devices outside the ring send nothing and receive zeros),
+    each member slotting the arriving block into its copy of the (k, ...)
+    result. Non-members end with zeros."""
+    k = ps.size()
+    member, setrank = _member_and_setrank(ps)
+    ring = [(ps.ranks[i], ps.ranks[(i + 1) % k]) for i in range(k)]
+    cur = jnp.where(member, x, jnp.zeros_like(x))
+    buf = jnp.zeros((k,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_index_in_dim(buf, cur[None], setrank, 0)
+    for step in range(k - 1):
+        cur = lax.ppermute(cur, ps.axis, ring)
+        slot = (setrank - step - 1) % k
+        buf = lax.dynamic_update_index_in_dim(buf, cur[None], slot, 0)
+    return buf
+
+
 def _set_gather(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
     """Gather ``x`` from every member of ``ps`` into axis 0 (shape-uniform on
-    all devices; non-members receive zeros). psum-of-one-hot, so any subset
-    works — XLA's AllGather only handles uniform replica groups.
+    all devices; non-members receive zeros). Two lowerings — XLA's AllGather
+    only handles uniform replica groups, so any subset needs one of:
 
-    Cost note: the psum moves a (k, |x|) buffer over the FULL axis, i.e.
-    O(k*|x|) traffic per device regardless of membership — fine for the
-    small-subset/small-tensor uses process sets exist for (metric groups,
-    per-pipeline-stage sync), quadratic for large subsets of large tensors.
-    For those, prefer the global set (plain all_gather) or a dedicated
-    sub-mesh via ``horovod_tpu.parallel.make_mesh`` and collectives over
-    its axis; a ppermute ring for mid-size subsets is a possible future
-    optimisation."""
+    * **one-hot psum** (small tensors): a (k, |x|) zero buffer with this
+      member's row filled, psum-ed over the full axis. One collective,
+      best latency; O(k*|x|) traffic per device regardless of membership.
+    * **member ring** (``>= RING_GATHER_THRESHOLD_BYTES``): k-1 ppermute
+      hops among the members only — (k-1)*|x| traffic that non-members
+      never carry, the right shape for large subsets of large tensors.
+    """
     k = ps.size()
+    if ps.ranks is not None and k > 2 and \
+            x.size * x.dtype.itemsize >= RING_GATHER_THRESHOLD_BYTES:
+        return _set_gather_ring(x, ps)
     member, setrank = _member_and_setrank(ps)
     contrib = jnp.where(member, x, jnp.zeros_like(x))
     buf = jnp.zeros((k,) + x.shape, x.dtype)

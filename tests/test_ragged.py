@@ -131,3 +131,44 @@ class TestRaggedAlltoall:
                 hvd.alltoall([x[:0] for x in xs], splits=ok, process_set=ps)
         finally:
             hvd.remove_process_set(ps)
+
+
+class TestRingSubsetGather:
+    """Large subset tensors gather over the member ring (ppermute hops among
+    members only) instead of the full-axis one-hot psum — same results."""
+
+    def test_ring_path_matches_psum_path(self, rng, monkeypatch):
+        from horovod_tpu import collective as C
+        x = rng.standard_normal((N, 64, 8)).astype(np.float32)
+        ps = hvd.add_process_set([1, 3, 5, 6])
+        try:
+            # force the ring on (threshold 0) and off (threshold huge)
+            monkeypatch.setattr(C, "RING_GATHER_THRESHOLD_BYTES", 0)
+            ring = np.asarray(hvd.allgather(x, process_set=ps))
+            C._EAGER_CACHE.clear()
+            monkeypatch.setattr(C, "RING_GATHER_THRESHOLD_BYTES", 1 << 40)
+            psum = np.asarray(hvd.allgather(x, process_set=ps))
+        finally:
+            hvd.remove_process_set(ps)
+        np.testing.assert_allclose(ring, psum, rtol=1e-6)
+        want = np.concatenate([x[1], x[3], x[5], x[6]])
+        for r in (1, 3, 5, 6):
+            np.testing.assert_allclose(ring[r], want, rtol=1e-6)
+        for r in (0, 2, 4, 7):
+            np.testing.assert_array_equal(ring[r], 0.0)
+
+    def test_subset_product_on_ring_path(self, rng, monkeypatch):
+        from horovod_tpu import collective as C
+        monkeypatch.setattr(C, "RING_GATHER_THRESHOLD_BYTES", 0)
+        C._EAGER_CACHE.clear()
+        x = rng.standard_normal((N, 16)).astype(np.float32)
+        ps = hvd.add_process_set([0, 2, 4])
+        try:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Product,
+                                           process_set=ps))
+        finally:
+            hvd.remove_process_set(ps)
+            C._EAGER_CACHE.clear()
+        want = x[0] * x[2] * x[4]
+        for r in (0, 2, 4):
+            np.testing.assert_allclose(out[r], want, rtol=1e-5)
