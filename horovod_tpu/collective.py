@@ -642,13 +642,19 @@ def _ps_key(ps: ProcessSet):
 def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=Compression.none, name: Optional[str] = None,
-              fusion_threshold_bytes: int = _fusion.DEFAULT_FUSION_THRESHOLD_BYTES):
+              fusion_threshold_bytes: Optional[int] = None):
     """Allreduce a tensor or pytree across the communicator (``hvd.allreduce``).
 
     Inside jit/shard_map: lowers to XLA psum/pmin/pmax/ppermute over the mesh
     axis. Eagerly: ``tensor[r]`` is rank ``r``'s value and the stacked result
     is returned (identical rows for reductions).
+
+    ``fusion_threshold_bytes`` defaults to ``HOROVOD_FUSION_THRESHOLD``
+    (64 MB when unset), read at init like upstream.
     """
+    if fusion_threshold_bytes is None:
+        from horovod_tpu.config import get_config
+        fusion_threshold_bytes = get_config().fusion_threshold_bytes
     ps = _resolve_ps(process_set)
     args = (op, ps, float(prescale_factor), float(postscale_factor),
             compression, int(fusion_threshold_bytes))

@@ -1,0 +1,115 @@
+"""Runtime configuration from upstream environment variables.
+
+Rebuild of the knob surface the reference reads at startup
+(``horovod/common/utils/env_parser.cc`` + ``horovod/runner/common/util/
+env.py``): the same ``HOROVOD_*`` variables configure the TPU-native
+engine, so launch scripts port unchanged. Variables whose mechanism has no
+TPU analogue (e.g. ``HOROVOD_CYCLE_TIME`` — there is no controller cycle
+to batch under SPMD) are accepted and recorded but have no effect; they're
+listed in :data:`Config.inert` so ``build_info`` can report them.
+
+Read once per :func:`horovod_tpu.init` (upstream reads once at
+``horovod_init``); :func:`refresh` re-reads for tests/elastic restarts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Config", "get_config", "refresh"]
+
+_MB = 1024 * 1024
+
+
+def _env_bytes(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Config:
+    # Fusion (fusion_buffer_manager.cc): HOROVOD_FUSION_THRESHOLD bytes.
+    fusion_threshold_bytes: int = 64 * _MB
+    # Timeline (timeline.cc): HOROVOD_TIMELINE=<path> starts the Chrome
+    # trace at init; HOROVOD_TIMELINE_MARK_CYCLES adds cycle markers.
+    timeline_path: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    # Autotune: HOROVOD_AUTOTUNE enables the online tuner;
+    # HOROVOD_AUTOTUNE_LOG mirrors upstream's tuning log path.
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    # Stall inspector (stall_inspector.cc): warning threshold + disable.
+    stall_check_disable: bool = False
+    stall_check_time_seconds: float = 60.0
+    # Elastic (runner/elastic): rendezvous/restart timeout.
+    elastic_timeout_seconds: float = 600.0
+    # Adasum hierarchy: HOROVOD_HIERARCHICAL_ALLREDUCE (read where used,
+    # mirrored here for build_info).
+    hierarchical_allreduce: bool = False
+    # Logging: HOROVOD_LOG_LEVEL (trace/debug/info/warning/error/fatal).
+    log_level: str = "warning"
+    # Accepted-but-inert on TPU, with the reason.
+    inert: dict = field(default_factory=dict)
+
+
+_CONFIG: Optional[Config] = None
+
+# Knobs whose mechanism SPMD/XLA deletes; accepted so upstream launch
+# scripts run unchanged, surfaced via build_info for transparency.
+_INERT_VARS = {
+    "HOROVOD_CYCLE_TIME": "no controller cycle under SPMD; XLA schedules",
+    "HOROVOD_CACHE_CAPACITY": "response cache is unbounded host-side",
+    "HOROVOD_BATCH_D2D_MEMCOPIES": "XLA fuses device copies",
+    "HOROVOD_NUM_NCCL_STREAMS": "ICI collectives are compiler-scheduled",
+    "HOROVOD_MPI_THREADS_DISABLE": "no MPI backend on TPU",
+    "HOROVOD_GLOO_TIMEOUT_SECONDS": "rendezvous rides jax.distributed",
+}
+
+
+def refresh() -> Config:
+    """Re-read ``HOROVOD_*`` from the environment (called by ``init()``)."""
+    global _CONFIG
+    cfg = Config(
+        fusion_threshold_bytes=_env_bytes("HOROVOD_FUSION_THRESHOLD",
+                                          64 * _MB),
+        timeline_path=os.environ.get("HOROVOD_TIMELINE") or None,
+        timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+        autotune=_env_bool("HOROVOD_AUTOTUNE"),
+        autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG") or None,
+        stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+        stall_check_time_seconds=_env_float(
+            "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+        elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
+        hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+        log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+        inert={k: reason for k, reason in _INERT_VARS.items()
+               if os.environ.get(k)},
+    )
+    _CONFIG = cfg
+
+    import logging
+    level = {"trace": logging.DEBUG, "debug": logging.DEBUG,
+             "info": logging.INFO, "warning": logging.WARNING,
+             "error": logging.ERROR, "fatal": logging.CRITICAL}.get(
+                 cfg.log_level, logging.WARNING)
+    logging.getLogger("horovod_tpu").setLevel(level)
+    return cfg
+
+
+def get_config() -> Config:
+    """The active configuration (reads the environment on first use)."""
+    return _CONFIG if _CONFIG is not None else refresh()

@@ -118,6 +118,15 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         _coll._EAGER_CACHE.clear()
         _coll._reset_negotiation()
         _ps._reset_for_init(m, axis_name)
+        # Upstream reads its HOROVOD_* knob surface once at horovod_init;
+        # same contract here (config.py documents the TPU-inert ones).
+        from horovod_tpu import config as _config
+        cfg = _config.refresh()
+        if cfg.timeline_path:
+            from horovod_tpu import timeline as _tl
+            if _tl.get_timeline() is None:
+                _tl.start_timeline(cfg.timeline_path,
+                                   mark_cycles=cfg.timeline_mark_cycles)
 
 
 def shutdown() -> None:
@@ -125,6 +134,10 @@ def shutdown() -> None:
     global _CTX
     with _LOCK:
         _CTX = None
+        # Finalize an active Chrome trace — an unflushed timeline is an
+        # invalid (or missing) file.
+        from horovod_tpu import timeline as _tl
+        _tl.shutdown_timeline()
         from horovod_tpu import collective as _coll
         from horovod_tpu import process_set as _ps
         _coll._EAGER_CACHE.clear()
@@ -206,6 +219,8 @@ def in_spmd_context() -> bool:
 
 def build_info() -> dict:
     """Capability flags (analogue of ``hvd.nccl_built``/``mpi_built`` etc.)."""
+    from horovod_tpu.config import get_config
+    cfg = get_config()
     backend = jax.default_backend()
     return {
         "backend": backend,
@@ -217,4 +232,10 @@ def build_info() -> dict:
         "pallas_built": True,
         "adasum_built": True,
         "elastic_built": True,
+        # Active HOROVOD_* knob surface (config.py): the resolved values
+        # plus any accepted-but-inert variables with the reason they have
+        # no TPU mechanism.
+        "fusion_threshold_bytes": cfg.fusion_threshold_bytes,
+        "autotune": cfg.autotune,
+        "inert_env": dict(cfg.inert),
     }

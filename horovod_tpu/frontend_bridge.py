@@ -24,6 +24,27 @@ def to_stacked(array_like) -> np.ndarray:
 
 
 def from_stacked(stacked) -> np.ndarray:
-    """Stacked result -> this process's value (row 0; reductions make every
-    row identical)."""
-    return np.asarray(stacked[0]).copy()
+    """Stacked result -> this process's value: row ``core.rank()``.
+
+    Single controller: the result is fully addressable and every simulated
+    rank is local; the process is rank 0 by convention (``core.rank()``
+    returns the first local device's rank). Multi-process: the row is read
+    straight off this process's addressable shard — no cross-process
+    fetch, and crucially the *correct* row for ops whose outputs differ
+    per rank (reducescatter chunks, alltoall receives), where a fixed
+    row 0 would silently hand every process rank 0's result.
+    """
+    import jax
+    if isinstance(stacked, jax.Array) and not stacked.is_fully_addressable:
+        r = core.rank()
+        for sh in stacked.addressable_shards:
+            s0 = sh.index[0] if sh.index else slice(None)
+            start = s0.start or 0
+            stop = s0.stop if s0.stop is not None else stacked.shape[0]
+            if start <= r < stop:
+                return np.asarray(sh.data)[r - start].copy()
+        raise RuntimeError(
+            f"rank {r}'s row of a stacked eager result is not addressable "
+            "on this process (unexpected output sharding "
+            f"{stacked.sharding})")
+    return np.asarray(stacked[core.rank()]).copy()

@@ -1,0 +1,7 @@
+"""``horovod_tpu.keras`` — alias of :mod:`horovod_tpu.tensorflow.keras`
+(upstream ships ``horovod.keras`` for standalone Keras and
+``horovod.tensorflow.keras`` for tf.keras; Keras 3 unified them, so one
+implementation serves both import paths)."""
+
+from horovod_tpu.tensorflow.keras import *  # noqa: F401,F403
+from horovod_tpu.tensorflow.keras import __all__  # noqa: F401

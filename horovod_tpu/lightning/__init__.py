@@ -1,30 +1,194 @@
-"""PyTorch Lightning integration surface (upstream
-``horovod/spark/lightning`` + the Lightning ``HorovodStrategy``).
+"""PyTorch Lightning integration (upstream Lightning ``HorovodStrategy`` +
+``horovod/spark/lightning`` estimator surface).
 
-API-parity stubs: pytorch-lightning is not in the TPU image. The equivalent
-capability — a trainer loop with distributed optimizer wrapping, metric
-averaging and checkpointing — is provided natively by
-``horovod_tpu.DistributedOptimizer`` + ``horovod_tpu.callbacks`` +
-``horovod_tpu.checkpoint``.
+pytorch-lightning is not in the TPU image (and PL 2.x removed its built-in
+Horovod strategy), so the capability is delivered standalone: the strategy
+implements the operations a distributed trainer delegates — ``setup`` /
+``reduce`` / ``all_gather`` / ``broadcast`` / ``barrier`` / rank
+properties — and the bundled :class:`Trainer` drives them for
+LightningModule-shaped objects (``training_step`` /
+``configure_optimizers``). It is NOT a drop-in ``pl.Trainer(strategy=...)``
+argument: PL validates strategies by isinstance against its own Strategy
+ABC and calls a wider interface; with PL installed, bridge by subclassing
+``pl.strategies.Strategy`` and delegating to this class's methods.
+Collectives ride the shared engine through :mod:`horovod_tpu.torch`.
 """
 
 from __future__ import annotations
 
-_MSG = ("horovod_tpu.lightning requires the pytorch-lightning package, "
-        "which is not in this environment. Use horovod_tpu.callbacks for "
-        "training-loop hooks, horovod_tpu.DistributedOptimizer for gradient "
-        "synchronisation, and horovod_tpu.checkpoint for checkpointing.")
+from typing import Iterable, Optional
 
-
-def _unavailable(*_a, **_k):
-    raise RuntimeError(_MSG)
-
-
-class TorchEstimator:
-    def __init__(self, *a, **k):
-        _unavailable()
+__all__ = ["HorovodStrategy", "Trainer", "TorchEstimator"]
 
 
 class HorovodStrategy:
-    def __init__(self, *a, **k):
-        _unavailable()
+    """Distributed-training strategy over the TPU communicator (the
+    capability of Lightning's ``HorovodStrategy``, rebuilt TPU-native).
+
+    Responsibilities (what PL's Trainer delegates to a strategy):
+
+    * identity — ``world_size`` / ``global_rank`` / ``local_rank`` /
+      ``is_global_zero``;
+    * ``setup(module)`` — broadcast initial parameters (and optimizer
+      state) from rank 0, wrap the module's optimizers so ``step()``
+      allreduces gradients first;
+    * ``reduce`` / ``all_gather`` / ``broadcast`` / ``barrier`` — tensor
+      and object collectives for metrics and control flow.
+    """
+
+    strategy_name = "horovod"
+
+    def __init__(self, compression=None, op=None):
+        import horovod_tpu.torch as hvt
+        self._hvt = hvt
+        self._compression = compression if compression is not None \
+            else hvt.Compression.none
+        self._op = op if op is not None else hvt.Average
+        hvt.init()
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return self._hvt.size()
+
+    @property
+    def global_rank(self) -> int:
+        return self._hvt.rank()
+
+    @property
+    def local_rank(self) -> int:
+        return self._hvt.local_rank()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.global_rank == 0
+
+    @property
+    def root_device(self):
+        import torch
+        return torch.device("cpu")   # torch is the host frontend on TPU
+
+    # -- setup -------------------------------------------------------------
+    def setup(self, module) -> list:
+        """Sync ``module`` from rank 0 and return its optimizers wrapped in
+        the hook-based DistributedOptimizer (PL calls this from
+        ``Trainer.fit`` before the first step). Accepts the standard
+        ``configure_optimizers`` return forms: a single optimizer, a list,
+        ``{"optimizer": opt, ...}``, a list of such dicts, or the
+        ``([optimizers], [schedulers])`` tuple — schedulers are returned to
+        the caller's responsibility (the bundled Trainer does not step
+        them)."""
+        self._hvt.broadcast_parameters(module.state_dict(), root_rank=0)
+        opts = self._unpack_optimizers(module.configure_optimizers())
+        wrapped = [self._hvt.DistributedOptimizer(
+            o, compression=self._compression, op=self._op) for o in opts]
+        for o in wrapped:
+            self._hvt.broadcast_optimizer_state(o, root_rank=0)
+        return wrapped
+
+    @staticmethod
+    def _unpack_optimizers(cfg) -> list:
+        if cfg is None:
+            return []
+        # ([optimizers], [schedulers]) tuple form
+        if isinstance(cfg, tuple) and len(cfg) == 2 and \
+                isinstance(cfg[0], (list, tuple)) and \
+                isinstance(cfg[1], (list, tuple)):
+            cfg = cfg[0]
+        if not isinstance(cfg, (list, tuple)):
+            cfg = [cfg]
+        opts = []
+        for item in cfg:
+            if isinstance(item, dict):
+                if "optimizer" not in item:
+                    raise ValueError(
+                        "configure_optimizers dict form requires an "
+                        f"'optimizer' key, got keys {sorted(item)}")
+                item = item["optimizer"]
+            if not hasattr(item, "param_groups"):
+                raise TypeError(
+                    "configure_optimizers must yield torch optimizers "
+                    f"(objects with param_groups); got {type(item).__name__}")
+            opts.append(item)
+        return opts
+
+    # -- collectives -------------------------------------------------------
+    def reduce(self, tensor, group=None, reduce_op: str = "mean"):
+        """Average (or sum) a tensor/scalar across workers (PL calls this on
+        logged metrics). ``reduce_op=None`` means no reduction — PL's
+        Strategy contract — and returns the tensor unchanged."""
+        if reduce_op is None:
+            return tensor
+        import torch
+        t = tensor if torch.is_tensor(tensor) \
+            else torch.as_tensor(float(tensor))
+        op = self._hvt.Average if str(reduce_op).lower() in (
+            "mean", "avg", "average") else self._hvt.Sum
+        out = self._hvt.allreduce(t.reshape(1) if t.ndim == 0 else t, op=op)
+        return out.reshape(()) if t.ndim == 0 else out
+
+    def all_gather(self, tensor, group=None, sync_grads: bool = False):
+        """Stack every worker's tensor on a new leading axis (PL's
+        ``self.all_gather``)."""
+        import torch
+        t = tensor if torch.is_tensor(tensor) else torch.as_tensor(tensor)
+        flat = t.reshape(1, *t.shape) if t.ndim == 0 else t[None]
+        out = self._hvt.allgather(flat)
+        return out.reshape(self.world_size, *t.shape)
+
+    def broadcast(self, obj, src: int = 0):
+        import horovod_tpu as hvd
+        return hvd.broadcast_object(obj, root_rank=src)
+
+    def barrier(self, name: Optional[str] = None) -> None:
+        import horovod_tpu as hvd
+        hvd.barrier()
+
+    def teardown(self) -> None:
+        pass
+
+
+class Trainer:
+    """Minimal fit-loop driver for LightningModule-shaped objects
+    (``training_step(batch, batch_idx) -> loss``, ``configure_optimizers``,
+    optional ``on_epoch_end(trainer)``) so the strategy is usable without
+    pytorch-lightning (see the module docstring for bridging to a real PL
+    Trainer)."""
+
+    def __init__(self, max_epochs: int = 1,
+                 strategy: Optional[HorovodStrategy] = None):
+        self.max_epochs = max_epochs
+        self.strategy = strategy or HorovodStrategy()
+        self.history: list = []
+
+    def fit(self, module, train_dataloader: Iterable) -> "Trainer":
+        import torch
+        optimizers = self.strategy.setup(module)
+        for epoch in range(self.max_epochs):
+            losses = []
+            for i, batch in enumerate(train_dataloader):
+                for opt in optimizers:
+                    opt.zero_grad()
+                loss = module.training_step(batch, i)
+                loss.backward()
+                for opt in optimizers:
+                    opt.step()       # allreduces grads, then inner step
+                losses.append(float(loss.detach()))
+            epoch_loss = float(torch.tensor(losses).mean()) if losses \
+                else float("nan")
+            # Cross-worker average, like PL's sync_dist logging.
+            self.history.append(float(self.strategy.reduce(epoch_loss)))
+            if hasattr(module, "on_epoch_end"):
+                module.on_epoch_end(self)
+        return self
+
+
+def TorchEstimator(*args, **kwargs):
+    """``horovod.spark.lightning.TorchEstimator`` equivalent: the spark
+    estimator state machine already trains torch modules through the same
+    strategy mechanics (parameter broadcast + hook-based distributed
+    optimizer); see
+    :class:`horovod_tpu.spark.estimator_torch.TorchEstimator`, constructed
+    here for API familiarity."""
+    from horovod_tpu.spark.estimator_torch import TorchEstimator as _TE
+    return _TE(*args, **kwargs)

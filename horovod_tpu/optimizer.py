@@ -28,7 +28,6 @@ import optax
 
 from horovod_tpu import collective as C
 from horovod_tpu import core
-from horovod_tpu import fusion as _fusion
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet
 
@@ -44,8 +43,7 @@ def allreduce_gradients(grads: Any, op: int = C.Average,
                         compression=Compression.none,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0,
-                        fusion_threshold_bytes: int =
-                        _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                        fusion_threshold_bytes: Optional[int] = None,
                         alive: Optional[jnp.ndarray] = None) -> Any:
     """Fused allreduce of a gradient pytree (in-trace).
 
@@ -86,8 +84,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression=Compression.none,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         fusion_threshold_bytes: int =
-                         _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                         fusion_threshold_bytes: Optional[int] = None,
                          backward_passes_per_step: int = 1,
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are synchronized before the update

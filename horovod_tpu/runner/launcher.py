@@ -243,6 +243,13 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
     import tempfile
     import time
 
+    if timeout is None and os.environ.get("HOROVOD_ELASTIC_TIMEOUT"):
+        # Upstream's elastic rendezvous timeout; the closest analogue in
+        # the relaunch model is the per-attempt job deadline. Only applied
+        # when the user set the variable — an unset default must not kill
+        # long jobs. Read the env var directly so a value set after
+        # init()'s config snapshot still applies.
+        timeout = float(os.environ["HOROVOD_ELASTIC_TIMEOUT"])
     if state_dir is None:
         state_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
     world = np
@@ -382,8 +389,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="execute the per-host commands over ssh and "
                              "supervise them (upstream gloo_run)")
     parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("--check-build", action="store_true",
+                        help="print capability flags and exit "
+                             "(horovodrun --check-build)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+    if args.check_build:
+        # No init(): the diagnostic must work even when the rendezvous
+        # would block or the accelerator is held (upstream --check-build
+        # prints build flags without initializing); build_info only reads
+        # the jax backend + config.
+        import json as _json
+
+        import horovod_tpu as _hvd
+        print(_json.dumps(_hvd.build_info(), indent=2, default=str))
+        return 0
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     if not args.command:

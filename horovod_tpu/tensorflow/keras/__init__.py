@@ -1,0 +1,286 @@
+"""Keras integration (upstream ``horovod/tensorflow/keras`` +
+``horovod/keras``).
+
+``DistributedOptimizer`` wraps any ``tf.keras`` optimizer so every gradient
+application first rides the shared collective engine (fused grouped
+allreduce), and the callbacks reproduce upstream's
+``horovod/_keras/callbacks.py`` set: initial-state broadcast, cross-worker
+metric averaging, and the Goyal et al. gradual LR warmup.
+
+Keras 3 routes both ``model.fit`` and custom ``apply_gradients`` loops
+through ``BaseOptimizer.apply``, so the mixin overrides ``apply`` — one
+interception point instead of upstream's per-backend ``get_gradients`` /
+``_aggregate_gradients`` overrides (TF-on-TPU performance work should use
+the JAX frontend; this is the capability bridge for unchanged upstream
+scripts).
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as _tf
+    _HAVE_TF = True
+except ImportError:
+    _tf = None
+    _HAVE_TF = False
+
+from horovod_tpu.collective import (  # noqa: F401
+    Average, Sum, Min, Max, Product, Adasum,
+)
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.core import (  # noqa: F401
+    init, shutdown, rank, size, local_rank, local_size, cross_rank,
+    cross_size,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    _allreduce_tf_list, _require_tf, allreduce, broadcast,
+    broadcast_variables,
+)
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "allreduce", "broadcast",
+    "broadcast_variables", "DistributedOptimizer",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateWarmupCallback", "LearningRateScheduleCallback",
+    "Average", "Sum", "Min", "Max", "Product", "Adasum", "Compression",
+]
+
+
+class _DistributedOptimizerMixin:
+    """Injected over the wrapped optimizer's class; ``apply`` is keras 3's
+    single gradient funnel (``apply_gradients`` delegates to it)."""
+
+    _hvd_op = Average
+    _hvd_compression = Compression.none
+    _hvd_prescale = 1.0
+    _hvd_postscale = 1.0
+    _hvd_process_set = None
+
+    def apply(self, grads, trainable_variables=None):
+        grads = _allreduce_tf_list(
+            list(grads), self._hvd_op, self._hvd_compression,
+            self._hvd_prescale, self._hvd_postscale, self._hvd_process_set)
+        if trainable_variables is None:
+            return super().apply(grads)
+        return super().apply(grads, trainable_variables)
+
+
+def DistributedOptimizer(optimizer, op=Average,
+                         compression=Compression.none,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0,
+                         process_set=None, name=None, **_ignored):
+    """Wrap a ``tf.keras`` optimizer for distributed training
+    (upstream ``horovod/tensorflow/keras/__init__.py:DistributedOptimizer``):
+    a dynamic subclass of the optimizer's own class whose gradient
+    application allreduces first, rebuilt from ``get_config`` so keras
+    serialization still works."""
+    _require_tf()
+    if not hasattr(optimizer, "apply"):
+        # Keras 2 (TF <= 2.15) optimizers have no apply() funnel; wrapping
+        # would silently skip the allreduce — refuse loudly instead.
+        raise TypeError(
+            "horovod_tpu.tensorflow.keras.DistributedOptimizer requires a "
+            "Keras 3 optimizer (keras >= 3 / TF >= 2.16, where "
+            "apply_gradients funnels through apply()); got "
+            f"{type(optimizer).__module__}.{type(optimizer).__name__}")
+    cls = type(optimizer.__class__.__name__,
+               (_DistributedOptimizerMixin, optimizer.__class__), {})
+    wrapped = cls.from_config(optimizer.get_config())
+    wrapped._hvd_op = op
+    wrapped._hvd_compression = compression
+    wrapped._hvd_prescale = float(prescale_factor)
+    wrapped._hvd_postscale = float(postscale_factor)
+    wrapped._hvd_process_set = process_set
+    return wrapped
+
+
+def _callback_base():
+    _require_tf()
+    return _tf.keras.callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast model + optimizer state from ``root_rank`` after the first
+    batch, once variables exist (upstream
+    ``callbacks.BroadcastGlobalVariablesCallback``)."""
+
+    def __new__(cls, root_rank: int = 0, *, device=None):
+        base = _callback_base()
+
+        class _Impl(base):
+            def __init__(self, root):
+                super().__init__()
+                self.root_rank = root
+                self.broadcast_done = False
+
+            def on_train_batch_end(self, batch, logs=None):
+                if self.broadcast_done:
+                    return
+                broadcast_variables(self.model.variables, self.root_rank)
+                opt = getattr(self.model, "optimizer", None)
+                if opt is not None and getattr(opt, "variables", None):
+                    broadcast_variables(
+                        [v for v in opt.variables
+                         if hasattr(v, "assign")], self.root_rank)
+                self.broadcast_done = True
+
+        return _Impl(root_rank)
+
+
+class MetricAverageCallback:
+    """Average epoch-end metrics over all workers so logs (and anything
+    keyed on them, like checkpointing-on-best) agree across ranks
+    (upstream ``callbacks.MetricAverageCallback``)."""
+
+    def __new__(cls, *, device=None):
+        base = _callback_base()
+
+        class _Impl(base):
+            def on_epoch_end(self, epoch, logs=None):
+                if not logs:
+                    return
+                for k, v in list(logs.items()):
+                    try:
+                        val = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    out = allreduce(_tf.constant(val, _tf.float32),
+                                    op=Average)
+                    logs[k] = float(out.numpy())
+
+        return _Impl()
+
+
+def _set_lr(model, value: float) -> None:
+    lr = model.optimizer.learning_rate
+    if hasattr(lr, "assign"):
+        lr.assign(value)
+    else:                                    # plain float config
+        model.optimizer.learning_rate = value
+
+
+def _get_steps(params):
+    """Steps per epoch as keras reports it, or None when unknown (e.g. a
+    tf.data pipeline of unknown cardinality)."""
+    s = (params or {}).get("steps")
+    return int(s) if s else None
+
+
+class LearningRateWarmupCallback:
+    """Gradual LR warmup (Goyal et al., upstream
+    ``callbacks.LearningRateWarmupCallback``): ramp per-batch from
+    ``initial_lr / size`` to ``initial_lr`` over ``warmup_epochs``, then
+    leave the LR alone."""
+
+    def __new__(cls, initial_lr: float, warmup_epochs: int = 5,
+                steps_per_epoch=None, verbose: int = 0, **_ignored):
+        base = _callback_base()
+        world = size()
+
+        class _Impl(base):
+            def __init__(self):
+                super().__init__()
+                self.current_epoch = 0
+                self.steps_per_epoch = steps_per_epoch
+                self.done = False
+                self._warned = False
+
+            def on_train_begin(self, logs=None):
+                if self.steps_per_epoch is None:
+                    self.steps_per_epoch = _get_steps(self.params)
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self.current_epoch = epoch
+                self._batches_seen = 0
+
+            def on_epoch_end(self, epoch, logs=None):
+                # Unknown-cardinality pipeline: learn steps/epoch from the
+                # first epoch so later epochs ramp per-batch.
+                if self.steps_per_epoch is None and self._batches_seen:
+                    self.steps_per_epoch = self._batches_seen
+
+            def on_train_batch_begin(self, batch, logs=None):
+                if self.done:
+                    return
+                if warmup_epochs <= 0:      # upstream: no warmup at all
+                    self.done = True
+                    return
+                self._batches_seen = batch + 1
+                if self.steps_per_epoch:
+                    within = batch / self.steps_per_epoch
+                else:
+                    # keras didn't report steps (unknown cardinality):
+                    # ramp at epoch granularity rather than collapsing
+                    # the warmup to `warmup_epochs` *batches*.
+                    within = 0.0
+                    if not self._warned:
+                        self._warned = True
+                        import logging
+                        logging.getLogger("horovod_tpu").warning(
+                            "LearningRateWarmupCallback: steps_per_epoch "
+                            "unknown; warming up at epoch granularity "
+                            "(pass steps_per_epoch= for per-batch ramp)")
+                progress = min(1.0, (self.current_epoch + within)
+                               / warmup_epochs)
+                lr = initial_lr * (1.0 / world + progress * (1 - 1.0 / world))
+                _set_lr(self.model, lr)
+                if progress >= 1.0:
+                    self.done = True
+                    if verbose:
+                        print(f"warmup complete: lr={lr:g}")
+
+        return _Impl()
+
+
+class LearningRateScheduleCallback:
+    """Piecewise LR schedule (upstream
+    ``callbacks.LearningRateScheduleCallback``): within
+    ``[start_epoch, end_epoch)`` set ``lr = initial_lr * multiplier``
+    where ``multiplier`` is a constant or ``f(epoch)``."""
+
+    def __new__(cls, initial_lr: float, multiplier, start_epoch: int = 0,
+                end_epoch=None, staircase: bool = True,
+                steps_per_epoch=None, **_ignored):
+        base = _callback_base()
+        mult = multiplier if callable(multiplier) else (lambda _e: multiplier)
+
+        class _Impl(base):
+            def __init__(self):
+                super().__init__()
+                self.steps_per_epoch = steps_per_epoch
+                self.current_epoch = 0
+
+            def on_train_begin(self, logs=None):
+                if self.steps_per_epoch is None:
+                    self.steps_per_epoch = _get_steps(self.params)
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self.current_epoch = epoch
+                self._batches_seen = 0
+                if staircase:
+                    self._maybe_set(float(epoch))
+
+            def on_epoch_end(self, epoch, logs=None):
+                if self.steps_per_epoch is None and \
+                        getattr(self, "_batches_seen", 0):
+                    self.steps_per_epoch = self._batches_seen
+
+            def on_train_batch_begin(self, batch, logs=None):
+                self._batches_seen = batch + 1
+                if not staircase:
+                    # Epoch granularity until steps/epoch is known (same
+                    # fallback as the warmup callback).
+                    within = batch / self.steps_per_epoch \
+                        if self.steps_per_epoch else 0.0
+                    self._maybe_set(self.current_epoch + within)
+
+            def _maybe_set(self, epoch: float):
+                if epoch < start_epoch:
+                    return
+                if end_epoch is not None and epoch >= end_epoch:
+                    return
+                _set_lr(self.model, initial_lr * mult(epoch))
+
+        return _Impl()

@@ -96,15 +96,27 @@ class Timeline:
                                "displayTimeUnit": "ms"}, f)
 
 
+_ATEXIT_REGISTERED = False
+
+
 def init_timeline(path: Optional[str] = None) -> Timeline:
-    """Enable the timeline (``HOROVOD_TIMELINE`` env var or explicit path)."""
-    global _TIMELINE
+    """Enable the timeline (``HOROVOD_TIMELINE`` env var or explicit path).
+
+    Registers an ``atexit`` flush the first time: the Chrome trace is only
+    valid once finalized, and scripts that never call ``stop_timeline`` /
+    ``shutdown`` must still get their file (upstream closes its timeline in
+    the background thread's teardown)."""
+    global _TIMELINE, _ATEXIT_REGISTERED
     with _LOCK:
         path = path or os.environ.get("HOROVOD_TIMELINE")
         if not path:
             raise ValueError(
                 "pass a path or set HOROVOD_TIMELINE=/path/timeline.json")
         _TIMELINE = Timeline(path)
+        if not _ATEXIT_REGISTERED:
+            import atexit
+            atexit.register(shutdown_timeline)
+            _ATEXIT_REGISTERED = True
         return _TIMELINE
 
 

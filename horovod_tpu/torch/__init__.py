@@ -31,7 +31,12 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size",
     "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
-    "alltoall", "grouped_allreduce",
+    "alltoall", "reducescatter", "grouped_allreduce",
+    "allreduce_async", "allreduce_async_", "allgather_async",
+    "broadcast_async", "broadcast_async_", "alltoall_async",
+    "reducescatter_async", "grouped_allreduce_async",
+    "synchronize", "poll", "join",
+    "broadcast_object", "allgather_object",
     "broadcast_parameters", "broadcast_optimizer_state",
     "DistributedOptimizer", "Compression", "SyncBatchNorm",
     "Average", "Sum", "Min", "Max", "Product", "Adasum", "ReduceOp",
@@ -52,6 +57,38 @@ def _torch():
     return torch
 
 
+# One ordered dispatch thread for every torch-frontend collective (the
+# analogue of upstream's background controller thread): submissions keep the
+# caller's program order — which is what the multi-process negotiation
+# protocol requires — while ``*_async`` calls return immediately instead of
+# blocking in the cross-process negotiation round. Sync ops submit and wait.
+import threading as _threading
+
+_DISPATCH = None
+_DISPATCH_LOCK = _threading.Lock()
+
+
+def _dispatcher():
+    global _DISPATCH
+    with _DISPATCH_LOCK:
+        # Locked creation: a first-call race from two user threads must not
+        # spawn two executors — a second queue would run collectives out of
+        # program order and trip the cross-process divergence check.
+        if _DISPATCH is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _DISPATCH = ThreadPoolExecutor(
+                1, thread_name_prefix="hvd_tpu_torch_dispatch")
+    return _DISPATCH
+
+
+def _submit(fn):
+    return _dispatcher().submit(fn)
+
+
+def _run_sync(fn):
+    return _submit(fn).result()
+
+
 def _to_jax_stacked(t):
     """torch tensor -> per-rank stacked array (shared bridge convention)."""
     from horovod_tpu.frontend_bridge import to_stacked
@@ -68,11 +105,11 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
               compression=Compression.none, prescale_factor: float = 1.0,
               postscale_factor: float = 1.0, process_set=None):
     """``hvd.torch.allreduce``: returns a new reduced tensor."""
-    out = _hvd.allreduce(_to_jax_stacked(tensor), op=op,
-                         compression=compression,
-                         prescale_factor=prescale_factor,
-                         postscale_factor=postscale_factor,
-                         process_set=process_set)
+    stacked = _to_jax_stacked(tensor)
+    out = _run_sync(lambda: _hvd.allreduce(
+        stacked, op=op, compression=compression,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
     return _from_stacked(out, tensor)
 
 
@@ -87,31 +124,199 @@ def grouped_allreduce(tensors: Iterable, op: int = Average, **kwargs):
     """Fused: one collective for the whole list (rides the fusion buffer,
     unlike a per-tensor loop)."""
     tensors = list(tensors)
-    outs = _hvd.grouped_allreduce(
-        [_to_jax_stacked(t) for t in tensors], op=op, **kwargs)
+    stacked = [_to_jax_stacked(t) for t in tensors]
+    outs = _run_sync(lambda: _hvd.grouped_allreduce(stacked, op=op,
+                                                    **kwargs))
     return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
-    out = _hvd.allgather(_to_jax_stacked(tensor), process_set=process_set)
+    stacked = _to_jax_stacked(tensor)
+    out = _run_sync(lambda: _hvd.allgather(stacked,
+                                           process_set=process_set))
     return _from_stacked(out, tensor)
 
 
 def alltoall(tensor, name: Optional[str] = None, process_set=None):
-    out = _hvd.alltoall(_to_jax_stacked(tensor), process_set=process_set)
+    stacked = _to_jax_stacked(tensor)
+    out = _run_sync(lambda: _hvd.alltoall(stacked, process_set=process_set))
     return _from_stacked(out, tensor)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set=None):
-    out = _hvd.broadcast(_to_jax_stacked(tensor), root_rank,
-                         process_set=process_set)
+    stacked = _to_jax_stacked(tensor)
+    out = _run_sync(lambda: _hvd.broadcast(stacked, root_rank,
+                                           process_set=process_set))
     return _from_stacked(out, tensor)
 
 
 def broadcast_(tensor, root_rank: int, **kwargs):
     tensor.copy_(broadcast(tensor, root_rank, **kwargs))
     return tensor
+
+
+def reducescatter(tensor, op: int = Average, name: Optional[str] = None,
+                  process_set=None):
+    """``hvd.torch.reducescatter``: reduce then keep this rank's dim-0 chunk
+    (upstream ``horovod/torch/mpi_ops.py:reducescatter``)."""
+    stacked = _to_jax_stacked(tensor)
+    out = _run_sync(lambda: _hvd.reducescatter(stacked, op=op,
+                                               process_set=process_set))
+    return _from_stacked(out, tensor)
+
+
+# ---------------------------------------------------------------------------
+# async handle API (upstream horovod/torch/mpi_ops.py *_async + synchronize)
+# ---------------------------------------------------------------------------
+
+class _AsyncHandle:
+    """An in-flight collective (upstream's integer handle into its op table).
+
+    The dispatch thread performs the ordered negotiation + jax enqueue; jax
+    dispatch is itself asynchronous, so by the time the future resolves the
+    device work is merely *launched*. ``poll`` is true once both have
+    finished; ``synchronize`` blocks and materialises the torch result
+    (copying into the original tensor for the in-place ``*_async_``
+    variants). A negotiation divergence raises at ``synchronize``, like
+    upstream's error surfacing on the handle wait.
+    """
+
+    __slots__ = ("_fut", "_like", "_target", "_grouped", "_result", "_done")
+
+    def __init__(self, fut, like, target=None, grouped=False):
+        self._fut = fut            # future resolving to the stacked out
+        self._like = like          # torch tensor(s) giving dtype back
+        self._target = target      # in-place destination(s) or None
+        self._grouped = grouped
+        self._result = None
+        self._done = False
+
+    def poll(self) -> bool:
+        if self._done:
+            return True
+        if not self._fut.done():
+            return False
+        if self._fut.exception() is not None:
+            return True            # completed with error; raises on sync
+        return _hvd.poll(self._fut.result())
+
+    def synchronize(self):
+        if self._done:
+            return self._result
+        out = self._fut.result()
+        if self._grouped:
+            outs = [_from_stacked(o, t) for o, t in zip(out, self._like)]
+            if self._target is not None:
+                for dst, src in zip(self._target, outs):
+                    dst.copy_(src)
+                outs = list(self._target)
+            self._result = outs
+        else:
+            res = _from_stacked(out, self._like)
+            if self._target is not None:
+                self._target.copy_(res)
+                res = self._target
+            self._result = res
+        self._done = True
+        self._fut = self._like = None   # release device/host references
+        return self._result
+
+
+def synchronize(handle):
+    """Block until an async collective completes and return its torch result
+    (``hvd.synchronize(handle)``)."""
+    return handle.synchronize()
+
+
+def poll(handle) -> bool:
+    """True once an async collective's device work has finished
+    (``hvd.poll(handle)``)."""
+    return handle.poll()
+
+
+def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
+                    compression=Compression.none,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0, process_set=None):
+    """``hvd.allreduce_async``: enqueue on the dispatch thread (negotiation
+    included — the caller is never blocked on peers), return a handle."""
+    stacked = _to_jax_stacked(tensor)
+    fut = _submit(lambda: _hvd.allreduce(
+        stacked, op=op, compression=compression,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+    return _AsyncHandle(fut, tensor)
+
+
+def allreduce_async_(tensor, **kwargs):
+    """In-place async allreduce: ``synchronize`` writes back into ``tensor``
+    and returns it (``hvd.allreduce_async_``)."""
+    h = allreduce_async(tensor, **kwargs)
+    h._target = tensor
+    return h
+
+
+def grouped_allreduce_async(tensors: Iterable, op: int = Average, **kwargs):
+    """One fused async collective for the whole list; ``synchronize`` returns
+    the list of reduced tensors (``hvd.grouped_allreduce_async``)."""
+    tensors = list(tensors)
+    stacked = [_to_jax_stacked(t) for t in tensors]
+    fut = _submit(lambda: _hvd.grouped_allreduce(stacked, op=op, **kwargs))
+    return _AsyncHandle(fut, tensors, grouped=True)
+
+
+def allgather_async(tensor, name: Optional[str] = None, process_set=None):
+    stacked = _to_jax_stacked(tensor)
+    fut = _submit(lambda: _hvd.allgather(stacked, process_set=process_set))
+    return _AsyncHandle(fut, tensor)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set=None):
+    stacked = _to_jax_stacked(tensor)
+    fut = _submit(lambda: _hvd.broadcast(stacked, root_rank,
+                                         process_set=process_set))
+    return _AsyncHandle(fut, tensor)
+
+
+def broadcast_async_(tensor, root_rank: int, **kwargs):
+    h = broadcast_async(tensor, root_rank, **kwargs)
+    h._target = tensor
+    return h
+
+
+def alltoall_async(tensor, name: Optional[str] = None, process_set=None):
+    stacked = _to_jax_stacked(tensor)
+    fut = _submit(lambda: _hvd.alltoall(stacked, process_set=process_set))
+    return _AsyncHandle(fut, tensor)
+
+
+def reducescatter_async(tensor, op: int = Average,
+                        name: Optional[str] = None, process_set=None):
+    stacked = _to_jax_stacked(tensor)
+    fut = _submit(lambda: _hvd.reducescatter(stacked, op=op,
+                                             process_set=process_set))
+    return _AsyncHandle(fut, tensor)
+
+
+def join() -> int:
+    """End-of-data election (``hvd.torch.join``); see
+    :func:`horovod_tpu.join`. Routed through the dispatch thread so it
+    cannot overtake an in-flight async collective's negotiation."""
+    return _run_sync(_hvd.join)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """``hvd.torch.broadcast_object`` (host-side pickle framing; ordered
+    behind any in-flight async collectives)."""
+    return _run_sync(lambda: _hvd.broadcast_object(obj,
+                                                   root_rank=root_rank))
+
+
+def allgather_object(obj, name: Optional[str] = None) -> list:
+    """``hvd.torch.allgather_object`` (ordered behind in-flight asyncs)."""
+    return _run_sync(lambda: _hvd.allgather_object(obj))
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
@@ -149,21 +354,63 @@ class _DistributedOptimizer:
         self._op = op
         self._predivide = gradient_predivide_factor
         self._process_set = process_set
+        # HOROVOD_AUTOTUNE=1: online fusion-threshold tuning from observed
+        # inter-step times (the reference's Bayesian autotuner, simplified
+        # to the candidate ladder in autotune.Autotuner).
+        from horovod_tpu.config import get_config
+        self._autotuner = None
+        self._last_step_t = None
+        self._autotune_synced = False
+        if get_config().autotune:
+            from horovod_tpu.autotune import Autotuner
+            self._autotuner = Autotuner()
 
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_opt"), name)
 
     def synchronize(self) -> None:
-        """Allreduce all gradients now (upstream ``synchronize``)."""
-        for group in self._opt.param_groups:
-            for p in group["params"]:
-                if p.grad is not None:
-                    allreduce_(p.grad,
-                               op=self._op,
-                               compression=self._compression,
-                               prescale_factor=1.0 / self._predivide,
-                               postscale_factor=self._predivide,
-                               process_set=self._process_set)
+        """Allreduce all gradients now (upstream ``synchronize``): one fused
+        async collective over every grad (the fusion buffer packs them), then
+        block and write back — the grouped analogue of upstream's per-grad
+        hook enqueue + handle wait."""
+        grads = [p.grad for group in self._opt.param_groups
+                 for p in group["params"] if p.grad is not None]
+        if not grads:
+            return
+        kwargs = {}
+        if self._autotuner is not None:
+            import time
+            now = time.perf_counter()
+            if self._last_step_t is not None:
+                self._autotuner.record(now - self._last_step_t)
+            self._last_step_t = now
+            if self._autotuner.converged and not self._autotune_synced:
+                # Convergence lands at the same step count on every
+                # process (one record per synchronize), but each argmin is
+                # over *local* timings — agree on rank 0's pick, otherwise
+                # the thresholds (part of the negotiation signature) would
+                # diverge and every later collective would raise.
+                best = int(broadcast_object(
+                    int(self._autotuner.current_threshold()), root_rank=0))
+                self._autotuner._best = best
+                self._autotune_synced = True
+                from horovod_tpu.config import get_config
+                log = get_config().autotune_log
+                if log and rank() == 0:
+                    import json
+                    with open(log, "a") as f:
+                        f.write(json.dumps(
+                            {"converged_fusion_threshold_bytes": best}) +
+                            "\n")
+            kwargs["fusion_threshold_bytes"] = \
+                self._autotuner.current_threshold()
+        h = grouped_allreduce_async(
+            grads, op=self._op, compression=self._compression,
+            prescale_factor=1.0 / self._predivide,
+            postscale_factor=self._predivide,
+            process_set=self._process_set, **kwargs)
+        h._target = grads
+        h.synchronize()
 
     def step(self, closure=None):
         self.synchronize()

@@ -22,8 +22,13 @@ __all__ = ["SyncBatchNorm"]
 
 
 def _allreduce_sum_np(vec: torch.Tensor) -> torch.Tensor:
-    """Sum-allreduce a small fp32 stats vector through the shared engine."""
-    out = _hvd.allreduce(to_stacked(vec.detach().cpu().numpy()), op=Sum)
+    """Sum-allreduce a small fp32 stats vector through the shared engine,
+    on the torch frontend's dispatch thread — a caller-thread collective
+    racing an in-flight ``*_async`` negotiation would reorder the op
+    sequence across processes and trip the divergence check."""
+    from horovod_tpu.torch import _run_sync
+    stacked = to_stacked(vec.detach().cpu().numpy())
+    out = _run_sync(lambda: _hvd.allreduce(stacked, op=Sum))
     return torch.from_numpy(from_stacked(out)).to(vec.dtype)
 
 

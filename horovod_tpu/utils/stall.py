@@ -24,9 +24,14 @@ class HealthWatchdog:
     """Call ``beat()`` every step; if no beat arrives within ``timeout_s``
     the ``on_stall(seconds_since_beat)`` callback fires (once per stall)."""
 
-    def __init__(self, timeout_s: float = 60.0,
+    def __init__(self, timeout_s: Optional[float] = None,
                  on_stall: Optional[Callable[[float], None]] = None,
                  poll_s: float = 1.0):
+        if timeout_s is None:
+            # HOROVOD_STALL_CHECK_TIME_SECONDS (upstream stall_inspector.cc
+            # warning threshold), 60s default.
+            from horovod_tpu.config import get_config
+            timeout_s = get_config().stall_check_time_seconds
         self.timeout_s = timeout_s
         self._on_stall = on_stall or (lambda dt: logger.warning(
             "horovod_tpu: no training progress for %.1fs — one or more "
@@ -39,6 +44,11 @@ class HealthWatchdog:
         self.stall_count = 0
 
     def start(self) -> "HealthWatchdog":
+        from horovod_tpu.config import get_config
+        if get_config().stall_check_disable:
+            # HOROVOD_STALL_CHECK_DISABLE=1 (upstream stall_inspector.cc
+            # gate): no watchdog thread, beats become no-ops.
+            return self
         self._last = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)

@@ -1,0 +1,119 @@
+"""HOROVOD_* environment knob surface (upstream env_parser.cc parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import config as hconfig
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    yield monkeypatch
+    # Re-read with the monkeypatched vars gone so later tests see defaults.
+    hconfig.refresh()
+
+
+class TestConfig:
+    def test_defaults(self, clean_env):
+        for k in ("HOROVOD_FUSION_THRESHOLD", "HOROVOD_TIMELINE"):
+            clean_env.delenv(k, raising=False)
+        cfg = hconfig.refresh()
+        assert cfg.fusion_threshold_bytes == 64 * 1024 * 1024
+        assert cfg.timeline_path is None
+        assert cfg.stall_check_time_seconds == 60.0
+
+    def test_fusion_threshold_env(self, clean_env):
+        clean_env.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+        cfg = hconfig.refresh()
+        assert cfg.fusion_threshold_bytes == 1 << 20
+        # and the default-path allreduce still computes correctly under it
+        out = hvd.allreduce(np.ones((hvd.size(), 4), np.float32), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((hvd.size(), 4), hvd.size()))
+
+    def test_stall_check_env(self, clean_env):
+        from horovod_tpu.utils.stall import HealthWatchdog
+        clean_env.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "7.5")
+        hconfig.refresh()
+        assert HealthWatchdog().timeout_s == 7.5
+
+    def test_inert_vars_surface_in_build_info(self, clean_env):
+        clean_env.setenv("HOROVOD_CYCLE_TIME", "5")
+        hconfig.refresh()
+        info = hvd.build_info()
+        assert "HOROVOD_CYCLE_TIME" in info["inert_env"]
+
+    def test_timeline_env_autostarts_on_init(self, clean_env, tmp_path):
+        from horovod_tpu import timeline as tl
+        path = str(tmp_path / "tl.json")
+        clean_env.setenv("HOROVOD_TIMELINE", path)
+        hvd.init()                     # reentrant; re-reads config
+        try:
+            assert tl.get_timeline() is not None
+            hvd.allreduce(np.ones((hvd.size(), 2), np.float32))
+        finally:
+            tl.stop_timeline()
+            clean_env.delenv("HOROVOD_TIMELINE")
+            hconfig.refresh()
+        assert os.path.exists(path)
+
+    def test_timeline_flushed_by_shutdown(self, clean_env, tmp_path):
+        import json
+        from horovod_tpu import timeline as tl
+        path = tmp_path / "tl2.json"
+        clean_env.setenv("HOROVOD_TIMELINE", str(path))
+        hvd.init()
+        hvd.allreduce(np.ones((hvd.size(), 2), np.float32))
+        clean_env.delenv("HOROVOD_TIMELINE")
+        hvd.shutdown()                 # must finalize the trace
+        assert tl.get_timeline() is None
+        data = json.loads(path.read_text())   # valid, closed JSON
+        assert data["traceEvents"] or data is not None
+        hconfig.refresh()
+        hvd.init()
+
+    def test_autotune_env_drives_torch_optimizer(self, clean_env, tmp_path):
+        torch = pytest.importorskip("torch")
+        import horovod_tpu.torch as hvt
+        log = tmp_path / "autotune.jsonl"
+        clean_env.setenv("HOROVOD_AUTOTUNE", "1")
+        clean_env.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+        hconfig.refresh()
+        model = torch.nn.Linear(4, 1)
+        opt = hvt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1))
+        assert opt._autotuner is not None
+        # Shrink the ladder so convergence happens in-test; the converged
+        # threshold is then broadcast-synced (rank 0's pick) and logged.
+        from horovod_tpu.autotune import Autotuner
+        opt._autotuner = Autotuner(candidates_bytes=[1 << 20, 4 << 20],
+                                   samples_per_candidate=2)
+        for _ in range(7):
+            opt.zero_grad()
+            model(torch.ones(2, 4)).sum().backward()
+            opt.step()
+        assert opt._autotuner.converged
+        assert opt._autotune_synced
+        assert opt._autotuner.current_threshold() in (1 << 20, 4 << 20)
+        import json
+        rec = json.loads(log.read_text().splitlines()[0])
+        assert rec["converged_fusion_threshold_bytes"] == \
+            opt._autotuner.current_threshold()
+
+    def test_stall_check_disable(self, clean_env):
+        from horovod_tpu.utils.stall import HealthWatchdog
+        clean_env.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+        hconfig.refresh()
+        w = HealthWatchdog(timeout_s=0.01).start()
+        assert w._thread is None     # no watchdog thread spawned
+        w.stop()
+
+    def test_log_level_env(self, clean_env):
+        import logging
+        clean_env.setenv("HOROVOD_LOG_LEVEL", "debug")
+        hconfig.refresh()
+        assert logging.getLogger("horovod_tpu").level == logging.DEBUG
+        clean_env.setenv("HOROVOD_LOG_LEVEL", "warning")

@@ -46,6 +46,15 @@ class TestExamples:
         out = _run("tensorflow2_mnist.py", "--steps", "60", timeout=600)
         assert "loss" in out
 
+    def test_tensorflow2_keras_mnist(self):
+        out = _run("tensorflow2_keras_mnist.py", "--epochs", "2",
+                   timeout=600)
+        assert "OK" in out
+
+    def test_pytorch_lightning_mnist(self):
+        out = _run("pytorch_lightning_mnist.py", "--epochs", "3")
+        assert "OK" in out
+
     def test_estimator_cluster(self):
         out = _run("estimator_cluster.py", "--workers", "2", "--epochs", "3",
                    devices=2, timeout=600)

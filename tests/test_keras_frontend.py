@@ -1,0 +1,144 @@
+"""Keras integration tests (upstream ``test/parallel/test_keras.py``
+coverage on the single-process bridge). Gated on tensorflow."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.keras as hvd_keras_alias  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd_keras  # noqa: E402
+
+
+def _model():
+    m = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Dense(1),
+    ])
+    return m
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    return x, y
+
+
+class TestDistributedOptimizer:
+    def test_fit_converges(self):
+        m = _model()
+        opt = hvd_keras.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        m.compile(optimizer=opt, loss="mse")
+        x, y = _data()
+        hist = m.fit(x, y, epochs=8, batch_size=32, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_apply_gradients_custom_loop(self):
+        m = _model()
+        opt = hvd_keras.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        x, y = _data(32)
+        m.build((None, 4))
+        with tf.GradientTape() as tape:
+            loss0 = tf.reduce_mean((m(x) - y) ** 2)
+        grads = tape.gradient(loss0, m.trainable_variables)
+        opt.apply_gradients(zip(grads, m.trainable_variables))
+        with tf.GradientTape() as tape:
+            loss1 = tf.reduce_mean((m(x) - y) ** 2)
+        assert float(loss1) < float(loss0)
+
+    def test_wrapped_class_name_and_config(self):
+        opt = hvd_keras.DistributedOptimizer(tf.keras.optimizers.Adam(1e-3))
+        assert type(opt).__name__ == "Adam"
+        assert "learning_rate" in opt.get_config()
+
+    def test_alias_module(self):
+        assert hvd_keras_alias.DistributedOptimizer \
+            is hvd_keras.DistributedOptimizer
+
+
+class TestCallbacks:
+    def test_broadcast_callback_runs_and_syncs(self):
+        m = _model()
+        opt = hvd_keras.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+        m.compile(optimizer=opt, loss="mse")
+        x, y = _data(32)
+        cb = hvd_keras.BroadcastGlobalVariablesCallback(root_rank=0)
+        m.fit(x, y, epochs=1, batch_size=16, verbose=0, callbacks=[cb])
+        assert cb.broadcast_done
+
+    def test_metric_average_callback(self):
+        cb = hvd_keras.MetricAverageCallback()
+        logs = {"loss": 2.0, "acc": 0.5, "other": "skip"}
+        cb.on_epoch_end(0, logs)
+        # single controller: every simulated rank holds the same value
+        assert logs["loss"] == pytest.approx(2.0, rel=1e-5)
+        assert logs["acc"] == pytest.approx(0.5, rel=1e-5)
+        assert logs["other"] == "skip"
+
+    def test_warmup_callback_ramps_to_target(self):
+        import horovod_tpu as hvd
+        m = _model()
+        m.compile(optimizer=tf.keras.optimizers.SGD(0.0), loss="mse")
+        cb = hvd_keras.LearningRateWarmupCallback(
+            initial_lr=0.8, warmup_epochs=2, steps_per_epoch=4)
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        cb.on_train_batch_begin(0)
+        first = float(m.optimizer.learning_rate.numpy())
+        assert first == pytest.approx(0.8 / hvd.size(), rel=1e-5)
+        cb.on_epoch_begin(2)
+        cb.on_train_batch_begin(0)
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(0.8, rel=1e-5)
+
+    def test_warmup_zero_epochs_is_noop(self):
+        m = _model()
+        m.compile(optimizer=tf.keras.optimizers.SGD(0.3), loss="mse")
+        cb = hvd_keras.LearningRateWarmupCallback(
+            initial_lr=0.8, warmup_epochs=0, steps_per_epoch=4)
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        cb.on_train_batch_begin(0)
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(0.3, rel=1e-6)     # untouched
+
+    def test_warmup_unknown_steps_epoch_granularity(self):
+        import horovod_tpu as hvd
+        m = _model()
+        m.compile(optimizer=tf.keras.optimizers.SGD(0.0), loss="mse")
+        cb = hvd_keras.LearningRateWarmupCallback(
+            initial_lr=0.8, warmup_epochs=4)
+        cb.set_model(m)
+        cb.set_params({})                    # keras reports no steps
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        for b in range(3):
+            cb.on_train_batch_begin(b)
+        # must NOT collapse the ramp to warmup_epochs *batches*
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(0.8 / hvd.size(), rel=1e-5)
+        cb.on_epoch_end(0)                   # learns 3 steps/epoch
+        cb.on_epoch_begin(2)
+        cb.on_train_batch_begin(1)
+        want = 0.8 * (1 / hvd.size() +
+                      min(1.0, (2 + 1 / 3) / 4) * (1 - 1 / hvd.size()))
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(want, rel=1e-5)
+
+    def test_schedule_callback_staircase(self):
+        m = _model()
+        m.compile(optimizer=tf.keras.optimizers.SGD(1.0), loss="mse")
+        cb = hvd_keras.LearningRateScheduleCallback(
+            initial_lr=1.0, multiplier=lambda e: 0.1 ** (e // 2),
+            start_epoch=0, steps_per_epoch=1)
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(1.0)
+        cb.on_epoch_begin(3)
+        assert float(m.optimizer.learning_rate.numpy()) == \
+            pytest.approx(0.1, rel=1e-5)

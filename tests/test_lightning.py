@@ -1,0 +1,103 @@
+"""Lightning strategy tests: the Strategy protocol + bundled Trainer loop
+(upstream Lightning ``HorovodStrategy`` semantics, no PL dependency)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.lightning import HorovodStrategy, Trainer  # noqa: E402
+
+
+class BoringModule(torch.nn.Module):
+    """LightningModule-shaped: training_step + configure_optimizers."""
+
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+        self.epochs_seen = 0
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=0.05)
+
+    def on_epoch_end(self, trainer):
+        self.epochs_seen += 1
+
+
+def _loader(n=64, bs=16):
+    rng = np.random.default_rng(1)
+    x = torch.from_numpy(rng.normal(size=(n, 4)).astype(np.float32))
+    y = x.sum(dim=1, keepdim=True)
+    return [(x[i:i + bs], y[i:i + bs]) for i in range(0, n, bs)]
+
+
+class TestStrategy:
+    def test_identity(self):
+        s = HorovodStrategy()
+        assert s.world_size == hvd.size()
+        assert s.global_rank == hvd.rank()
+        assert s.is_global_zero == (hvd.rank() == 0)
+        assert s.root_device.type == "cpu"
+
+    def test_reduce_scalar_and_tensor(self):
+        s = HorovodStrategy()
+        out = s.reduce(3.0, reduce_op="mean")
+        assert float(out) == pytest.approx(3.0, rel=1e-6)
+        out = s.reduce(torch.ones(4), reduce_op="sum")
+        assert torch.allclose(out, torch.full((4,), float(s.world_size)))
+
+    def test_all_gather_stacks_world(self):
+        s = HorovodStrategy()
+        out = s.all_gather(torch.tensor([1.0, 2.0]))
+        assert out.shape == (s.world_size, 2)
+        assert torch.allclose(out[0], torch.tensor([1.0, 2.0]))
+
+    def test_broadcast_object(self):
+        s = HorovodStrategy()
+        assert s.broadcast({"a": 1}, src=0) == {"a": 1}
+
+    def test_setup_wraps_optimizers(self):
+        s = HorovodStrategy()
+        m = BoringModule()
+        opts = s.setup(m)
+        assert len(opts) == 1
+        assert hasattr(opts[0], "synchronize")   # DistributedOptimizer
+
+    def test_reduce_op_none_is_identity(self):
+        s = HorovodStrategy()
+        t = torch.tensor([1.0, 2.0])
+        assert s.reduce(t, reduce_op=None) is t
+
+    def test_configure_optimizers_forms(self):
+        s = HorovodStrategy()
+        m = BoringModule()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        sched = torch.optim.lr_scheduler.StepLR(opt, 1)
+        unpack = s._unpack_optimizers
+        assert unpack(opt) == [opt]
+        assert unpack([opt]) == [opt]
+        assert unpack({"optimizer": opt, "lr_scheduler": sched}) == [opt]
+        assert unpack(([opt], [sched])) == [opt]
+        assert unpack(None) == []
+        with pytest.raises(ValueError):
+            unpack({"lr_scheduler": sched})
+        with pytest.raises(TypeError):
+            unpack([sched])
+
+
+class TestTrainer:
+    def test_fit_converges_and_hooks_fire(self):
+        m = BoringModule()
+        tr = Trainer(max_epochs=6).fit(m, _loader())
+        assert len(tr.history) == 6
+        assert tr.history[-1] < tr.history[0]
+        assert m.epochs_seen == 6

@@ -43,6 +43,22 @@ _WORKER = textwrap.dedent("""
         assert torch.allclose(b, torch.full((3,), 1.0)), b
         g = hvt.allgather(torch.full((2, 2), float(pid)))
         assert g.shape == (4, 2) and g[0, 0] == 0.0 and g[3, 0] == 1.0, g
+        # Ops whose outputs DIFFER per rank — a fixed row-0 readout would
+        # hand every process rank 0's result (caught in r2 review).
+        rs = hvt.reducescatter(torch.arange(4.) + 10 * pid, op=hvt.Sum)
+        exp = torch.tensor([10., 12.]) if pid == 0 \
+            else torch.tensor([14., 16.])
+        assert torch.allclose(rs, exp), (pid, rs)
+        a2a = hvt.alltoall(torch.arange(4.) + 10 * pid)
+        expa = torch.tensor([0., 1., 10., 11.]) if pid == 0 \
+            else torch.tensor([2., 3., 12., 13.])
+        assert torch.allclose(a2a, expa), (pid, a2a)
+        # Async handle across processes: negotiation rides the dispatch
+        # thread; synchronize resolves to the averaged value.
+        h = hvt.allreduce_async(torch.full((2,), float(pid)))
+        assert torch.allclose(hvt.synchronize(h),
+                              torch.full((2,), 0.5)), pid
+        assert hvt.poll(h)
         print(f"proc {{pid}} TORCH-OK", flush=True)
     elif mode == "stall":
         # End-to-end stall inspection: rank 1 delays its collective; rank

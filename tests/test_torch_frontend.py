@@ -43,6 +43,69 @@ class TestTorchCollectives:
         assert torch.allclose(out, t, atol=1e-2)
 
 
+class TestTorchAsync:
+    """Handle-based async API (upstream ``test_torch.py`` *_async tests)."""
+
+    def test_allreduce_async_matches_sync(self):
+        t = torch.randn(4, 3)
+        h = hvd_torch.allreduce_async(t, op=hvd_torch.Sum)
+        out = hvd_torch.synchronize(h)
+        assert torch.allclose(out, hvd_torch.allreduce(t, op=hvd_torch.Sum),
+                              atol=1e-6)
+
+    def test_poll_becomes_true_and_synchronize_idempotent(self):
+        t = torch.randn(8)
+        h = hvd_torch.allreduce_async(t)
+        first = hvd_torch.synchronize(h)
+        assert hvd_torch.poll(h)           # done after synchronize
+        assert hvd_torch.synchronize(h) is first
+
+    def test_allreduce_async_inplace_writes_back(self):
+        t = torch.ones(3)
+        h = hvd_torch.allreduce_async_(t, op=hvd_torch.Sum)
+        ret = hvd_torch.synchronize(h)
+        assert ret is t
+        assert torch.allclose(t, torch.full((3,), float(hvd_torch.size())))
+
+    def test_grouped_allreduce_async(self):
+        ts = [torch.randn(3), torch.randn(2, 2)]
+        h = hvd_torch.grouped_allreduce_async(ts, op=hvd_torch.Average)
+        outs = hvd_torch.synchronize(h)
+        assert len(outs) == 2
+        for o, t in zip(outs, ts):
+            assert torch.allclose(o, t, atol=1e-6)  # avg of identical copies
+
+    def test_broadcast_async_inplace(self):
+        t = torch.randn(5)
+        want = t.clone()
+        h = hvd_torch.broadcast_async_(t, root_rank=0)
+        assert hvd_torch.synchronize(h) is t
+        assert torch.allclose(t, want, atol=1e-6)
+
+    def test_allgather_async_shape(self):
+        t = torch.ones(2, 3)
+        out = hvd_torch.synchronize(hvd_torch.allgather_async(t))
+        assert out.shape == (2 * hvd_torch.size(), 3)
+
+    def test_many_outstanding_handles_resolve_in_any_order(self):
+        ts = [torch.full((4,), float(i)) for i in range(6)]
+        hs = [hvd_torch.allreduce_async(t, op=hvd_torch.Sum) for t in ts]
+        for i in reversed(range(6)):
+            out = hvd_torch.synchronize(hs[i])
+            assert torch.allclose(
+                out, torch.full((4,), float(i * hvd_torch.size())))
+
+    def test_reducescatter_sync_and_async(self):
+        n = hvd_torch.size()
+        t = torch.ones(2 * n, 3)
+        out = hvd_torch.reducescatter(t, op=hvd_torch.Sum)
+        assert out.shape == (2, 3)
+        assert torch.allclose(out, torch.full((2, 3), float(n)))
+        out2 = hvd_torch.synchronize(
+            hvd_torch.reducescatter_async(t, op=hvd_torch.Sum))
+        assert torch.allclose(out2, out)
+
+
 class TestTorchOptimizer:
     def _train(self, steps=5):
         model = torch.nn.Sequential(
