@@ -64,13 +64,19 @@ def autotune_fusion_threshold(
 def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
                           candidates: Optional[List[tuple]] = None,
                           steps_per_trial: int = 5,
-                          include_backward: bool = True):
+                          include_backward: bool = True,
+                          chain: int = 8):
     """Measure flash-attention (block_q, block_k) tilings on this device.
 
     The best tiles depend on head_dim, sequence length and VMEM pressure
-    from the backward kernels (e.g. 512x512 Q-blocks spill on v5e while
-    256x512 is fastest). Returns ``((block_q, block_k), trials_dict)`` where
-    ``trials_dict`` maps each candidate to measured seconds/step.
+    from the backward kernels. Returns ``((block_q, block_k), trials_dict)``
+    where ``trials_dict`` maps each candidate to measured seconds per
+    attention invocation (fwd+bwd when ``include_backward``).
+
+    ``chain`` kernel invocations are scanned inside ONE jit (each step's
+    output feeds the next step's queries), so a single dispatch carries
+    ``chain``x the device work — per-dispatch host latency (large over a
+    remote PJRT transport) is amortized out of the per-kernel number.
 
     Args:
       q_shape: (batch, seq, heads, head_dim) to tune for.
@@ -78,10 +84,15 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
       causal: tune the causal or full-attention variant.
       candidates: (block_q, block_k) pairs; defaults to a v5e-shaped grid.
       include_backward: time fwd+bwd (the training shape) vs fwd only.
+      chain: attention invocations chained per dispatch. Compile time per
+        candidate grows with ``chain`` (the backward scan differentiates
+        every link); over a remote PJRT transport where kernel compiles
+        are shipped, prefer ``chain=2``/``include_backward=False`` probes.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax import lax
 
     from horovod_tpu.ops.flash_attention import flash_attention
 
@@ -95,26 +106,40 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
     trials: Dict[tuple, float] = {}
     last_error: Optional[Exception] = None
     for bq, bk in candidates:
+        def chained(q, k, v, bq=bq, bk=bk):
+            def body(c, _):
+                o = flash_attention(c, k, v, causal=causal, block_q=bq,
+                                    block_k=bk)
+                return o.astype(c.dtype), None
+            out, _ = lax.scan(body, q, None, length=chain)
+            return out
+
         if include_backward:
             fn = jax.jit(jax.grad(
                 lambda q, k, v, bq=bq, bk=bk: jnp.sum(
-                    flash_attention(q, k, v, causal=causal, block_q=bq,
-                                    block_k=bk).astype(jnp.float32) ** 2),
+                    chained(q, k, v, bq, bk).astype(jnp.float32) ** 2),
                 argnums=(0, 1, 2)))
         else:
-            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                q, k, v, causal=causal, block_q=bq, block_k=bk))
+            fn = jax.jit(chained)
+        def _sync(out):
+            # Host fetch: block_until_ready is unreliable over some PJRT
+            # transports (see ROOFLINE.md); fetching one element of the
+            # last result bounds the serialized device queue.
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            np.asarray(jax.device_get(leaf)).ravel()[:1]
+
         try:
             out = fn(q, k, v)
-            jax.block_until_ready(out)
+            _sync(out)
         except Exception as e:  # tiling not compilable for this shape
             last_error = e
             continue
         t0 = time.perf_counter()
         for _ in range(steps_per_trial):
             out = fn(q, k, v)
-        jax.block_until_ready(out)
-        trials[(bq, bk)] = (time.perf_counter() - t0) / steps_per_trial
+        _sync(out)
+        trials[(bq, bk)] = (time.perf_counter() - t0) / steps_per_trial \
+            / max(chain, 1)
     if not trials:
         raise RuntimeError(
             f"no flash tiling compiled for shape {q_shape}") from last_error

@@ -57,9 +57,9 @@ class Config:
     stall_check_time_seconds: float = 60.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
-    # Adasum hierarchy: HOROVOD_HIERARCHICAL_ALLREDUCE (read where used,
-    # mirrored here for build_info).
-    hierarchical_allreduce: bool = False
+    # NOTE: HOROVOD_HIERARCHICAL_ALLREDUCE is deliberately NOT mirrored
+    # here — collective.py/adasum.py read it at call time so tests and
+    # scripts can toggle it between collectives without a refresh().
     # Logging: HOROVOD_LOG_LEVEL (trace/debug/info/warning/error/fatal).
     log_level: str = "warning"
     # Accepted-but-inert on TPU, with the reason.
@@ -94,7 +94,6 @@ def refresh() -> Config:
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
-        hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
         log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
         inert={k: reason for k, reason in _INERT_VARS.items()
                if os.environ.get(k)},

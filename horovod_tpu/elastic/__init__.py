@@ -14,7 +14,9 @@ pytrees off-device; ``restore()`` puts them back on the (new) mesh.
 
 import os as _os
 
-from horovod_tpu.elastic.state import State, JaxState  # noqa: F401
+from horovod_tpu.elastic.state import (  # noqa: F401
+    JaxState, State, TensorFlowKerasState, TorchState,
+)
 
 
 def state_dir():

@@ -9,7 +9,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
-__all__ = ["State", "JaxState"]
+__all__ = ["State", "JaxState", "TorchState", "TensorFlowKerasState"]
 
 
 class State:
@@ -132,3 +132,160 @@ def _is_pytree_of_arrays(v: Any) -> bool:
     leaves = jax.tree_util.tree_leaves(v)
     return bool(leaves) and all(
         isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+
+class _AttrState(State):
+    """Shared plain-attribute bookkeeping (epoch/step counters) for the
+    framework states below — committed/restored/synced alongside the
+    framework objects, exposed as normal attributes (upstream
+    ``ObjectState``)."""
+
+    def __init__(self, **kwargs: Any):
+        self._attrs: Dict[str, Any] = dict(kwargs)
+        self._saved_attrs: Dict[str, Any] = {}
+
+    def save(self, path: str) -> None:
+        """Persist the last commit to disk (atomic write) — the
+        ``runner.run_elastic`` recovery contract (see ``JaxState.save``)."""
+        import os
+        import pickle
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"saved": self._saved,
+                         "attrs": self._saved_attrs,
+                         "commit_count": self.commit_count}, f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        """Load a saved commit (see :meth:`save`) and restore it."""
+        import pickle
+
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._saved = blob["saved"]
+        self._saved_attrs = blob["attrs"]
+        self.commit_count = blob["commit_count"]
+        self.restore()
+
+    def __getattr__(self, name):
+        attrs = object.__getattribute__(self, "_attrs")
+        if name in attrs:
+            return attrs[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name in ("model", "optimizer",
+                                            "commit_count"):
+            object.__setattr__(self, name, value)
+        elif "_attrs" in self.__dict__ and name in self._attrs:
+            self._attrs[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+
+class TorchState(_AttrState):
+    """Elastic state for torch training (upstream
+    ``horovod/torch/elastic/state.py:TorchState``): snapshots
+    ``model.state_dict()`` + ``optimizer.state_dict()`` host-side;
+    ``restore`` loads them back, ``sync`` broadcasts the committed
+    snapshot from rank 0 so restarted/joining workers agree."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.model = model
+        self.optimizer = optimizer
+        self._saved: Dict[str, Any] = {}
+        self.commit_count = 0
+        self.commit()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {}
+        if self.model is not None:
+            snap["model"] = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            snap["optimizer"] = copy.deepcopy(self.optimizer.state_dict())
+        return snap
+
+    def commit(self) -> None:
+        self._saved = self._snapshot()
+        self._saved_attrs = copy.deepcopy(self._attrs)
+        self.commit_count += 1
+
+    def restore(self) -> None:
+        if "model" in self._saved and self.model is not None:
+            self.model.load_state_dict(copy.deepcopy(self._saved["model"]))
+        if "optimizer" in self._saved and self.optimizer is not None:
+            self.optimizer.load_state_dict(
+                copy.deepcopy(self._saved["optimizer"]))
+        self._attrs = copy.deepcopy(self._saved_attrs)
+
+    def sync(self) -> None:
+        from horovod_tpu import collective as C
+        if jax.process_count() > 1:
+            self._saved = C.broadcast_object(self._saved, 0)
+            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+        self.restore()
+
+
+class TensorFlowKerasState(_AttrState):
+    """Elastic state for tf.keras training (upstream
+    ``horovod/tensorflow/elastic.py:TensorFlowKerasState``): snapshots
+    model weights + optimizer variables as numpy."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.model = model
+        self.optimizer = optimizer if optimizer is not None else \
+            getattr(model, "optimizer", None)
+        self._saved: Dict[str, Any] = {}
+        self.commit_count = 0
+        self.commit()
+
+    def _opt_vars(self):
+        opt = self.optimizer
+        return [v for v in (getattr(opt, "variables", None) or [])
+                if hasattr(v, "assign")] if opt is not None else []
+
+    @staticmethod
+    def _var_key(v) -> str:
+        return getattr(v, "path", None) or v.name
+
+    def commit(self) -> None:
+        snap: Dict[str, Any] = {}
+        if self.model is not None:
+            snap["weights"] = [np.asarray(w)
+                               for w in self.model.get_weights()]
+        snap["opt"] = {self._var_key(v): np.asarray(v)
+                       for v in self._opt_vars()}
+        self._saved = snap
+        self._saved_attrs = copy.deepcopy(self._attrs)
+        self.commit_count += 1
+
+    def restore(self) -> None:
+        if "weights" in self._saved and self.model is not None:
+            self.model.set_weights(self._saved["weights"])
+        saved = self._saved.get("opt", {})
+        lr_var = getattr(self.optimizer, "learning_rate", None) \
+            if self.optimizer is not None else None
+        for var in self._opt_vars():
+            key = self._var_key(var)
+            if key in saved:
+                var.assign(saved[key])
+            elif var is lr_var:
+                pass   # hyperparameter, not training state — keep it
+            else:
+                # Slot variables created AFTER the commit (keras builds
+                # them lazily on the first step): at commit time the
+                # optimizer state was effectively fresh, so reset to zero —
+                # keeping post-failure momenta/iteration counts would pair
+                # stale state with rolled-back weights.
+                var.assign(np.zeros(var.shape, np.asarray(var).dtype))
+        self._attrs = copy.deepcopy(self._saved_attrs)
+
+    def sync(self) -> None:
+        from horovod_tpu import collective as C
+        if jax.process_count() > 1:
+            self._saved = C.broadcast_object(self._saved, 0)
+            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+        self.restore()

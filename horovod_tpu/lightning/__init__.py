@@ -137,12 +137,14 @@ class HorovodStrategy:
         return out.reshape(self.world_size, *t.shape)
 
     def broadcast(self, obj, src: int = 0):
-        import horovod_tpu as hvd
-        return hvd.broadcast_object(obj, root_rank=src)
+        # Via the torch frontend so it is ordered behind any in-flight
+        # async collective's negotiation (single dispatch thread).
+        return self._hvt.broadcast_object(obj, root_rank=src)
 
     def barrier(self, name: Optional[str] = None) -> None:
         import horovod_tpu as hvd
-        hvd.barrier()
+        from horovod_tpu.torch import _run_sync
+        _run_sync(hvd.barrier)
 
     def teardown(self) -> None:
         pass

@@ -9,6 +9,7 @@ three), optional remat per layer.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import flax.linen as nn
 import jax
@@ -28,6 +29,8 @@ class BertConfig:
     # "dense" | "flash" (fused pallas kernel; the key-padding mask rides the
     # kernel's key_bias input).
     attention: str = "dense"
+    # Optional (block_q, block_k) flash tiling override (autotuned).
+    flash_blocks: Optional[tuple] = None
 
     @staticmethod
     def large() -> "BertConfig":
@@ -54,8 +57,9 @@ class EncoderLayer(nn.Module):
         v = v.reshape(B, T, H, D // H)
         from horovod_tpu.ops.attention import multihead_attention
         att = multihead_attention(q, k, v, impl=cfg.attention, causal=False,
-                                  key_mask=mask,
-                                  out_dtype=cfg.dtype).reshape(B, T, D)
+                                  key_mask=mask, out_dtype=cfg.dtype,
+                                  flash_blocks=cfg.flash_blocks
+                                  ).reshape(B, T, D)
         att = nn.Dense(D, dtype=cfg.dtype, name="out")(att)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + att)
         h = nn.Dense(4 * D, dtype=cfg.dtype, name="fc")(x)

@@ -43,6 +43,9 @@ class GPT2Config:
     ring_layout: str = "contiguous"
     # "dense" | "flash" (fused pallas kernel, single-device/dp layouts).
     attention: str = "dense"
+    # Optional (block_q, block_k) flash tiling override; feed
+    # autotune_flash_blocks' pick for this shape, None = kernel defaults.
+    flash_blocks: Optional[tuple] = None
     # > 0 replaces every block's dense MLP with an expert-parallel MoE MLP
     # (ops/moe.py); experts shard over the "ep" mesh axis. Aux load-balance
     # losses are sown into the "losses" collection — train with
@@ -90,7 +93,8 @@ class Attention(nn.Module):
         else:
             from horovod_tpu.ops.attention import multihead_attention
             o = multihead_attention(q, k, v, impl=cfg.attention, causal=True,
-                                    out_dtype=cfg.dtype)
+                                    out_dtype=cfg.dtype,
+                                    flash_blocks=cfg.flash_blocks)
         o = o.reshape(B, T, D)
         return nn.Dense(D, dtype=cfg.dtype, name="out")(o)
 

@@ -9,6 +9,7 @@ embeddings.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import flax.linen as nn
 import jax
@@ -28,6 +29,8 @@ class ViTConfig:
     # "dense" | "flash" (fused pallas kernel; the 197-token sequence runs as
     # one full-sequence block).
     attention: str = "dense"
+    # Optional (block_q, block_k) flash tiling override (autotuned).
+    flash_blocks: Optional[tuple] = None
 
     @staticmethod
     def b16() -> "ViTConfig":
@@ -55,7 +58,9 @@ class ViTBlock(nn.Module):
         v = v.reshape(B, T, H, D // H)
         from horovod_tpu.ops.attention import multihead_attention
         att = multihead_attention(q, k, v, impl=cfg.attention, causal=False,
-                                  out_dtype=cfg.dtype).reshape(B, T, D)
+                                  out_dtype=cfg.dtype,
+                                  flash_blocks=cfg.flash_blocks
+                                  ).reshape(B, T, D)
         x = x + nn.Dense(D, dtype=cfg.dtype, name="out")(att)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="fc")(y)

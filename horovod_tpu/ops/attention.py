@@ -24,7 +24,8 @@ _NEG_INF = -1e30
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         *, impl: str, causal: bool,
                         key_mask: Optional[jnp.ndarray] = None,
-                        out_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+                        out_dtype: Optional[jnp.dtype] = None,
+                        flash_blocks: Optional[tuple] = None) -> jnp.ndarray:
     """softmax(q k^T / sqrt(d) [+ masks]) v over (B, T, H, D) tensors.
 
     Args:
@@ -35,6 +36,9 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       key_mask: optional (B, T_kv) bool; False keys are masked out
         (key-padding).
       out_dtype: dtype of the returned tensor (defaults to q.dtype).
+      flash_blocks: optional (block_q, block_k) tiling override for the
+        flash kernel — feed ``autotune_flash_blocks``'s pick for this
+        shape; None keeps the kernel defaults. Ignored by "dense".
 
     Returns (B, T_q, H, D).
     """
@@ -50,8 +54,13 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         key_bias = None
         if key_mask is not None:
             key_bias = jnp.where(key_mask, 0.0, _NEG_INF).astype(jnp.float32)
+        blocks = {}
+        if flash_blocks is not None:
+            blocks = {"block_q": int(flash_blocks[0]),
+                      "block_k": int(flash_blocks[1])}
         return flash_attention(q, k, v, causal=causal,
-                               key_bias=key_bias).astype(out_dtype)
+                               key_bias=key_bias,
+                               **blocks).astype(out_dtype)
 
     scale = d ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale

@@ -95,11 +95,17 @@ class TestStubs:
             ex.run(lambda: 1)
 
     def test_lightning_surface(self):
+        # Functional since r2 (see tests/test_lightning.py for behavior):
+        # the strategy constructs without pytorch-lightning and exposes the
+        # trainer-delegated operations; TorchEstimator builds the spark
+        # torch estimator.
         import horovod_tpu.lightning as hl
-        with pytest.raises(RuntimeError, match="DistributedOptimizer"):
-            hl.HorovodStrategy()
-        with pytest.raises(RuntimeError):
-            hl.TorchEstimator()
+        s = hl.HorovodStrategy()
+        assert s.world_size == hvd.size()
+        torch = pytest.importorskip("torch")
+        est = hl.TorchEstimator(model=torch.nn.Linear(2, 1),
+                                loss=torch.nn.functional.mse_loss)
+        assert type(est).__name__ == "TorchEstimator"
 
     def test_tensorflow_surface_without_tf(self):
         import horovod_tpu.tensorflow as hvd_tf

@@ -12,7 +12,10 @@ from horovod_tpu import config as hconfig
 @pytest.fixture
 def clean_env(monkeypatch):
     yield monkeypatch
-    # Re-read with the monkeypatched vars gone so later tests see defaults.
+    # Undo the patches FIRST, then re-read: teardown here runs before
+    # monkeypatch's own undo, so refreshing immediately would re-cache the
+    # patched values and leak them into every later test.
+    monkeypatch.undo()
     hconfig.refresh()
 
 

@@ -47,6 +47,87 @@ class TestState:
         assert s.extra == 42
 
 
+class TestFrameworkStates:
+    def test_torch_state_commit_restore_sync(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.elastic import TorchState
+        m = torch.nn.Linear(3, 2)
+        opt = torch.optim.SGD(m.parameters(), lr=0.5, momentum=0.9)
+        st = TorchState(model=m, optimizer=opt, epoch=0)
+        w0 = m.weight.detach().clone()
+        # train a step so weights + momentum buffers change
+        m(torch.ones(4, 3)).sum().backward()
+        opt.step()
+        assert not torch.allclose(m.weight, w0)
+        st.restore()
+        assert torch.allclose(m.weight, w0)
+        # commit the new point, mutate, sync() rolls back to the commit
+        opt.zero_grad()
+        m(torch.ones(4, 3)).sum().backward()
+        opt.step()
+        w1 = m.weight.detach().clone()
+        st.epoch = 3
+        st.commit()
+        with torch.no_grad():
+            m.weight.add_(1.0)
+        st.epoch = 7
+        st.sync()
+        assert torch.allclose(m.weight, w1)
+        assert st.epoch == 3
+        assert st.commit_count == 2
+
+    def test_torch_state_save_load_roundtrip(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.elastic import TorchState
+        m = torch.nn.Linear(3, 2)
+        st = TorchState(model=m, epoch=5)
+        path = str(tmp_path / "commit.pkl")
+        st.save(path)
+        m2 = torch.nn.Linear(3, 2)
+        st2 = TorchState(model=m2, epoch=0)
+        st2.load(path)
+        assert torch.allclose(m2.weight, m.weight)
+        assert st2.epoch == 5 and st2.commit_count == st.commit_count
+
+    def test_tf_keras_state_resets_late_built_optimizer_vars(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.elastic import TensorFlowKerasState
+        m = tf.keras.Sequential([tf.keras.layers.Input((3,)),
+                                 tf.keras.layers.Dense(2)])
+        m.compile(optimizer=tf.keras.optimizers.Adam(0.1), loss="mse")
+        st = TensorFlowKerasState(model=m)   # commit BEFORE slots exist
+        m.fit(np.ones((8, 3), np.float32), np.ones((8, 2), np.float32),
+              epochs=1, verbose=0)
+        assert any(np.abs(np.asarray(v)).sum() > 0
+                   for v in m.optimizer.variables
+                   if hasattr(v, "assign"))  # slots built + nonzero
+        st.restore()
+        # rolled back to the commit: fresh (zero) optimizer state, not
+        # post-failure momenta paired with pre-failure weights — but the
+        # learning-rate hyperparameter variable is kept
+        lr = m.optimizer.learning_rate
+        for v in m.optimizer.variables:
+            if hasattr(v, "assign") and v is not lr:
+                np.testing.assert_allclose(np.asarray(v), 0.0)
+        assert float(np.asarray(lr)) == pytest.approx(0.1)
+
+    def test_tf_keras_state_commit_restore(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.elastic import TensorFlowKerasState
+        m = tf.keras.Sequential([tf.keras.layers.Input((3,)),
+                                 tf.keras.layers.Dense(2)])
+        m.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+        st = TensorFlowKerasState(model=m, epoch=1)
+        w0 = [w.copy() for w in m.get_weights()]
+        m.fit(np.ones((8, 3), np.float32), np.ones((8, 2), np.float32),
+              epochs=1, verbose=0)
+        assert not np.allclose(m.get_weights()[0], w0[0])
+        st.restore()
+        for a, b in zip(m.get_weights(), w0):
+            np.testing.assert_allclose(a, b)
+        assert st.epoch == 1
+
+
 class TestElasticRun:
     def test_recovery_from_membership_change(self):
         """Simulate losing 4 of 8 devices mid-training: state rolls back to
