@@ -430,6 +430,7 @@ def _reset_negotiation() -> None:
     _NEG_COORD = None
     _NEG_CACHE.clear()
     _NEG_STATS["full"] = _NEG_STATS["fast"] = 0
+    _SUBSET_BARRIER_SEQ.clear()
 
 
 def _neg_coordinator():
@@ -884,18 +885,40 @@ def poll(handle) -> bool:
         return True
 
 
+_SUBSET_BARRIER_SEQ: dict = {}
+
+
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
-    """Block until all members reach the barrier (``hvd.barrier``)."""
+    """Block until all members reach the barrier (``hvd.barrier``).
+
+    Subset process sets in multi-process mode ride the distributed
+    runtime's keyed barrier over the member *processes* only (the
+    host-side sub-rendezvous upstream's controller provides): member
+    processes block until every member arrives, non-members return
+    immediately — they never participate, so they cannot deadlock.
+    """
     ps = _resolve_ps(process_set)
     if jax.process_count() > 1:
         if ps.ranks is not None:
-            # sync_global_devices requires every process; a subset barrier
-            # would deadlock non-members. Horovod's subset barrier needs a
-            # host-side sub-rendezvous (planned with the C++ controller, see
-            # SURVEY §2 row 11).
-            raise NotImplementedError(
-                "barrier over a subset process set is not supported in "
-                "multi-process mode")
+            from jax._src import distributed
+            devs = list(core.mesh().devices.ravel())
+            member_procs = sorted({devs[r].process_index
+                                   for r in ps.ranks})
+            me = jax.process_index()
+            if me not in member_procs:
+                return
+            if len(member_procs) == 1:
+                return
+            # Monotonic id per process set so repeated barriers cannot
+            # collide; members call in the same order by the eager
+            # ordering contract.
+            seq = _SUBSET_BARRIER_SEQ.get(ps.process_set_id, 0)
+            _SUBSET_BARRIER_SEQ[ps.process_set_id] = seq + 1
+            distributed.global_state.client.wait_at_barrier(
+                f"hvdtpu_ps{ps.process_set_id}_b{seq}",
+                timeout_in_ms=10 * 60 * 1000,
+                process_ids=list(member_procs))
+            return
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("horovod_tpu_barrier")
         return

@@ -70,10 +70,14 @@ class JaxState(State):
             object.__setattr__(self, name, value)
         elif "_pytrees" in self.__dict__ and name in self._pytrees:
             self._pytrees[name] = value
-        elif "_attrs" in self.__dict__ and name in self._attrs:
-            self._attrs[name] = value
         elif _is_pytree_of_arrays(value) and "_pytrees" in self.__dict__:
             self._pytrees[name] = value
+        elif "_attrs" in self.__dict__:
+            # Any public attribute — constructor kwarg or set later — is
+            # tracked state: an untracked counter would survive restore()
+            # with its post-failure value and silently desynchronize the
+            # resumed run (LR schedule, data position).
+            self._attrs[name] = value
         else:
             object.__setattr__(self, name, value)
 
@@ -178,7 +182,9 @@ class _AttrState(State):
         if name.startswith("_") or name in ("model", "optimizer",
                                             "commit_count"):
             object.__setattr__(self, name, value)
-        elif "_attrs" in self.__dict__ and name in self._attrs:
+        elif "_attrs" in self.__dict__:
+            # Track every public attribute (not just constructor kwargs) —
+            # see JaxState.__setattr__.
             self._attrs[name] = value
         else:
             object.__setattr__(self, name, value)
@@ -221,10 +227,13 @@ class TorchState(_AttrState):
         self._attrs = copy.deepcopy(self._saved_attrs)
 
     def sync(self) -> None:
-        from horovod_tpu import collective as C
         if jax.process_count() > 1:
-            self._saved = C.broadcast_object(self._saved, 0)
-            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+            # Through the torch frontend's dispatch thread: sync() can race
+            # an in-flight *_async handle's negotiation (elastic membership
+            # change mid-step), and host collectives must stay ordered.
+            from horovod_tpu.torch import broadcast_object
+            self._saved = broadcast_object(self._saved, 0)
+            self._saved_attrs = broadcast_object(self._saved_attrs, 0)
         self.restore()
 
 
@@ -284,6 +293,9 @@ class TensorFlowKerasState(_AttrState):
         self._attrs = copy.deepcopy(self._saved_attrs)
 
     def sync(self) -> None:
+        # The TF frontend has no async handle queue to race (its
+        # collectives run on the caller thread), so the direct host
+        # channel is already ordered.
         from horovod_tpu import collective as C
         if jax.process_count() > 1:
             self._saved = C.broadcast_object(self._saved, 0)

@@ -18,11 +18,14 @@ every TPU-VM worker).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import shlex
 import subprocess
 import sys
 from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("horovod_tpu")
 
 __all__ = ["HostSpec", "parse_hosts", "build_worker_env", "worker_commands",
            "run", "run_func", "run_elastic"]
@@ -165,10 +168,21 @@ def _supervise(procs: List[subprocess.Popen],
     return 0
 
 
+def _rank_output(output_filename: Optional[str], rank: int):
+    """Per-rank log sink (upstream ``horovodrun --output-filename``:
+    ``<dir>/rank.<N>/stdout``). None = inherit the launcher's streams."""
+    if output_filename is None:
+        return None
+    d = os.path.join(output_filename, f"rank.{rank}")
+    os.makedirs(d, exist_ok=True)
+    return open(os.path.join(d, "stdout"), "wb")
+
+
 def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
         coordinator_port: int = DEFAULT_PORT, dry_run: bool = False,
         extra_env: Optional[Dict[str, str]] = None,
-        timeout: Optional[float] = None, ssh: bool = False):
+        timeout: Optional[float] = None, ssh: bool = False,
+        output_filename: Optional[str] = None):
     """``horovodrun`` equivalent.
 
     - ``hosts=None``: spawn ``np`` local worker processes and wait.
@@ -182,6 +196,9 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
       this many seconds (upstream ``--start-timeout``'s role: a wedged
       rendezvous or accelerator runtime turns into an error, not a silent
       infinite hang).
+    - ``output_filename``: directory for per-rank logs
+      (``<dir>/rank.<N>/stdout``, stderr merged — upstream
+      ``--output-filename``).
     """
     if hosts is not None:
         specs = parse_hosts(hosts)
@@ -193,8 +210,12 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
             for c in cmds:
                 print(c)
             return cmds
-        procs = [subprocess.Popen(_ssh_argv(spec.host, line))
-                 for spec, line in zip(specs, cmds)]
+        procs = []
+        for rank, (spec, line) in enumerate(zip(specs, cmds)):
+            sink = _rank_output(output_filename, rank)
+            procs.append(subprocess.Popen(
+                _ssh_argv(spec.host, line), stdout=sink,
+                stderr=subprocess.STDOUT if sink else None))
         return _supervise(procs, timeout)
 
     coordinator = f"127.0.0.1:{coordinator_port}"
@@ -214,7 +235,10 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
             env.setdefault("JAX_PLATFORMS", "cpu")
         if extra_env:
             env.update(extra_env)
-        procs.append(subprocess.Popen(list(command), env=env))
+        sink = _rank_output(output_filename, pid)
+        procs.append(subprocess.Popen(
+            list(command), env=env, stdout=sink,
+            stderr=subprocess.STDOUT if sink else None))
     return _supervise(procs, timeout)
 
 
@@ -223,7 +247,8 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
                 coordinator_port: int = DEFAULT_PORT,
                 state_dir: Optional[str] = None,
                 extra_env: Optional[Dict[str, str]] = None,
-                timeout: Optional[float] = None) -> int:
+                timeout: Optional[float] = None,
+                discovery=None) -> int:
     """Fault-tolerant multi-process launch (upstream
     ``horovod/runner/elastic/driver.py``).
 
@@ -239,6 +264,11 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
 
     Stops when a relaunch would drop below ``min_np`` or after
     ``max_restarts`` attempts; returns the number of restarts on success.
+
+    ``discovery``: optional zero-arg callable returning the currently
+    available slot count (upstream ``--host-discovery-script``); consulted
+    between attempts so recovered capacity scales the relaunch back up
+    (capped at ``np``). Without it the world only shrinks (survivors).
     """
     import tempfile
     import time
@@ -308,6 +338,16 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
         # Only organically-failed workers (nonzero exit before teardown)
         # count as lost hosts; survivors we terminated relaunch.
         world = world - failed
+        if discovery is not None:
+            # Upstream's host-discovery hook (--host-discovery-script /
+            # elastic driver polling): consult it between attempts so
+            # recovered capacity scales the job back UP, capped at the
+            # original np (slots beyond it were never provisioned).
+            try:
+                world = max(world, min(int(discovery()), np))
+            except Exception as e:
+                logger.warning("elastic discovery hook failed (%s); "
+                               "continuing with world=%d", e, world)
         restarts += 1
         if world < min_np:
             raise RuntimeError(
@@ -388,6 +428,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--ssh", action="store_true",
                         help="execute the per-host commands over ssh and "
                              "supervise them (upstream gloo_run)")
+    parser.add_argument("--output-filename", default=None,
+                        help="directory for per-rank logs "
+                             "(<dir>/rank.N/stdout, stderr merged; "
+                             "upstream --output-filename)")
     parser.add_argument("--dry-run", action="store_true")
     parser.add_argument("--check-build", action="store_true",
                         help="print capability flags and exit "
@@ -410,7 +454,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("no command given")
     out = run(args.command, np=args.num_proc, hosts=args.hosts,
               coordinator_port=args.port, dry_run=args.dry_run,
-              timeout=args.start_timeout, ssh=args.ssh)
+              timeout=args.start_timeout, ssh=args.ssh,
+              output_filename=args.output_filename)
     if args.dry_run and isinstance(out, list):
         for c in out:
             print(c)

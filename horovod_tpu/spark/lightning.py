@@ -1,0 +1,13 @@
+"""``horovod_tpu.spark.lightning`` — upstream ``horovod.spark.lightning``
+namespace. The estimator surface is the torch estimator (upstream's
+lightning estimator trains a LightningModule-shaped torch model on spark
+workers; here :class:`~horovod_tpu.spark.estimator_torch.TorchEstimator`
+plays that role over the injected cluster backend), and the strategy lives
+in :mod:`horovod_tpu.lightning`."""
+
+from horovod_tpu.lightning import HorovodStrategy, Trainer  # noqa: F401
+from horovod_tpu.spark.estimator_torch import (  # noqa: F401
+    TorchEstimator, TorchModel,
+)
+
+__all__ = ["TorchEstimator", "TorchModel", "HorovodStrategy", "Trainer"]

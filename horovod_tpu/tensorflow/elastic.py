@@ -1,0 +1,11 @@
+"""``horovod_tpu.tensorflow.elastic`` — upstream ``horovod.tensorflow.elastic``
+namespace: the tf.keras framework state plus the shared elastic driver
+surface (the state machinery itself lives in
+:mod:`horovod_tpu.elastic.state`)."""
+
+from horovod_tpu.elastic import (  # noqa: F401
+    State, TensorFlowKerasState, run, restart_count, state_dir,
+)
+
+__all__ = ["State", "TensorFlowKerasState", "run", "restart_count",
+           "state_dir"]

@@ -1,0 +1,10 @@
+"""``horovod_tpu.torch.elastic`` — upstream ``horovod.torch.elastic``
+namespace: the torch framework state plus the shared elastic driver
+surface (the state machinery itself lives in
+:mod:`horovod_tpu.elastic.state`)."""
+
+from horovod_tpu.elastic import (  # noqa: F401
+    State, TorchState, run, restart_count, state_dir,
+)
+
+__all__ = ["State", "TorchState", "run", "restart_count", "state_dir"]

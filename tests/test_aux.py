@@ -181,6 +181,20 @@ class TestRunFunc:
         with pytest.raises(RuntimeError):
             run_func(boom, np=1)
 
+    def test_output_filename_writes_per_rank_logs(self, tmp_path):
+        from horovod_tpu.runner.launcher import run
+        out = str(tmp_path / "logs")
+        rc = run(["python", "-c",
+                  "import os, sys; print('rank', "
+                  "os.environ['HVD_TPU_PROCESS_ID']); "
+                  "print('err', file=sys.stderr)"],
+                 np=2, output_filename=out, timeout=120)
+        assert rc == 0
+        for r in range(2):
+            text = (tmp_path / "logs" / f"rank.{r}" / "stdout").read_text()
+            assert f"rank {r}" in text
+            assert "err" in text       # stderr merged, upstream behavior
+
     def test_run_timeout_kills_wedged_workers(self):
         from horovod_tpu.runner.launcher import run
         import time

@@ -76,6 +76,22 @@ class TestFrameworkStates:
         assert st.epoch == 3
         assert st.commit_count == 2
 
+    def test_post_init_attrs_are_tracked(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.elastic import JaxState, TorchState
+        st = TorchState(model=torch.nn.Linear(2, 1))
+        st.epoch = 3                  # set AFTER construction
+        st.commit()
+        st.epoch = 9
+        st.restore()
+        assert st.epoch == 3          # rolled back, not an untracked attr
+        js = JaxState(w=jnp.zeros(2))
+        js.step = 4
+        js.commit()
+        js.step = 8
+        js.restore()
+        assert js.step == 4
+
     def test_torch_state_save_load_roundtrip(self, tmp_path):
         torch = pytest.importorskip("torch")
         from horovod_tpu.elastic import TorchState

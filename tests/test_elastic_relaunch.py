@@ -80,6 +80,28 @@ def test_worker_death_relaunch_restores_committed_state():
 
 
 @pytest.mark.slow
+def test_discovery_scales_relaunch_back_up():
+    """With a discovery hook reporting restored capacity, the relaunch
+    returns to full world instead of shrinking to survivors (upstream
+    --host-discovery-script semantics)."""
+    from horovod_tpu.runner.launcher import run_elastic
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = _WORKER.format(repo=repo)
+    with tempfile.TemporaryDirectory(prefix="hvd_elastic_test_") as sdir:
+        restarts = run_elastic(
+            [sys.executable, "-c", script], np=2, min_np=1,
+            coordinator_port=29700, state_dir=sdir, timeout=240,
+            discovery=lambda: 2)
+        assert restarts == 1
+        with open(os.path.join(sdir, "result.json")) as f:
+            result = json.load(f)
+    assert result["world"] == 2          # scaled back up, not survivors-only
+    assert result["step"] == 6
+    assert result["w"] == [6.0, 6.0, 6.0, 6.0]
+
+
+@pytest.mark.slow
 def test_below_min_np_raises():
     from horovod_tpu.runner.launcher import run_elastic
 

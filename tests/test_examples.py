@@ -46,6 +46,10 @@ class TestExamples:
         out = _run("tensorflow2_mnist.py", "--steps", "60", timeout=600)
         assert "loss" in out
 
+    def test_gpt2_long_context(self):
+        out = _run("gpt2_long_context.py", "--steps", "2")
+        assert "8 sp shards" in out and "OK" in out
+
     def test_tensorflow2_keras_mnist(self):
         out = _run("tensorflow2_keras_mnist.py", "--epochs", "2",
                    timeout=600)

@@ -82,6 +82,27 @@ _WORKER = textwrap.dedent("""
             print(f"proc {{pid}} STALL-SEEN", flush=True)
         else:
             print(f"proc {{pid}} STALL-OK", flush=True)
+    elif mode == "subset_barrier":
+        import time
+        from horovod_tpu.process_set import add_process_set
+        ps_solo = add_process_set([0])
+        ps_both = add_process_set([0, 1])
+        # Non-member (pid 1) and single-member-process (pid 0) return
+        # immediately.
+        t0 = time.monotonic()
+        hvd.barrier(process_set=ps_solo)
+        assert time.monotonic() - t0 < 5.0
+        # Both-members barrier: the late rank gates the early one.
+        if pid == 1:
+            time.sleep(2.0)
+        t0 = time.monotonic()
+        hvd.barrier(process_set=ps_both)
+        waited = time.monotonic() - t0
+        if pid == 0:
+            assert waited > 1.0, waited   # blocked on the sleeping peer
+        # Second barrier on the same set must not collide with the first.
+        hvd.barrier(process_set=ps_both)
+        print(f"proc {{pid}} SUBSET-BARRIER-OK", flush=True)
     elif mode == "join":
         import time
         if pid == 1:
@@ -159,6 +180,13 @@ def test_two_process_join_returns_last_rank():
     for rc, out in _run_pair("join"):
         assert rc == 0, out
         assert "JOIN-OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_subset_barrier():
+    for rc, out in _run_pair("subset_barrier"):
+        assert rc == 0, out
+        assert "SUBSET-BARRIER-OK" in out
 
 
 @pytest.mark.slow
