@@ -4,12 +4,55 @@
 from __future__ import annotations
 
 import copy
+import logging
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 __all__ = ["State", "JaxState", "TorchState", "TensorFlowKerasState"]
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _copy_attrs(attrs: Dict[str, Any], warned: set) -> Dict[str, Any]:
+    """Deep-copy tracked attributes, falling back to by-reference (with a
+    one-time warning) for values deepcopy cannot handle (locks, loggers,
+    loader handles) — every public attribute is tracked so counters roll
+    back on restore(), but a stateful helper object must not turn commit()
+    into a crash."""
+    out = {}
+    for k, v in attrs.items():
+        try:
+            out[k] = copy.deepcopy(v)
+        except Exception:
+            if k not in warned:
+                warned.add(k)
+                logger.warning(
+                    "elastic state attribute %r is not deep-copyable; it "
+                    "is kept by reference and will NOT roll back on "
+                    "restore()", k)
+            out[k] = v
+    return out
+
+
+def _picklable_attrs(attrs: Dict[str, Any], warned: set) -> Dict[str, Any]:
+    """Subset of attributes that survive pickling (save()/sync() wire
+    format); the rest are dropped with a one-time warning."""
+    import pickle
+    out = {}
+    for k, v in attrs.items():
+        try:
+            pickle.dumps(v)
+            out[k] = v
+        except Exception:
+            key = ("pickle", k)
+            if key not in warned:
+                warned.add(key)
+                logger.warning(
+                    "elastic state attribute %r is not picklable; it is "
+                    "excluded from save()/sync()", k)
+    return out
 
 
 class State:
@@ -47,6 +90,7 @@ class JaxState(State):
         self._attrs: Dict[str, Any] = {}
         self._saved_pytrees: Dict[str, Any] = {}
         self._saved_attrs: Dict[str, Any] = {}
+        self._warn: set = set()
         for k, v in kwargs.items():
             if _is_pytree_of_arrays(v):
                 self._pytrees[k] = v
@@ -85,14 +129,14 @@ class JaxState(State):
         self._saved_pytrees = {
             k: jax.tree_util.tree_map(lambda x: np.asarray(x), v)
             for k, v in self._pytrees.items()}
-        self._saved_attrs = copy.deepcopy(self._attrs)
+        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
         self.commit_count += 1
 
     def restore(self) -> None:
         self._pytrees = {
             k: jax.tree_util.tree_map(jax.numpy.asarray, v)
             for k, v in self._saved_pytrees.items()}
-        self._attrs = copy.deepcopy(self._saved_attrs)
+        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
 
     def sync(self) -> None:
         """After re-init: broadcast committed state from the coordinator so
@@ -100,7 +144,8 @@ class JaxState(State):
         from horovod_tpu import collective as C
         if jax.process_count() > 1:
             self._saved_pytrees = C.broadcast_object(self._saved_pytrees, 0)
-            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+            self._saved_attrs = C.broadcast_object(
+                _picklable_attrs(self._saved_attrs, self._warn), 0)
         self.restore()
 
     def save(self, path: str) -> None:
@@ -115,7 +160,8 @@ class JaxState(State):
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"pytrees": self._saved_pytrees,
-                         "attrs": self._saved_attrs,
+                         "attrs": _picklable_attrs(self._saved_attrs,
+                                                   self._warn),
                          "commit_count": self.commit_count}, f)
         import os
         os.replace(tmp, path)
@@ -147,6 +193,7 @@ class _AttrState(State):
     def __init__(self, **kwargs: Any):
         self._attrs: Dict[str, Any] = dict(kwargs)
         self._saved_attrs: Dict[str, Any] = {}
+        self._warn: set = set()
 
     def save(self, path: str) -> None:
         """Persist the last commit to disk (atomic write) — the
@@ -157,7 +204,8 @@ class _AttrState(State):
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"saved": self._saved,
-                         "attrs": self._saved_attrs,
+                         "attrs": _picklable_attrs(self._saved_attrs,
+                                                   self._warn),
                          "commit_count": self.commit_count}, f)
         os.replace(tmp, path)
 
@@ -215,7 +263,7 @@ class TorchState(_AttrState):
 
     def commit(self) -> None:
         self._saved = self._snapshot()
-        self._saved_attrs = copy.deepcopy(self._attrs)
+        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
         self.commit_count += 1
 
     def restore(self) -> None:
@@ -224,7 +272,7 @@ class TorchState(_AttrState):
         if "optimizer" in self._saved and self.optimizer is not None:
             self.optimizer.load_state_dict(
                 copy.deepcopy(self._saved["optimizer"]))
-        self._attrs = copy.deepcopy(self._saved_attrs)
+        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
 
     def sync(self) -> None:
         if jax.process_count() > 1:
@@ -233,7 +281,8 @@ class TorchState(_AttrState):
             # change mid-step), and host collectives must stay ordered.
             from horovod_tpu.torch import broadcast_object
             self._saved = broadcast_object(self._saved, 0)
-            self._saved_attrs = broadcast_object(self._saved_attrs, 0)
+            self._saved_attrs = broadcast_object(
+                _picklable_attrs(self._saved_attrs, self._warn), 0)
         self.restore()
 
 
@@ -268,7 +317,7 @@ class TensorFlowKerasState(_AttrState):
         snap["opt"] = {self._var_key(v): np.asarray(v)
                        for v in self._opt_vars()}
         self._saved = snap
-        self._saved_attrs = copy.deepcopy(self._attrs)
+        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
         self.commit_count += 1
 
     def restore(self) -> None:
@@ -290,7 +339,7 @@ class TensorFlowKerasState(_AttrState):
                 # keeping post-failure momenta/iteration counts would pair
                 # stale state with rolled-back weights.
                 var.assign(np.zeros(var.shape, np.asarray(var).dtype))
-        self._attrs = copy.deepcopy(self._saved_attrs)
+        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
 
     def sync(self) -> None:
         # The TF frontend has no async handle queue to race (its

@@ -29,8 +29,10 @@ from jax import lax
 from horovod_tpu.models.gpt2 import GPT2Config, Block, loss_fn
 
 __all__ = ["stack_block_params", "stack_block_params_interleaved",
+           "make_pp_tp_params", "block_specs_tp",
            "gpt2_pp_loss", "gpt2_pp_loss_interleaved",
-           "gpt2_pp_loss_and_grad", "gpt2_pp_loss_and_grad_interleaved"]
+           "gpt2_pp_loss_and_grad", "gpt2_pp_loss_and_grad_interleaved",
+           "gpt2_pp_tp_loss", "gpt2_pp_tp_loss_and_grad"]
 
 
 def stack_block_params(params: dict, num_stages: int) -> Tuple[Any, dict]:
@@ -121,9 +123,10 @@ def gpt2_pp_loss(cfg: GPT2Config, blocks: Any, rest: dict,
 
 
 def _pp_loss(cfg: GPT2Config, blocks: Any, rest: dict, tokens: jnp.ndarray,
-             axis_name: str, pipeline_fn) -> jnp.ndarray:
+             axis_name: str, pipeline_fn, stage_fn=None) -> jnp.ndarray:
     """Shared embedding → pipeline → LN + tied-head loss assembly; the
-    schedule is the injected ``pipeline_fn`` (GPipe or interleaved)."""
+    schedule is the injected ``pipeline_fn`` (GPipe or interleaved) and the
+    per-stage body the injected ``stage_fn`` (plain or tensor-parallel)."""
     blocks = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, axis=0), blocks)
 
     M, mb, T = tokens.shape
@@ -139,8 +142,8 @@ def _pp_loss(cfg: GPT2Config, blocks: Any, rest: dict, tokens: jnp.ndarray,
         logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32), wte)
         return loss_fn(logits, tokens.reshape(M * mb, T))
 
-    return pipeline_fn(_stage_fn(cfg), blocks, x, loss_from_outputs,
-                       axis_name)
+    return pipeline_fn(stage_fn if stage_fn is not None else _stage_fn(cfg),
+                       blocks, x, loss_from_outputs, axis_name)
 
 
 def gpt2_pp_loss_interleaved(cfg: GPT2Config, blocks: Any, rest: dict,
@@ -184,6 +187,188 @@ def gpt2_pp_loss_and_grad(cfg: GPT2Config, axis_name: str = "pp"):
         l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
             blocks, rest)
         g_rest = lax.psum(g_rest, axis_name)
+        return l, g_blocks, g_rest
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# pipeline x tensor parallelism (Megatron-inside-GPipe)
+# ---------------------------------------------------------------------------
+
+def make_pp_tp_params(params: dict, num_stages: int,
+                      num_heads: int) -> Tuple[Any, dict]:
+    """Stack + re-lay a ``GPT2`` param dict for the pp x tp layout.
+
+    :func:`stack_block_params`, then the attention kernels are re-laid for
+    head-major tensor parallelism: the fused qkv kernel packs ``[q|k|v]``
+    along its output axis, so a contiguous tp slice would mix q columns
+    with k's — reshaping to ``(S, K, D, 3, H, hd)`` (bias
+    ``(S, K, 3, H, hd)``, out projection ``(S, K, H, hd, D)``) makes the
+    head axis explicit for ``shard_map`` to shard. Pure restack — a
+    checkpoint still moves losslessly (reshape back restores the plain
+    layout). ``num_heads`` disambiguates the head axis."""
+    blocks, rest = stack_block_params(params, num_stages)
+    qkv_k = blocks["attn"]["qkv"]["kernel"]         # (S, K, D, 3D)
+    S, K, D, _ = qkv_k.shape
+    H = num_heads
+    hd = D // H
+    blocks = dict(blocks)
+    blocks["attn"] = dict(blocks["attn"])
+    blocks["attn"]["qkv"] = {
+        "kernel": qkv_k.reshape(S, K, D, 3, H, hd),
+        "bias": blocks["attn"]["qkv"]["bias"].reshape(S, K, 3, H, hd),
+    }
+    blocks["attn"]["out"] = {
+        "kernel": blocks["attn"]["out"]["kernel"].reshape(S, K, H, hd, D),
+        "bias": blocks["attn"]["out"]["bias"],
+    }
+    return blocks, rest
+
+
+def block_specs_tp(pp_axis: str = "pp", tp_axis: str = "tp"):
+    """PartitionSpec pytree for :func:`make_pp_tp_params` blocks: stage axis
+    over ``pp``, head/feature axes of the Megatron-parallel kernels over
+    ``tp``, everything else replicated per stage."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "ln1": {"scale": P(pp_axis), "bias": P(pp_axis)},
+        "ln2": {"scale": P(pp_axis), "bias": P(pp_axis)},
+        "attn": {
+            "qkv": {"kernel": P(pp_axis, None, None, None, tp_axis, None),
+                    "bias": P(pp_axis, None, None, tp_axis, None)},
+            "out": {"kernel": P(pp_axis, None, tp_axis, None, None),
+                    "bias": P(pp_axis)},
+        },
+        "mlp": {
+            "fc": {"kernel": P(pp_axis, None, None, tp_axis),
+                   "bias": P(pp_axis, None, tp_axis)},
+            "proj": {"kernel": P(pp_axis, None, tp_axis, None),
+                     "bias": P(pp_axis)},
+        },
+    }
+
+
+def _bwd_psum(axis_name: str):
+    """Megatron's ``f`` operator: identity forward, psum-over-tp backward.
+    A column-parallel matmul's input is replicated over tp but each member
+    back-propagates only its local heads'/features' contribution — the
+    cotangent must be summed across tp or the residual stream's gradient
+    (and every upstream parameter grad) silently loses all but one shard's
+    share."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _fwd_psum(axis_name: str):
+    """Megatron's ``g`` operator: psum forward, **identity** backward (the
+    row-parallel output reduction). A plain ``lax.psum`` would transpose to
+    another psum under ``check_vma=False`` (replication is untracked), so
+    the replicated cotangent gets multiplied by the tp size at every
+    reduction and the error compounds 2^(2L) through the blocks; each
+    member's partial must instead receive the cotangent unchanged."""
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis_name)
+
+    def fwd(x):
+        return lax.psum(x, axis_name), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+def _stage_fn_tp(cfg: GPT2Config, tp_axis: str = "tp"):
+    """Per-stage block application with Megatron tensor parallelism inside:
+    column-parallel qkv/fc (local heads / local ffn features), row-parallel
+    out/proj with one psum each — exactly two tp collectives per block, the
+    Megatron count. Numerics mirror :class:`~horovod_tpu.models.gpt2.Block`
+    with the head axis sliced."""
+    ln = nn.LayerNorm(dtype=jnp.float32)
+    f = _bwd_psum(tp_axis)
+    g = _fwd_psum(tp_axis)
+
+    def apply_block(p, h):
+        from horovod_tpu.ops.attention import multihead_attention
+        dt = cfg.dtype
+        x = ln.apply({"params": p["ln1"]}, h).astype(dt)
+        x = f(x)
+        qkv = jnp.einsum("btd,dchn->btchn", x,
+                         p["attn"]["qkv"]["kernel"].astype(dt))
+        qkv = qkv + p["attn"]["qkv"]["bias"].astype(dt)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,T,Hl,hd)
+        o = multihead_attention(q, k, v, impl=cfg.attention, causal=True,
+                                out_dtype=dt, flash_blocks=cfg.flash_blocks)
+        part = jnp.einsum("bthn,hnd->btd", o,
+                          p["attn"]["out"]["kernel"].astype(dt))
+        att = g(part) + p["attn"]["out"]["bias"].astype(dt)
+        h = h + att
+        x = ln.apply({"params": p["ln2"]}, h).astype(dt)
+        x = f(x)
+        fc = jnp.einsum("btd,df->btf", x,
+                        p["mlp"]["fc"]["kernel"].astype(dt))
+        fc = nn.gelu(fc + p["mlp"]["fc"]["bias"].astype(dt))
+        part = jnp.einsum("btf,fd->btd", fc,
+                          p["mlp"]["proj"]["kernel"].astype(dt))
+        mlp = g(part) + p["mlp"]["proj"]["bias"].astype(dt)
+        return h + mlp
+
+    def apply_blocks(blocks_k, h):
+        def body(h, p):
+            return apply_block(p, h), None
+        h, _ = lax.scan(body, h, blocks_k)
+        return h
+
+    return apply_blocks
+
+
+def gpt2_pp_tp_loss(cfg: GPT2Config, blocks: Any, rest: dict,
+                    tokens: jnp.ndarray, pp_axis: str = "pp",
+                    tp_axis: str = "tp") -> jnp.ndarray:
+    """Pipelined + tensor-parallel GPT-2 LM loss; call inside ``shard_map``
+    over a ``(pp, tp)`` mesh with ``blocks`` sharded per
+    :func:`block_specs_tp` and ``rest``/``tokens`` replicated.
+
+    Activations hop stages over ``pp`` within each tp fiber; inside a stage
+    every matmul is Megatron-split over ``tp``. Embedding and the LM head
+    run replicated on every tp member (identical inputs -> identical
+    outputs), so the loss and ``rest`` grads are tp-replicated by
+    construction.
+    """
+    from horovod_tpu.parallel.pipeline import pipeline_loss
+    return _pp_loss(cfg, blocks, rest, tokens, pp_axis, pipeline_loss,
+                    stage_fn=_stage_fn_tp(cfg, tp_axis))
+
+
+def gpt2_pp_tp_loss_and_grad(cfg: GPT2Config, pp_axis: str = "pp",
+                             tp_axis: str = "tp"):
+    """Per-device ``(blocks, rest, tokens) -> (loss, grads)`` for the
+    pp x tp layout: block grads stay local to their (stage, tp-shard);
+    ``rest`` grads psum over ``pp`` only (already tp-replicated)."""
+
+    def step(blocks, rest, tokens):
+        def loss(blocks, rest):
+            return gpt2_pp_tp_loss(cfg, blocks, rest, tokens,
+                                   pp_axis, tp_axis)
+
+        l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
+            blocks, rest)
+        g_rest = lax.psum(g_rest, pp_axis)
         return l, g_blocks, g_rest
 
     return step

@@ -96,8 +96,19 @@ def _run_pipeline(stage_fn: Callable, stage_params: Any,
 
     act0 = jnp.zeros(mb_shape, microbatches.dtype)
     out0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    act0, out0 = _vary_over(axis_name, act0, out0)
     (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(T))
     return outputs, stage, S
+
+
+def _vary_over(axis_name: str, *xs):
+    """Mark fresh zeros as varying over the pipe axis: under a multi-axis
+    ``shard_map`` the scan carry's output is pp-varying (ppermute), and jax
+    requires the initial carry to match (vma typing)."""
+    try:
+        return tuple(lax.pcast(x, (axis_name,), to="varying") for x in xs)
+    except (AttributeError, TypeError):
+        return xs
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any,

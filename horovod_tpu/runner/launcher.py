@@ -216,6 +216,8 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
             procs.append(subprocess.Popen(
                 _ssh_argv(spec.host, line), stdout=sink,
                 stderr=subprocess.STDOUT if sink else None))
+            if sink is not None:
+                sink.close()   # the child holds its own duplicate fd
         return _supervise(procs, timeout)
 
     coordinator = f"127.0.0.1:{coordinator_port}"
@@ -239,6 +241,8 @@ def run(command: Sequence[str], np: int = 1, hosts: Optional[str] = None,
         procs.append(subprocess.Popen(
             list(command), env=env, stdout=sink,
             stderr=subprocess.STDOUT if sink else None))
+        if sink is not None:
+            sink.close()   # the child holds its own duplicate fd
     return _supervise(procs, timeout)
 
 

@@ -76,6 +76,20 @@ class TestFrameworkStates:
         assert st.epoch == 3
         assert st.commit_count == 2
 
+    def test_non_copyable_attr_does_not_break_commit(self, tmp_path):
+        import threading
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.elastic import TorchState
+        st = TorchState(model=torch.nn.Linear(2, 1), epoch=0)
+        st.lock = threading.Lock()        # stateful helper, not rollable
+        st.epoch = 4
+        st.commit()                       # must not raise
+        st.save(str(tmp_path / "c.pkl"))  # lock excluded from the pickle
+        st.epoch = 9
+        st.restore()
+        assert st.epoch == 4              # data attrs still roll back
+        assert hasattr(st.lock, "acquire")
+
     def test_post_init_attrs_are_tracked(self):
         torch = pytest.importorskip("torch")
         from horovod_tpu.elastic import JaxState, TorchState
