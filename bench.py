@@ -332,7 +332,8 @@ def main():
     on_tpu = jax.default_backend() != "cpu"
     if args.model == "all":
         # headline (resnet50) last so single-line parsers read it.
-        for name in ("mnist", "vit", "bert", "gpt2", "resnet50"):
+        for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
+                     "resnet50"):
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)

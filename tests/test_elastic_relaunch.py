@@ -164,6 +164,7 @@ def test_torch_state_survives_relaunch():
     """TorchState in the run_elastic recovery contract: worker death ->
     relaunch over survivors -> model+optimizer restored from the last
     committed save, training resumes to completion."""
+    pytest.importorskip("torch")
     from horovod_tpu.runner.launcher import run_elastic
 
     repo = str(pathlib.Path(__file__).resolve().parent.parent)
@@ -171,7 +172,7 @@ def test_torch_state_survives_relaunch():
     with tempfile.TemporaryDirectory(prefix="hvd_elastic_torch_") as sdir:
         restarts = run_elastic(
             [sys.executable, "-c", script], np=2, min_np=1,
-            coordinator_port=29750, state_dir=sdir, timeout=300)
+            coordinator_port=29820, state_dir=sdir, timeout=300)
         assert restarts == 1
         with open(os.path.join(sdir, "result.json")) as f:
             result = json.load(f)
