@@ -303,3 +303,57 @@ class TestGPT2PipelineTensorParallel:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5),
             g_rest, ref_rest)
+
+    def test_gpt2_pp_tp_dp_matches_single_device(self):
+        """Full 3-D composition: pp2 x tp2 x dp2 — each dp replica trains a
+        batch shard through the Megatron-in-GPipe program; dp-averaged loss
+        and grads must equal the single-device full-batch model."""
+        from jax import lax
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+        from horovod_tpu.models.gpt2_pipeline import (
+            block_specs_tp, gpt2_pp_tp_loss_and_grad, make_pp_tp_params)
+        from horovod_tpu.parallel import make_mesh
+
+        S, TP, DP = 2, 2, 2
+        cfg = GPT2Config(vocab_size=128, max_seq_len=32, num_layers=S * 2,
+                         num_heads=4, d_model=32, dtype=jnp.float32)
+        M, T = 4, 16
+        rng = np.random.default_rng(17)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (M, DP, T)), jnp.int32)
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            tokens.reshape(M * DP, T))["params"]
+
+        blocks, rest = make_pp_tp_params(params, S, cfg.num_heads)
+        specs = block_specs_tp("pp", "tp")
+        mesh = make_mesh({"pp": S, "tp": TP, "dp": DP})
+        base = gpt2_pp_tp_loss_and_grad(cfg, "pp", "tp")
+
+        def step(blocks, rest, toks):
+            l, gb, gr = base(blocks, rest, toks)
+            l = lax.pmean(l, "dp")
+            gb = jax.tree_util.tree_map(lambda g: lax.pmean(g, "dp"), gb)
+            gr = jax.tree_util.tree_map(lambda g: lax.pmean(g, "dp"), gr)
+            return l, gb, gr
+
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(specs, P(), P(None, "dp")),
+            out_specs=(P(), specs, P()),
+            check_vma=False))
+        loss, g_blocks, g_rest = fn(blocks, rest, tokens)
+
+        def ref(params):
+            logits = model.apply({"params": params},
+                                 tokens.reshape(M * DP, T))
+            return loss_fn(logits, tokens.reshape(M * DP, T))
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params)
+        np.testing.assert_allclose(float(loss), float(ref_l),
+                                   rtol=1e-5, atol=1e-6)
+        ref_blocks, ref_rest = make_pp_tp_params(ref_g, S, cfg.num_heads)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5),
+            (g_blocks, g_rest), (ref_blocks, ref_rest))

@@ -11,9 +11,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+# Force CPU so a doc build never claims an accelerator. The env var alone
+# is too late in images whose sitecustomize pre-imports jax (conftest.py
+# has the same workaround), so also re-assert through jax.config.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except Exception:
+    pass
 
 MODULES = [
     "horovod_tpu",
@@ -67,6 +74,11 @@ def first_line(obj) -> str:
         return ""              # constants: the builtin docstring is noise
     doc = inspect.getdoc(obj) or ""
     line = doc.strip().split("\n", 1)[0].strip()
+    if " object at 0x" in line:
+        return ""  # synthesized dataclass docstring embeds addresses —
+        # non-deterministic output would churn the committed file
+    if line.startswith("partial(func,"):
+        return ""  # functools boilerplate, not a summary
     return line
 
 
@@ -85,23 +97,17 @@ def main() -> None:
             continue
         symbols = getattr(mod, "__all__", None)
         if not symbols:
-            symbols = [k for k in vars(mod)
+            symbols = [k for k, v in vars(mod).items()
                        if not k.startswith("_") and
-                       getattr(vars(mod)[k], "__module__", name) == name]
+                       not inspect.ismodule(v) and
+                       getattr(v, "__module__", name) == name]
         out.append(f"## `{name}`")
         mline = first_line(mod)
         if mline:
             out.append(f"*{mline}*")
         out.append("")
         for s in symbols:
-            obj = getattr(mod, s, None)
-            if obj is None:
-                try:
-                    obj = getattr(mod, s)
-                except AttributeError:
-                    out.append(f"- `{s}`")
-                    continue
-            line = first_line(obj)
+            line = first_line(getattr(mod, s, None))
             out.append(f"- `{s}`" + (f" — {line}" if line else ""))
         out.append("")
     path = os.path.join(os.path.dirname(os.path.dirname(
