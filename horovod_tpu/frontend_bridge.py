@@ -13,7 +13,32 @@ import numpy as np
 
 from horovod_tpu import core
 
-__all__ = ["to_stacked", "from_stacked"]
+__all__ = ["to_stacked", "from_stacked", "resolve_reduce_op"]
+
+
+def resolve_reduce_op(op, average):
+    """Shared legacy-``average=`` resolution for the frontends (upstream's
+    pre-0.21 API, still accepted with a deprecation upstream).
+
+    In the old signature ``average`` was the SECOND positional parameter,
+    so ``allreduce(t, True)`` from a legacy script lands in ``op`` — and
+    ``Average == 0`` / ``Sum == 1`` are bool-compatible ints that would
+    silently INVERT the requested semantics. A bool ``op`` is therefore
+    interpreted as the positional ``average``; passing both raises, like
+    upstream.
+    """
+    from horovod_tpu.collective import Average, Sum
+    if isinstance(op, bool):
+        if average is not None:
+            raise ValueError(
+                "specify either op= or the legacy average=, not both")
+        op, average = None, op
+    if average is None:
+        return Average if op is None else op
+    if op is not None:
+        raise ValueError(
+            "specify either op= or the legacy average=, not both")
+    return Average if average else Sum
 
 
 def to_stacked(array_like) -> np.ndarray:

@@ -38,8 +38,10 @@ def _require_tf():
         raise RuntimeError(_MSG)
 
 
-def allreduce(tensor, op: int = Average, **kwargs):
+def allreduce(tensor, op=None, average=None, **kwargs):
     _require_tf()
+    from horovod_tpu.frontend_bridge import resolve_reduce_op
+    op = resolve_reduce_op(op, average)
     import horovod_tpu as hvd
     from horovod_tpu.frontend_bridge import from_stacked, to_stacked
     out = hvd.allreduce(to_stacked(tensor.numpy()), op=op, **kwargs)

@@ -101,10 +101,17 @@ def _from_stacked(out, like):
     return torch.from_numpy(from_stacked(out)).to(like.dtype)
 
 
-def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+def _resolve_op(op, average):
+    from horovod_tpu.frontend_bridge import resolve_reduce_op
+    return resolve_reduce_op(op, average)
+
+
+def allreduce(tensor, op: Optional[int] = None, name: Optional[str] = None,
               compression=Compression.none, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0, process_set=None):
+              postscale_factor: float = 1.0, process_set=None,
+              average=None):
     """``hvd.torch.allreduce``: returns a new reduced tensor."""
+    op = _resolve_op(op, average)
     stacked = _to_jax_stacked(tensor)
     out = _run_sync(lambda: _hvd.allreduce(
         stacked, op=op, compression=compression,
@@ -120,9 +127,11 @@ def allreduce_(tensor, **kwargs):
     return tensor
 
 
-def grouped_allreduce(tensors: Iterable, op: int = Average, **kwargs):
+def grouped_allreduce(tensors: Iterable, op: Optional[int] = None,
+                      average=None, **kwargs):
     """Fused: one collective for the whole list (rides the fusion buffer,
     unlike a per-tensor loop)."""
+    op = _resolve_op(op, average)
     tensors = list(tensors)
     stacked = [_to_jax_stacked(t) for t in tensors]
     outs = _run_sync(lambda: _hvd.grouped_allreduce(stacked, op=op,
@@ -235,12 +244,15 @@ def poll(handle) -> bool:
     return handle.poll()
 
 
-def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
+def allreduce_async(tensor, op: Optional[int] = None,
+                    name: Optional[str] = None,
                     compression=Compression.none,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0, process_set=None):
+                    postscale_factor: float = 1.0, process_set=None,
+                    average=None):
     """``hvd.allreduce_async``: enqueue on the dispatch thread (negotiation
     included — the caller is never blocked on peers), return a handle."""
+    op = _resolve_op(op, average)
     stacked = _to_jax_stacked(tensor)
     fut = _submit(lambda: _hvd.allreduce(
         stacked, op=op, compression=compression,
@@ -257,9 +269,11 @@ def allreduce_async_(tensor, **kwargs):
     return h
 
 
-def grouped_allreduce_async(tensors: Iterable, op: int = Average, **kwargs):
+def grouped_allreduce_async(tensors: Iterable, op: Optional[int] = None,
+                            average=None, **kwargs):
     """One fused async collective for the whole list; ``synchronize`` returns
     the list of reduced tensors (``hvd.grouped_allreduce_async``)."""
+    op = _resolve_op(op, average)
     tensors = list(tensors)
     stacked = [_to_jax_stacked(t) for t in tensors]
     fut = _submit(lambda: _hvd.grouped_allreduce(stacked, op=op, **kwargs))
