@@ -28,7 +28,8 @@ def resolve_reduce_op(op, average):
     upstream.
     """
     from horovod_tpu.collective import Average, Sum
-    if isinstance(op, bool):
+    if isinstance(op, (bool, np.bool_)):
+        op = bool(op)
         if average is not None:
             raise ValueError(
                 "specify either op= or the legacy average=, not both")

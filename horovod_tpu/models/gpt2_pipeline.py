@@ -29,10 +29,13 @@ from jax import lax
 from horovod_tpu.models.gpt2 import GPT2Config, Block, loss_fn
 
 __all__ = ["stack_block_params", "stack_block_params_interleaved",
-           "make_pp_tp_params", "block_specs_tp",
+           "make_pp_tp_params", "make_pp_tp_params_interleaved",
+           "block_specs_tp",
            "gpt2_pp_loss", "gpt2_pp_loss_interleaved",
            "gpt2_pp_loss_and_grad", "gpt2_pp_loss_and_grad_interleaved",
-           "gpt2_pp_tp_loss", "gpt2_pp_tp_loss_and_grad"]
+           "gpt2_pp_tp_loss", "gpt2_pp_tp_loss_and_grad",
+           "gpt2_pp_tp_loss_interleaved",
+           "gpt2_pp_tp_loss_and_grad_interleaved"]
 
 
 def stack_block_params(params: dict, num_stages: int) -> Tuple[Any, dict]:
@@ -158,38 +161,37 @@ def gpt2_pp_loss_interleaved(cfg: GPT2Config, blocks: Any, rest: dict,
                     pipeline_loss_interleaved)
 
 
-def gpt2_pp_loss_and_grad_interleaved(cfg: GPT2Config,
-                                      axis_name: str = "pp"):
-    """Interleaved analogue of :func:`gpt2_pp_loss_and_grad`."""
+def _make_loss_and_grad(loss_call, pp_axis: str):
+    """Shared step builder for every pipeline layout: value_and_grad over
+    (blocks, rest) with rest grads psum-ed over the pipe axis (block grads
+    stay local to their stage / tp shard)."""
 
     def step(blocks, rest, tokens):
         def loss(blocks, rest):
-            return gpt2_pp_loss_interleaved(cfg, blocks, rest, tokens,
-                                            axis_name)
+            return loss_call(blocks, rest, tokens)
 
         l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
             blocks, rest)
-        g_rest = lax.psum(g_rest, axis_name)
+        g_rest = lax.psum(g_rest, pp_axis)
         return l, g_blocks, g_rest
 
     return step
+
+
+def gpt2_pp_loss_and_grad_interleaved(cfg: GPT2Config,
+                                      axis_name: str = "pp"):
+    """Interleaved analogue of :func:`gpt2_pp_loss_and_grad`."""
+    return _make_loss_and_grad(
+        lambda b, r, t: gpt2_pp_loss_interleaved(cfg, b, r, t, axis_name),
+        axis_name)
 
 
 def gpt2_pp_loss_and_grad(cfg: GPT2Config, axis_name: str = "pp"):
     """Build a per-device ``(blocks, rest, tokens) -> (loss, grads)`` for use
     under ``shard_map``: block grads stay stage-local (sharded out_spec),
     ``rest`` grads are psum-ed over the pipe axis (replicated out_spec)."""
-
-    def step(blocks, rest, tokens):
-        def loss(blocks, rest):
-            return gpt2_pp_loss(cfg, blocks, rest, tokens, axis_name)
-
-        l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
-            blocks, rest)
-        g_rest = lax.psum(g_rest, axis_name)
-        return l, g_blocks, g_rest
-
-    return step
+    return _make_loss_and_grad(
+        lambda b, r, t: gpt2_pp_loss(cfg, b, r, t, axis_name), axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -209,42 +211,67 @@ def make_pp_tp_params(params: dict, num_stages: int,
     checkpoint still moves losslessly (reshape back restores the plain
     layout). ``num_heads`` disambiguates the head axis."""
     blocks, rest = stack_block_params(params, num_stages)
-    qkv_k = blocks["attn"]["qkv"]["kernel"]         # (S, K, D, 3D)
-    S, K, D, _ = qkv_k.shape
+    return _relayout_heads(blocks, num_heads), rest
+
+
+def make_pp_tp_params_interleaved(params: dict, num_stages: int,
+                                  rounds: int,
+                                  num_heads: int) -> Tuple[Any, dict]:
+    """Interleaved analogue of :func:`make_pp_tp_params`: stack via
+    :func:`stack_block_params_interleaved` to ``(S, R, K, ...)``, then
+    re-lay the attention kernels head-major for tp sharding."""
+    blocks, rest = stack_block_params_interleaved(params, num_stages,
+                                                  rounds)
+    return _relayout_heads(blocks, num_heads), rest
+
+
+def _relayout_heads(blocks: dict, num_heads: int) -> dict:
+    qkv_k = blocks["attn"]["qkv"]["kernel"]   # (..., D, 3D)
+    lead = qkv_k.shape[:-2]
+    D = qkv_k.shape[-2]
     H = num_heads
     hd = D // H
     blocks = dict(blocks)
     blocks["attn"] = dict(blocks["attn"])
     blocks["attn"]["qkv"] = {
-        "kernel": qkv_k.reshape(S, K, D, 3, H, hd),
-        "bias": blocks["attn"]["qkv"]["bias"].reshape(S, K, 3, H, hd),
+        "kernel": qkv_k.reshape(lead + (D, 3, H, hd)),
+        "bias": blocks["attn"]["qkv"]["bias"].reshape(lead + (3, H, hd)),
     }
     blocks["attn"]["out"] = {
-        "kernel": blocks["attn"]["out"]["kernel"].reshape(S, K, H, hd, D),
+        "kernel": blocks["attn"]["out"]["kernel"].reshape(
+            lead + (H, hd, D)),
         "bias": blocks["attn"]["out"]["bias"],
     }
-    return blocks, rest
+    return blocks
 
 
-def block_specs_tp(pp_axis: str = "pp", tp_axis: str = "tp"):
+def block_specs_tp(pp_axis: str = "pp", tp_axis: str = "tp",
+                   extra_dims: int = 0):
     """PartitionSpec pytree for :func:`make_pp_tp_params` blocks: stage axis
     over ``pp``, head/feature axes of the Megatron-parallel kernels over
-    ``tp``, everything else replicated per stage."""
+    ``tp``, everything else replicated per stage. ``extra_dims`` inserts
+    that many replicated dims after the stage axis (1 for the interleaved
+    ``(S, R, K, ...)`` layout's rounds axis)."""
     from jax.sharding import PartitionSpec as P
+    e = (None,) * extra_dims
+
+    def spec(*tail):
+        return P(pp_axis, *e, *tail)
+
     return {
-        "ln1": {"scale": P(pp_axis), "bias": P(pp_axis)},
-        "ln2": {"scale": P(pp_axis), "bias": P(pp_axis)},
+        "ln1": {"scale": spec(), "bias": spec()},
+        "ln2": {"scale": spec(), "bias": spec()},
         "attn": {
-            "qkv": {"kernel": P(pp_axis, None, None, None, tp_axis, None),
-                    "bias": P(pp_axis, None, None, tp_axis, None)},
-            "out": {"kernel": P(pp_axis, None, tp_axis, None, None),
-                    "bias": P(pp_axis)},
+            "qkv": {"kernel": spec(None, None, None, tp_axis, None),
+                    "bias": spec(None, None, tp_axis, None)},
+            "out": {"kernel": spec(None, tp_axis, None, None),
+                    "bias": spec()},
         },
         "mlp": {
-            "fc": {"kernel": P(pp_axis, None, None, tp_axis),
-                   "bias": P(pp_axis, None, tp_axis)},
-            "proj": {"kernel": P(pp_axis, None, tp_axis, None),
-                     "bias": P(pp_axis)},
+            "fc": {"kernel": spec(None, None, tp_axis),
+                   "bias": spec(None, tp_axis)},
+            "proj": {"kernel": spec(None, tp_axis, None),
+                     "bias": spec()},
         },
     }
 
@@ -360,15 +387,29 @@ def gpt2_pp_tp_loss_and_grad(cfg: GPT2Config, pp_axis: str = "pp",
     """Per-device ``(blocks, rest, tokens) -> (loss, grads)`` for the
     pp x tp layout: block grads stay local to their (stage, tp-shard);
     ``rest`` grads psum over ``pp`` only (already tp-replicated)."""
+    return _make_loss_and_grad(
+        lambda b, r, t: gpt2_pp_tp_loss(cfg, b, r, t, pp_axis, tp_axis),
+        pp_axis)
 
-    def step(blocks, rest, tokens):
-        def loss(blocks, rest):
-            return gpt2_pp_tp_loss(cfg, blocks, rest, tokens,
-                                   pp_axis, tp_axis)
 
-        l, (g_blocks, g_rest) = jax.value_and_grad(loss, argnums=(0, 1))(
-            blocks, rest)
-        g_rest = lax.psum(g_rest, pp_axis)
-        return l, g_blocks, g_rest
+def gpt2_pp_tp_loss_interleaved(cfg: GPT2Config, blocks: Any, rest: dict,
+                                tokens: jnp.ndarray, pp_axis: str = "pp",
+                                tp_axis: str = "tp") -> jnp.ndarray:
+    """Interleaved (circular) schedule with Megatron tp inside each virtual
+    stage; ``blocks`` is the local ``(1, R, K, ...)`` shard from
+    :func:`make_pp_tp_params_interleaved` (specs:
+    ``block_specs_tp(extra_dims=1)``)."""
+    from horovod_tpu.parallel.pipeline import pipeline_loss_interleaved
+    return _pp_loss(cfg, blocks, rest, tokens, pp_axis,
+                    pipeline_loss_interleaved,
+                    stage_fn=_stage_fn_tp(cfg, tp_axis))
 
-    return step
+
+def gpt2_pp_tp_loss_and_grad_interleaved(cfg: GPT2Config,
+                                         pp_axis: str = "pp",
+                                         tp_axis: str = "tp"):
+    """Interleaved analogue of :func:`gpt2_pp_tp_loss_and_grad`."""
+    return _make_loss_and_grad(
+        lambda b, r, t: gpt2_pp_tp_loss_interleaved(cfg, b, r, t,
+                                                    pp_axis, tp_axis),
+        pp_axis)
