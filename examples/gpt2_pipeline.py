@@ -42,6 +42,9 @@ def main():
                     help="use the circular schedule with R rounds per "
                          "device (model depth = stages*R*layers-per-stage; "
                          "requires microbatches <= stages)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel width inside every stage "
+                         "(Megatron-in-GPipe; devices = stages * tp)")
     ap.add_argument("--layers-per-stage", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=8)
     ap.add_argument("--microbatch-size", type=int, default=2)
@@ -52,13 +55,14 @@ def main():
     args = ap.parse_args()
 
     hvd.init(axis_name="pp")
-    S = args.stages or hvd.size()
-    if S > len(jax.devices()):
+    TP = max(args.tp, 1)
+    S = args.stages or hvd.size() // TP
+    if S < 1 or S * TP > len(jax.devices()):
         raise SystemExit(
-            f"--stages {S} exceeds the {len(jax.devices())} available "
-            "devices")
-    if hvd.size() != S:
-        hvd.init(devices=jax.devices()[:S], axis_name="pp")
+            f"--stages {S} x --tp {TP} does not fit the "
+            f"{len(jax.devices())} available devices")
+    if hvd.size() != S * TP:
+        hvd.init(devices=jax.devices()[:S * TP], axis_name="pp")
 
     R = max(args.interleave, 0)
     layers = S * args.layers_per_stage * (R or 1)
@@ -84,7 +88,29 @@ def main():
                          jnp.int32)
     params = GPT2(cfg).init(jax.random.PRNGKey(0),
                             tokens.reshape(M * mb, T))["params"]
-    if R:
+    if TP > 1:
+        # Megatron-in-GPipe: every stage's matmuls head/feature-split over
+        # a tp mesh axis (f/g conjugate ops inside the stage body).
+        from horovod_tpu.models.gpt2_pipeline import (
+            block_specs_tp, gpt2_pp_tp_loss_and_grad,
+            gpt2_pp_tp_loss_and_grad_interleaved, make_pp_tp_params,
+            make_pp_tp_params_interleaved)
+        from horovod_tpu.parallel import make_mesh
+        if R:
+            blocks, rest = make_pp_tp_params_interleaved(
+                params, S, R, cfg.num_heads)
+            grad_step = gpt2_pp_tp_loss_and_grad_interleaved(cfg, "pp",
+                                                             "tp")
+            specs = block_specs_tp("pp", "tp", extra_dims=1)
+        else:
+            blocks, rest = make_pp_tp_params(params, S, cfg.num_heads)
+            grad_step = gpt2_pp_tp_loss_and_grad(cfg, "pp", "tp")
+            specs = block_specs_tp("pp", "tp")
+
+        mesh = make_mesh({"pp": S, "tp": TP},
+                         devices=jax.devices()[:S * TP])
+        print(f"tensor-parallel width tp={TP} inside every stage")
+    elif R:
         from horovod_tpu.models.gpt2_pipeline import (
             stack_block_params_interleaved,
             gpt2_pp_loss_and_grad_interleaved)
@@ -102,9 +128,14 @@ def main():
             lambda p, g: p - args.lr * g, rest, g_rest)
         return loss, blocks, rest
 
-    fn = hvd.spmd(train_step,
-                  in_specs=(P("pp"), P(), P()),
-                  out_specs=(P(), P("pp"), P()))
+    if TP > 1:
+        fn = jax.jit(jax.shard_map(
+            train_step, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs, P()), check_vma=False))
+    else:
+        fn = hvd.spmd(train_step,
+                      in_specs=(P("pp"), P(), P()),
+                      out_specs=(P(), P("pp"), P()))
     for step in range(args.steps):
         loss, blocks, rest = fn(blocks, rest, tokens)
         print(f"step {step}: loss {float(loss):.4f}")

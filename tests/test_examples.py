@@ -38,6 +38,16 @@ class TestExamples:
         out = _run("gpt2_pipeline.py", "--steps", "2", "--interleave", "2")
         assert "circular" in out
 
+    def test_gpt2_pipeline_tensor_parallel(self):
+        out = _run("gpt2_pipeline.py", "--steps", "2", "--stages", "4",
+                   "--tp", "2", "--microbatches", "4")
+        assert "tp=2" in out
+
+    def test_gpt2_pipeline_interleaved_tensor_parallel(self):
+        out = _run("gpt2_pipeline.py", "--steps", "2", "--stages", "4",
+                   "--tp", "2", "--interleave", "2", "--microbatches", "4")
+        assert "tp=2" in out and "circular" in out
+
     def test_pytorch_mnist(self):
         out = _run("pytorch_mnist.py", "--steps", "25")
         assert "loss" in out
