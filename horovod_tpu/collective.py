@@ -231,18 +231,19 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
     if op not in _SCALING_OPS and (prescale != 1.0 or postscale != 1.0):
         raise ValueError("prescale/postscale only apply to Sum/Average/Adasum")
 
-    if compression is Compression.int8:
+    wire = getattr(compression, "wire", None)
+    if wire is not None:
         # Quantized allreduce restructures the reduction itself (EQuARX
         # two-phase); see ops/quantized.py. The fusion buffer is packed
         # with every leaf padded to a whole number of quantization blocks,
         # so one leaf's magnitude can never set another leaf's scale.
         if op not in (ReduceOp.Sum, ReduceOp.Average):
             raise ValueError(
-                "int8 quantized allreduce supports Sum and Average")
+                f"{wire} quantized allreduce supports Sum and Average")
         if ps.ranks is not None:
             raise NotImplementedError(
-                "int8 quantized allreduce supports the global process set "
-                "only")
+                f"{wire} quantized allreduce supports the global process "
+                "set only")
         from horovod_tpu.ops.quantized import BLOCK, quantized_allreduce
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -274,7 +275,8 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
         seg = max(BLOCK, (int(fusion_threshold) // 4) // BLOCK * BLOCK)
         pieces = [
             quantized_allreduce(buf[s:s + seg], ps.axis, core.size(),
-                                average=(op == ReduceOp.Average))
+                                average=(op == ReduceOp.Average),
+                                wire=wire)
             for s in range(0, buf.shape[0], seg)
         ]
         out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)

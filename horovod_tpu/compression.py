@@ -63,17 +63,19 @@ class BF16Compressor(_CastCompressor):
     _wire_dtype = jnp.bfloat16
 
 
-class Int8Compressor(Compressor):
-    """Marker for the int8 quantized allreduce (EQuARX-style).
+class _QuantizedMarker(Compressor):
+    """Marker for the quantized allreduce wire formats (EQuARX-style).
 
-    A cast compressor cannot express int8 correctly — summing quantized
+    A cast compressor cannot express these correctly — summing quantized
     values overflows and mixes scales — so the collective layer routes
-    this marker to ``ops.quantized.quantized_allreduce``, which
+    the marker to ``ops.quantized.quantized_allreduce``, which
     restructures the reduction (quantize → all_to_all → fp32 reduce →
     re-quantize → all_gather). Sum/Average over the global set only.
     ``compress``/``decompress`` are identity so any accidental use outside
     allreduce degrades to uncompressed, never to wrong numbers.
     """
+
+    wire = None  # "int8" | "fp8"
 
     @staticmethod
     def compress(tensor):
@@ -84,10 +86,22 @@ class Int8Compressor(Compressor):
         return tensor
 
 
+class Int8Compressor(_QuantizedMarker):
+    """int8 wire: uniform steps over each block's max-abs range."""
+    wire = "int8"
+
+
+class FP8Compressor(_QuantizedMarker):
+    """float8_e4m3fn wire: block max scaled to 448; log-spaced mantissas
+    keep relative precision for small values inside outlier blocks."""
+    wire = "fp8"
+
+
 class Compression:
     """Namespace matching ``hvd.Compression`` (upstream compression.py),
-    plus TPU-native bf16 and the quantized-allreduce int8 marker."""
+    plus TPU-native bf16 and the quantized-allreduce int8/fp8 markers."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    fp8 = FP8Compressor

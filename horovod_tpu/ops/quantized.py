@@ -1,46 +1,82 @@
-"""Quantized allreduce: int8 wire format with per-block scales.
+"""Quantized allreduce: 1-byte wire formats with per-block scales.
 
 EQuARX-style (PAPERS.md: "Efficient Quantized AllReduce in XLA"): a plain
-cast-to-int8 compressor would be numerically wrong — the *sum* would
-overflow and mix scales — so the reduction is restructured into the
-two-phase form where dequantization happens at every reduction point:
+cast compressor would be numerically wrong — the *sum* would overflow and
+mix scales — so the reduction is restructured into the two-phase form
+where dequantization happens at every reduction point:
 
 1. **reduce-scatter phase**: each device splits its buffer into one chunk
    per peer, quantizes with a scale per fixed-size *block* (``BLOCK``
    elements — fine-grained, so a large-magnitude layer sharing a fused
    bucket with a small-magnitude layer cannot flush the latter to zero),
-   ships int8 + scales with a single ``all_to_all``, dequantizes the
-   received contributions in fp32 and reduces its owned chunk exactly.
+   ships the 1-byte payload + scales with a single ``all_to_all``,
+   dequantizes the received contributions in fp32 and reduces its owned
+   chunk exactly.
 2. **allgather phase**: the reduced chunk is re-quantized (fresh per-block
    scales) and ``all_gather`` reassembles the full result everywhere.
 
+Two wire formats share the structure:
+
+* ``"int8"`` — uniform steps over the block range; error bounded by half
+  an int8 step of the block's max-abs.
+* ``"fp8"`` — ``float8_e4m3fn`` scaled so the block max hits 448 (the
+  format's max): log-spaced mantissas keep *relative* precision for the
+  small values inside a block with outliers, where int8's uniform grid
+  flushes them toward zero. Caveat: e4m3's dynamic range is ~2.3e5
+  (448 down to the 2^-9 subnormal floor), so within-block ratios beyond
+  that still underflow — the per-BLOCK scale granularity is what keeps
+  ratios small in practice.
+
 Wire traffic is ~1/4 of fp32 (~1/2 of bf16) plus one fp32 scale per
-``BLOCK`` int8 values (1.6 % overhead at the default 256); the error is
-bounded by half an int8 step of each *block's* max-abs. Exposed through
-``hvd.allreduce(..., compression=Compression.int8)`` /
-``DistributedOptimizer(compression=Compression.int8)``.
+``BLOCK`` values (1.6 % overhead at the default 256). Exposed through
+``hvd.allreduce(..., compression=Compression.int8 / Compression.fp8)`` /
+``DistributedOptimizer(compression=...)``.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-__all__ = ["quantized_allreduce", "BLOCK"]
+__all__ = ["quantized_allreduce", "BLOCK", "WIRE_FORMATS"]
 
 # Elements sharing one quantization scale. Must divide the padded chunk.
 BLOCK = 256
 
+WIRE_FORMATS = ("int8", "fp8")
 
-def _quantize_blocks(x: jnp.ndarray):
-    """(..., L) with L % BLOCK == 0 -> (int8 (..., L), scales (..., L/BLOCK))
-    using symmetric per-block max-abs scales."""
+_F8 = jnp.float8_e4m3fn
+_F8_MAX = 448.0
+
+
+def _blockify(x: jnp.ndarray):
     shape = x.shape
-    blocks = x.reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+    return x.reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK)), shape
+
+
+def _quantize_blocks(x: jnp.ndarray, wire: str = "int8"):
+    """(..., L) with L % BLOCK == 0 -> (1-byte (..., L), scales
+    (..., L/BLOCK)) using symmetric per-block max-abs scales."""
+    blocks, shape = _blockify(x)
     absmax = jnp.max(jnp.abs(blocks), axis=-1)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(blocks / scale[..., None]), -127,
-                 127).astype(jnp.int8)
+    if wire == "int8":
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(blocks / scale[..., None]), -127,
+                     127).astype(jnp.int8)
+    elif wire == "fp8":
+        # Floor at the smallest fp32 normal: an fp32-SUBNORMAL absmax
+        # would underflow absmax/448 to 0.0 and blocks/0 -> inf -> NaN in
+        # the e4m3 cast; such blocks instead keep scale 1 and flush to ~0
+        # (matching the int8 path's graceful degradation). The clip guards
+        # the cast against scale-rounding overflow past 448.
+        scale = jnp.where(absmax > np.float32(1.2e-38),
+                          absmax / _F8_MAX, 1.0)
+        q = jnp.clip(blocks / scale[..., None],
+                     -_F8_MAX, _F8_MAX).astype(_F8)
+    else:
+        raise ValueError(f"unknown wire format {wire!r}; expected one of "
+                         f"{WIRE_FORMATS}")
     return q.reshape(shape), scale
 
 
@@ -52,9 +88,11 @@ def _dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
 
 
 def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
-                        average: bool = True) -> jnp.ndarray:
-    """Allreduce ``x`` (any shape) across ``axis_name`` with int8 wire
-    format; call inside shard_map over the full axis."""
+                        average: bool = True,
+                        wire: str = "int8") -> jnp.ndarray:
+    """Allreduce ``x`` (any shape) across ``axis_name`` with a 1-byte wire
+    format (``"int8"`` or ``"fp8"``); call inside shard_map over the full
+    axis."""
     n = axis_size
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).ravel()
@@ -67,7 +105,7 @@ def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
 
     # Phase 1: quantize per destination chunk (per-block scales),
     # all_to_all, exact fp32 reduction of the owned chunk.
-    q, scale = _quantize_blocks(chunks)            # (n, c), (n, c/BLOCK)
+    q, scale = _quantize_blocks(chunks, wire)      # (n, c), (n, c/BLOCK)
     q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
     s_recv = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
     part = jnp.sum(_dequantize_blocks(q_recv, s_recv), axis=0)    # (c,)
@@ -75,7 +113,7 @@ def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
         part = part / n
 
     # Phase 2: re-quantize the owned reduced chunk, allgather everywhere.
-    q2, s2 = _quantize_blocks(part)
+    q2, s2 = _quantize_blocks(part, wire)
     qg = lax.all_gather(q2, axis_name)                       # (n, c)
     sg = lax.all_gather(s2, axis_name)                       # (n, c/BLOCK)
     out = _dequantize_blocks(qg, sg).reshape(n * c)[:L]

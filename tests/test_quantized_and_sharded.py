@@ -63,6 +63,68 @@ class TestQuantizedAllreduce:
             hvd.remove_process_set(ps)
 
 
+class TestFP8Allreduce:
+    """float8_e4m3fn wire format: same two-phase structure, log-spaced
+    mantissas inside each block."""
+
+    def test_average_within_fp8_error(self, rng):
+        x = rng.standard_normal((N, 1000)).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, compression=hvd.Compression.fp8))
+        want = x.mean(0)
+        # e4m3: 3 mantissa bits -> relative step 2^-3; two quantization
+        # points (per-contribution + re-quantize) bound the error at a few
+        # eighths of the magnitude scale.
+        bound = 0.5 * np.abs(x).max() / 8
+        assert np.abs(out[0] - want).max() < bound
+        np.testing.assert_allclose(out[0], out[-1], rtol=1e-6)
+
+    def test_sum_odd_length(self, rng):
+        x = rng.standard_normal((N, 257)).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum,
+                                       compression=hvd.Compression.fp8))
+        want = x.sum(0)
+        bound = N * np.abs(x).max() / 8
+        assert np.abs(out[0] - want).max() < bound
+
+    def test_relative_precision_survives_outlier_block(self, rng):
+        # One outlier in the block (ratio 1e4, inside e4m3's ~2.3e5
+        # dynamic range): int8's uniform grid snaps the small values to
+        # multiples of max/127 (=0.79 -> flushed to 0); fp8 keeps ~2^-4
+        # RELATIVE error on them.
+        x = np.full((N, 256), 1e-2, np.float32)
+        x[:, 0] = 100.0
+        small_want = x[:, 1:].mean(0)
+        out8 = np.asarray(hvd.allreduce(
+            x, compression=hvd.Compression.int8))[0][1:]
+        outf8 = np.asarray(hvd.allreduce(
+            x, compression=hvd.Compression.fp8))[0][1:]
+        err8 = np.abs(out8 - small_want).max()
+        errf8 = np.abs(outf8 - small_want).max()
+        assert errf8 < err8          # int8 flushed them
+        assert errf8 < 1e-2 / 4      # fp8 keeps relative precision
+
+    def test_zero_and_guards(self, rng):
+        x = np.zeros((N, 64), np.float32)
+        out = np.asarray(hvd.allreduce(x, compression=hvd.Compression.fp8))
+        np.testing.assert_array_equal(out, 0.0)
+        y = rng.standard_normal((N, 8)).astype(np.float32)
+        with pytest.raises(ValueError, match="Sum and Average"):
+            hvd.allreduce(y, op=hvd.Max, compression=hvd.Compression.fp8)
+
+    def test_subnormal_block_flushes_not_nans(self):
+        # fp32-subnormal magnitudes: the scale would underflow to 0 and
+        # NaN the e4m3 cast without the floor; must flush to ~0 like int8.
+        x = np.full((N, 256), 1e-44, np.float32)
+        out = np.asarray(hvd.allreduce(x, compression=hvd.Compression.fp8))
+        assert np.isfinite(out).all()
+        assert np.abs(out).max() < 1e-6
+
+    def test_unknown_wire_rejected(self):
+        from horovod_tpu.ops.quantized import _quantize_blocks
+        with pytest.raises(ValueError, match="unknown wire format"):
+            _quantize_blocks(jnp.zeros((256,)), "int4")
+
+
 class TestShardedAdamW:
     def _tree(self, rng):
         return {"w": rng.standard_normal((13, 7)).astype(np.float32),
