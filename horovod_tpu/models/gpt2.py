@@ -41,6 +41,11 @@ class GPT2Config:
     # striped_lm_loss cover every token pair exactly; feed tokens striped:
     # shard r holds positions r, r+n, r+2n, ...
     ring_layout: str = "contiguous"
+    # "ring" | "ulysses": sequence-parallel mechanism. Ring hops K/V blocks
+    # device-to-device (ppermute; composes with ring_layout); Ulysses
+    # all-to-alls heads<->sequence so each device runs ordinary full-
+    # sequence attention on a head subset (contiguous layout only).
+    sp_impl: str = "ring"
     # "dense" | "flash" (fused pallas kernel, single-device/dp layouts).
     attention: str = "dense"
     # Optional (block_q, block_k) flash tiling override; feed
@@ -78,7 +83,15 @@ class Attention(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
-            if cfg.attention == "flash":
+            if cfg.sp_impl == "ulysses":
+                from horovod_tpu.ops.sequence import ulysses_attention
+                blocks = {}
+                if cfg.flash_blocks is not None:
+                    blocks = {"block_q": int(cfg.flash_blocks[0]),
+                              "block_k": int(cfg.flash_blocks[1])}
+                o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
+                                      impl=cfg.attention, **blocks)
+            elif cfg.attention == "flash":
                 from horovod_tpu.ops.ring_flash import ring_flash_attention
                 o = ring_flash_attention(q, k, v, axis_name="sp", causal=True,
                                          layout=cfg.ring_layout)
@@ -141,6 +154,24 @@ class GPT2(nn.Module):
             raise ValueError(
                 f"unknown attention impl {cfg.attention!r} for the ring "
                 "path; expected 'dense' or 'flash'")
+        if cfg.use_ring_attention and cfg.sp_impl not in ("ring",
+                                                          "ulysses"):
+            raise ValueError(
+                f"unknown sp_impl {cfg.sp_impl!r}; expected 'ring' or "
+                "'ulysses'")
+        if cfg.use_ring_attention and cfg.ring_layout not in (
+                "contiguous", "striped"):
+            # A typo here would silently fall back to contiguous positions
+            # against striped-ordered tokens — wrong logits, no error.
+            raise ValueError(
+                f"unknown ring_layout {cfg.ring_layout!r}; expected "
+                "'contiguous' or 'striped'")
+        if cfg.use_ring_attention and cfg.sp_impl == "ulysses" and \
+                cfg.ring_layout == "striped":
+            raise ValueError(
+                "ulysses sequence parallelism gathers the full sequence "
+                "per head — positions are globally contiguous; use "
+                "ring_layout='contiguous'")
         B, T = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)

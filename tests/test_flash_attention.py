@@ -352,3 +352,55 @@ def test_gpt2_striped_sp_matches_single_device(rng):
         # every shard returns the same replicated global loss
         np.testing.assert_allclose(np.asarray(losses),
                                    float(ref_loss), rtol=1e-4)
+
+
+def test_gpt2_ulysses_matches_single_device(rng):
+    """sp_impl='ulysses': all-to-all sequence parallelism in the model zoo —
+    dense and flash local attention both equal the single-device model."""
+    import horovod_tpu as hvd
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+
+    tokens = jnp.asarray(rng.integers(0, 256, (2, 64)), jnp.int32)
+    params = GPT2(GPT2Config.tiny(dtype=jnp.float32)).init(
+        jax.random.PRNGKey(0), tokens[:, :8])
+
+    def run(attention):
+        cfg = GPT2Config.tiny(dtype=jnp.float32, use_ring_attention=True,
+                              sp_impl="ulysses", attention=attention)
+        model = GPT2(cfg)
+        hvd.init(axis_name="sp")
+        try:
+            fwd = hvd.spmd(lambda p, t: model.apply(p, t),
+                           in_specs=(P(), P(None, "sp")),
+                           out_specs=P(None, "sp"))
+            return np.asarray(fwd(params, tokens))
+        finally:
+            hvd.init()
+
+    want = np.asarray(GPT2(GPT2Config.tiny(dtype=jnp.float32))
+                      .apply(params, tokens))
+    np.testing.assert_allclose(run("dense"), want, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(run("flash"), want, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt2_ulysses_rejects_striped_layout():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(use_ring_attention=True, sp_impl="ulysses",
+                          ring_layout="striped")
+    with pytest.raises(ValueError, match="contiguous"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_gpt2_unknown_sp_impl_rejected():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(use_ring_attention=True, sp_impl="ringish")
+    with pytest.raises(ValueError, match="sp_impl"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_gpt2_unknown_ring_layout_rejected():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(use_ring_attention=True, ring_layout="stripe")
+    with pytest.raises(ValueError, match="ring_layout"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
