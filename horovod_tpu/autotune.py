@@ -124,9 +124,11 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
         def _sync(out):
             # Host fetch: block_until_ready is unreliable over some PJRT
             # transports (see ROOFLINE.md); fetching one element of the
-            # last result bounds the serialized device queue.
+            # last result bounds the serialized device queue. Slice ON
+            # DEVICE first so only one scalar crosses the transport — a
+            # full-tensor device_get would land inside the timed window.
             leaf = jax.tree_util.tree_leaves(out)[0]
-            np.asarray(jax.device_get(leaf)).ravel()[:1]
+            np.asarray(jax.device_get(leaf.ravel()[:1]))
 
         try:
             out = fn(q, k, v)

@@ -916,10 +916,26 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
             # ordering contract.
             seq = _SUBSET_BARRIER_SEQ.get(ps.process_set_id, 0)
             _SUBSET_BARRIER_SEQ[ps.process_set_id] = seq + 1
-            distributed.global_state.client.wait_at_barrier(
-                f"hvdtpu_ps{ps.process_set_id}_b{seq}",
-                timeout_in_ms=10 * 60 * 1000,
-                process_ids=list(member_procs))
+            from horovod_tpu.config import get_config
+            timeout_s = get_config().barrier_timeout_seconds
+            try:
+                distributed.global_state.client.wait_at_barrier(
+                    f"hvdtpu_ps{ps.process_set_id}_b{seq}",
+                    timeout_in_ms=int(timeout_s * 1000),
+                    process_ids=list(member_procs))
+            except Exception as e:
+                msg = str(e)
+                if "DEADLINE_EXCEEDED" in msg or "imed out" in msg:
+                    raise RuntimeError(
+                        f"subset barrier {seq} on process set "
+                        f"{ps.process_set_id} timed out after "
+                        f"{timeout_s:.0f}s (HOROVOD_BARRIER_TIMEOUT). If "
+                        f"another member raised out of an earlier "
+                        f"collective, its barrier sequence number no "
+                        f"longer matches this process's — every member "
+                        f"must issue the same number of barriers on a "
+                        f"process set.") from e
+                raise
             return
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("horovod_tpu_barrier")

@@ -57,6 +57,9 @@ class Config:
     stall_check_time_seconds: float = 60.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
+    # Subset-barrier wait (collective.barrier on a process set); its own
+    # knob so tuning elastic failover never shortens unrelated barriers.
+    barrier_timeout_seconds: float = 600.0
     # NOTE: HOROVOD_HIERARCHICAL_ALLREDUCE is deliberately NOT mirrored
     # here — collective.py/adasum.py read it at call time so tests and
     # scripts can toggle it between collectives without a refresh().
@@ -94,6 +97,8 @@ def refresh() -> Config:
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
+        barrier_timeout_seconds=max(
+            1.0, _env_float("HOROVOD_BARRIER_TIMEOUT", 600.0)),
         log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
         inert={k: reason for k, reason in _INERT_VARS.items()
                if os.environ.get(k)},

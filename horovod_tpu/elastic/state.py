@@ -144,8 +144,7 @@ class JaxState(State):
         from horovod_tpu import collective as C
         if jax.process_count() > 1:
             self._saved_pytrees = C.broadcast_object(self._saved_pytrees, 0)
-            self._saved_attrs = C.broadcast_object(
-                _picklable_attrs(self._saved_attrs, self._warn), 0)
+            self._saved_attrs = _sync_attrs(self._saved_attrs, self._warn)
         self.restore()
 
     def save(self, path: str) -> None:
@@ -176,6 +175,31 @@ class JaxState(State):
         self._saved_attrs = blob["attrs"]
         self.commit_count = blob["commit_count"]
         self.restore()
+
+
+def _sync_attrs(saved: Dict[str, Any], warned: set,
+                broadcast_fn=None) -> Dict[str, Any]:
+    """Broadcast committed attrs from the coordinator. The coordinator also
+    announces WHICH keys its pickle filter dropped (loader handles, locks):
+    every rank keeps its local value for exactly those keys — the
+    coordinator must not lose a usable unpicklable attr just because it
+    cannot cross the wire, while keys that are picklable on the coordinator
+    still converge on all ranks (and keys the coordinator never had are
+    removed, so ranks agree)."""
+    if broadcast_fn is None:
+        from horovod_tpu import collective as C
+        broadcast_fn = C.broadcast_object
+    if jax.process_index() == 0:
+        filtered = _picklable_attrs(saved, warned)
+        payload = (filtered, sorted(set(saved) - set(filtered)))
+    else:
+        payload = ({}, [])   # ignored: broadcast ships the root's payload
+    wire, dropped = broadcast_fn(payload, 0)
+    merged = dict(wire)
+    for k in dropped:
+        if k in saved:
+            merged[k] = saved[k]
+    return merged
 
 
 def _is_pytree_of_arrays(v: Any) -> bool:
@@ -281,8 +305,8 @@ class TorchState(_AttrState):
             # change mid-step), and host collectives must stay ordered.
             from horovod_tpu.torch import broadcast_object
             self._saved = broadcast_object(self._saved, 0)
-            self._saved_attrs = broadcast_object(
-                _picklable_attrs(self._saved_attrs, self._warn), 0)
+            self._saved_attrs = _sync_attrs(self._saved_attrs, self._warn,
+                                            broadcast_fn=broadcast_object)
         self.restore()
 
 
@@ -348,5 +372,5 @@ class TensorFlowKerasState(_AttrState):
         from horovod_tpu import collective as C
         if jax.process_count() > 1:
             self._saved = C.broadcast_object(self._saved, 0)
-            self._saved_attrs = C.broadcast_object(self._saved_attrs, 0)
+            self._saved_attrs = _sync_attrs(self._saved_attrs, self._warn)
         self.restore()

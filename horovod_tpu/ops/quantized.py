@@ -61,16 +61,21 @@ def _quantize_blocks(x: jnp.ndarray, wire: str = "int8"):
     blocks, shape = _blockify(x)
     absmax = jnp.max(jnp.abs(blocks), axis=-1)
     if wire == "int8":
-        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        # Same derived-scale floor as fp8 below: absmax/127 must be a
+        # normal fp32, else TPU FTZ flushes it to 0 and zeros become NaN.
+        scale = jnp.where(absmax > np.float32(127.0 * np.finfo(np.float32).tiny),
+                          absmax / 127.0, 1.0)
         q = jnp.clip(jnp.round(blocks / scale[..., None]), -127,
                      127).astype(jnp.int8)
     elif wire == "fp8":
-        # Floor at the smallest fp32 normal: an fp32-SUBNORMAL absmax
-        # would underflow absmax/448 to 0.0 and blocks/0 -> inf -> NaN in
-        # the e4m3 cast; such blocks instead keep scale 1 and flush to ~0
-        # (matching the int8 path's graceful degradation). The clip guards
-        # the cast against scale-rounding overflow past 448.
-        scale = jnp.where(absmax > np.float32(1.2e-38),
+        # Floor absmax so that the DERIVED scale absmax/448 is a normal
+        # fp32 value: for absmax in (tiny, 448*tiny) the quotient is
+        # itself fp32-subnormal and flushes to 0 on TPU, making exact-zero
+        # elements 0/0 = NaN through the e4m3 cast. Blocks below the floor
+        # keep scale 1 and flush to ~0 (matching the int8 path's graceful
+        # degradation). The clip guards the cast against scale-rounding
+        # overflow past 448.
+        scale = jnp.where(absmax > np.float32(_F8_MAX * np.finfo(np.float32).tiny),
                           absmax / _F8_MAX, 1.0)
         q = jnp.clip(blocks / scale[..., None],
                      -_F8_MAX, _F8_MAX).astype(_F8)
