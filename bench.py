@@ -18,6 +18,8 @@ update under one jit with donated state.
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 from functools import partial
@@ -47,8 +49,10 @@ def _peak_tflops():
 def _sync(x):
     """Host fetch (block_until_ready is unreliable over some PJRT
     transports); the device queue serializes programs, so fetching the last
-    result bounds them all."""
-    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0])).ravel()[:1]
+    result bounds them all. Slice ON DEVICE first so only one scalar
+    crosses the transport — a full-leaf device_get would land inside the
+    timed window and deflate every reported throughput."""
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0].ravel()[:1]))
 
 
 def _measure(step, state, extra, steps):
@@ -318,12 +322,7 @@ _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
             "allreduce": bench_allreduce}
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50",
-                   choices=list(_BENCHES) + ["all"])
-    args = p.parse_args()
-    import os
+def _inner_main(args):
     if os.environ.get("JAX_PLATFORMS"):
         # The image's sitecustomize imports jax before env vars can apply;
         # honor an explicit platform request (e.g. the virtual CPU mesh).
@@ -337,6 +336,104 @@ def main():
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)
+
+
+_HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
+                    "all": "resnet50_images_per_sec_per_chip",
+                    "gpt2": "gpt2_medium_tokens_per_sec_per_chip",
+                    "bert": "bert_large_tokens_per_sec_per_chip",
+                    "vit": "vit_b16_images_per_sec_per_chip",
+                    "mnist": "mnist_images_per_sec_per_chip",
+                    "allreduce": "allreduce_scaling_efficiency"}
+
+
+def _probe_backend(timeout_s: float) -> str:
+    """Check the TPU backend from a SUBPROCESS with a hard deadline.
+
+    The relay has two failure modes (BENCH_r02: rc=1 UNAVAILABLE; and a
+    wedge where ``jax.devices()`` hangs forever) — neither is recoverable
+    in-process, so the probe must be a child we can kill. Returns "ok",
+    "hang", or the error tail."""
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "print('HVD_PROBE_OK', d[0].platform, len(d))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return "hang"
+    if r.returncode == 0 and "HVD_PROBE_OK" in r.stdout:
+        return "ok"
+    return (r.stderr or r.stdout).strip()[-400:] or f"rc={r.returncode}"
+
+
+def _supervise(args) -> int:
+    """Run the bench as a supervised child so a relay wedge yields an
+    honest JSON line (value null + reason) instead of rc=1 or a silent
+    hang — the driver records the last JSON line whatever happens."""
+    probe_timeout = float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT", "60"))
+    attempts = int(os.environ.get("HVD_BENCH_PROBE_ATTEMPTS", "5"))
+    backoff = float(os.environ.get("HVD_BENCH_PROBE_BACKOFF", "90"))
+    run_timeout = float(os.environ.get("HVD_BENCH_RUN_TIMEOUT", "2700"))
+
+    def give_up(reason, note, rc=0):
+        print(json.dumps({
+            "metric": _HEADLINE_METRIC.get(
+                args.model, f"{args.model}_unavailable"),
+            "value": None, "unit": "unavailable", "vs_baseline": None,
+            "error": reason, "note": note}), flush=True)
+        return rc
+
+    relay_note = ("TPU relay unreachable at bench time; see ROOFLINE.md "
+                  "for the last self-measured numbers on this code.")
+
+    last = None
+    for i in range(attempts):
+        if i:
+            time.sleep(backoff)
+        last = _probe_backend(probe_timeout)
+        print(f"# probe {i + 1}/{attempts}: "
+              f"{'ok' if last == 'ok' else last!r}", file=sys.stderr,
+              flush=True)
+        if last == "ok":
+            break
+    else:
+        kind = "hung (relay wedge)" if last == "hang" else f"failed: {last}"
+        return give_up(f"TPU backend probe {kind} "
+                       f"x{attempts} over ~{attempts * backoff / 60:.0f}min",
+                       relay_note)
+
+    # Backend answers — run the real bench with a deadline in case the
+    # relay wedges mid-run (compiles + 6 configs fit well inside it).
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--model", args.model, "--inner"]
+    try:
+        r = subprocess.run(cmd, timeout=run_timeout)
+    except subprocess.TimeoutExpired:
+        return give_up(f"bench run exceeded {run_timeout:.0f}s "
+                       f"(relay wedged mid-run)", relay_note)
+    if r.returncode != 0:
+        # The probe just proved the relay reachable, so a crashing child
+        # is most likely a CODE regression — say so and keep the nonzero
+        # rc so gates notice; the JSON line still carries the detail.
+        return give_up(f"bench run exited rc={r.returncode} "
+                       f"after a successful backend probe",
+                       "bench child crashed after a healthy backend probe "
+                       "— likely a code regression, not the relay.", rc=1)
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=list(_BENCHES) + ["all"])
+    p.add_argument("--inner", action="store_true",
+                   help="run directly in-process (no probe/supervision)")
+    args = p.parse_args()
+    if args.inner or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # Explicit CPU runs (tests, virtual mesh) never touch the relay.
+        return _inner_main(args)
+    return _supervise(args)
 
 
 if __name__ == "__main__":

@@ -235,3 +235,42 @@ class TestDiscovery:
             assert not mgr.changed
         finally:
             mgr.stop()
+
+
+class TestSyncAttrsMerge:
+    """_sync_attrs wire protocol: picklable attrs converge on the root's
+    values; keys the ROOT's filter dropped keep each rank's local value;
+    keys the root never had are removed."""
+
+    def _run(self, saved, root_payload):
+        from horovod_tpu.elastic.state import _sync_attrs
+        calls = []
+
+        def fake_broadcast(payload, root):
+            calls.append((payload, root))
+            return root_payload   # what the root shipped
+
+        out = _sync_attrs(saved, warned=set(), broadcast_fn=fake_broadcast)
+        return out, calls
+
+    def test_root_values_win_for_picklable_keys(self):
+        out, calls = self._run({"step": 9, "lr": 0.5},
+                               root_payload=({"step": 3, "lr": 0.1}, []))
+        assert out == {"step": 3, "lr": 0.1}
+        assert calls[0][1] == 0
+
+    def test_dropped_keys_keep_local_value(self):
+        lock = object()
+        out, _ = self._run({"step": 9, "loader": lock},
+                           root_payload=({"step": 3}, ["loader"]))
+        assert out["step"] == 3 and out["loader"] is lock
+
+    def test_dropped_key_absent_locally_is_skipped(self):
+        out, _ = self._run({"step": 9},
+                           root_payload=({"step": 3}, ["loader"]))
+        assert out == {"step": 3}
+
+    def test_keys_root_never_had_are_removed(self):
+        out, _ = self._run({"step": 9, "stale": 1},
+                           root_payload=({"step": 3}, []))
+        assert out == {"step": 3}
