@@ -363,6 +363,12 @@ def _probe_backend(timeout_s: float) -> str:
     except subprocess.TimeoutExpired:
         return "hang"
     if r.returncode == 0 and "HVD_PROBE_OK" in r.stdout:
+        platform = r.stdout.split("HVD_PROBE_OK", 1)[1].split()[0]
+        if platform == "cpu":
+            # jax fell back to CPU after a non-fatal relay failure: a
+            # "successful" run here would publish CPU numbers under the
+            # TPU metric names — treat as a failed probe instead.
+            return "backend fell back to cpu (TPU relay init failed)"
         return "ok"
     return (r.stderr or r.stdout).strip()[-400:] or f"rc={r.returncode}"
 
@@ -399,8 +405,10 @@ def _supervise(args) -> int:
             break
     else:
         kind = "hung (relay wedge)" if last == "hang" else f"failed: {last}"
+        waited = (attempts - 1) * backoff + attempts * (
+            probe_timeout if last == "hang" else 0)
         return give_up(f"TPU backend probe {kind} "
-                       f"x{attempts} over ~{attempts * backoff / 60:.0f}min",
+                       f"x{attempts} over ~{waited / 60:.0f}min",
                        relay_note)
 
     # Backend answers — run the real bench with a deadline in case the

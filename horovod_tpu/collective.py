@@ -783,7 +783,10 @@ def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
     * **Eager**: ``tensor`` is a length-n sequence (entry r = rank r's
       array), ``splits`` an (n, n) matrix (row r = rank r's send counts).
       Returns the per-rank list of concatenated received rows, exactly
-      upstream's semantics.
+      upstream's semantics. Multi-process: entries for other processes'
+      ranks are ``None`` (their rows live on their processes); the torch
+      frontend's ``alltoall(tensor, splits)`` wraps this with the
+      per-process size exchange.
     """
     ps = _resolve_ps(process_set)
     if splits is None:
@@ -856,6 +859,19 @@ def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
     recv, rsplits = _eager_run(
         "ragged_alltoall", (stacked, sp_dev), (ps,), (_ps_key(ps),),
         negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))))
+    if jax.process_count() > 1:
+        # Only this process's row of the stacked outputs is addressable;
+        # read it off the local shard (a direct np.asarray of the sharded
+        # result would raise). Foreign ranks' entries are None — their
+        # rows live on their processes, exactly upstream's locality.
+        from horovod_tpu.frontend_bridge import from_stacked
+        me = core.rank()
+        recv_local = from_stacked(recv)          # (n, T, ...)
+        rsp_local = from_stacked(rsplits)        # (n,)
+        segs = [recv_local[j, : int(rsp_local[j])] for j in range(n)]
+        mine = (np.concatenate(segs) if segs
+                else recv_local[0, :0])
+        return [mine if r == me else None for r in range(n)]
     rsplits = np.asarray(rsplits)               # (n, n)
     outs = []
     for r in range(n):

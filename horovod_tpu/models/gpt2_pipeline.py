@@ -33,6 +33,7 @@ __all__ = ["stack_block_params", "stack_block_params_interleaved",
            "block_specs_tp",
            "gpt2_pp_loss", "gpt2_pp_loss_interleaved",
            "gpt2_pp_loss_and_grad", "gpt2_pp_loss_and_grad_interleaved",
+           "gpt2_pp_1f1b_loss_and_grad",
            "gpt2_pp_tp_loss", "gpt2_pp_tp_loss_and_grad",
            "gpt2_pp_tp_loss_interleaved",
            "gpt2_pp_tp_loss_and_grad_interleaved"]
@@ -139,11 +140,16 @@ def _pp_loss(cfg: GPT2Config, blocks: Any, rest: dict, tokens: jnp.ndarray,
 
     ln_f = nn.LayerNorm(dtype=jnp.float32)
 
-    def loss_from_outputs(outs):
-        h = outs.reshape((M * mb, T, -1))
+    def loss_from_outputs(outs, mb_start):
+        # two-arg chunking form: outs may be a sub-range of the M
+        # microbatches starting at static mb_start (interleaved schedules
+        # with M > S chunk automatically); targets follow the slice.
+        Mc = outs.shape[0]
+        h = outs.reshape((Mc * mb, T, -1))
         h = ln_f.apply({"params": rest["ln_f"]}, h)
         logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32), wte)
-        return loss_fn(logits, tokens.reshape(M * mb, T))
+        tgt = lax.dynamic_slice_in_dim(tokens, mb_start, Mc, 0)
+        return loss_fn(logits, tgt.reshape(Mc * mb, T))
 
     return pipeline_fn(stage_fn if stage_fn is not None else _stage_fn(cfg),
                        blocks, x, loss_from_outputs, axis_name)
@@ -413,3 +419,56 @@ def gpt2_pp_tp_loss_and_grad_interleaved(cfg: GPT2Config,
         lambda b, r, t: gpt2_pp_tp_loss_interleaved(cfg, b, r, t,
                                                     pp_axis, tp_axis),
         pp_axis)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def gpt2_pp_1f1b_loss_and_grad(cfg: GPT2Config, axis_name: str = "pp"):
+    """GPT-2 on the hand-scheduled 1F1B pipeline: a per-device
+    ``(blocks, rest, tokens) -> (loss, g_blocks, g_rest)`` step for use
+    under ``shard_map``, same contract as :func:`gpt2_pp_loss_and_grad`
+    but with the activation stash bounded at ``min(2S-1, M)`` microbatches
+    (see :func:`horovod_tpu.parallel.pipeline.pipeline_1f1b`).
+
+    The embedding runs outside the pipeline core with its own ``jax.vjp``
+    (stage 0's input cotangents chain into ``wte``/``wpe`` grads) and the
+    final LN + tied head run inside the per-microbatch loss (their grads
+    surface on the last stage); both land in ``g_rest`` which is psum-ed
+    over the pipe axis exactly like the GPipe step.
+    """
+    from horovod_tpu.parallel.pipeline import pipeline_1f1b
+
+    stage_fn = _stage_fn(cfg)
+    ln_f = nn.LayerNorm(dtype=jnp.float32)
+
+    def step(blocks, rest, tokens):
+        blocks_local = jax.tree_util.tree_map(
+            lambda x: jnp.squeeze(x, axis=0), blocks)
+        M, mb, T = tokens.shape
+
+        def embed(rest):
+            pos = jnp.arange(T)
+            return (rest["wte"][tokens].astype(cfg.dtype)
+                    + rest["wpe"][pos].astype(cfg.dtype))
+
+        x, embed_vjp = jax.vjp(embed, rest)     # x: (M, mb, T, D)
+
+        def per_mb_loss(rest, y, m):
+            h = ln_f.apply({"params": rest["ln_f"]}, y)
+            logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32),
+                                rest["wte"])
+            tgt = lax.dynamic_index_in_dim(tokens, m, 0, keepdims=False)
+            return loss_fn(logits, tgt)
+
+        core = pipeline_1f1b(stage_fn, per_mb_loss, axis_name)
+        loss, (g_blocks, g_rest_head, g_x) = core(blocks_local, rest, x)
+        (g_rest_embed,) = embed_vjp(g_x)
+        g_rest = jax.tree_util.tree_map(lambda a, b: a + b,
+                                        g_rest_head, g_rest_embed)
+        g_rest = lax.psum(g_rest, axis_name)
+        g_blocks = jax.tree_util.tree_map(lambda g: g[None], g_blocks)
+        return loss, g_blocks, g_rest
+
+    return step

@@ -29,11 +29,11 @@ M / (S-1)    bubble fraction
 i.e. use ``M >= 4*(S-1)`` to keep the bubble under ~20 %. Memory grows
 linearly in ``M`` (the scan saves each tick's stage activations for the
 backward pass, which is exactly GPipe's per-microbatch stashing), so ``M``
-trades bubble against HBM the same way it does upstream. A 1F1B schedule
-would cap the stash at ``S`` in-flight microbatches instead of ``M``; under
-scan+autodiff the stash is the scan residual, so 1F1B's memory advantage
-needs a hand-scheduled backward — use ``jax.checkpoint`` on ``stage_fn``
-(recompute per-tick) for the same effect at ~33 % extra FLOPs.
+trades bubble against HBM the same way it does upstream. When that stash
+does not fit, use :func:`pipeline_1f1b` — a hand-scheduled forward+backward
+schedule whose stash is a ring buffer of ``min(2S-1, M)`` in-flight
+microbatches (O(S), independent of M), the TPU analogue of the 1F1B
+schedules the reference ecosystem layers on hvd p2p (Megatron/DeepSpeed).
 
 Training: use :func:`pipeline_loss`, which computes the caller's loss on the
 **last stage only** (masked before the cross-stage psum) so gradients are
@@ -49,7 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "pipeline_loss", "pipeline_loss_interleaved"]
+__all__ = ["pipeline_apply", "pipeline_loss", "pipeline_loss_interleaved",
+           "pipeline_1f1b"]
 
 
 def _graft_last_stage_loss(local, is_last, axis_name):
@@ -165,8 +166,9 @@ def pipeline_loss(stage_fn: Callable, stage_params: Any,
     """
     outputs, stage, S = _run_pipeline(stage_fn, stage_params, microbatches,
                                       axis_name)
-    return _graft_last_stage_loss(loss_fn(outputs), stage == S - 1,
-                                  axis_name)
+    local = (loss_fn(outputs, 0) if _loss_takes_start(loss_fn)
+             else loss_fn(outputs))
+    return _graft_last_stage_loss(local, stage == S - 1, axis_name)
 
 
 def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
@@ -184,22 +186,44 @@ def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
     Why: the bubble is ``1 - R*M / (M + R*S - 1)``; at ``M = S`` that is
     ``~1/(R+1)`` — e.g. 20 % at R=4 with only S microbatches in flight,
     where plain GPipe needs ``M = 4*(S-1)`` microbatches (4x the activation
-    memory) for the same bubble. Constraint: ``M <= S`` (more microbatches
-    than stages would collide on the ring; chunk the batch and accumulate
-    instead).
+    memory) for the same bubble.
+
+    Ring constraint + automatic chunking: at most ``S`` microbatches fit
+    on the wrapped ring at once. ``M > S`` is handled by chunking the
+    microbatches into ``ceil(M/S)`` sub-schedules and accumulating — the
+    total is the microbatch-count-weighted mean of chunk losses, which
+    equals the full-batch loss when ``loss_fn`` is a mean over the
+    microbatch axis (autodiff accumulates the grads). Chunking needs the
+    two-argument loss form (below) so targets follow their microbatches.
 
     ``loss_fn(outputs) -> scalar`` is evaluated on (M, mb, ...) outputs,
     masked to the final virtual stage's device exactly like
-    :func:`pipeline_loss`.
+    :func:`pipeline_loss`. A two-argument ``loss_fn(outputs, mb_start)``
+    is also accepted (required for chunking): ``mb_start`` is the static
+    index of ``outputs[0]`` in the full microbatch sequence, letting the
+    loss slice its closed-over targets.
     """
     S = lax.psum(1, axis_name)
     d = lax.axis_index(axis_name)
     R = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
     M = microbatches.shape[0]
     if M > S:
-        raise ValueError(
-            f"interleaved schedule needs microbatches ({M}) <= stages ({S});"
-            " chunk the batch and accumulate gradients instead")
+        if not _loss_takes_start(loss_fn):
+            raise ValueError(
+                f"interleaved schedule fits at most S={S} microbatches on "
+                f"the ring at once; chunking the given M={M} automatically "
+                f"needs a loss_fn(outputs, mb_start) so targets can follow "
+                f"their chunk — got a single-argument loss_fn")
+        def chunk_loss(start):
+            # unary on purpose: the recursive call must not re-chunk it
+            return lambda outs: loss_fn(outs, start)
+
+        total = jnp.float32(0.0)
+        for start in range(0, M, S):
+            chunk = microbatches[start:start + S]
+            total = total + (chunk.shape[0] / M) * pipeline_loss_interleaved(
+                stage_fn, stage_params, chunk, chunk_loss(start), axis_name)
+        return total
     T = M + R * S - 1
     mb_shape = microbatches.shape[1:]
 
@@ -232,4 +256,202 @@ def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
     out0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
     (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(T))
 
-    return _graft_last_stage_loss(loss_fn(outputs), d == S - 1, axis_name)
+    local = (loss_fn(outputs, 0) if _loss_takes_start(loss_fn)
+             else loss_fn(outputs))
+    return _graft_last_stage_loss(local, d == S - 1, axis_name)
+
+
+def _loss_takes_start(loss_fn) -> bool:
+    """Does ``loss_fn`` accept the two-argument ``(outputs, mb_start)``
+    chunking form?"""
+    import inspect
+    try:
+        params = inspect.signature(loss_fn).parameters.values()
+    except (TypeError, ValueError):
+        return False
+    positional = [p for p in params if p.kind in
+                  (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    if len(positional) < 2:
+        return False
+    # A unary loss with an optional second param (e.g. eps=1e-6) must not
+    # receive mb_start: only a required second positional — or one
+    # literally named mb_start — selects the two-argument form.
+    second = positional[1]
+    return second.default is inspect.Parameter.empty or \
+        second.name == "mb_start"
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: hand-scheduled backward with an O(S) activation stash
+# ---------------------------------------------------------------------------
+
+def _x_dependent_leaf_mask(stage_fn, stage_params, x_struct):
+    """Which leaves of ``jax.vjp(stage_fn, p, x)[1]`` (a flattenable
+    ``Partial`` pytree) depend on ``x``?
+
+    Param-only residual leaves (e.g. the weight a matmul transpose reads)
+    are identical every microbatch, so ring-stashing them would duplicate
+    the stage weights ``O(S)`` times; the 1F1B scan instead takes them from
+    the current tick's vjp and stashes only the x-dependent leaves. The
+    test is a conservative taint walk over the jaxpr: a leaf is "dependent"
+    if any path from the x invars reaches it (over-approximation only ever
+    stashes more, never corrupts)."""
+    try:
+        from jax.extend import core as jcore       # public alias
+    except ImportError:                            # older jax
+        from jax._src import core as jcore
+
+    def residuals(p, xx):
+        return jax.tree_util.tree_leaves(jax.vjp(stage_fn, p, xx)[1])
+
+    p_struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), stage_params)
+    closed = jax.make_jaxpr(residuals)(p_struct, x_struct)
+    jaxpr = closed.jaxpr
+    n_p = len(jax.tree_util.tree_leaves(stage_params))
+    tainted = set(jaxpr.invars[n_p:])
+    for eqn in jaxpr.eqns:
+        if any(isinstance(v, jcore.Var) and v in tainted
+               for v in eqn.invars):
+            tainted.update(eqn.outvars)
+    return [isinstance(ov, jcore.Var) and ov in tainted
+            for ov in jaxpr.outvars]
+
+
+def pipeline_1f1b(stage_fn: Callable, per_mb_loss: Callable,
+                  axis_name: str) -> Callable:
+    """Build a 1F1B pipeline step: hand-scheduled forward AND backward in
+    one ``lax.scan``, activation stash bounded at ``min(2S-1, M)``
+    microbatches per device instead of GPipe-under-autodiff's ``M + S - 1``
+    per-tick residual sets.
+
+    Reference parity: this is the role of the 1F1B/PipeDream-flush
+    schedules the reference ecosystem (Megatron-LM, DeepSpeed) layers on
+    horovod p2p sends. TPU-first shape: the schedule is a single compiled
+    scan of masked F and B slots in lock-step — device ``s`` runs the
+    forward of microbatch ``t - s`` and the backward of microbatch
+    ``t - 2(S-1) + s`` at tick ``t``; activations hop forward and
+    cotangents hop backward with one ``lax.ppermute`` ICI-neighbour step
+    per tick. No recompute: the per-microbatch vjp residuals are stashed
+    in a ring buffer, with param-only residual leaves (stage weights)
+    deduplicated via :func:`_x_dependent_leaf_mask` so the ring holds only
+    x-dependent activations.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``.
+      per_mb_loss: ``(loss_params, y, m) -> scalar`` — microbatch ``m``'s
+        loss contribution given the last stage's output ``y``; the total
+        loss is the MEAN over microbatches (so a per-microbatch mean loss
+        composes to the same value as a full-batch mean). It may index
+        closed-over targets with the traced ``m``.
+      axis_name: the ``pp`` mesh axis.
+
+    Returns ``fn(stage_params, loss_params, microbatches) ->
+    (loss, (g_stage, g_loss_params, g_microbatches))`` for use inside
+    ``shard_map``; no outer ``jax.grad`` — the backward IS the schedule.
+    ``loss`` and ``g_loss_params`` are nonzero on the last stage only and
+    ``g_microbatches`` on stage 0 only (psum them over ``axis_name`` to
+    replicate — they are zero elsewhere, so the psum is a broadcast);
+    ``g_stage`` is stage-local like the params themselves.
+    """
+
+    def fn(stage_params, loss_params, microbatches):
+        S = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        M = microbatches.shape[0]
+        mb_shape = microbatches.shape[1:]
+        dtype = microbatches.dtype
+        W = min(2 * S - 1, M)
+        T = M + 2 * (S - 1)
+
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+        x_struct = jax.ShapeDtypeStruct(mb_shape, dtype)
+        dep_mask = _x_dependent_leaf_mask(stage_fn, stage_params, x_struct)
+        res_structs = jax.eval_shape(
+            lambda p, xx: jax.tree_util.tree_leaves(
+                jax.vjp(stage_fn, p, xx)[1]),
+            stage_params, x_struct)
+
+        def tick(carry, t):
+            act_in, cot_in, ring, g_stage, g_loss, g_x, loss_acc = carry
+
+            # ---- F slot: forward of microbatch t - stage
+            m_f = t - stage
+            active_f = (m_f >= 0) & (m_f < M)
+            feed = lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x = jnp.where(stage == 0, feed, act_in)
+            y, vjp_fn = jax.vjp(stage_fn, stage_params, x)
+            cur_leaves, res_treedef = jax.tree_util.tree_flatten(vjp_fn)
+            slot_f = jnp.remainder(jnp.clip(m_f, 0, M - 1), W)
+            new_ring = []
+            for r, leaf, dep in zip(ring, cur_leaves, dep_mask):
+                if not dep:
+                    new_ring.append(r)      # param-only: never stashed
+                    continue
+                old = lax.dynamic_index_in_dim(r, slot_f, 0, keepdims=False)
+                new_ring.append(lax.dynamic_update_index_in_dim(
+                    r, jnp.where(active_f, leaf, old), slot_f, 0))
+            ring = new_ring
+
+            # ---- B slot: backward of microbatch t - 2(S-1) + stage
+            m_b = t - 2 * (S - 1) + stage
+            active_b = (m_b >= 0) & (m_b < M)
+            mb_idx = jnp.clip(m_b, 0, M - 1)
+            # Last stage: seed cotangent from THIS tick's forward output
+            # (at stage S-1, m_b == m_f, and its residuals were just
+            # written). per_mb_loss runs masked on every stage (SPMD).
+            l, l_vjp = jax.vjp(
+                lambda lp, yy: per_mb_loss(lp, yy, mb_idx), loss_params, y)
+            g_lp_m, gy_seed = l_vjp(jnp.asarray(1.0 / M, l.dtype))
+            g_in = jnp.where(stage == S - 1, gy_seed, cot_in)
+
+            slot_b = jnp.remainder(mb_idx, W)
+            res_b = [
+                leaf if not dep
+                else lax.dynamic_index_in_dim(r, slot_b, 0, keepdims=False)
+                for r, leaf, dep in zip(ring, cur_leaves, dep_mask)]
+            vjp_b = jax.tree_util.tree_unflatten(res_treedef, res_b)
+            gp, gx = vjp_b(g_in)
+
+            bmask = active_b
+            g_stage = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(bmask, g, jnp.zeros_like(g)),
+                g_stage, gp)
+            lmask = bmask & (stage == S - 1)
+            g_loss = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(lmask, g, jnp.zeros_like(g)),
+                g_loss, g_lp_m)
+            loss_acc = loss_acc + jnp.where(
+                lmask, l.astype(jnp.float32) / M, 0.0)
+            gx_cur = lax.dynamic_index_in_dim(g_x, mb_idx, 0, keepdims=False)
+            g_x = lax.dynamic_update_index_in_dim(
+                g_x, jnp.where(bmask & (stage == 0), gx, gx_cur),
+                mb_idx, 0)
+
+            # ---- hops: activations forward, cotangents backward
+            act_next = lax.ppermute(y, axis_name, fwd_perm)
+            cot_next = lax.ppermute(gx, axis_name, bwd_perm)
+            return (act_next, cot_next, ring, g_stage, g_loss, g_x,
+                    loss_acc), None
+
+        ring0 = [jnp.zeros((W,) + s.shape, s.dtype) if dep
+                 else jnp.zeros((), jnp.float32)   # placeholder, unused
+                 for s, dep in zip(res_structs, dep_mask)]
+        carry0 = (jnp.zeros(mb_shape, dtype),
+                  jnp.zeros(mb_shape, dtype),
+                  ring0,
+                  jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+                  jax.tree_util.tree_map(jnp.zeros_like, loss_params),
+                  jnp.zeros((M,) + mb_shape, dtype),
+                  jnp.zeros((), jnp.float32))
+        carry0 = jax.tree_util.tree_map(
+            lambda a: _vary_over(axis_name, a)[0], carry0)
+        (_, _, _, g_stage, g_loss, g_x, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        loss = lax.psum(loss_acc, axis_name)   # nonzero on last stage only
+        return loss, (g_stage, g_loss, g_x)
+
+    return fn

@@ -139,14 +139,95 @@ def grouped_allreduce(tensors: Iterable, op: Optional[int] = None,
     return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
 
 
+def _ragged_allgather_job(arr, process_set):
+    """Dispatch-thread body for a ragged allgather: exchange per-process
+    dim-0 sizes (upstream's controller size negotiation), build the core
+    eager per-rank list, return the concatenated numpy result.
+
+    Multi-process: rows for other processes feed the process-local shard
+    assembly and are never read, so size-matched zeros stand in. Single
+    controller: every simulated rank holds this process's value (the
+    ``to_stacked`` convention), so all entries are the real tensor."""
+    import jax
+    import numpy as np
+
+    n = size()
+    if jax.process_count() > 1:
+        sizes = [int(s) for s in _hvd.allgather_object(int(arr.shape[0]))]
+        entries = [arr if r == rank() else
+                   np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
+                   for r in range(n)]
+    else:
+        entries = [arr] * n
+    return np.asarray(_hvd.ragged_allgather(entries,
+                                            process_set=process_set))
+
+
 def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """``hvd.torch.allgather``: concatenate every rank's tensor along dim 0.
+
+    Like upstream, first dimensions may DIFFER per rank (the controller's
+    size negotiation, rebuilt as an object allgather + the core ragged
+    gather); trailing dims must match."""
+    arr = tensor.detach().cpu().numpy()
+    import jax
+    if jax.process_count() > 1:
+        out = _run_sync(lambda: _ragged_allgather_job(arr, process_set))
+        torch = _torch()
+        return torch.from_numpy(out).to(tensor.dtype)
     stacked = _to_jax_stacked(tensor)
     out = _run_sync(lambda: _hvd.allgather(stacked,
                                            process_set=process_set))
     return _from_stacked(out, tensor)
 
 
-def alltoall(tensor, name: Optional[str] = None, process_set=None):
+def _alltoall_splits_job(arr, splits_row, process_set):
+    """Dispatch-thread body for ``alltoall(tensor, splits)``: exchange the
+    per-rank split rows, run the core ragged alltoall, return this rank's
+    received rows + received splits (both numpy)."""
+    import jax
+    import numpy as np
+
+    n = size()
+    sp_row = np.asarray(splits_row, np.int64).reshape(-1)
+    if sp_row.shape[0] != n:
+        raise ValueError(f"splits must have one entry per rank ({n}), got "
+                         f"{sp_row.shape[0]}")
+    if int(sp_row.sum()) != arr.shape[0]:
+        raise ValueError(f"splits sum to {int(sp_row.sum())} but tensor has "
+                         f"{arr.shape[0]} rows")
+    if jax.process_count() > 1:
+        rows = _hvd.allgather_object(sp_row.tolist())
+        sp = np.asarray(rows, np.int64)
+        entries = [arr if r == rank() else
+                   np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
+                   for r in range(n)]
+    else:
+        sp = np.tile(sp_row, (n, 1))
+        entries = [arr] * n
+    outs = _hvd.alltoall(entries, splits=sp, process_set=process_set)
+    return np.asarray(outs[rank()]), sp[:, rank()].copy()
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    """``hvd.torch.alltoall``: scatter dim-0 slices to every rank, gather
+    theirs.
+
+    Without ``splits``: equal slices (dim 0 divisible by the set size);
+    returns the received tensor. With ``splits`` (a per-destination row
+    count vector, upstream ``horovod/torch/mpi_ops.py:alltoall``): returns
+    ``(received, received_splits)`` — matching upstream's two-value return
+    when splits are passed."""
+    if splits is not None:
+        if hasattr(splits, "detach"):
+            splits = splits.detach().cpu().numpy()
+        arr = tensor.detach().cpu().numpy()
+        out, rsplits = _run_sync(
+            lambda: _alltoall_splits_job(arr, splits, process_set))
+        torch = _torch()
+        return (torch.from_numpy(out).to(tensor.dtype),
+                torch.from_numpy(rsplits))
     stacked = _to_jax_stacked(tensor)
     out = _run_sync(lambda: _hvd.alltoall(stacked, process_set=process_set))
     return _from_stacked(out, tensor)
@@ -191,13 +272,15 @@ class _AsyncHandle:
     upstream's error surfacing on the handle wait.
     """
 
-    __slots__ = ("_fut", "_like", "_target", "_grouped", "_result", "_done")
+    __slots__ = ("_fut", "_like", "_target", "_grouped", "_raw", "_result",
+                 "_done")
 
-    def __init__(self, fut, like, target=None, grouped=False):
+    def __init__(self, fut, like, target=None, grouped=False, raw=False):
         self._fut = fut            # future resolving to the stacked out
         self._like = like          # torch tensor(s) giving dtype back
         self._target = target      # in-place destination(s) or None
         self._grouped = grouped
+        self._raw = raw            # future already resolves to final torch
         self._result = None
         self._done = False
 
@@ -214,6 +297,11 @@ class _AsyncHandle:
         if self._done:
             return self._result
         out = self._fut.result()
+        if self._raw:
+            self._result = out
+            self._done = True
+            self._fut = self._like = None
+            return self._result
         if self._grouped:
             outs = [_from_stacked(o, t) for o, t in zip(out, self._like)]
             if self._target is not None:
@@ -281,6 +369,19 @@ def grouped_allreduce_async(tensors: Iterable, op: Optional[int] = None,
 
 
 def allgather_async(tensor, name: Optional[str] = None, process_set=None):
+    import jax
+    if jax.process_count() > 1:
+        # Ragged-capable path (per-rank dim-0 sizes may differ): the whole
+        # job — size exchange included — runs on the dispatch thread so it
+        # cannot overtake an earlier async collective's negotiation.
+        arr = tensor.detach().cpu().numpy()
+        dtype = tensor.dtype
+
+        def job():
+            out = _ragged_allgather_job(arr, process_set)
+            return _torch().from_numpy(out).to(dtype)
+
+        return _AsyncHandle(_submit(job), None, raw=True)
     stacked = _to_jax_stacked(tensor)
     fut = _submit(lambda: _hvd.allgather(stacked, process_set=process_set))
     return _AsyncHandle(fut, tensor)
@@ -300,7 +401,23 @@ def broadcast_async_(tensor, root_rank: int, **kwargs):
     return h
 
 
-def alltoall_async(tensor, name: Optional[str] = None, process_set=None):
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set=None):
+    """Async ``alltoall``; with ``splits``, ``synchronize`` returns
+    ``(received, received_splits)`` like the sync form."""
+    if splits is not None:
+        if hasattr(splits, "detach"):
+            splits = splits.detach().cpu().numpy()
+        arr = tensor.detach().cpu().numpy()
+        dtype = tensor.dtype
+
+        def job():
+            out, rsplits = _alltoall_splits_job(arr, splits, process_set)
+            torch = _torch()
+            return (torch.from_numpy(out).to(dtype),
+                    torch.from_numpy(rsplits))
+
+        return _AsyncHandle(_submit(job), None, raw=True)
     stacked = _to_jax_stacked(tensor)
     fut = _submit(lambda: _hvd.alltoall(stacked, process_set=process_set))
     return _AsyncHandle(fut, tensor)

@@ -59,6 +59,27 @@ _WORKER = textwrap.dedent("""
         assert torch.allclose(hvt.synchronize(h),
                               torch.full((2,), 0.5)), pid
         assert hvt.poll(h)
+        # Ragged allgather: per-rank dim-0 sizes DIFFER (upstream
+        # allgather's size negotiation) — pid 0 contributes 1 row, pid 1
+        # two rows.
+        rg = hvt.allgather(torch.arange(float(pid + 1)) + 10 * pid)
+        assert torch.allclose(rg, torch.tensor([0., 10., 11.])), (pid, rg)
+        # alltoall with UNEQUAL splits: pid 0 sends [0|1,2], pid 1 sends
+        # [10,11|12]; received splits report each source's contribution.
+        sp = torch.tensor([1, 2]) if pid == 0 else torch.tensor([2, 1])
+        out, rsp = hvt.alltoall(torch.arange(3.) + 10 * pid, splits=sp)
+        expo = torch.tensor([0., 10., 11.]) if pid == 0 \
+            else torch.tensor([1., 2., 12.])
+        expr = torch.tensor([1, 2]) if pid == 0 else torch.tensor([2, 1])
+        assert torch.allclose(out, expo), (pid, out)
+        assert torch.equal(rsp.long(), expr), (pid, rsp)
+        # ... and the async variant resolves to the same pair through the
+        # ordered dispatch thread.
+        h2 = hvt.alltoall_async(torch.arange(3.) + 10 * pid, splits=sp)
+        out2, rsp2 = hvt.synchronize(h2)
+        assert torch.allclose(out2, expo) and torch.equal(rsp2.long(),
+                                                          expr), pid
+        assert hvt.poll(h2)
         print(f"proc {{pid}} TORCH-OK", flush=True)
     elif mode == "stall":
         # End-to-end stall inspection: rank 1 delays its collective; rank

@@ -149,3 +149,44 @@ class TestTorchOptimizer:
         opt = hvd_torch.DistributedOptimizer(
             torch.optim.SGD(model.parameters(), lr=0.1))
         assert opt.param_groups[0]["lr"] == 0.1
+
+
+class TestRaggedSurfaces:
+    """alltoall(splits=) + ragged allgather plumbing (VERDICT r2 item 5).
+    Single-controller semantics: every simulated rank holds this process's
+    tensor; the 2-process distinct-value flows live in
+    test_multiprocess.py."""
+
+    def test_alltoall_with_splits_returns_pair(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        splits = torch.tensor([3] + [1] * (n - 2) + [0])
+        t = torch.arange(float(int(splits.sum())))
+        out, rsplits = hvt.alltoall(t, splits=splits)
+        # every simulated rank sends the same first-3 rows to rank 0
+        want = torch.cat([t[:3]] * n)
+        assert torch.allclose(out, want), out
+        assert torch.equal(rsplits.long(), torch.full((n,), 3).long())
+
+    def test_alltoall_splits_validation(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        with pytest.raises(ValueError, match="one entry per rank"):
+            hvt.alltoall(torch.arange(4.), splits=torch.ones(n - 1).long())
+        with pytest.raises(ValueError, match="sum"):
+            hvt.alltoall(torch.arange(4.),
+                         splits=torch.ones(n).long() * 2)
+
+    def test_alltoall_async_with_splits(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        splits = torch.ones(n).long()
+        t = torch.arange(float(n))
+        h = hvt.alltoall_async(t, splits=splits)
+        out, rsplits = hvt.synchronize(h)
+        assert torch.allclose(out, torch.zeros(n)), out   # row 0 from all
+        assert torch.equal(rsplits.long(), splits)
+        assert hvt.poll(h)
