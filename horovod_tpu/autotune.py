@@ -14,6 +14,7 @@ into DistributedOptimizer/allreduce.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -65,7 +66,10 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
                           candidates: Optional[List[tuple]] = None,
                           steps_per_trial: int = 5,
                           include_backward: bool = True,
-                          chain: int = 8):
+                          chain: int = 8,
+                          record: bool = False,
+                          record_kind: Optional[str] = None,
+                          record_path=None):
     """Measure flash-attention (block_q, block_k) tilings on this device.
 
     The best tiles depend on head_dim, sequence length and VMEM pressure
@@ -88,6 +92,13 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
         candidate grows with ``chain`` (the backward scan differentiates
         every link); over a remote PJRT transport where kernel compiles
         are shipped, prefer ``chain=2``/``include_backward=False`` probes.
+      record: write the winner into the checked-in tile table
+        (``ops/tile_table.py``) so future ``flash_attention`` calls with
+        this shape pick it up by default.
+      record_kind: tile-table kind for the recorded entry; defaults to
+        "causal"/"full" from ``causal``. Pass "ring" when tuning tiles
+        for ``ring_flash_attention``'s per-hop shape.
+      record_path: alternate table file (tests); None = the shipped table.
     """
     import jax
     import jax.numpy as jnp
@@ -95,6 +106,21 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
     from jax import lax
 
     from horovod_tpu.ops.flash_attention import flash_attention
+
+    if record:
+        # Validate the destination BEFORE the sweep — a typo'd kind or
+        # unwritable table path must not discard an hour of measurements.
+        from horovod_tpu.ops import tile_table
+        kind = record_kind or ("causal" if causal else "full")
+        if kind not in tile_table.KINDS:
+            raise ValueError(f"unknown record_kind {kind!r}; expected one "
+                             f"of {tile_table.KINDS}")
+        dest = (tile_table.table_path() if record_path is None
+                else record_path)
+        import pathlib
+        dp = pathlib.Path(dest)
+        if not os.access(dp.parent if not dp.exists() else dp, os.W_OK):
+            raise PermissionError(f"tile table {dest} is not writable")
 
     if candidates is None:
         candidates = [(128, 128), (128, 512), (256, 256), (256, 512),
@@ -146,6 +172,15 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
         raise RuntimeError(
             f"no flash tiling compiled for shape {q_shape}") from last_error
     best = min(trials, key=trials.get)
+    if record:
+        tile_table.record(
+            head_dim=q_shape[-1], seq=q_shape[1], dtype=dtype, kind=kind,
+            block_q=best[0], block_k=best[1],
+            us_per_call=trials[best] * 1e6,
+            source=f"tuned-{jax.default_backend()}"
+                   + ("" if include_backward else "-fwdonly"),
+            device=jax.devices()[0].device_kind,
+            path=record_path)
     return best, trials
 
 

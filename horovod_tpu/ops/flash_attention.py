@@ -434,7 +434,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, scale: Optional[float] = None,
                     key_bias: Optional[jnp.ndarray] = None,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     causal_offset: int = 0) -> jnp.ndarray:
     """Fused attention ``softmax(q k^T * scale + key_bias [+ mask]) v``.
 
@@ -451,11 +452,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       causal_offset: shifts the causal diagonal — visible iff
         ``i + causal_offset >= j`` (−1 = strict causal; used by striped
         ring layouts). Only meaningful with ``causal=True``.
-      block_q, block_k: tile sizes (clamped to the sequence lengths). The
-        (256, 512) defaults were measured fastest on v5e for fwd+bwd —
-        128-tiles drown in per-step grid overhead, and 512x512 Q-blocks
-        overflow VMEM in the backward kernels (score temporaries spill).
-        Ragged edges are position-masked.
+      block_q, block_k: tile sizes (clamped to the sequence lengths).
+        ``None`` (default) consults the checked-in tile table
+        (``ops/tile_table.py``, regenerated by ``autotune_flash_blocks``)
+        for the best measured tiling for this (head_dim, seq, dtype);
+        table fallback is (256, 512), measured fastest on v5e for
+        fwd+bwd — 128-tiles drown in per-step grid overhead, and 512x512
+        Q-blocks overflow VMEM in the backward kernels (score temporaries
+        spill). Ragged edges are position-masked.
 
     Returns (batch, t_q, heads, head_dim), same dtype as ``q``.
     """
@@ -465,6 +469,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(f"causal flash attention needs t_q == t_kv, "
                          f"got {tq} != {tk}")
     scale = d ** -0.5 if scale is None else scale
+
+    if block_q is None or block_k is None:
+        from horovod_tpu.ops import tile_table
+        tq_, tk_ = tile_table.lookup(d, max(tq, tk), q.dtype,
+                                     "causal" if causal else "full")
+        block_q = tq_ if block_q is None else block_q
+        block_k = tk_ if block_k is None else block_k
 
     # (B, T, H, D) -> (B*H, T, D): each grid row owns one head's sequence.
     def pack(x):

@@ -192,8 +192,8 @@ _ring.defvjp(_ring_fwd, _ring_bwd)
 def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          axis_name: str, causal: bool = True,
                          scale: Optional[float] = None,
-                         block_q: int = 256,
-                         block_k: int = 512,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          layout: str = "contiguous") -> jnp.ndarray:
     """Exact attention with q/k/v sequence-sharded across ``axis_name``.
 
@@ -215,12 +215,21 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       axis_name: mesh axis the sequence is sharded over.
       causal: global causal mask.
       scale: logit scale; defaults to head_dim**-0.5.
-      block_q, block_k: flash kernel tile sizes.
+      block_q, block_k: flash kernel tile sizes; ``None`` (default)
+        consults the checked-in tile table (``ops/tile_table.py``,
+        kind="ring": the per-hop sequence is the local shard and the
+        backward is a second explicit ring, so the VMEM profile differs
+        from single-device flash).
 
     Returns (batch, t_local, heads, head_dim), dtype of ``q``.
     """
     b, t, h, d = q.shape
     scale = d ** -0.5 if scale is None else scale
+    if block_q is None or block_k is None:
+        from horovod_tpu.ops import tile_table
+        tq_, tk_ = tile_table.lookup(d, t, q.dtype, "ring")
+        block_q = tq_ if block_q is None else block_q
+        block_k = tk_ if block_k is None else block_k
     if layout not in ("contiguous", "striped"):
         raise ValueError(f"unknown layout {layout!r}; expected "
                          "'contiguous' or 'striped'")

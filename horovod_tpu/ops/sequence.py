@@ -22,8 +22,8 @@ __all__ = ["ulysses_attention"]
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = True,
                       scale: Optional[float] = None,
-                      impl: str = "dense", block_q: int = 256,
-                      block_k: int = 512) -> jnp.ndarray:
+                      impl: str = "dense", block_q: Optional[int] = None,
+                      block_k: Optional[int] = None) -> jnp.ndarray:
     """Attention with q/k/v sequence-sharded on ``axis_name``
     (shapes (B, t_local, H, D)). When the axis size does not divide the
     head count, heads are zero-padded up to the next multiple (the padded
@@ -34,7 +34,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     fused pallas kernel — after the all-to-all this is ordinary single-
     device attention, so the kernel drops straight in (and its custom VJP
     composes with the all-to-alls' autodiff). ``block_q``/``block_k`` feed
-    the kernel tiles (see ``autotune.autotune_flash_blocks``).
+    the kernel tiles; ``None`` (default) lets the kernel consult the
+    checked-in tile table for the post-all-to-all full-sequence shape
+    (see ``ops/tile_table.py`` / ``autotune.autotune_flash_blocks``).
     """
     B, Tq, H, D = q.shape
     scale = D ** -0.5 if scale is None else scale

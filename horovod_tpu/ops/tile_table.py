@@ -1,0 +1,174 @@
+"""Flash-attention tile table: tuned (block_q, block_k) shipped as data.
+
+Upstream Horovod ships autotune results as runtime state discovered per job
+(``horovod/runner/autotune``); on TPU the analogous knob is the pallas
+flash-attention tiling, whose best value depends on (head_dim, seq, dtype,
+kind-of-attention) and on VMEM pressure from the backward kernels — a pure
+compile-time property of the shape, so it belongs in a checked-in table, not
+a per-job search. ``flash_attention`` / ``ring_flash_attention`` /
+``ulysses_attention`` consult this table whenever the caller does not pass
+explicit tiles; ``autotune_flash_blocks(record=True)`` and
+``tools/tune_tiles.py`` regenerate it from on-device measurements.
+
+Table file: ``flash_tiles.json`` next to this module (override with
+``HOROVOD_FLASH_TILE_TABLE=/path.json``). Schema::
+
+    {"version": 1,
+     "device": "tpu v5e",
+     "default": {"block_q": 256, "block_k": 512},
+     "entries": [{"head_dim": 64, "seq": 2048, "dtype": "bfloat16",
+                  "kind": "causal", "block_q": 256, "block_k": 512,
+                  "us_per_call": 950.0, "source": "tuned-v5e"}, ...]}
+
+``kind`` is one of "causal" | "full" | "ring" (the ring kernel's VMEM
+profile differs: its per-hop seq is the local shard and the backward is an
+explicit second ring). Lookup is nearest-match: exact kind and dtype
+preferred, then closest head_dim and seq in log space — so one measured
+point generalises to neighbouring shapes until the tuner fills them in.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["lookup", "record", "load_table", "save_table", "table_path",
+           "DEFAULT_TILES", "KINDS"]
+
+DEFAULT_TILES = (256, 512)   # measured fastest on v5e (ROOFLINE.md r1)
+KINDS = ("causal", "full", "ring")
+
+_lock = threading.Lock()
+# (path, mtime_ns) -> parsed table; invalidated when the file changes.
+_cache: Dict[Tuple[str, int], dict] = {}
+
+
+def table_path() -> Path:
+    env = os.environ.get("HOROVOD_FLASH_TILE_TABLE")
+    if env:
+        return Path(env)
+    return Path(__file__).with_name("flash_tiles.json")
+
+
+def _empty_table() -> dict:
+    return {"version": 1, "device": "unknown",
+            "default": {"block_q": DEFAULT_TILES[0],
+                        "block_k": DEFAULT_TILES[1]},
+            "entries": []}
+
+
+def load_table(path: Optional[os.PathLike] = None) -> dict:
+    """Parse the tile table (cached on (path, mtime))."""
+    p = Path(path) if path is not None else table_path()
+    try:
+        mtime = p.stat().st_mtime_ns
+    except OSError:
+        return _empty_table()
+    key = (str(p), mtime)
+    with _lock:
+        if key not in _cache:
+            _cache.clear()   # at most one live version per path
+            try:
+                with open(p) as f:
+                    _cache[key] = json.load(f)
+            except (OSError, ValueError):
+                # Truncated/corrupt table: serve defaults, don't take
+                # training down over a tuning hint.
+                _cache[key] = _empty_table()
+        return _cache[key]
+
+
+def save_table(table: dict, path: Optional[os.PathLike] = None) -> Path:
+    p = Path(path) if path is not None else table_path()
+    table["entries"] = sorted(
+        table["entries"],
+        key=lambda e: (e["kind"], e["dtype"], e["head_dim"], e["seq"]))
+    tmp = p.with_suffix(".json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, p)
+    with _lock:
+        _cache.clear()
+    return p
+
+
+def _distance(e: dict, head_dim: int, seq: int, dtype: str,
+              kind: str) -> float:
+    """Mismatch score; lower is better. Kind dominates, then dtype, then
+    geometry in log space (a 2x-off seq beats a wrong-kind exact hit)."""
+    d = 0.0
+    if e["kind"] != kind:
+        d += 1000.0
+    if e["dtype"] != dtype:
+        d += 100.0
+    d += 10.0 * abs(math.log2(max(e["head_dim"], 1) / max(head_dim, 1)))
+    d += abs(math.log2(max(e["seq"], 1) / max(seq, 1)))
+    return d
+
+
+def lookup(head_dim: int, seq: int, dtype, kind: str,
+           path: Optional[os.PathLike] = None) -> Tuple[int, int]:
+    """Best-known (block_q, block_k) for this attention shape.
+
+    Falls back to the table's default (then ``DEFAULT_TILES``) when the
+    table is missing or empty. Never raises on a malformed entry — the
+    kernel clamps tiles to the sequence length anyway, and a bad table
+    must not take training down.
+    """
+    if kind not in KINDS:
+        raise ValueError(f"unknown tile kind {kind!r}; expected one of "
+                         f"{KINDS}")
+    dtype = str(dtype)
+    table = load_table(path)
+    entries: List[dict] = table.get("entries") or []
+    best, best_d = None, float("inf")
+    for e in entries:
+        try:
+            d = _distance(e, head_dim, seq, dtype, kind)
+            tiles = (int(e["block_q"]), int(e["block_k"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+        if tiles[0] <= 0 or tiles[1] <= 0:
+            continue
+        if d < best_d:
+            best, best_d = tiles, d
+    if best is not None:
+        return best
+    try:
+        default = table.get("default") or {}
+        return (int(default.get("block_q", DEFAULT_TILES[0])),
+                int(default.get("block_k", DEFAULT_TILES[1])))
+    except (TypeError, ValueError, AttributeError):
+        return DEFAULT_TILES
+
+
+def record(head_dim: int, seq: int, dtype, kind: str, block_q: int,
+           block_k: int, us_per_call: Optional[float] = None,
+           source: str = "tuned", device: Optional[str] = None,
+           path: Optional[os.PathLike] = None) -> Path:
+    """Insert-or-replace one measured entry and rewrite the table file."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown tile kind {kind!r}; expected one of "
+                         f"{KINDS}")
+    p = Path(path) if path is not None else table_path()
+    table = load_table(p) if p.exists() else _empty_table()
+    table = json.loads(json.dumps(table))   # private copy (cache aliases)
+    if device:
+        table["device"] = device
+    key = (int(head_dim), int(seq), str(dtype), kind)
+    table["entries"] = [
+        e for e in table.get("entries", [])
+        if (e.get("head_dim"), e.get("seq"), e.get("dtype"),
+            e.get("kind")) != key]
+    table["entries"].append({
+        "head_dim": int(head_dim), "seq": int(seq), "dtype": str(dtype),
+        "kind": kind, "block_q": int(block_q), "block_k": int(block_k),
+        "us_per_call": (None if us_per_call is None
+                        else round(float(us_per_call), 2)),
+        "source": source})
+    return save_table(table, p)

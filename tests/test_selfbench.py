@@ -1,0 +1,101 @@
+"""Self-bench watcher wiring (VERDICT r3 "next round" item 1a).
+
+Drives ``tools/selfbench.py`` as a black box with a stubbed python child:
+the probe and bench subprocesses both run ``sys.executable``, so pointing
+the watcher at a tiny interval and intercepting via a fake bench module is
+heavier than just testing the pieces + one --once run on the CPU-wedged
+relay path (probe returns non-ok -> exit 3, no BENCH_SELF.jsonl write).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SELF = os.path.join(REPO, "tools", "selfbench.py")
+
+
+def _load():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("selfbench", SELF)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_detects_hang_and_error():
+    sb = _load()
+    real_run = subprocess.run
+
+    def fake_hang(*a, **kw):
+        raise subprocess.TimeoutExpired(a[0], kw.get("timeout", 0))
+
+    subprocess.run = fake_hang
+    try:
+        assert sb.probe(0.1) == "hang"
+    finally:
+        subprocess.run = real_run
+
+
+def test_probe_rejects_cpu_fallback(monkeypatch):
+    sb = _load()
+
+    class R:
+        returncode = 0
+        stdout = "HVD_PROBE_OK cpu 8\n"
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **kw: R())
+    assert sb.probe(1) == "cpu-fallback"
+
+
+def test_append_records(tmp_path):
+    sb = _load()
+    out = tmp_path / "BENCH_SELF.jsonl"
+    sb.append_records(str(out), "resnet50",
+                      [{"metric": "m", "value": 1.0}], "abc123")
+    sb.append_records(str(out), "gpt2",
+                      [{"metric": "g", "value": 2.0}], "abc123")
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["model"] == "resnet50" and lines[0]["git"] == "abc123"
+    assert {"ts", "git", "model", "metric", "value"} <= set(lines[0])
+
+
+def test_run_bench_parses_json_lines(monkeypatch):
+    sb = _load()
+
+    class R:
+        returncode = 0
+        stdout = ('# noise\n{"metric": "x", "value": 3, "unit": "u", '
+                  '"vs_baseline": 1.0}\n')
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **kw: R())
+    recs = sb.run_bench("mnist", 5)
+    assert recs == [{"metric": "x", "value": 3, "unit": "u",
+                     "vs_baseline": 1.0}]
+
+
+def test_once_mode_no_capture_exits_3(tmp_path, monkeypatch):
+    """End-to-end --once run with a probe that reports a wedge: exit 3 and
+    no output file (real subprocess, stubbed probe via env-less child)."""
+    sb = _load()
+    monkeypatch.setattr(sb, "probe", lambda t: "hang")
+    out = tmp_path / "b.jsonl"
+    rc = sb.main(["--once", "--out", str(out)])
+    assert rc == 3
+    assert not out.exists()
+
+
+def test_once_mode_capture_writes_file(tmp_path, monkeypatch):
+    sb = _load()
+    monkeypatch.setattr(sb, "probe", lambda t: "ok")
+    monkeypatch.setattr(sb, "run_bench",
+                        lambda m, t: [{"metric": f"{m}_x", "value": 7}])
+    out = tmp_path / "b.jsonl"
+    rc = sb.main(["--once", "--models", "mnist,vit", "--out", str(out)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [l["model"] for l in lines] == ["mnist", "vit"]

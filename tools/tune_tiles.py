@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Regenerate the flash-attention tile table from on-device measurements.
+
+Sweeps a grid of attention shapes through ``autotune_flash_blocks`` and
+records each winner into ``horovod_tpu/ops/flash_tiles.json`` (the table
+``flash_attention`` consults by default — see ``ops/tile_table.py``).
+
+Run on a real TPU:  python tools/tune_tiles.py [--quick] [--out PATH]
+
+``--quick`` uses fwd-only chain=2 probes (minutes instead of ~an hour over
+a remote PJRT relay, where differentiated pallas chains compile for minutes
+per candidate — see ROOFLINE.md). Shapes cover the model zoo: GPT-2 (d64
+causal @1024), BERT (d64 full @512), long-context (d64/d128 @4096/8192),
+and the per-hop ring shard shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+# (head_dim, seq, batch, heads, causal, kind)
+# Ring probes run causal=False: all but one of a ring's n hops carry
+# fully-unmasked blocks (the causal mask only bites near the diagonal hop),
+# so the unmasked kernel is the representative per-hop workload — a causal
+# probe would skip ~half the KV blocks and crown tiles tuned for the
+# wrong grid-overhead/VMEM balance.
+SHAPES = [
+    (64, 1024, 8, 12, True, "causal"),    # GPT-2 base
+    (64, 512, 8, 12, False, "full"),      # BERT-large class
+    (64, 4096, 2, 12, True, "causal"),    # long context
+    (128, 2048, 2, 16, True, "causal"),   # wide-head LLM class
+    (64, 1024, 2, 12, False, "ring"),     # ring per-hop local shard
+    (64, 2048, 2, 12, False, "ring"),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="fwd-only chain=2 probes (relay-friendly)")
+    ap.add_argument("--out", default=None,
+                    help="alternate table path (default: shipped table)")
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args(argv)
+
+    import jax
+    from horovod_tpu.autotune import autotune_flash_blocks
+
+    backend = jax.default_backend()
+    print(f"backend={backend} device={jax.devices()[0].device_kind}")
+    if backend != "tpu":
+        print("WARNING: not a TPU — measurements will be interpreter-mode "
+              "noise; refusing to overwrite the shipped table without "
+              "--out.", file=sys.stderr)
+        if args.out is None:
+            return 2
+
+    kw = dict(include_backward=not args.quick,
+              chain=2 if args.quick else 8,
+              steps_per_trial=3 if args.quick else 5)
+    for head_dim, seq, batch, heads, causal, kind in SHAPES:
+        shape = (batch, seq, heads, head_dim)
+        t0 = time.time()
+        try:
+            best, trials = autotune_flash_blocks(
+                shape, dtype=args.dtype, causal=causal, record=True,
+                record_kind=kind, record_path=args.out, **kw)
+        except Exception as e:   # one bad shape must not kill the sweep
+            print(f"  {kind} d{head_dim} T{seq}: FAILED ({e})")
+            continue
+        print(f"  {kind} d{head_dim} T{seq}: best={best} "
+              f"({trials[best] * 1e6:.0f} us/call, "
+              f"{len(trials)} candidates, {time.time() - t0:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
