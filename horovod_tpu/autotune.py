@@ -119,8 +119,12 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
                 else record_path)
         import pathlib
         dp = pathlib.Path(dest)
-        if not os.access(dp.parent if not dp.exists() else dp, os.W_OK):
-            raise PermissionError(f"tile table {dest} is not writable")
+        # save_table writes a sibling tmp file then os.replace()s it, so
+        # the requirement is parent-DIRECTORY write permission, whether or
+        # not the table file itself exists or is writable.
+        if not os.access(dp.parent, os.W_OK):
+            raise PermissionError(
+                f"tile table directory {dp.parent} is not writable")
 
     if candidates is None:
         candidates = [(128, 128), (128, 512), (256, 256), (256, 512),

@@ -43,8 +43,9 @@ DEFAULT_TILES = (256, 512)   # measured fastest on v5e (ROOFLINE.md r1)
 KINDS = ("causal", "full", "ring")
 
 _lock = threading.Lock()
-# (path, mtime_ns) -> parsed table; invalidated when the file changes.
-_cache: Dict[Tuple[str, int], dict] = {}
+# path -> (mtime_ns, parsed table); one live version per path, so tuner
+# writes to --out don't evict the shipped table between trace-time lookups.
+_cache: Dict[str, Tuple[int, dict]] = {}
 
 
 def table_path() -> Path:
@@ -68,25 +69,29 @@ def load_table(path: Optional[os.PathLike] = None) -> dict:
         mtime = p.stat().st_mtime_ns
     except OSError:
         return _empty_table()
-    key = (str(p), mtime)
+    key = str(p)
     with _lock:
-        if key not in _cache:
-            _cache.clear()   # at most one live version per path
+        hit = _cache.get(key)
+        if hit is None or hit[0] != mtime:
             try:
                 with open(p) as f:
-                    _cache[key] = json.load(f)
+                    _cache[key] = (mtime, json.load(f))
             except (OSError, ValueError):
                 # Truncated/corrupt table: serve defaults, don't take
                 # training down over a tuning hint.
-                _cache[key] = _empty_table()
-        return _cache[key]
+                _cache[key] = (mtime, _empty_table())
+        return _cache[key][1]
 
 
 def save_table(table: dict, path: Optional[os.PathLike] = None) -> Path:
     p = Path(path) if path is not None else table_path()
+    # Tolerate the same malformed entries lookup() tolerates — record()
+    # must not crash after an hour-long sweep because an old entry is
+    # missing a key.
     table["entries"] = sorted(
         table["entries"],
-        key=lambda e: (e["kind"], e["dtype"], e["head_dim"], e["seq"]))
+        key=lambda e: (str(e.get("kind", "")), str(e.get("dtype", "")),
+                       str(e.get("head_dim", "")), str(e.get("seq", ""))))
     tmp = p.with_suffix(".json.tmp")
     with open(tmp, "w") as f:
         json.dump(table, f, indent=1)

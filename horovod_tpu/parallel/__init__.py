@@ -6,7 +6,8 @@ named mesh dimensions and XLA places the collectives.
 
 from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
-    pipeline_apply, pipeline_loss, pipeline_loss_interleaved,
+    chunkable_loss, pipeline_1f1b, pipeline_apply, pipeline_loss,
+    pipeline_loss_interleaved,
 )
 from horovod_tpu.parallel.sharding import (  # noqa: F401
     PartitionRules, apply_rules, shard_pytree,

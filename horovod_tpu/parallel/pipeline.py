@@ -43,6 +43,7 @@ forward/inference variant that broadcasts the final outputs to every stage.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -50,7 +51,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["pipeline_apply", "pipeline_loss", "pipeline_loss_interleaved",
-           "pipeline_1f1b"]
+           "pipeline_1f1b", "chunkable_loss"]
 
 
 def _graft_last_stage_loss(local, is_last, axis_name):
@@ -213,7 +214,10 @@ def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
                 f"interleaved schedule fits at most S={S} microbatches on "
                 f"the ring at once; chunking the given M={M} automatically "
                 f"needs a loss_fn(outputs, mb_start) so targets can follow "
-                f"their chunk — got a single-argument loss_fn")
+                f"their chunk. Name the second positional 'mb_start', or —"
+                f" for functools.partial / C callables whose signature "
+                f"cannot be inspected — mark the loss with "
+                f"horovod_tpu.parallel.chunkable_loss")
         def chunk_loss(start):
             # unary on purpose: the recursive call must not re-chunk it
             return lambda outs: loss_fn(outs, start)
@@ -261,9 +265,43 @@ def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
     return _graft_last_stage_loss(local, d == S - 1, axis_name)
 
 
+def chunkable_loss(loss_fn):
+    """Explicitly mark ``loss_fn`` as taking the two-argument
+    ``(outputs, mb_start)`` chunking form.
+
+    The chunking schedules (``pipeline_loss_interleaved`` with ``M > S``)
+    detect the two-argument form by signature, which cannot see through
+    ``functools.partial`` or C-accelerated callables — wrap those with this
+    marker::
+
+        loss = hvd.parallel.chunkable_loss(functools.partial(f, cfg))
+
+    Plain ``def loss(outputs, mb_start)`` needs no marker (the parameter
+    name is recognised).
+    """
+    try:
+        loss_fn._hvd_mb_start = True
+        return loss_fn
+    except (AttributeError, TypeError):   # builtins reject attributes
+        @functools.wraps(loss_fn, assigned=("__doc__",), updated=())
+        def wrapped(outputs, mb_start):
+            return loss_fn(outputs, mb_start)
+        wrapped._hvd_mb_start = True
+        return wrapped
+
+
 def _loss_takes_start(loss_fn) -> bool:
     """Does ``loss_fn`` accept the two-argument ``(outputs, mb_start)``
-    chunking form?"""
+    chunking form?
+
+    True iff the loss is marked via :func:`chunkable_loss` or its second
+    positional parameter is literally named ``mb_start``. A merely-binary
+    signature does NOT opt in: ``loss(outputs, weights)`` must fail loudly
+    (TypeError at call) rather than silently receive an index where data
+    was expected.
+    """
+    if getattr(loss_fn, "_hvd_mb_start", False):
+        return True
     import inspect
     try:
         params = inspect.signature(loss_fn).parameters.values()
@@ -271,14 +309,7 @@ def _loss_takes_start(loss_fn) -> bool:
         return False
     positional = [p for p in params if p.kind in
                   (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-    if len(positional) < 2:
-        return False
-    # A unary loss with an optional second param (e.g. eps=1e-6) must not
-    # receive mb_start: only a required second positional — or one
-    # literally named mb_start — selects the two-argument form.
-    second = positional[1]
-    return second.default is inspect.Parameter.empty or \
-        second.name == "mb_start"
+    return len(positional) >= 2 and positional[1].name == "mb_start"
 
 
 # ---------------------------------------------------------------------------
@@ -349,10 +380,11 @@ def pipeline_1f1b(stage_fn: Callable, per_mb_loss: Callable,
     Returns ``fn(stage_params, loss_params, microbatches) ->
     (loss, (g_stage, g_loss_params, g_microbatches))`` for use inside
     ``shard_map``; no outer ``jax.grad`` — the backward IS the schedule.
-    ``loss`` and ``g_loss_params`` are nonzero on the last stage only and
-    ``g_microbatches`` on stage 0 only (psum them over ``axis_name`` to
-    replicate — they are zero elsewhere, so the psum is a broadcast);
-    ``g_stage`` is stage-local like the params themselves.
+    ``loss`` is returned ALREADY replicated across stages (do not psum it
+    again — that would multiply it by S). ``g_loss_params`` is nonzero on
+    the last stage only and ``g_microbatches`` on stage 0 only (psum those
+    over ``axis_name`` to replicate — they are zero elsewhere, so the psum
+    is a broadcast); ``g_stage`` is stage-local like the params themselves.
     """
 
     def fn(stage_params, loss_params, microbatches):
@@ -451,7 +483,9 @@ def pipeline_1f1b(stage_fn: Callable, per_mb_loss: Callable,
             lambda a: _vary_over(axis_name, a)[0], carry0)
         (_, _, _, g_stage, g_loss, g_x, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T))
-        loss = lax.psum(loss_acc, axis_name)   # nonzero on last stage only
+        # loss_acc is nonzero on the last stage only; the psum replicates
+        # it, so the returned loss is identical on every stage.
+        loss = lax.psum(loss_acc, axis_name)
         return loss, (g_stage, g_loss, g_x)
 
     return fn

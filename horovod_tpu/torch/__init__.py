@@ -139,6 +139,17 @@ def grouped_allreduce(tensors: Iterable, op: Optional[int] = None,
     return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
 
 
+def _per_rank(per_process: list) -> list:
+    """Expand a one-entry-per-PROCESS list (``allgather_object``'s shape)
+    to one entry per RANK: rank ``r`` lives on process ``r // local_size``
+    and — in the torch frontend's one-host-tensor-per-process model — every
+    local rank carries that process's value. Without this expansion,
+    indexing a per-process list with ranks breaks the moment a process
+    drives more than one device (a 4-chip TPU host)."""
+    ls = local_size()
+    return [v for v in per_process for _ in range(ls)]
+
+
 def _ragged_allgather_job(arr, process_set):
     """Dispatch-thread body for a ragged allgather: exchange per-process
     dim-0 sizes (upstream's controller size negotiation), build the core
@@ -152,9 +163,12 @@ def _ragged_allgather_job(arr, process_set):
     import numpy as np
 
     n = size()
+    me = jax.process_index()
+    ls = local_size()
     if jax.process_count() > 1:
-        sizes = [int(s) for s in _hvd.allgather_object(int(arr.shape[0]))]
-        entries = [arr if r == rank() else
+        sizes = _per_rank(
+            [int(s) for s in _hvd.allgather_object(int(arr.shape[0]))])
+        entries = [arr if r // ls == me else
                    np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
                    for r in range(n)]
     else:
@@ -197,9 +211,11 @@ def _alltoall_splits_job(arr, splits_row, process_set):
         raise ValueError(f"splits sum to {int(sp_row.sum())} but tensor has "
                          f"{arr.shape[0]} rows")
     if jax.process_count() > 1:
-        rows = _hvd.allgather_object(sp_row.tolist())
-        sp = np.asarray(rows, np.int64)
-        entries = [arr if r == rank() else
+        me = jax.process_index()
+        ls = local_size()
+        rows = _per_rank(_hvd.allgather_object(sp_row.tolist()))
+        sp = np.asarray(rows, np.int64)          # (size, size) after expand
+        entries = [arr if r // ls == me else
                    np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
                    for r in range(n)]
     else:

@@ -89,6 +89,19 @@ def test_once_mode_no_capture_exits_3(tmp_path, monkeypatch):
     assert not out.exists()
 
 
+def test_all_error_cycle_does_not_count(tmp_path, monkeypatch):
+    """Probe passes but the relay wedges mid-run (every record an error):
+    the cycle must not satisfy --max-captures."""
+    sb = _load()
+    monkeypatch.setattr(sb, "probe", lambda t: "ok")
+    monkeypatch.setattr(sb, "run_bench",
+                        lambda m, t: [{"model": m, "error": "timeout"}])
+    out = tmp_path / "b.jsonl"
+    rc = sb.main(["--once", "--models", "resnet50", "--out", str(out)])
+    assert rc == 3   # no usable capture
+    assert "error" in out.read_text()   # the attempt is still recorded
+
+
 def test_once_mode_capture_writes_file(tmp_path, monkeypatch):
     sb = _load()
     monkeypatch.setattr(sb, "probe", lambda t: "ok")

@@ -97,6 +97,20 @@ def test_cache_invalidates_on_rewrite(tmp_table):
                              path=tmp_table) == (128, 128)
 
 
+def test_record_tolerates_malformed_existing_entries(tmp_path):
+    """record() after a sweep must survive entries lookup() tolerates
+    (missing keys / wrong types) — no KeyError from the sort."""
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"version": 1, "entries": [
+        {"kind": "causal", "dtype": "bfloat16", "head_dim": 64},  # no seq
+        {"kind": "full", "dtype": "f32", "head_dim": "x", "seq": "y",
+         "block_q": 1, "block_k": 1},
+    ]}))
+    tile_table.record(64, 1024, "bfloat16", "causal", 256, 512, path=p)
+    assert tile_table.lookup(64, 1024, "bfloat16", "causal",
+                             path=p) == (256, 512)
+
+
 def test_shipped_table_is_valid():
     table = tile_table.load_table()
     assert table["entries"], "shipped flash_tiles.json missing or empty"

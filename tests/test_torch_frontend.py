@@ -179,6 +179,17 @@ class TestRaggedSurfaces:
             hvt.alltoall(torch.arange(4.),
                          splits=torch.ones(n).long() * 2)
 
+    def test_per_rank_expansion(self, monkeypatch):
+        """allgather_object returns one entry per PROCESS; the ragged jobs
+        index per RANK. On a 4-chip-per-host topology the lists differ —
+        _per_rank repeats each process's entry local_size times (advisor
+        r3 medium finding)."""
+        import horovod_tpu.torch as hvt
+        monkeypatch.setattr(hvt, "local_size", lambda: 4)
+        assert hvt._per_rank(["a", "b"]) == ["a"] * 4 + ["b"] * 4
+        monkeypatch.setattr(hvt, "local_size", lambda: 1)
+        assert hvt._per_rank([1, 2, 3]) == [1, 2, 3]
+
     def test_alltoall_async_with_splits(self):
         import torch
         import horovod_tpu.torch as hvt

@@ -119,6 +119,7 @@ def main(argv=None) -> int:
               f"{status}", flush=True)
         if status == "ok":
             rev = git_rev()
+            usable = False
             for model in args.models.split(","):
                 model = model.strip()
                 if not model:
@@ -128,7 +129,10 @@ def main(argv=None) -> int:
                 append_records(args.out, model, records, rev)
                 for rec in records:
                     print(json.dumps(rec), flush=True)
-            captures += 1
+                usable = usable or any("error" not in r for r in records)
+            # A cycle where the relay wedged mid-run (every record an
+            # error) must NOT count: keep watching for a real heal.
+            captures += 1 if usable else 0
             if captures >= args.max_captures:
                 print(f"# done: {captures} capture(s) -> {args.out}",
                       flush=True)
