@@ -22,7 +22,14 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["DistributedSampler", "ShardedBatchIterator", "shard_arrays"]
+__all__ = ["DistributedSampler", "ShardedBatchIterator", "shard_arrays",
+           "Store", "LocalStore", "FsspecStore", "write_dataset",
+           "read_meta", "ShardedDatasetReader"]
+
+from horovod_tpu.data.store import (  # noqa: E402,F401
+    FsspecStore, LocalStore, ShardedDatasetReader, Store, read_meta,
+    write_dataset,
+)
 
 
 class DistributedSampler:

@@ -1,0 +1,329 @@
+"""Durable dataset store for the estimator layer.
+
+Rebuild of upstream ``horovod/spark/common/store.py`` + the petastorm data
+path: upstream estimators materialise the DataFrame to parquet under a
+``Store`` (local FS / HDFS / S3), then each training worker streams only its
+partition back through ``make_batch_reader``. The TPU-native shape keeps the
+same three pieces:
+
+- :class:`Store`: filesystem abstraction + the run directory layout
+  (intermediate train/val data, per-run checkpoints and logs).
+  :class:`LocalStore` is plain ``os``; :class:`FsspecStore` covers any
+  ``fsspec`` URL (``s3://``, ``gs://``, ``memory://`` ...).
+- :func:`write_dataset`: shard a column dict into ``part-NNNNN`` files
+  (npz native, parquet via pyarrow for interop) plus a ``_meta.json``
+  carrying schema, shapes and per-shard row counts.
+- :class:`ShardedDatasetReader`: worker ``r`` of ``w`` owns shards
+  ``r, r+w, ...`` (round-robin — petastorm's row-group partitioning
+  analogue) and never opens another worker's files; batches stream with
+  deterministic per-epoch shuffling and static shapes (ragged tail
+  dropped, TPU-friendly).
+
+Multi-dim columns ride parquet as FixedSizeList values with the original
+shape recorded in the meta (petastorm needs a Unischema for the same
+reason: parquet is a flat-column format).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import posixpath
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["Store", "LocalStore", "FsspecStore", "write_dataset",
+           "read_meta", "ShardedDatasetReader"]
+
+META_FILE = "_meta.json"
+
+
+class Store:
+    """Filesystem abstraction + run layout (upstream
+    ``horovod/spark/common/store.py:Store``). Instances must be picklable
+    (they travel to workers inside the cluster-backend payload)."""
+
+    prefix: str
+
+    # -- filesystem contract -------------------------------------------
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def open(self, path: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        """Base names of entries under ``path`` (files only is fine)."""
+        raise NotImplementedError
+
+    def join(self, *parts: str) -> str:
+        return posixpath.join(*parts)
+
+    # -- run layout (upstream path scheme) -----------------------------
+    def train_data_path(self, run_id: str = "default") -> str:
+        return self.join(self.prefix, "intermediate_train_data", run_id)
+
+    def val_data_path(self, run_id: str = "default") -> str:
+        return self.join(self.prefix, "intermediate_val_data", run_id)
+
+    def run_path(self, run_id: str = "default") -> str:
+        return self.join(self.prefix, "runs", run_id)
+
+    def checkpoint_path(self, run_id: str = "default") -> str:
+        return self.join(self.run_path(run_id), "checkpoints")
+
+    def logs_path(self, run_id: str = "default") -> str:
+        return self.join(self.run_path(run_id), "logs")
+
+    # -- factory --------------------------------------------------------
+    @staticmethod
+    def create(prefix: str) -> "Store":
+        """``/local/dir`` -> LocalStore; anything with a ``scheme://`` ->
+        FsspecStore."""
+        if "://" in prefix:
+            return FsspecStore(prefix)
+        return LocalStore(prefix)
+
+
+class LocalStore(Store):
+    """Store on the local filesystem (upstream ``LocalStore``)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = str(prefix)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def open(self, path: str, mode: str = "rb"):
+        if any(c in mode for c in "wa"):
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        return open(path, mode)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path))
+
+    def join(self, *parts: str) -> str:
+        return os.path.join(*parts)
+
+
+class FsspecStore(Store):
+    """Store over any fsspec filesystem URL (upstream's HDFSStore/S3 role).
+
+    The filesystem handle is resolved lazily and dropped from the pickled
+    state — workers reconnect from the URL (fs clients hold sockets)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self._fs = None
+
+    @property
+    def fs(self):
+        if self._fs is None:
+            try:
+                import fsspec
+            except ImportError as e:   # pragma: no cover - fsspec is baked in
+                raise ImportError(
+                    "FsspecStore requires fsspec; use LocalStore for "
+                    "plain paths") from e
+            self._fs = fsspec.open(self.prefix).fs
+        return self._fs
+
+    def __getstate__(self):
+        return {"prefix": self.prefix}
+
+    def __setstate__(self, state):
+        self.prefix = state["prefix"]
+        self._fs = None
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        self.fs.makedirs(path, exist_ok=True)
+
+    def open(self, path: str, mode: str = "rb"):
+        return self.fs.open(path, mode)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(posixpath.basename(p.rstrip("/"))
+                      for p in self.fs.ls(path, detail=False))
+
+
+# ---------------------------------------------------------------------------
+# Dataset materialisation
+# ---------------------------------------------------------------------------
+
+def _shard_name(i: int, fmt: str) -> str:
+    return f"part-{i:05d}.{fmt}"
+
+
+def write_dataset(columns: Dict[str, np.ndarray], store: Store, path: str,
+                  num_shards: int = 4, fmt: str = "npz") -> dict:
+    """Materialise a column dict as ``num_shards`` row-sharded files +
+    ``_meta.json`` under ``path``. Returns the meta dict.
+
+    The petastorm-conversion analogue (upstream ``util.prepare_data``):
+    after this, workers stream their partition from the store instead of
+    receiving arrays through the task payload.
+    """
+    if fmt not in ("npz", "parquet"):
+        raise ValueError(f"unknown dataset format {fmt!r}; expected "
+                         "'npz' or 'parquet'")
+    columns = {k: np.asarray(v) for k, v in columns.items()}
+    if not columns:
+        raise ValueError("write_dataset needs at least one column")
+    sizes = {k: len(v) for k, v in columns.items()}
+    n = next(iter(sizes.values()))
+    if any(s != n for s in sizes.values()):
+        raise ValueError(f"columns must share dim 0, got {sizes}")
+    num_shards = max(1, min(num_shards, n))
+
+    store.makedirs(path)
+    bounds = np.linspace(0, n, num_shards + 1, dtype=np.int64)
+    shards = []
+    for i in range(num_shards):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        fname = _shard_name(i, fmt)
+        part = {k: v[lo:hi] for k, v in columns.items()}
+        with store.open(store.join(path, fname), "wb") as f:
+            if fmt == "npz":
+                # savez wants a seekable file; buffer then dump.
+                buf = io.BytesIO()
+                np.savez_compressed(buf, **part)
+                f.write(buf.getvalue())
+            else:
+                _write_parquet(part, f)
+        shards.append({"file": fname, "rows": hi - lo})
+
+    meta = {
+        "version": 1,
+        "format": fmt,
+        "total_rows": int(n),
+        "columns": {k: {"dtype": str(v.dtype), "shape": list(v.shape[1:])}
+                    for k, v in columns.items()},
+        "shards": shards,
+    }
+    with store.open(store.join(path, META_FILE), "w") as f:
+        f.write(json.dumps(meta, indent=1))
+    return meta
+
+
+def _write_parquet(part: Dict[str, np.ndarray], f) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    arrays, names = [], []
+    for k, v in part.items():
+        if v.ndim == 1:
+            arrays.append(pa.array(v))
+        else:
+            flat = np.ascontiguousarray(v).reshape(len(v), -1)
+            values = pa.array(flat.ravel())
+            arrays.append(pa.FixedSizeListArray.from_arrays(
+                values, flat.shape[1]))
+        names.append(k)
+    pq.write_table(pa.table(arrays, names=names), f)
+
+
+def read_meta(store: Store, path: str) -> dict:
+    with store.open(store.join(path, META_FILE), "r") as f:
+        return json.loads(f.read())
+
+
+def _read_shard(store: Store, path: str, fname: str, meta: dict
+                ) -> Dict[str, np.ndarray]:
+    fmt = meta["format"]
+    full = store.join(path, fname)
+    if fmt == "npz":
+        with store.open(full, "rb") as f:
+            data = np.load(io.BytesIO(f.read()))
+            return {k: data[k] for k in data.files}
+    import pyarrow.parquet as pq
+    with store.open(full, "rb") as f:
+        table = pq.read_table(f)
+    out = {}
+    for k in table.column_names:
+        col = table.column(k).combine_chunks()
+        spec = meta["columns"][k]
+        arr = np.asarray(col.flatten() if spec["shape"] else col)
+        out[k] = arr.reshape([-1] + spec["shape"]).astype(spec["dtype"])
+    return out
+
+
+class ShardedDatasetReader:
+    """Stream worker ``rank``'s partition of a materialised dataset.
+
+    Shards are assigned round-robin (``rank, rank+world, ...``); this
+    worker NEVER opens another worker's files — the property upstream gets
+    from petastorm reading only the assigned row groups. ``files_read``
+    records every shard actually opened (tests assert the partition
+    discipline with it).
+    """
+
+    def __init__(self, store: Store, path: str, rank: int = 0,
+                 world: int = 1):
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.store = store
+        self.path = path
+        self.rank = rank
+        self.world = world
+        self.meta = read_meta(store, path)
+        self.my_shards = [s["file"] for s in
+                          self.meta["shards"][rank::world]]
+        self.num_rows = int(sum(s["rows"] for s in
+                                self.meta["shards"][rank::world]))
+        self.files_read: List[str] = []
+
+    def load_columns(self) -> Dict[str, np.ndarray]:
+        """Concatenate this worker's shards (the small-data path; batches()
+        streams shard-by-shard for the large one)."""
+        parts = [self._load(f) for f in self.my_shards]
+        if not parts:
+            return {k: np.zeros([0] + spec["shape"], spec["dtype"])
+                    for k, spec in self.meta["columns"].items()}
+        return {k: np.concatenate([p[k] for p in parts])
+                for k in parts[0]}
+
+    def _load(self, fname: str) -> Dict[str, np.ndarray]:
+        self.files_read.append(fname)
+        return _read_shard(self.store, self.path, fname, self.meta)
+
+    def batches(self, batch_size: int, epochs: int = 1, seed: int = 0,
+                shuffle: bool = True, drop_last: bool = True
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield static-shape column batches, one shard in memory at a
+        time. Shuffling is two-level and deterministic per epoch: shard
+        order, then rows within the shard (petastorm's shuffle model —
+        global shuffles would need the whole partition resident)."""
+        for epoch in range(epochs):
+            rng = np.random.default_rng(seed + epoch)
+            order = (rng.permutation(len(self.my_shards)) if shuffle
+                     else np.arange(len(self.my_shards)))
+            carry: Optional[Dict[str, np.ndarray]] = None
+            for si in order:
+                cols = self._load(self.my_shards[int(si)])
+                if carry is not None:
+                    cols = {k: np.concatenate([carry[k], cols[k]])
+                            for k in cols}
+                n = len(next(iter(cols.values())))
+                ridx = rng.permutation(n) if shuffle else np.arange(n)
+                full = (n // batch_size) * batch_size
+                for i in range(0, full, batch_size):
+                    sel = ridx[i:i + batch_size]
+                    yield {k: v[sel] for k, v in cols.items()}
+                tail = ridx[full:]
+                carry = ({k: v[tail] for k, v in cols.items()}
+                         if len(tail) else None)
+            if carry is not None and not drop_last:
+                yield carry

@@ -16,11 +16,12 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
+from horovod_tpu.data.store import Store  # noqa: F401
 from horovod_tpu.spark.estimator import JaxEstimator, JaxModel  # noqa: F401
 
 __all__ = ["run", "run_elastic", "JaxEstimator", "JaxModel", "SparkBackend",
            "spark_available", "KerasEstimator", "TorchEstimator",
-           "TorchModel"]
+           "TorchModel", "Store"]
 
 
 def run_elastic(*_a, **_k):

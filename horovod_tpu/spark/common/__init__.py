@@ -1,0 +1,2 @@
+"""Upstream import-path alias: ``horovod.spark.common`` — the store/data
+machinery lives in :mod:`horovod_tpu.data.store`."""

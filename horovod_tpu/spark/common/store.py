@@ -1,0 +1,18 @@
+"""Upstream import-path alias for ``horovod/spark/common/store.py``.
+
+The implementation is :mod:`horovod_tpu.data.store` (the store is not
+Spark-specific here — every estimator and the data layer share it).
+"""
+
+from horovod_tpu.data.store import (  # noqa: F401
+    FsspecStore, LocalStore, ShardedDatasetReader, Store, read_meta,
+    write_dataset,
+)
+
+# Upstream names HDFS/S3 concrete classes; fsspec covers those URLs.
+HDFSStore = FsspecStore
+DBFSLocalStore = LocalStore
+
+__all__ = ["Store", "LocalStore", "FsspecStore", "HDFSStore",
+           "DBFSLocalStore", "ShardedDatasetReader", "write_dataset",
+           "read_meta"]

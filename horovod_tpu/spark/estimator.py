@@ -13,13 +13,68 @@ scheduler, not a dependency) and with flax/optax as the native framework.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
 
-__all__ = ["JaxEstimator", "JaxModel"]
+__all__ = ["JaxEstimator", "JaxModel", "StoreDataRef"]
+
+
+@dataclass
+class StoreDataRef:
+    """Reference to a dataset materialised in a durable Store — what
+    travels to workers instead of the arrays themselves (upstream ships a
+    store path + petastorm reader config, not the DataFrame)."""
+    store: Any          # horovod_tpu.data.store.Store (picklable)
+    path: str
+
+
+def _min_partition_rows(data, world: int) -> int:
+    """Smallest partition size across ALL ranks — computable on every
+    worker without communication (the store meta carries every shard's
+    row count; the in-memory slicing is deterministic)."""
+    if isinstance(data, StoreDataRef):
+        from horovod_tpu.data.store import read_meta
+        shards = read_meta(data.store, data.path)["shards"]
+        return min(sum(s["rows"] for s in shards[r::world])
+                   for r in range(world))
+    n = len(next(iter(data.values())))
+    return min(_shard(n, r, world)[1] - _shard(n, r, world)[0]
+               for r in range(world))
+
+
+def _worker_partition(data, feature_col: str, label_col: str,
+                      rank: int, world: int, batch_size: int):
+    """Resolve this worker's data partition + the collective step plan.
+
+    ``data`` is either the in-memory column dict (legacy path: equal
+    contiguous slices) or a :class:`StoreDataRef`, in which case rank ``r``
+    loads ONLY shards ``r, r+world, ...`` from the store (upstream's
+    petastorm partition discipline).
+
+    Returns ``(feats, labels, files_read, bs, steps)``. ``bs`` and
+    ``steps`` (batches per epoch) are derived from the GLOBAL minimum
+    partition size, not this rank's, because every rank must run the same
+    number of per-batch gradient collectives — a rank-local batch count
+    would leave the larger partitions allreducing against nobody.
+    ``files_read`` is None for the in-memory path.
+    """
+    min_rows = _min_partition_rows(data, world)
+    bs = min(batch_size, max(min_rows, 1))
+    steps = min_rows // bs
+    if isinstance(data, StoreDataRef):
+        from horovod_tpu.data.store import ShardedDatasetReader
+        reader = ShardedDatasetReader(data.store, data.path, rank, world)
+        cols = reader.load_columns()
+        return (cols[feature_col], cols[label_col],
+                list(reader.files_read), bs, steps)
+    feats = data[feature_col]
+    labels = data[label_col]
+    lo, hi = _shard(len(feats), rank, world)
+    return feats[lo:hi], labels[lo:hi], None, bs, steps
 
 
 def _to_columns(df: Any) -> Dict[str, np.ndarray]:
@@ -53,7 +108,7 @@ def _shard(n_rows: int, rank: int, world: int):
     return lo, hi
 
 
-def _fit_worker(model_bytes: bytes, columns: Dict[str, np.ndarray],
+def _fit_worker(model_bytes: bytes, data,
                 feature_col: str, label_col: str,
                 lr: float, epochs: int, batch_size: int, seed: int):
     """Runs on every worker with hvd initialized (backend contract).
@@ -63,6 +118,11 @@ def _fit_worker(model_bytes: bytes, columns: Dict[str, np.ndarray],
     frontend-bridge stacked convention), then an identical local optimizer
     step on every worker — replicas never diverge, rank 0's weights are the
     model.
+
+    Store-backed (``data`` a :class:`StoreDataRef`): batches stream
+    shard-by-shard through ``ShardedDatasetReader.batches`` — the worker
+    never holds its whole partition, let alone the dataset (upstream's
+    petastorm loop).
     """
     import cloudpickle
     import jax
@@ -76,13 +136,26 @@ def _fit_worker(model_bytes: bytes, columns: Dict[str, np.ndarray],
     rank = jax.process_index()
     world = jax.process_count()
 
-    feats = columns[feature_col]
-    labels = columns[label_col]
-    lo, hi = _shard(len(feats), rank, world)
-    feats, labels = feats[lo:hi], labels[lo:hi]
+    reader = None
+    if isinstance(data, StoreDataRef):
+        from horovod_tpu.data.store import ShardedDatasetReader
+        reader = ShardedDatasetReader(data.store, data.path, rank, world)
+        spec = reader.meta["columns"][feature_col]
+        sample = jnp.zeros([1] + spec["shape"], spec["dtype"])
+    else:
+        feats = data[feature_col]
+        labels = data[label_col]
+        lo, hi = _shard(len(feats), rank, world)
+        feats, labels = feats[lo:hi], labels[lo:hi]
+        sample = jnp.asarray(feats[:1])
+    # bs and steps derive from the GLOBAL minimum partition, not this
+    # rank's rows: every rank must run the same number of per-batch
+    # gradient allreduces or the collectives desync.
+    min_rows = _min_partition_rows(data, world)
+    bs = min(batch_size, max(min_rows, 1))
+    steps_per_epoch = min_rows // bs
 
-    params = model.init(jax.random.PRNGKey(seed),
-                        jnp.asarray(feats[:1]))["params"]
+    params = model.init(jax.random.PRNGKey(seed), sample)["params"]
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
@@ -97,16 +170,25 @@ def _fit_worker(model_bytes: bytes, columns: Dict[str, np.ndarray],
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
-    n = len(feats)
-    bs = min(batch_size, n)
+    def epoch_batches(epoch):
+        if reader is not None:
+            import itertools
+            yield from itertools.islice(
+                reader.batches(bs, epochs=1, seed=seed + epoch),
+                steps_per_epoch)
+            return
+        order = np.random.default_rng(seed + epoch).permutation(len(feats))
+        for i in range(steps_per_epoch):
+            idx = order[i * bs:(i + 1) * bs]
+            yield {feature_col: feats[idx], label_col: labels[idx]}
+
     history = []
     for epoch in range(epochs):
-        order = np.random.default_rng(seed + epoch).permutation(n)
         losses = []
-        for i in range(0, n - bs + 1, bs):
-            idx = order[i:i + bs]
-            l, grads = grads_of(params, jnp.asarray(feats[idx]),
-                                jnp.asarray(labels[idx]))
+        for batch in epoch_batches(epoch):
+            l, grads = grads_of(params,
+                                jnp.asarray(batch[feature_col]),
+                                jnp.asarray(batch[label_col]))
             # Cross-process gradient sync: one fused eager allreduce.
             g_np = jax.tree_util.tree_map(
                 lambda g: to_stacked(np.asarray(g)), grads)
@@ -118,7 +200,9 @@ def _fit_worker(model_bytes: bytes, columns: Dict[str, np.ndarray],
 
     params_np = jax.tree_util.tree_map(np.asarray, params)
     return {"rank": rank, "world": world, "params": params_np,
-            "history": history}
+            "history": history,
+            "files_read": sorted(set(reader.files_read))
+            if reader is not None else None}
 
 
 class JaxModel:
@@ -147,7 +231,57 @@ class JaxModel:
         return columns
 
 
-class JaxEstimator:
+class _StoreFitMixin:
+    """Driver-side store staging shared by the three estimators
+    (upstream ``horovod/spark/common/util.prepare_data``)."""
+
+    def _prepare_data(self, df: Any):
+        """With a store, materialise the columns once and hand workers a
+        :class:`StoreDataRef`; otherwise ship the columns in the payload.
+        ``df=None`` with a store reuses data already materialised under
+        this run_id (``fit_on_store``)."""
+        if self.store is None:
+            columns = _to_columns(df)
+            self._check_cols(sorted(columns))
+            return columns
+        from horovod_tpu.data import store as dstore
+        path = self.store.train_data_path(self.run_id)
+        if df is not None:
+            columns = _to_columns(df)
+            self._check_cols(sorted(columns))
+            dstore.write_dataset(
+                columns, self.store, path,
+                num_shards=self.num_shards or 2 * self.backend.num_workers,
+                fmt=self.data_format)
+        else:
+            meta = dstore.read_meta(self.store, path)
+            self._check_cols(sorted(meta["columns"]))
+        return StoreDataRef(self.store, path)
+
+    def _check_cols(self, have):
+        if self.feature_col not in have or self.label_col not in have:
+            raise KeyError(
+                f"dataset must contain {self.feature_col!r} and "
+                f"{self.label_col!r}; has {have}")
+
+    def fit_on_store(self):
+        """Train from data already materialised in the store under
+        ``run_id`` (no DataFrame in sight — the fully durable flow)."""
+        if self.store is None:
+            raise ValueError("fit_on_store() requires store=")
+        return self.fit(None)
+
+    def _init_store(self, store, run_id, num_shards, data_format):
+        if isinstance(store, str):
+            from horovod_tpu.data.store import Store
+            store = Store.create(store)
+        self.store = store
+        self.run_id = run_id
+        self.num_shards = num_shards
+        self.data_format = data_format
+
+
+class JaxEstimator(_StoreFitMixin):
     """``horovod.spark`` estimator parity, TPU-native.
 
     Args:
@@ -157,6 +291,11 @@ class JaxEstimator:
       num_proc: worker count when no backend is injected.
       backend: any :class:`ClusterBackend`; defaults to local processes.
       feature_col / label_col: column names in the dataset.
+      store: optional :class:`horovod_tpu.data.store.Store` (or a path/URL
+        string) — ``fit`` materialises the dataset there and workers
+        stream only their shard partition (upstream's Store + petastorm
+        path) instead of receiving arrays through the task payload.
+      run_id / num_shards / data_format: store layout knobs.
     """
 
     def __init__(self, model: Any, loss: Callable, lr: float = 1e-2,
@@ -164,7 +303,9 @@ class JaxEstimator:
                  num_proc: int = 2,
                  backend: Optional[ClusterBackend] = None,
                  feature_col: str = "features", label_col: str = "label",
-                 seed: int = 0):
+                 seed: int = 0, store: Any = None,
+                 run_id: str = "default", num_shards: Optional[int] = None,
+                 data_format: str = "npz"):
         self.model = model
         self.loss = loss
         self.lr = lr
@@ -174,21 +315,18 @@ class JaxEstimator:
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> JaxModel:
         import cloudpickle
 
-        columns = _to_columns(df)
-        if self.feature_col not in columns or self.label_col not in columns:
-            raise KeyError(
-                f"dataset must contain {self.feature_col!r} and "
-                f"{self.label_col!r}; has {sorted(columns)}")
+        data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker,
-            args=(model_bytes, columns, self.feature_col, self.label_col,
+            args=(model_bytes, data, self.feature_col, self.label_col,
                   self.lr, self.epochs, self.batch_size, self.seed))
         self.last_fit_results = results
         # Rank 0's weights are the trained model (allreduced grads keep all

@@ -12,15 +12,17 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
-from horovod_tpu.spark.estimator import _shard, _to_columns
+from horovod_tpu.spark.estimator import (_StoreFitMixin, _to_columns,
+                                         _worker_partition)
 
 __all__ = ["KerasEstimator", "KerasModel"]
 
 
-def _fit_worker_keras(model_bytes: bytes, columns: Dict[str, np.ndarray],
+def _fit_worker_keras(model_bytes: bytes, data,
                       feature_col: str, label_col: str,
                       lr: float, epochs: int, batch_size: int, seed: int):
-    """Runs on every worker with hvd initialized (backend contract)."""
+    """Runs on every worker with hvd initialized (backend contract).
+    Store-backed ``data`` loads only this rank's shard partition."""
     import cloudpickle
     import jax
     import tensorflow as tf
@@ -31,11 +33,10 @@ def _fit_worker_keras(model_bytes: bytes, columns: Dict[str, np.ndarray],
     rank = jax.process_index()
     world = jax.process_count()
 
-    feats = columns[feature_col]
-    labels = columns[label_col]
-    lo, hi = _shard(len(feats), rank, world)
-    feats = tf.constant(feats[lo:hi])
-    labels = tf.constant(labels[lo:hi])
+    feats, labels, files_read, bs, steps = _worker_partition(
+        data, feature_col, label_col, rank, world, batch_size)
+    feats = tf.constant(feats)
+    labels = tf.constant(labels)
 
     opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.Adam(lr))
     # The pickled model carries identical weights; broadcast is the
@@ -43,13 +44,15 @@ def _fit_worker_keras(model_bytes: bytes, columns: Dict[str, np.ndarray],
     hvd_tf.broadcast_variables(model.trainable_variables, root_rank=0)
 
     n = int(feats.shape[0])
-    bs = min(batch_size, n)
     history = []
     for epoch in range(epochs):
         order = np.random.default_rng(seed + epoch).permutation(n)
         losses = []
-        for i in range(0, n - bs + 1, bs):
-            idx = tf.constant(order[i:i + bs])
+        # `steps` comes from the GLOBAL minimum partition (see
+        # _worker_partition): every rank runs the same number of
+        # DistributedGradientTape allreduces.
+        for i in range(steps):
+            idx = tf.constant(order[i * bs:(i + 1) * bs])
             xb = tf.gather(feats, idx)
             yb = tf.gather(labels, idx)
             with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
@@ -62,7 +65,7 @@ def _fit_worker_keras(model_bytes: bytes, columns: Dict[str, np.ndarray],
     weights = [w.astype(np.float32) if hasattr(w, "astype") else w
                for w in model.get_weights()]
     return {"rank": rank, "world": world, "weights": weights,
-            "history": history}
+            "history": history, "files_read": files_read}
 
 
 class KerasModel:
@@ -85,7 +88,7 @@ class KerasModel:
         return columns
 
 
-class KerasEstimator:
+class KerasEstimator(_StoreFitMixin):
     """``horovod.spark.keras.KerasEstimator`` parity: a keras model + loss
     trained data-parallel on the cluster backend (requires tensorflow;
     raises with guidance otherwise)."""
@@ -95,7 +98,9 @@ class KerasEstimator:
                  num_proc: int = 2,
                  backend: Optional[ClusterBackend] = None,
                  feature_col: str = "features", label_col: str = "label",
-                 seed: int = 0, **_compat):
+                 seed: int = 0, store: Any = None, run_id: str = "default",
+                 num_shards: Optional[int] = None,
+                 data_format: str = "npz", **_compat):
         try:
             import tensorflow  # noqa: F401
         except ImportError:
@@ -113,21 +118,18 @@ class KerasEstimator:
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> KerasModel:
         import cloudpickle
 
-        columns = _to_columns(df)
-        if self.feature_col not in columns or self.label_col not in columns:
-            raise KeyError(
-                f"dataset must contain {self.feature_col!r} and "
-                f"{self.label_col!r}; has {sorted(columns)}")
+        data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker_keras,
-            args=(model_bytes, columns, self.feature_col, self.label_col,
+            args=(model_bytes, data, self.feature_col, self.label_col,
                   self.lr, self.epochs, self.batch_size, self.seed))
         self.last_fit_results = results
         weights = next(r["weights"] for r in results if r["rank"] == 0)

@@ -12,15 +12,17 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
-from horovod_tpu.spark.estimator import _shard, _to_columns
+from horovod_tpu.spark.estimator import (_StoreFitMixin, _to_columns,
+                                         _worker_partition)
 
 __all__ = ["TorchEstimator", "TorchModel"]
 
 
-def _fit_worker_torch(model_bytes: bytes, columns: Dict[str, np.ndarray],
+def _fit_worker_torch(model_bytes: bytes, data,
                       feature_col: str, label_col: str,
                       lr: float, epochs: int, batch_size: int, seed: int):
-    """Runs on every worker with hvd initialized (backend contract)."""
+    """Runs on every worker with hvd initialized (backend contract).
+    Store-backed ``data`` loads only this rank's shard partition."""
     import cloudpickle
     import jax
     import torch
@@ -31,11 +33,10 @@ def _fit_worker_torch(model_bytes: bytes, columns: Dict[str, np.ndarray],
     rank = jax.process_index()
     world = jax.process_count()
 
-    feats = columns[feature_col]
-    labels = columns[label_col]
-    lo, hi = _shard(len(feats), rank, world)
-    feats = torch.from_numpy(np.ascontiguousarray(feats[lo:hi]))
-    labels = torch.from_numpy(np.ascontiguousarray(labels[lo:hi]))
+    feats, labels, files_read, bs, steps = _worker_partition(
+        data, feature_col, label_col, rank, world, batch_size)
+    feats = torch.from_numpy(np.ascontiguousarray(feats))
+    labels = torch.from_numpy(np.ascontiguousarray(labels))
 
     opt = hvt.DistributedOptimizer(
         torch.optim.Adam(model.parameters(), lr=lr))
@@ -45,13 +46,15 @@ def _fit_worker_torch(model_bytes: bytes, columns: Dict[str, np.ndarray],
     hvt.broadcast_parameters(model.state_dict(), root_rank=0)
 
     n = len(feats)
-    bs = min(batch_size, n)
     history = []
     for epoch in range(epochs):
         order = np.random.default_rng(seed + epoch).permutation(n)
         losses = []
-        for i in range(0, n - bs + 1, bs):
-            idx = torch.from_numpy(order[i:i + bs].copy())
+        # `steps` comes from the GLOBAL minimum partition (see
+        # _worker_partition): every rank runs the same number of
+        # DistributedOptimizer allreduces.
+        for i in range(steps):
+            idx = torch.from_numpy(order[i * bs:(i + 1) * bs].copy())
             opt.zero_grad()
             loss = loss_fn(model(feats[idx]), labels[idx])
             loss.backward()
@@ -62,7 +65,7 @@ def _fit_worker_torch(model_bytes: bytes, columns: Dict[str, np.ndarray],
     state = {k: v.detach().cpu().numpy()
              for k, v in model.state_dict().items()}
     return {"rank": rank, "world": world, "state_dict": state,
-            "history": history}
+            "history": history, "files_read": files_read}
 
 
 class TorchModel:
@@ -94,14 +97,14 @@ class TorchModel:
         return columns
 
 
-class TorchEstimator:
+class TorchEstimator(_StoreFitMixin):
     """``horovod.spark.torch.TorchEstimator`` parity.
 
     Args:
       model: a ``torch.nn.Module`` (cloudpickled to workers with its
         initial weights).
       loss: ``(predictions, labels) -> scalar torch loss``.
-      lr / epochs / batch_size / num_proc / backend / columns: as
+      lr / epochs / batch_size / num_proc / backend / columns / store: as
       :class:`~horovod_tpu.spark.estimator.JaxEstimator`.
     """
 
@@ -110,7 +113,9 @@ class TorchEstimator:
                  num_proc: int = 2,
                  backend: Optional[ClusterBackend] = None,
                  feature_col: str = "features", label_col: str = "label",
-                 seed: int = 0, **_compat):
+                 seed: int = 0, store: Any = None, run_id: str = "default",
+                 num_shards: Optional[int] = None,
+                 data_format: str = "npz", **_compat):
         if model is None or loss is None:
             raise ValueError("TorchEstimator requires model= and loss=")
         self.model = model
@@ -122,21 +127,18 @@ class TorchEstimator:
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> TorchModel:
         import cloudpickle
 
-        columns = _to_columns(df)
-        if self.feature_col not in columns or self.label_col not in columns:
-            raise KeyError(
-                f"dataset must contain {self.feature_col!r} and "
-                f"{self.label_col!r}; has {sorted(columns)}")
+        data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker_torch,
-            args=(model_bytes, columns, self.feature_col, self.label_col,
+            args=(model_bytes, data, self.feature_col, self.label_col,
                   self.lr, self.epochs, self.batch_size, self.seed))
         self.last_fit_results = results
         state = next(r["state_dict"] for r in results if r["rank"] == 0)

@@ -1,0 +1,351 @@
+"""Durable estimator store (VERDICT r3 item 3; upstream
+``horovod/spark/common/store.py`` + petastorm loaders).
+
+Covers the filesystem abstraction, dataset materialisation (npz AND
+parquet), round-robin shard partitioning with the never-open-anothers-files
+discipline, streaming batches, and the end-to-end estimator flow: 2 REAL
+subprocess workers training from an on-disk store, each reading only its
+partition.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data.store import (FsspecStore, LocalStore,
+                                    ShardedDatasetReader, Store, read_meta,
+                                    write_dataset)
+
+
+def _cols(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "features": rng.standard_normal((n, 3)).astype(np.float32),
+        "label": rng.standard_normal((n,)).astype(np.float32),
+        "image": rng.standard_normal((n, 4, 2)).astype(np.float32),
+    }
+
+
+class TestStoreAbstraction:
+    def test_create_dispatch(self, tmp_path):
+        assert isinstance(Store.create(str(tmp_path)), LocalStore)
+        assert isinstance(Store.create("memory://bucket/x"), FsspecStore)
+
+    def test_layout_paths(self, tmp_path):
+        s = LocalStore(str(tmp_path))
+        assert s.train_data_path("r1").endswith(
+            os.path.join("intermediate_train_data", "r1"))
+        assert s.checkpoint_path("r1").endswith(
+            os.path.join("runs", "r1", "checkpoints"))
+        assert s.logs_path("r1").endswith(
+            os.path.join("runs", "r1", "logs"))
+
+    def test_fsspec_store_roundtrip_and_pickle(self):
+        import pickle
+        s = FsspecStore("memory://hvdtest")
+        p = s.join(s.prefix, "dir", "f.bin")
+        with s.open(p, "wb") as f:
+            f.write(b"abc")
+        assert s.exists(p)
+        with s.open(p, "rb") as f:
+            assert f.read() == b"abc"
+        s2 = pickle.loads(pickle.dumps(s))   # fs handle must not pickle
+        with s2.open(p, "rb") as f:
+            assert f.read() == b"abc"
+
+
+class TestWriteDataset:
+    @pytest.mark.parametrize("fmt", ["npz", "parquet"])
+    def test_roundtrip_all_shards(self, tmp_path, fmt):
+        cols = _cols()
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path("run")
+        meta = write_dataset(cols, store, path, num_shards=4, fmt=fmt)
+        assert meta["total_rows"] == 48
+        assert [s["rows"] for s in meta["shards"]] == [12, 12, 12, 12]
+        assert meta["columns"]["image"]["shape"] == [4, 2]
+
+        reader = ShardedDatasetReader(store, path)   # world=1: everything
+        got = reader.load_columns()
+        for k in cols:
+            np.testing.assert_allclose(got[k], cols[k], rtol=1e-6)
+
+    def test_mismatched_rows_raise(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        with pytest.raises(ValueError, match="dim 0"):
+            write_dataset({"a": np.zeros(3), "b": np.zeros(4)}, store,
+                          store.train_data_path())
+
+    def test_meta_is_json(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        write_dataset(_cols(), store, path, num_shards=2)
+        with open(os.path.join(path, "_meta.json")) as f:
+            meta = json.load(f)
+        assert meta["format"] == "npz" and len(meta["shards"]) == 2
+
+    def test_fsspec_memory_dataset(self):
+        store = FsspecStore("memory://hvdds")
+        path = store.train_data_path("m1")
+        cols = _cols(n=20)
+        write_dataset(cols, store, path, num_shards=3)
+        got = ShardedDatasetReader(store, path).load_columns()
+        np.testing.assert_allclose(got["label"], cols["label"])
+
+
+class TestShardedReader:
+    def test_partition_discipline(self, tmp_path):
+        """Workers own disjoint round-robin shard sets covering everything
+        and never open another worker's files."""
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        meta = write_dataset(_cols(), store, path, num_shards=5)
+        all_files = {s["file"] for s in meta["shards"]}
+
+        readers = [ShardedDatasetReader(store, path, rank=r, world=2)
+                   for r in range(2)]
+        owned = [set(r.my_shards) for r in readers]
+        assert owned[0] | owned[1] == all_files
+        assert owned[0] & owned[1] == set()
+        assert sum(r.num_rows for r in readers) == meta["total_rows"]
+
+        for r in readers:
+            r.load_columns()
+            for _ in r.batches(4, epochs=1):
+                pass
+            assert set(r.files_read) <= set(r.my_shards)
+
+    def test_batches_static_shape_and_deterministic(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        write_dataset(_cols(n=23), store, path, num_shards=3)
+        reader = ShardedDatasetReader(store, path)
+        batches = list(reader.batches(5, epochs=1, seed=7))
+        assert len(batches) == 4            # 23 // 5, ragged tail dropped
+        assert all(b["features"].shape == (5, 3) for b in batches)
+        # same seed -> identical stream; different seed -> different order
+        again = list(ShardedDatasetReader(store, path).batches(
+            5, epochs=1, seed=7))
+        np.testing.assert_allclose(batches[0]["features"],
+                                   again[0]["features"])
+        other = list(ShardedDatasetReader(store, path).batches(
+            5, epochs=1, seed=8))
+        assert not np.allclose(batches[0]["features"],
+                               other[0]["features"])
+
+    def test_batches_cover_rows_across_shards(self, tmp_path):
+        """The cross-shard carry means no row is lost to per-shard
+        remainders — only the global epoch tail is dropped."""
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        n = 30
+        cols = {"features": np.arange(n, dtype=np.float32)[:, None],
+                "label": np.arange(n, dtype=np.float32)}
+        write_dataset(cols, store, path, num_shards=4)  # shards of 7/8
+        reader = ShardedDatasetReader(store, path)
+        seen = np.concatenate([b["label"] for b in
+                               reader.batches(4, epochs=1, seed=0)])
+        assert len(seen) == (n // 4) * 4
+        assert len(np.unique(seen)) == len(seen)
+
+    def test_bad_rank_raises(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        write_dataset(_cols(), store, path)
+        with pytest.raises(ValueError, match="rank"):
+            ShardedDatasetReader(store, path, rank=2, world=2)
+
+
+class TestEstimatorFromStore:
+    def _fit(self, tmp_path, backend, fmt="npz", **kw):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from horovod_tpu.spark import JaxEstimator
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        def mse(pred, label):
+            return jnp.mean((pred - label) ** 2)
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, -2.0, 0.5], np.float32)).astype(np.float32)
+        est = JaxEstimator(Linear(), mse, lr=0.1, epochs=12, batch_size=8,
+                           store=str(tmp_path), data_format=fmt,
+                           backend=backend, **kw)
+        model = est.fit({"features": X, "label": y})
+        return est, model, X, y
+
+    def test_inline_store_fit(self, tmp_path):
+        from horovod_tpu.cluster import InlineBackend
+        est, model, X, y = self._fit(tmp_path, InlineBackend())
+        r = est.last_fit_results[0]
+        assert r["files_read"], "worker did not stream from the store"
+        hist = r["history"]
+        assert hist[-1] < 0.5 * hist[0], hist
+        assert model.predict(X).shape == (64,)
+        # the dataset really lives on disk
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "intermediate_train_data", "default",
+            "_meta.json"))
+
+    def test_two_subprocess_workers_read_only_their_partition(
+            self, tmp_path):
+        """VERDICT r3 item 3's done-criterion."""
+        from horovod_tpu.cluster import LocalProcessBackend
+        est, model, X, y = self._fit(
+            tmp_path, LocalProcessBackend(2, coordinator_port=29770))
+        results = est.last_fit_results
+        assert [r["rank"] for r in results] == [0, 1]
+        reads = [set(r["files_read"]) for r in results]
+        assert reads[0] and reads[1]
+        assert reads[0] & reads[1] == set(), reads
+        meta = read_meta(LocalStore(str(tmp_path)),
+                         LocalStore(str(tmp_path)).train_data_path())
+        assert reads[0] | reads[1] == {s["file"] for s in meta["shards"]}
+        # replicas stayed in sync through per-batch allreduce
+        import jax
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            results[0]["params"], results[1]["params"])
+        hist = results[0]["history"]
+        assert hist[-1] < 0.5 * hist[0], hist
+
+    def test_uneven_partitions_stay_in_sync(self, tmp_path):
+        """3 shards over 2 workers (rank0 owns 2, rank1 owns 1): the
+        collective step plan must equalize or the allreduces hang
+        (review finding r4)."""
+        from horovod_tpu.cluster import LocalProcessBackend
+        est, model, X, y = self._fit(
+            tmp_path, LocalProcessBackend(2, coordinator_port=29780),
+            num_shards=3)
+        results = est.last_fit_results
+        reads = [set(r["files_read"]) for r in results]
+        assert len(reads[0]) == 2 and len(reads[1]) == 1
+        import jax
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            results[0]["params"], results[1]["params"])
+
+    def test_worker_partition_step_plan_is_global(self, tmp_path):
+        """bs/steps derive from the global MIN partition on every rank."""
+        from horovod_tpu.spark.estimator import (StoreDataRef,
+                                                 _worker_partition)
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path()
+        cols = {"features": np.zeros((30, 3), np.float32),
+                "label": np.zeros(30, np.float32)}
+        write_dataset(cols, store, path, num_shards=3)   # 10 rows each
+        ref = StoreDataRef(store, path)
+        plans = [_worker_partition(ref, "features", "label", r, 2, 8)[3:]
+                 for r in range(2)]
+        assert plans[0] == plans[1] == (8, 1)   # min partition 10 -> 1 step
+
+        # empty partition (1 shard, 2 workers): steps 0 everywhere, no
+        # crash, no desync
+        write_dataset(cols, store, store.train_data_path("one"),
+                      num_shards=1)
+        ref1 = StoreDataRef(store, store.train_data_path("one"))
+        for r in range(2):
+            feats, labels, files, bs, steps = _worker_partition(
+                ref1, "features", "label", r, 2, 8)
+            assert steps == 0 and bs >= 1
+
+    def test_fit_on_store_without_df(self, tmp_path):
+        """Data materialised once, then trained on with no DataFrame."""
+        from horovod_tpu.cluster import InlineBackend
+
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from horovod_tpu.spark import JaxEstimator
+
+        store = LocalStore(str(tmp_path))
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((32, 3)).astype(np.float32)
+        y = X.sum(1).astype(np.float32)
+        write_dataset({"features": X, "label": y}, store,
+                      store.train_data_path("warm"), num_shards=2)
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        est = JaxEstimator(
+            Linear(), lambda p, l: jnp.mean((p - l) ** 2), lr=0.1,
+            epochs=8, batch_size=8, store=store, run_id="warm",
+            backend=InlineBackend())
+        model = est.fit_on_store()
+        assert model.predict(X).shape == (32,)
+
+    def test_torch_estimator_from_store(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import TorchEstimator
+
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([0.5, -1.0, 2.0], np.float32)).astype(np.float32)
+        model = torch.nn.Sequential(torch.nn.Linear(3, 1),
+                                    torch.nn.Flatten(0))
+        est = TorchEstimator(model=model,
+                             loss=torch.nn.functional.mse_loss,
+                             lr=0.05, epochs=20, batch_size=16,
+                             store=str(tmp_path),
+                             backend=InlineBackend())
+        fitted = est.fit({"features": X, "label": y})
+        r = est.last_fit_results[0]
+        assert r["files_read"], "torch worker did not read from the store"
+        assert r["history"][-1] < 0.2 * r["history"][0]
+        assert fitted.predict(X).shape == (64,)
+
+    def test_keras_estimator_from_store(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import KerasEstimator
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1),
+                                     tf.keras.layers.Flatten()])
+        model.build((None, 3))
+
+        def mse(pred, label):
+            return tf.reduce_mean(tf.square(tf.squeeze(pred, -1) - label))
+
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, 0.5, -1.0], np.float32)).astype(np.float32)
+        est = KerasEstimator(model=model, loss=mse, lr=0.1, epochs=15,
+                             batch_size=16, store=str(tmp_path),
+                             backend=InlineBackend())
+        fitted = est.fit({"features": X, "label": y})
+        r = est.last_fit_results[0]
+        assert r["files_read"], "keras worker did not read from the store"
+        assert r["history"][-1] < 0.3 * r["history"][0]
+        assert fitted.predict(X).shape[0] == 64
+
+    def test_fit_on_store_requires_store(self):
+        from horovod_tpu.cluster import InlineBackend
+
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from horovod_tpu.spark import JaxEstimator
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        est = JaxEstimator(Linear(), lambda p, l: jnp.mean((p - l) ** 2),
+                           backend=InlineBackend())
+        with pytest.raises(ValueError, match="store"):
+            est.fit_on_store()
