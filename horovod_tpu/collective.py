@@ -486,26 +486,36 @@ def negotiation_stall_report(timeout_s: float = 60.0):
     return coord.stall_check(timeout_s) if coord is not None else []
 
 
-def _negotiate(kind: str, sig_key: tuple) -> None:
+def _negotiate(kind: str, sig_key: tuple,
+               service_desc: Optional[tuple] = None) -> tuple:
     """Multi-process eager negotiation (upstream ``controller.cc`` +
     ``response_cache.cc``, rebuilt host-side).
 
-    Every process must issue the same eager collectives in the same order —
-    a mismatch would execute different global programs and hang the slice.
+    Every ACTIVE process must issue the same eager collectives in the same
+    order — a mismatch would execute different global programs and hang
+    the slice. Processes that have called :func:`join` participate in
+    every round with a ``joined`` flag instead (upstream's controller
+    keeps servicing stragglers with the joined rank contributing zeros).
 
     Protocol (one fixed-shape round steady-state):
 
     1. Fold ``(sequence_number, op, shapes, params)`` into a rolling
-       128-bit signature hash; allgather ``[hash_0..hash_3, need_full]``
-       (5 int32 — ONE host round). The rolling hash covers the entire op
-       history, so any reorder/skip/divergence makes hashes differ at the
-       next call and every process raises *before* touching the device.
+       128-bit signature hash; allgather ``[hash_0..hash_3, need_full,
+       joined]`` (6 int32 — ONE host round). The rolling hash covers the
+       entire op history, so any reorder/skip/divergence makes hashes
+       differ at the next call and every process raises *before* touching
+       the device. Joined rows are excluded from the comparison.
     2. If any process flags ``need_full`` (signature not in its response
-       cache), everyone runs the full signature allgather (two more
-       rounds), verifies equality, and caches it — the reference's
-       response-cache warmup. Both paths start with the same fixed-shape
-       round, so a cache hit on one process and a miss on another can
-       never deadlock on mismatched host collectives.
+       cache) — joined processes always do — everyone runs the full
+       object allgather, actives verify signature equality, and joined
+       peers receive ``service_desc``: the op descriptor they need to
+       replay the device collective with neutral contributions. Both
+       paths start with the same fixed-shape round, so a cache hit on one
+       process and a miss on another can never deadlock on mismatched
+       host collectives.
+
+    Returns the tuple of JOINED process indices observed this round (empty
+    when nobody has joined — the common case).
 
     The native Coordinator (cpp/hvdtpu_core.cpp) backs the response cache
     and tracks the op as pending until negotiation completes, which is what
@@ -513,16 +523,17 @@ def _negotiate(kind: str, sig_key: tuple) -> None:
     stops responding.
     """
     if jax.process_count() <= 1:
-        return
+        return ()
     from horovod_tpu import timeline as _tl
     t = _tl.get_timeline()
     if t is not None:
         with t.activity(f"negotiate:{kind}", category="negotiation"):
-            return _negotiate_inner(kind, sig_key)
-    return _negotiate_inner(kind, sig_key)
+            return _negotiate_inner(kind, sig_key, service_desc)
+    return _negotiate_inner(kind, sig_key, service_desc)
 
 
-def _negotiate_inner(kind: str, sig_key: tuple) -> None:
+def _negotiate_inner(kind: str, sig_key: tuple,
+                     service_desc: Optional[tuple] = None) -> tuple:
     global _OP_SEQ, _NEG_HASH
     import hashlib
     _OP_SEQ += 1
@@ -538,14 +549,20 @@ def _negotiate_inner(kind: str, sig_key: tuple) -> None:
 
     need_full = 0 if _cache_seen(cache_key) else 1
     rows = _host_allgather_i32(
-        np.concatenate([h, [need_full]]).astype(np.int32))
+        np.concatenate([h, [need_full, 0]]).astype(np.int32))
+    joined = tuple(int(i) for i in np.nonzero(rows[:, 5])[0])
+    active = [i for i in range(rows.shape[0]) if rows[i, 5] == 0]
 
-    if rows[:, 4].any():
+    if rows[active, 4].any() or joined:
         _NEG_STATS["full"] += 1
-        sigs = allgather_object(sig)
-        if any(s != sig for s in sigs):
-            table = "\n".join(f"  process {i}: {s}"
-                              for i, s in enumerate(sigs))
+        # Joined peers need the descriptor to replay the collective with
+        # neutral contributions; attach it only when one is listening.
+        payload = ("active", sig, service_desc if joined else None)
+        objs = allgather_object(payload)
+        act_sigs = [o[1] for o in objs if o[0] == "active"]
+        if any(s != sig for s in act_sigs):
+            table = "\n".join(f"  process {i}: {o[1] if len(o) > 1 else o}"
+                              for i, o in enumerate(objs))
             raise RuntimeError(
                 "eager collective mismatch across processes — every process "
                 "must issue the same collectives in the same order "
@@ -567,15 +584,18 @@ def _negotiate_inner(kind: str, sig_key: tuple) -> None:
             if r != me:
                 coord.submit(r, sig)
         coord.pop_ready()
+    return joined
 
 
 def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
-               negotiate_key: tuple = ()):
+               negotiate_key: tuple = (), _skip_negotiate: bool = False):
     """Run an eager collective. ``param_key`` keys the compile cache (static
     facts the compiled program depends on); ``negotiate_key`` carries extra
     per-call values (e.g. ragged sizes/splits) that must *match* across
     processes but travel as device inputs — they join the negotiation
-    signature without fragmenting the compile cache."""
+    signature without fragmenting the compile cache.
+    ``_skip_negotiate`` is the join-service replay path: the round already
+    happened, this call only executes the device program."""
     m = core.mesh()
     axis = core.axis_name()
     n = core.size()
@@ -586,8 +606,30 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
             raise ValueError(
                 f"eager collectives expect per-rank values stacked on axis 0 "
                 f"(leading dim {n}), got shape {x.shape}")
-    shapes = tuple((x.shape, str(x.dtype)) for x in leaves)
-    _negotiate(kind, (shapes, param_key, negotiate_key))
+    shapes = tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
+    joined: tuple = ()
+    if not _skip_negotiate:
+        desc = None
+        if kind == "allreduce" and params[1].ranks is None:
+            # Everything a joined peer needs to replay this collective
+            # with neutral contributions (all picklable by reference).
+            op_, _ps_, pre_, post_, comp_, fus_ = params
+            desc = ("allreduce", shapes, op_, pre_, post_, comp_, fus_)
+        joined = _negotiate(kind, (shapes, param_key, negotiate_key),
+                            service_desc=desc)
+        if joined:
+            if kind != "allreduce":
+                raise RuntimeError(
+                    f"process(es) {list(joined)} have joined; eager "
+                    f"{kind} cannot be serviced by joined peers — only "
+                    "allreduce has defined join semantics (neutral "
+                    "contributions; upstream horovod/common/ops join).")
+            if params[1].ranks is not None:
+                raise RuntimeError(
+                    "eager allreduce on a subset process set while "
+                    f"process(es) {list(joined)} are joined is not "
+                    "supported — use the global set or the in-jit mask "
+                    "join.")
     key = (kind, treedef, shapes, param_key, id(m))
     fn = _EAGER_CACHE.get(key)
     if fn is None:
@@ -630,7 +672,27 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
     else:
         placed = [place(x) for x in leaves]
         out_leaves = fn(*placed)
-    return jax.tree_util.tree_unflatten(treedef, list(out_leaves))
+    out_leaves = list(out_leaves)
+    if joined and kind == "allreduce" and params[0] == ReduceOp.Average:
+        # The compiled program divides by the full world size; joined
+        # ranks contributed zeros, so rescale to divide by the ACTIVE
+        # rank count only (upstream excludes joined ranks from the
+        # divisor). Join is process-granular: a joined process's devices
+        # are all excluded.
+        devs = list(m.devices.ravel())
+        n_joined = sum(1 for d in devs if d.process_index in set(joined))
+        n_active = n - n_joined
+        if n_active <= 0:
+            raise RuntimeError("every process is joined; no active ranks")
+        factor = n / n_active
+        for i, o in enumerate(out_leaves):
+            if not jnp.issubdtype(o.dtype, jnp.floating):
+                raise RuntimeError(
+                    "integer Average allreduce with joined ranks is not "
+                    "supported (the divisor correction needs float "
+                    "arithmetic) — use Sum and divide yourself.")
+            out_leaves[i] = o * jnp.asarray(factor, o.dtype)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
 def _ps_key(ps: ProcessSet):
@@ -969,24 +1031,28 @@ def join() -> int:
     more batches; blocks until every process joins and returns the rank of
     the **last** process to join (upstream ``horovod/common/ops/../join``).
 
-    Restriction vs upstream: every process must have finished issuing
-    eager collectives before any process calls ``join()`` — the ordered
-    negotiation protocol treats a join racing a peer's allreduce as
-    divergence and raises (upstream's controller instead keeps servicing
-    the stragglers with the joined rank contributing zeros). For genuinely
-    uneven per-rank data, run the step loop to the *max* step count with
-    the mask-based join (``DistributedOptimizer(...)`` + ``alive=``),
-    which reproduces upstream's zero-contribution semantics inside jit;
-    use eager ``join()`` as the end-of-training election it is here.
+    While waiting, a joined process SERVICES the still-active peers'
+    eager allreduces (upstream's controller keeps servicing stragglers
+    with the joined rank contributing zeros): each negotiation round it
+    flags ``joined``, receives the op descriptor, and replays the device
+    collective with the op's neutral element — zeros for Sum/Average,
+    ±inf for Min/Max, ones for Product. Active peers' Average divisors
+    exclude the joined ranks, so ``rank 1`` can keep averaging through
+    steps rank 0 no longer has data for and get the mathematically
+    correct per-active-rank mean. Only ``allreduce`` on the global
+    process set is serviceable this way — an eager allgather/alltoall
+    racing a join still raises (their results would need ragged shapes;
+    use the in-jit mask join for those).
 
-    Multi-process: every process blocks in an allgather until all have
-    joined; each then measures how long it waited on its own *monotonic*
-    clock — the last joiner waited least — and a second allgather elects
-    argmin(wait) with ties to the higher rank. Wall clocks never cross
-    hosts, so NTP skew cannot flip the election (only network jitter on
-    the rendezvous release, which is milliseconds against join-scale
-    gaps). A device barrier then flushes outstanding collectives. Ranks
-    are process-granular, matching the one-process-per-host TPU model.
+    Multi-process: every process loops in negotiation rounds until all
+    have joined; each then measures how long it waited on its own
+    *monotonic* clock — the last joiner waited least — and an object
+    allgather elects argmin(wait) with ties to the higher rank. Wall
+    clocks never cross hosts, so NTP skew cannot flip the election. A
+    device barrier then flushes outstanding collectives, and the
+    negotiation history restarts symmetrically (joined ranks serviced
+    ops without folding them into their rolling hash). Ranks are
+    process-granular, matching the one-process-per-host TPU model.
     In SPMD-under-jit the equivalent mechanism is mask-based — see
     ``horovod_tpu.optimizer.DistributedOptimizer(join=...)`` which psums
     an alive mask with the gradients. Single-controller eager: a barrier;
@@ -994,14 +1060,89 @@ def join() -> int:
     if jax.process_count() > 1:
         import time
         t0 = time.monotonic()
-        allgather_object("join")            # blocks until everyone joins
+        while not _join_service_round():
+            pass
         waited = time.monotonic() - t0
         table = allgather_object((waited, -jax.process_index()))
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("horovod_tpu_join")
+        # Joined ranks serviced peers' ops without folding them into
+        # their rolling hash; restart the history symmetrically (every
+        # process is here) so post-join collectives negotiate cleanly.
+        global _OP_SEQ, _NEG_HASH
+        _OP_SEQ = 0
+        _NEG_HASH = b"\x00" * 16
         return -min(table)[1]
     barrier()
     return core.size() - 1
+
+
+def _join_service_round() -> bool:
+    """One negotiation round participated as a JOINED process: either every
+    process has joined (returns True) or an active peer submitted an op —
+    replay it with neutral contributions and return False to keep
+    servicing."""
+    rows = _host_allgather_i32(np.array([0, 0, 0, 0, 1, 1], np.int32))
+    if rows[:, 5].all():
+        return True
+    objs = allgather_object(("joined",))
+    actives = [o for o in objs if o[0] == "active"]
+    if any(o[1] != actives[0][1] for o in actives):
+        # The actives are raising their mismatch error this round; a
+        # joined rank must raise too — replaying a device collective the
+        # actives never launch would wedge the slice instead of failing.
+        table = "\n".join(f"  process {i}: {o[1] if len(o) > 1 else o}"
+                          for i, o in enumerate(objs))
+        raise RuntimeError(
+            "eager collective mismatch across ACTIVE processes while this "
+            f"process is joined — nothing to service.\n{table}")
+    desc = next((o[2] for o in actives if o[2] is not None), None)
+    if desc is None:
+        # Actives always attach a descriptor when a joined peer is in the
+        # round — its absence means the op has no join semantics (the
+        # actives are raising the same round).
+        raise RuntimeError(
+            "joined process cannot service this eager collective (no "
+            "descriptor — only global-set allreduce is join-serviceable)")
+    kind, shapes, op, prescale, postscale, compression, fusion = desc
+    leaves = [np.full(shape, _neutral_host(op, np.dtype(dtype)), dtype)
+              for shape, dtype in shapes]
+    # Single-leaf ops (the common case) replay as the bare array so the
+    # treedef — part of the compile-cache key — matches what allreduce()
+    # compiled while this process was active. Multi-leaf pytrees replay
+    # as a list: same flat order, HLO-equivalent, worst case a local
+    # recompile.
+    tree = leaves[0] if len(leaves) == 1 else leaves
+    # Rebuild the exact param_key allreduce() uses so the replay hits the
+    # _EAGER_CACHE entries this process compiled while it was active —
+    # an ad-hoc key would recompile per shape with the peers already
+    # parked inside the device collective.
+    ps = _resolve_ps(None)
+    pk = (op, _ps_key(ps), prescale, postscale, compression.__name__,
+          fusion)
+    if op == ReduceOp.Adasum:
+        groups = _hierarchical_adasum_groups(ps)
+        pk = pk + (None if groups is None
+                   else tuple(tuple(g) for g in groups),)
+    _eager_run(kind, tree,
+               (op, ps, prescale, postscale, compression, fusion),
+               pk, _skip_negotiate=True)
+    return False
+
+
+def _neutral_host(op: int, dtype: np.dtype):
+    """Host-side neutral element for a joined rank's contribution."""
+    if op in (ReduceOp.Sum, ReduceOp.Average, ReduceOp.Adasum):
+        return dtype.type(0)
+    if op == ReduceOp.Min:
+        return (np.finfo(dtype).max if np.issubdtype(dtype, np.floating)
+                else np.iinfo(dtype).max)
+    if op == ReduceOp.Max:
+        return (np.finfo(dtype).min if np.issubdtype(dtype, np.floating)
+                else np.iinfo(dtype).min)
+    if op == ReduceOp.Product:
+        return dtype.type(1)
+    raise RuntimeError(f"op {op} has no join-neutral element")
 
 
 # ---------------------------------------------------------------------------

@@ -32,18 +32,32 @@ class StoreDataRef:
     path: str
 
 
-def _min_partition_rows(data, world: int) -> int:
+def _min_partition_rows(data, world: int, meta: Optional[dict] = None
+                        ) -> int:
     """Smallest partition size across ALL ranks — computable on every
     worker without communication (the store meta carries every shard's
-    row count; the in-memory slicing is deterministic)."""
+    row count; the in-memory slicing is deterministic). Pass ``meta`` when
+    a reader already parsed it (saves a remote round-trip on fsspec
+    stores)."""
     if isinstance(data, StoreDataRef):
-        from horovod_tpu.data.store import read_meta
-        shards = read_meta(data.store, data.path)["shards"]
+        if meta is None:
+            from horovod_tpu.data.store import read_meta
+            meta = read_meta(data.store, data.path)
+        shards = meta["shards"]
         return min(sum(s["rows"] for s in shards[r::world])
                    for r in range(world))
     n = len(next(iter(data.values())))
     return min(_shard(n, r, world)[1] - _shard(n, r, world)[0]
                for r in range(world))
+
+
+def _step_plan(min_rows: int, batch_size: int):
+    """(bs, steps_per_epoch) from the GLOBAL minimum partition size —
+    rank-independent by construction, so every rank runs the same number
+    of per-batch gradient collectives (a rank-local plan would leave the
+    larger partitions allreducing against nobody)."""
+    bs = min(batch_size, max(min_rows, 1))
+    return bs, min_rows // bs
 
 
 def _worker_partition(data, feature_col: str, label_col: str,
@@ -56,21 +70,19 @@ def _worker_partition(data, feature_col: str, label_col: str,
     petastorm partition discipline).
 
     Returns ``(feats, labels, files_read, bs, steps)``. ``bs`` and
-    ``steps`` (batches per epoch) are derived from the GLOBAL minimum
-    partition size, not this rank's, because every rank must run the same
-    number of per-batch gradient collectives — a rank-local batch count
-    would leave the larger partitions allreducing against nobody.
-    ``files_read`` is None for the in-memory path.
+    ``steps`` (batches per epoch) come from :func:`_step_plan` over the
+    GLOBAL minimum partition size. ``files_read`` is None for the
+    in-memory path.
     """
-    min_rows = _min_partition_rows(data, world)
-    bs = min(batch_size, max(min_rows, 1))
-    steps = min_rows // bs
     if isinstance(data, StoreDataRef):
         from horovod_tpu.data.store import ShardedDatasetReader
         reader = ShardedDatasetReader(data.store, data.path, rank, world)
+        bs, steps = _step_plan(
+            _min_partition_rows(data, world, meta=reader.meta), batch_size)
         cols = reader.load_columns()
         return (cols[feature_col], cols[label_col],
                 list(reader.files_read), bs, steps)
+    bs, steps = _step_plan(_min_partition_rows(data, world), batch_size)
     feats = data[feature_col]
     labels = data[label_col]
     lo, hi = _shard(len(feats), rank, world)
@@ -148,12 +160,10 @@ def _fit_worker(model_bytes: bytes, data,
         lo, hi = _shard(len(feats), rank, world)
         feats, labels = feats[lo:hi], labels[lo:hi]
         sample = jnp.asarray(feats[:1])
-    # bs and steps derive from the GLOBAL minimum partition, not this
-    # rank's rows: every rank must run the same number of per-batch
-    # gradient allreduces or the collectives desync.
-    min_rows = _min_partition_rows(data, world)
-    bs = min(batch_size, max(min_rows, 1))
-    steps_per_epoch = min_rows // bs
+    bs, steps_per_epoch = _step_plan(
+        _min_partition_rows(data, world,
+                            meta=reader.meta if reader else None),
+        batch_size)
 
     params = model.init(jax.random.PRNGKey(seed), sample)["params"]
     tx = optax.adam(lr)

@@ -131,6 +131,30 @@ _WORKER = textwrap.dedent("""
         last = hvd.join()
         assert last == 1, last
         print(f"proc {{pid}} JOIN-OK", flush=True)
+    elif mode == "join_service":
+        # VERDICT r3 item 4: rank 0 joins at step 3; rank 1 keeps
+        # allreducing through step 6 with CORRECT averages (divisor
+        # excludes the joined rank; joined peer services with zeros).
+        import torch
+        import horovod_tpu.torch as hvt
+        steps = 3 if pid == 0 else 6
+        for step in range(steps):
+            avg = hvt.allreduce(
+                torch.full((4,), float((pid + 1) * (step + 1))))
+            want = 1.5 * (step + 1) if step < 3 else 2.0 * (step + 1)
+            assert torch.allclose(avg, torch.full((4,), want)), (step, avg)
+        if pid == 1:
+            # other ops while the peer is joined: Sum (zeros), Max (-inf)
+            tot = hvt.allreduce(torch.full((2,), 5.0), op=hvt.Sum)
+            assert torch.allclose(tot, torch.full((2,), 5.0)), tot
+            mx = hvt.allreduce(torch.full((2,), -7.0), op=hvt.Max)
+            assert torch.allclose(mx, torch.full((2,), -7.0)), mx
+        last = hvd.join()
+        assert last == 1, last
+        # post-join: negotiation history restarted symmetrically
+        avg = hvt.allreduce(torch.full((2,), float(pid)))
+        assert torch.allclose(avg, torch.full((2,), 0.5)), avg
+        print(f"proc {{pid}} JOIN-SERVICE-OK", flush=True)
     elif mode == "match":
         C._negotiate("allreduce", (("sig",), (0,)))
         C._negotiate("allreduce", (("sig",), (0,)))  # cache hit
@@ -201,6 +225,16 @@ def test_two_process_join_returns_last_rank():
     for rc, out in _run_pair("join"):
         assert rc == 0, out
         assert "JOIN-OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_joined_peer_services_allreduce():
+    """Upstream join semantics (horovod/common/ops join): rank 0 joins at
+    step 3, rank 1 allreduces through step 6 — joined peer contributes
+    neutrals, Average divisor excludes it, post-join ops still work."""
+    for rc, out in _run_pair("join_service"):
+        assert rc == 0, out
+        assert "JOIN-SERVICE-OK" in out
 
 
 @pytest.mark.slow
