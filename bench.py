@@ -94,7 +94,22 @@ def _report(metric, unit, per_sec, dt, flops, vs_baseline=None):
 def bench_resnet50(on_tpu):
     from horovod_tpu.models import ResNet50
     batch, size, steps = (128, 224, 30) if on_tpu else (8, 64, 3)
-    model = ResNet50(num_classes=1000)
+    # ROOFLINE BN-ceiling experiments, CPU-prepped and flag-gated so they
+    # can be measured the moment the relay answers (VERDICT r3 item 6):
+    #   HOROVOD_BENCH_BN_STATS=bf16  -> bf16 BN moment accumulation
+    #   HOROVOD_BENCH_STEM=s2d       -> MLPerf space-to-depth stem
+    variant = {}
+    bn_stats = os.environ.get("HOROVOD_BENCH_BN_STATS", "").lower()
+    if bn_stats in ("bf16", "bfloat16"):
+        variant["bn_stats_dtype"] = jnp.bfloat16
+    elif bn_stats in ("fp32", "float32"):
+        variant["bn_stats_dtype"] = jnp.float32
+    stem = os.environ.get("HOROVOD_BENCH_STEM", "").lower()
+    if stem:
+        variant["stem"] = stem
+    model = ResNet50(num_classes=1000, **variant)
+    if variant:
+        print(f"# resnet50 variant: {variant}", file=sys.stderr, flush=True)
     images = jnp.asarray(
         np.random.default_rng(0).standard_normal((batch, size, size, 3)),
         jnp.bfloat16)

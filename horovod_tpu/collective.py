@@ -630,6 +630,9 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
                     f"process(es) {list(joined)} are joined is not "
                     "supported — use the global set or the in-jit mask "
                     "join.")
+            # Symmetric with the joined side's check: both raise in the
+            # same round, BEFORE anyone launches the device collective.
+            _check_join_avg_dtypes(params[0], shapes)
     key = (kind, treedef, shapes, param_key, id(m))
     fn = _EAGER_CACHE.get(key)
     if fn is None:
@@ -685,13 +688,9 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
         if n_active <= 0:
             raise RuntimeError("every process is joined; no active ranks")
         factor = n / n_active
-        for i, o in enumerate(out_leaves):
-            if not jnp.issubdtype(o.dtype, jnp.floating):
-                raise RuntimeError(
-                    "integer Average allreduce with joined ranks is not "
-                    "supported (the divisor correction needs float "
-                    "arithmetic) — use Sum and divide yourself.")
-            out_leaves[i] = o * jnp.asarray(factor, o.dtype)
+        # Float-only by construction: _check_join_avg_dtypes raised before
+        # the device launch otherwise.
+        out_leaves = [o * jnp.asarray(factor, o.dtype) for o in out_leaves]
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
@@ -1105,8 +1104,12 @@ def _join_service_round() -> bool:
             "joined process cannot service this eager collective (no "
             "descriptor — only global-set allreduce is join-serviceable)")
     kind, shapes, op, prescale, postscale, compression, fusion = desc
-    leaves = [np.full(shape, _neutral_host(op, np.dtype(dtype)), dtype)
-              for shape, dtype in shapes]
+    _check_join_avg_dtypes(op, shapes)
+    # broadcast_to: O(1) host memory for the full (n, ...) stacked view —
+    # place() only reads this process's rows anyway.
+    leaves = [np.broadcast_to(
+        np.asarray(_neutral_host(op, np.dtype(dtype)), dtype), shape)
+        for shape, dtype in shapes]
     # Single-leaf ops (the common case) replay as the bare array so the
     # treedef — part of the compile-cache key — matches what allreduce()
     # compiled while this process was active. Multi-leaf pytrees replay
@@ -1130,18 +1133,39 @@ def _join_service_round() -> bool:
     return False
 
 
+def _check_join_avg_dtypes(op: int, shapes) -> None:
+    """Integer Average cannot take the joined-divisor correction (it needs
+    float arithmetic); raise on BOTH sides of the round, before the device
+    collective launches, so neither peer is left parked inside it."""
+    if op != ReduceOp.Average:
+        return
+    bad = [d for _, d in shapes
+           if not jnp.issubdtype(np.dtype(d), jnp.floating)]
+    if bad:
+        raise RuntimeError(
+            f"integer Average allreduce (dtypes {bad}) with joined ranks "
+            "is not supported (the divisor correction needs float "
+            "arithmetic) — use Sum and divide yourself.")
+
+
 def _neutral_host(op: int, dtype: np.dtype):
-    """Host-side neutral element for a joined rank's contribution."""
+    """Host-side neutral element for a joined rank's contribution.
+
+    Uses jnp dtype introspection: numpy's ``issubdtype``/``finfo`` do not
+    recognise ml_dtypes floats (bfloat16), and a crash here would leave
+    the active peers parked inside the device collective."""
     if op in (ReduceOp.Sum, ReduceOp.Average, ReduceOp.Adasum):
-        return dtype.type(0)
+        return np.zeros((), dtype)[()]
     if op == ReduceOp.Min:
-        return (np.finfo(dtype).max if np.issubdtype(dtype, np.floating)
-                else np.iinfo(dtype).max)
+        return (jnp.finfo(dtype).max
+                if jnp.issubdtype(dtype, jnp.floating)
+                else jnp.iinfo(dtype).max)
     if op == ReduceOp.Max:
-        return (np.finfo(dtype).min if np.issubdtype(dtype, np.floating)
-                else np.iinfo(dtype).min)
+        return (jnp.finfo(dtype).min
+                if jnp.issubdtype(dtype, jnp.floating)
+                else jnp.iinfo(dtype).min)
     if op == ReduceOp.Product:
-        return dtype.type(1)
+        return np.ones((), dtype)[()]
     raise RuntimeError(f"op {op} has no join-neutral element")
 
 

@@ -164,6 +164,7 @@ def test_joined_peer_forces_full_round_and_ships_descriptor(monkeypatch,
 
 
 def test_neutral_host_elements():
+    import jax.numpy as jnp
     assert C._neutral_host(C.ReduceOp.Sum, np.dtype(np.float32)) == 0
     assert C._neutral_host(C.ReduceOp.Average, np.dtype(np.float32)) == 0
     assert C._neutral_host(C.ReduceOp.Product, np.dtype(np.float32)) == 1
@@ -171,8 +172,24 @@ def test_neutral_host_elements():
         np.finfo(np.float32).max
     assert C._neutral_host(C.ReduceOp.Max, np.dtype(np.int32)) == \
         np.iinfo(np.int32).min
+    # bfloat16: numpy's finfo/issubdtype don't recognise ml_dtypes floats;
+    # a crash here would wedge the active peers mid-collective.
+    bf16 = np.dtype("bfloat16")
+    assert float(C._neutral_host(C.ReduceOp.Min, bf16)) == \
+        float(jnp.finfo(jnp.bfloat16).max)
+    assert float(C._neutral_host(C.ReduceOp.Max, bf16)) == \
+        float(jnp.finfo(jnp.bfloat16).min)
     with pytest.raises(RuntimeError, match="neutral"):
         C._neutral_host(999, np.dtype(np.float32))
+
+
+def test_join_avg_dtype_check():
+    shapes_f = (((2, 4), "float32"),)
+    shapes_i = (((2, 4), "int32"),)
+    C._check_join_avg_dtypes(C.ReduceOp.Average, shapes_f)   # fine
+    C._check_join_avg_dtypes(C.ReduceOp.Sum, shapes_i)       # Sum: fine
+    with pytest.raises(RuntimeError, match="integer Average"):
+        C._check_join_avg_dtypes(C.ReduceOp.Average, shapes_i)
 
 
 def test_native_coordinator_tracks_pending_ops(monkeypatch, rng):
