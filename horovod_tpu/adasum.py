@@ -185,6 +185,60 @@ def adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
     return jnp.where(member, result, x)
 
 
+def _group_tables(axis_size: int, groups):
+    """Static per-device lookup tables for a (possibly partial, possibly
+    unequal-size) grouping of the axis: (member?, group size)."""
+    member_np = np.zeros(axis_size, bool)
+    gsize_np = np.ones(axis_size, np.float32)
+    for g in groups:
+        for rk in g:
+            member_np[rk] = True
+            gsize_np[rk] = len(g)
+    return member_np, gsize_np
+
+
+def _group_mean_ppermute(x: jnp.ndarray, axis: str, axis_size: int,
+                         groups) -> jnp.ndarray:
+    """Mean of ``x`` within each group via ``max(gsize)-1`` cyclic-shift
+    ppermute rounds — masked SPMD that, unlike ``axis_index_groups``
+    collectives, needs neither a full partition of the axis nor equal
+    group sizes (the subset-process-set case). Devices outside every
+    group pass through unchanged."""
+    _, gsize_np = _group_tables(axis_size, groups)
+    gid = lax.axis_index(axis)
+    gsize = jnp.asarray(gsize_np)[gid]
+    gmax = max((len(g) for g in groups), default=1)
+    x0 = x.astype(jnp.float32)
+    acc = x0
+    for t in range(1, gmax):
+        # Round t: every member receives groupmate (i+t) mod gs's ORIGINAL
+        # value; groups smaller than t contribute no entries and their
+        # members receive ppermute's zero fill (acc unchanged).
+        perm = [(g[(i + t) % len(g)], g[i])
+                for g in groups if len(g) > t for i in range(len(g))]
+        acc = acc + lax.ppermute(x0, axis, perm)
+    return (acc / gsize).astype(x.dtype)
+
+
+def _group_broadcast_ppermute(x: jnp.ndarray, axis: str, axis_size: int,
+                              groups) -> jnp.ndarray:
+    """Broadcast each group's FIRST member's value to the rest of its
+    group with one ppermute per receiver offset; non-group devices pass
+    through. Same masked-SPMD rationale as :func:`_group_mean_ppermute`."""
+    gmax = max((len(g) for g in groups), default=1)
+    out = x
+    for t in range(1, gmax):
+        perm = [(g[0], g[t]) for g in groups if len(g) > t]
+        targets = np.zeros(axis_size, bool)
+        for g in groups:
+            if len(g) > t:
+                targets[g[t]] = True
+        recv = lax.ppermute(x, axis, perm)
+        is_t = jnp.asarray(targets)[lax.axis_index(axis)]
+        out = jnp.where(is_t, recv, out)
+    return out
+
+
 def hierarchical_adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
                                   groups) -> jnp.ndarray:
     """Hierarchical Adasum (upstream ``HOROVOD_HIERARCHICAL_ALLREDUCE`` +
@@ -193,30 +247,52 @@ def hierarchical_adasum_allreduce(x: jnp.ndarray, axis: str, axis_size: int,
     sensitive inter-host combine), then broadcast each leader's result back
     to its group.
 
-    ``groups`` partitions the axis ranks into equal-size lists (e.g. one
-    list per process/host). Group size 1 degrades to plain Adasum; a single
-    group degrades to a plain average — exactly upstream's semantics.
+    ``groups`` lists the member ranks per host. When they partition the
+    whole axis with equal sizes (the global process set), the local phases
+    ride ``axis_index_groups`` psums; otherwise (a SUBSET process set —
+    per-host member counts may differ and non-members exist) the local
+    phases run as masked cyclic ppermutes and non-members get ``x`` back
+    unchanged. Group size 1 degrades to plain Adasum; a single group to a
+    plain average — upstream's semantics either way.
     """
     groups = [list(g) for g in groups]
-    sizes = {len(g) for g in groups}
-    if len(sizes) != 1:
-        raise ValueError(
-            f"hierarchical adasum requires equal group sizes, got "
-            f"{sorted(len(g) for g in groups)}")
-    gsize = sizes.pop()
+    sizes = sorted({len(g) for g in groups})
+    covered = sorted(r for g in groups for r in g)
+    full_partition = (covered == list(range(axis_size))
+                      and len(sizes) == 1)
+    member_np, _ = _group_tables(axis_size, groups)
+
+    def member_mask():
+        return jnp.asarray(member_np)[lax.axis_index(axis)]
+
     if len(groups) == 1:
-        # One host: the hierarchy degenerates to the local average (XLA
-        # also rejects axis_index_groups that span the whole axis here).
-        return lax.pmean(x, axis)
-    if gsize > 1:
-        x = lax.psum(x, axis, axis_index_groups=groups) / gsize
+        if full_partition:
+            # One host: the hierarchy degenerates to the local average
+            # (XLA also rejects axis_index_groups spanning the whole axis).
+            return lax.pmean(x, axis)
+        out = _group_mean_ppermute(x, axis, axis_size, groups)
+        return jnp.where(member_mask(), out, x)
+
+    gmax = max(len(g) for g in groups)
+    if gmax > 1:
+        if full_partition:
+            x_loc = lax.psum(x, axis, axis_index_groups=groups) / sizes[0]
+        else:
+            x_loc = _group_mean_ppermute(x, axis, axis_size, groups)
+    else:
+        x_loc = x
     leaders = [g[0] for g in groups]
-    out = adasum_allreduce(x, axis, axis_size, ranks=leaders)
-    if gsize > 1:
-        is_leader = np.zeros(axis_size, bool)
-        for r in leaders:
-            is_leader[r] = True
-        lead = jnp.asarray(is_leader)[lax.axis_index(axis)]
-        out = lax.psum(jnp.where(lead, out, jnp.zeros_like(out)), axis,
-                       axis_index_groups=groups)
+    out = adasum_allreduce(x_loc, axis, axis_size, ranks=leaders)
+    if gmax > 1:
+        if full_partition:
+            is_leader = np.zeros(axis_size, bool)
+            for r in leaders:
+                is_leader[r] = True
+            lead = jnp.asarray(is_leader)[lax.axis_index(axis)]
+            out = lax.psum(jnp.where(lead, out, jnp.zeros_like(out)),
+                           axis, axis_index_groups=groups)
+        else:
+            out = _group_broadcast_ppermute(out, axis, axis_size, groups)
+    if not full_partition:
+        out = jnp.where(member_mask(), out, x)
     return out

@@ -147,20 +147,25 @@ def _set_gather(x: jnp.ndarray, ps: ProcessSet) -> jnp.ndarray:
 def _hierarchical_adasum_groups(ps: ProcessSet):
     """Local-average groups for hierarchical Adasum (upstream
     ``HOROVOD_HIERARCHICAL_ALLREDUCE``): when the env flag is set, devices
-    group by owning process (one group per host); None disables. Global
-    process set only — a subset would need subgroup leader election that
-    upstream doesn't define either."""
+    group by owning process (one group per host); None disables.
+
+    Subset process sets group only the MEMBER ranks by process — per-host
+    member counts may then differ, which
+    ``hierarchical_adasum_allreduce`` handles with masked cyclic ppermutes
+    instead of ``axis_index_groups`` psums (which need a full equal-size
+    partition). The leader of each group is its lowest set-order rank,
+    matching upstream's local-root election."""
     import os
     if os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "").lower() \
             not in ("1", "true", "yes"):
         return None
-    if ps.ranks is not None:
-        raise NotImplementedError(
-            "hierarchical Adasum supports the global process set only")
     devs = list(core.mesh().devices.ravel())
+    member = (set(range(len(devs))) if ps.ranks is None
+              else set(ps.ranks))
     by_proc: dict = {}
     for i, d in enumerate(devs):
-        by_proc.setdefault(d.process_index, []).append(i)
+        if i in member:
+            by_proc.setdefault(d.process_index, []).append(i)
     groups = list(by_proc.values())
     return groups if len(groups) >= 1 else None
 
@@ -240,10 +245,6 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
         if op not in (ReduceOp.Sum, ReduceOp.Average):
             raise ValueError(
                 f"{wire} quantized allreduce supports Sum and Average")
-        if ps.ranks is not None:
-            raise NotImplementedError(
-                f"{wire} quantized allreduce supports the global process "
-                "set only")
         from horovod_tpu.ops.quantized import BLOCK, quantized_allreduce
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -276,15 +277,20 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
         pieces = [
             quantized_allreduce(buf[s:s + seg], ps.axis, core.size(),
                                 average=(op == ReduceOp.Average),
-                                wire=wire)
+                                wire=wire, ranks=ps.ranks)
             for s in range(0, buf.shape[0], seg)
         ]
         out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
         if postscale != 1.0:
             out = out * postscale
+        member, _ = _member_and_setrank(ps)
         for (i, l), (start, ln) in zip(live, spans):
-            new_leaves[i] = lax.dynamic_slice(out, (start,), (ln,)) \
+            reduced = lax.dynamic_slice(out, (start,), (ln,)) \
                 .reshape(l.shape).astype(l.dtype)
+            # Subset sets: non-members get their input back EXACTLY, same
+            # contract as _allreduce_leaf (pre-prescale, un-postscaled).
+            new_leaves[i] = (reduced if ps.ranks is None
+                             else jnp.where(member, reduced, l))
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def reduce_buffer(buf):

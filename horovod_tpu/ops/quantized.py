@@ -93,17 +93,37 @@ def _dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
 
 
 def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
-                        average: bool = True,
-                        wire: str = "int8") -> jnp.ndarray:
+                        average: bool = True, wire: str = "int8",
+                        ranks=None) -> jnp.ndarray:
     """Allreduce ``x`` (any shape) across ``axis_name`` with a 1-byte wire
     format (``"int8"`` or ``"fp8"``); call inside shard_map over the full
-    axis."""
+    axis.
+
+    ``ranks`` restricts the reduction to a subset process set: non-members
+    contribute exact-zero blocks to the full-axis two-phase exchange (zero
+    blocks quantize to zero payloads, so they cannot perturb any scale)
+    and get ``x`` back unchanged; ``average`` divides by the MEMBER count.
+    The wire still rides the whole axis — the same masked-full-axis shape
+    every other subset collective here uses, because subgroup replica
+    groups are not expressible under shard_map.
+    """
     n = axis_size
+    member = None
+    k = n
+    if ranks is not None:
+        ranks = list(ranks)            # one-shot iterables: list first
+        member_np = np.zeros(n, bool)
+        for r in ranks:
+            member_np[r] = True
+        member = jnp.asarray(member_np)[lax.axis_index(axis_name)]
+        k = len(ranks)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).ravel()
     L = flat.shape[0]
     if L == 0:
         return x
+    if member is not None:
+        flat = jnp.where(member, flat, jnp.zeros_like(flat))
     c = -(-L // (n * BLOCK)) * BLOCK    # chunk length, BLOCK-aligned
     flat = jnp.pad(flat, (0, n * c - L))
     chunks = flat.reshape(n, c)
@@ -115,11 +135,14 @@ def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
     s_recv = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
     part = jnp.sum(_dequantize_blocks(q_recv, s_recv), axis=0)    # (c,)
     if average:
-        part = part / n
+        part = part / k
 
     # Phase 2: re-quantize the owned reduced chunk, allgather everywhere.
     q2, s2 = _quantize_blocks(part, wire)
     qg = lax.all_gather(q2, axis_name)                       # (n, c)
     sg = lax.all_gather(s2, axis_name)                       # (n, c/BLOCK)
     out = _dequantize_blocks(qg, sg).reshape(n * c)[:L]
-    return out.reshape(orig_shape).astype(orig_dtype)
+    out = out.reshape(orig_shape).astype(orig_dtype)
+    if member is not None:
+        out = jnp.where(member, out, x)
+    return out

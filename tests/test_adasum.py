@@ -160,16 +160,66 @@ class TestHierarchicalAdasum:
         out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
         np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5, atol=1e-6)
 
-    def test_unequal_groups_raise(self, rng):
-        from horovod_tpu.adasum import hierarchical_adasum_allreduce
+    def test_unequal_groups_match_reference(self, rng):
+        """Unequal group sizes (the subset-process-set shape: per-host
+        member counts differ) run the masked-ppermute local phases and
+        match the host reference; VERDICT r3 item 7."""
         from jax.sharding import PartitionSpec as P
+        from horovod_tpu.adasum import hierarchical_adasum_allreduce
 
-        x = rng.standard_normal((N, 4)).astype(np.float32)
+        x = rng.standard_normal((N, 13)).astype(np.float32)
+        groups = [[0, 1, 2], [3, 4, 5, 6, 7]]
 
         def body(xs):
             return hierarchical_adasum_allreduce(
-                xs[0], "hvd", N, [[0, 1, 2], [3, 4, 5, 6, 7]])[None]
+                xs[0], "hvd", N, groups)[None]
 
-        with pytest.raises(ValueError, match="equal group sizes"):
-            hvd.spmd(body, in_specs=P("hvd"), out_specs=P("hvd"))(
-                jnp.asarray(x))
+        out = np.asarray(hvd.spmd(body, in_specs=P("hvd"),
+                                  out_specs=P("hvd"))(jnp.asarray(x)))
+        m0 = x[:3].astype(np.float64).mean(0)
+        m1 = x[3:].astype(np.float64).mean(0)
+        want = combine(m0, m1)
+        for i in range(N):
+            np.testing.assert_allclose(out[i], want, rtol=1e-4, atol=1e-5)
+
+    def test_partial_axis_groups_nonmembers_passthrough(self, rng):
+        """Groups that do NOT cover the axis (a subset process set):
+        members get the hierarchical result, non-members x back."""
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.adasum import hierarchical_adasum_allreduce
+
+        x = rng.standard_normal((N, 11)).astype(np.float32)
+        groups = [[0, 1, 2], [4, 5]]          # 3, 6, 7 are non-members
+
+        def body(xs):
+            return hierarchical_adasum_allreduce(
+                xs[0], "hvd", N, groups)[None]
+
+        out = np.asarray(hvd.spmd(body, in_specs=P("hvd"),
+                                  out_specs=P("hvd"))(jnp.asarray(x)))
+        want = combine(x[:3].astype(np.float64).mean(0),
+                       x[4:6].astype(np.float64).mean(0))
+        for i in (0, 1, 2, 4, 5):
+            np.testing.assert_allclose(out[i], want, rtol=1e-4, atol=1e-5)
+        for i in (3, 6, 7):
+            np.testing.assert_array_equal(out[i], x[i])
+
+    def test_env_flag_subset_process_set(self, rng, monkeypatch):
+        """HOROVOD_HIERARCHICAL_ALLREDUCE + a subset process set (the two
+        NotImplementedErrors of VERDICT r3 item 7): single test process =>
+        one group of the member ranks => hierarchical degrades to the
+        member mean; non-members get x back."""
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+        members = [1, 3, 5]
+        x = rng.standard_normal((N, 6)).astype(np.float32)
+        ps = hvd.add_process_set(members)
+        try:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Adasum,
+                                           process_set=ps))
+        finally:
+            hvd.remove_process_set(ps)
+        want = x[members].mean(0)
+        for m in members:
+            np.testing.assert_allclose(out[m], want, rtol=1e-5, atol=1e-6)
+        for nm in sorted(set(range(N)) - set(members)):
+            np.testing.assert_array_equal(out[nm], x[nm])

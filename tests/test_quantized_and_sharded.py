@@ -54,13 +54,56 @@ class TestQuantizedAllreduce:
         with pytest.raises(ValueError, match="Sum and Average"):
             hvd.allreduce(x, op=hvd.Min,
                           compression=hvd.Compression.int8)
-        ps = hvd.add_process_set([0, 1])
+
+    @pytest.mark.parametrize("wire", ["int8", "fp8"])
+    @pytest.mark.parametrize("op", ["avg", "sum"])
+    def test_subset_process_set(self, rng, wire, op):
+        """Quantized wire on a subset set (VERDICT r3 item 7): members get
+        the member-only reduction within quantization error, non-members
+        their input back EXACTLY."""
+        members = [1, 3, 6]
+        x = rng.standard_normal((N, 515)).astype(np.float32)  # odd length
+        ps = hvd.add_process_set(members)
+        comp = getattr(hvd.Compression, wire)
+        kw = {} if op == "avg" else {"op": hvd.Sum}
         try:
-            with pytest.raises(NotImplementedError):
-                hvd.allreduce(x, compression=hvd.Compression.int8,
-                              process_set=ps)
+            out = np.asarray(hvd.allreduce(x, compression=comp,
+                                           process_set=ps, **kw))
         finally:
             hvd.remove_process_set(ps)
+        want = (x[members].mean(0) if op == "avg" else x[members].sum(0))
+        tol = 127 if wire == "int8" else 100   # fp8 e4m3: coarser grid
+        bound = 3.0 * len(members) * np.abs(x[members]).max() / tol
+        assert np.abs(out[members[0]] - want).max() < bound
+        for m in members[1:]:
+            np.testing.assert_allclose(out[m], out[members[0]], rtol=1e-6)
+        for nm in sorted(set(range(N)) - set(members)):
+            np.testing.assert_array_equal(out[nm], x[nm])
+
+    def test_subset_exact_leaves_and_prescale(self, rng):
+        """Mixed pytree through the quantized subset path: non-float leaves
+        take the exact reduction, prescale/postscale apply to members only
+        (non-members still get raw input back)."""
+        members = [0, 2, 4, 5]
+        ps = hvd.add_process_set(members)
+        xf = rng.standard_normal((N, 300)).astype(np.float32)
+        xi = rng.integers(0, 10, (N, 7)).astype(np.int32)
+        try:
+            out = hvd.allreduce({"f": xf, "i": xi}, op=hvd.Sum,
+                                compression=hvd.Compression.int8,
+                                prescale_factor=2.0,
+                                process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+        of, oi = np.asarray(out["f"]), np.asarray(out["i"])
+        wantf = 2.0 * xf[members].sum(0)
+        bound = 2 * 3.0 * len(members) * np.abs(xf[members]).max() / 127
+        assert np.abs(of[members[0]] - wantf).max() < bound
+        np.testing.assert_array_equal(oi[members[0]],
+                                      2 * xi[members].sum(0))
+        for nm in sorted(set(range(N)) - set(members)):
+            np.testing.assert_array_equal(of[nm], xf[nm])
+            np.testing.assert_array_equal(oi[nm], xi[nm])
 
 
 class TestFP8Allreduce:
