@@ -150,6 +150,23 @@ def _per_rank(per_process: list) -> list:
     return [v for v in per_process for _ in range(ls)]
 
 
+def _exchange_sizes_i32(row):
+    """One FIXED-SHAPE host round exchanging per-process int32 size rows
+    (upstream folds size negotiation into the single controller round;
+    ``allgather_object`` would cost two-plus rounds of pickled max-length
+    padding — r3 weak 5). Returns the (process_count, len(row)) matrix."""
+    import numpy as np
+
+    from horovod_tpu.collective import _host_allgather_i32
+    row = np.asarray(row, np.int64).reshape(-1)
+    if (row < 0).any() or (row >= 2 ** 31).any():
+        # The pickled exchange this replaces was exact for any Python int;
+        # an int32 wraparound would silently truncate peer shapes.
+        raise ValueError(f"ragged sizes/splits must be in [0, 2^31), got "
+                         f"{row.tolist()}")
+    return _host_allgather_i32(row.astype(np.int32))
+
+
 def _ragged_allgather_job(arr, process_set):
     """Dispatch-thread body for a ragged allgather: exchange per-process
     dim-0 sizes (upstream's controller size negotiation), build the core
@@ -167,7 +184,7 @@ def _ragged_allgather_job(arr, process_set):
     ls = local_size()
     if jax.process_count() > 1:
         sizes = _per_rank(
-            [int(s) for s in _hvd.allgather_object(int(arr.shape[0]))])
+            [int(s) for s in _exchange_sizes_i32([arr.shape[0]])[:, 0]])
         entries = [arr if r // ls == me else
                    np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
                    for r in range(n)]
@@ -213,7 +230,7 @@ def _alltoall_splits_job(arr, splits_row, process_set):
     if jax.process_count() > 1:
         me = jax.process_index()
         ls = local_size()
-        rows = _per_rank(_hvd.allgather_object(sp_row.tolist()))
+        rows = _per_rank(list(_exchange_sizes_i32(sp_row)))
         sp = np.asarray(rows, np.int64)          # (size, size) after expand
         entries = [arr if r // ls == me else
                    np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
