@@ -17,11 +17,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
 from horovod_tpu.data.store import Store  # noqa: F401
-from horovod_tpu.spark.estimator import JaxEstimator, JaxModel  # noqa: F401
+from horovod_tpu.spark.estimator import (  # noqa: F401
+    JaxEstimator, JaxModel, load_checkpoint,
+)
 
 __all__ = ["run", "run_elastic", "JaxEstimator", "JaxModel", "SparkBackend",
            "spark_available", "KerasEstimator", "TorchEstimator",
-           "TorchModel", "Store"]
+           "TorchModel", "Store", "load_checkpoint"]
 
 
 def run_elastic(*_a, **_k):

@@ -20,7 +20,24 @@ import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
 
-__all__ = ["JaxEstimator", "JaxModel", "StoreDataRef"]
+__all__ = ["JaxEstimator", "JaxModel", "StoreDataRef", "load_checkpoint"]
+
+
+def _checkpoint_file(store, run_id: str) -> str:
+    """The one place the estimator checkpoint layout is defined."""
+    return store.join(store.checkpoint_path(run_id), "final.pkl")
+
+
+def load_checkpoint(store, run_id: str = "default") -> dict:
+    """Load the weights an estimator persisted to the store's per-run
+    checkpoint path (``{"params": ...}`` / ``{"state_dict": ...}`` /
+    ``{"weights": ...}`` depending on the estimator family)."""
+    import cloudpickle
+    if isinstance(store, str):
+        from horovod_tpu.data.store import Store
+        store = Store.create(store)
+    with store.open(_checkpoint_file(store, run_id), "rb") as f:
+        return cloudpickle.loads(f.read())
 
 
 @dataclass
@@ -281,6 +298,21 @@ class _StoreFitMixin:
             raise ValueError("fit_on_store() requires store=")
         return self.fit(None)
 
+    def _store_checkpoint(self, payload: dict) -> None:
+        """Persist the trained weights under the store's per-run
+        checkpoint path (upstream keeps serialized model blobs in the
+        Store — ``horovod/spark/common/store.py`` checkpoint dirs)."""
+        if self.store is None:
+            return
+        import cloudpickle
+        # Only LocalStore.open auto-creates parents; fsspec filesystems
+        # (incl. file://) do not — a missing makedirs would crash AFTER
+        # training and lose the model.
+        self.store.makedirs(self.store.checkpoint_path(self.run_id))
+        with self.store.open(_checkpoint_file(self.store, self.run_id),
+                             "wb") as f:
+            f.write(cloudpickle.dumps(payload))
+
     def _init_store(self, store, run_id, num_shards, data_format):
         if isinstance(store, str):
             from horovod_tpu.data.store import Store
@@ -342,4 +374,5 @@ class JaxEstimator(_StoreFitMixin):
         # Rank 0's weights are the trained model (allreduced grads keep all
         # replicas identical; collecting rank 0 mirrors upstream).
         params = next(r["params"] for r in results if r["rank"] == 0)
+        self._store_checkpoint({"params": params})
         return JaxModel(self.model, params, self.feature_col)

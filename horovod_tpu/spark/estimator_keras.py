@@ -133,4 +133,5 @@ class KerasEstimator(_StoreFitMixin):
                   self.lr, self.epochs, self.batch_size, self.seed))
         self.last_fit_results = results
         weights = next(r["weights"] for r in results if r["rank"] == 0)
+        self._store_checkpoint({"weights": weights})
         return KerasModel(self.model, weights, self.feature_col)

@@ -142,4 +142,5 @@ class TorchEstimator(_StoreFitMixin):
                   self.lr, self.epochs, self.batch_size, self.seed))
         self.last_fit_results = results
         state = next(r["state_dict"] for r in results if r["rank"] == 0)
+        self._store_checkpoint({"state_dict": state})
         return TorchModel(self.model, state, self.feature_col)

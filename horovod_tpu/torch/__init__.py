@@ -159,12 +159,21 @@ def _exchange_sizes_i32(row):
 
     from horovod_tpu.collective import _host_allgather_i32
     row = np.asarray(row, np.int64).reshape(-1)
-    if (row < 0).any() or (row >= 2 ** 31).any():
-        # The pickled exchange this replaces was exact for any Python int;
-        # an int32 wraparound would silently truncate peer shapes.
-        raise ValueError(f"ragged sizes/splits must be in [0, 2^31), got "
-                         f"{row.tolist()}")
-    return _host_allgather_i32(row.astype(np.int32))
+    # The pickled exchange this replaces was exact for any Python int; an
+    # int32 wraparound would silently truncate peer shapes. A LOCAL raise
+    # before the collective would wedge the peers already inside it, so
+    # the validity flag rides the round in-band and every process raises
+    # together.
+    bad = int(bool((row < 0).any() or (row >= 2 ** 31).any()))
+    wire = np.concatenate([np.clip(row, 0, 2 ** 31 - 1), [bad]])
+    rows = _host_allgather_i32(wire.astype(np.int32))
+    if rows[:, -1].any():
+        offenders = [int(i) for i in np.nonzero(rows[:, -1])[0]]
+        raise ValueError(
+            f"ragged sizes/splits must be in [0, 2^31) on every process; "
+            f"process(es) {offenders} sent out-of-range values"
+            + (f" (local row: {row.tolist()})" if bad else ""))
+    return rows[:, :-1]
 
 
 def _ragged_allgather_job(arr, process_set):

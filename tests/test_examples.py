@@ -74,6 +74,13 @@ class TestExamples:
                    devices=2, timeout=600)
         assert "worker:" in out
 
+    def test_estimator_store(self):
+        out = _run("estimator_store.py", "--workers", "2", "--epochs", "3",
+                   devices=2, timeout=600)
+        assert "staged 256 rows" in out
+        assert "read only" in out
+        assert "reloaded checkpoint matches" in out
+
     def test_resnet50_train(self):
         _run("resnet50_train.py", "--steps", "2", "--batch-per-chip", "2",
              "--image-size", "64")

@@ -194,6 +194,14 @@ class TestEstimatorFromStore:
         assert os.path.exists(os.path.join(
             str(tmp_path), "intermediate_train_data", "default",
             "_meta.json"))
+        # ... and so do the trained weights (upstream's store checkpoints)
+        from horovod_tpu.spark import load_checkpoint
+        import jax
+        ckpt = load_checkpoint(str(tmp_path))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            ckpt["params"], model.params)
 
     def test_two_subprocess_workers_read_only_their_partition(
             self, tmp_path):
@@ -217,6 +225,20 @@ class TestEstimatorFromStore:
             results[0]["params"], results[1]["params"])
         hist = results[0]["history"]
         assert hist[-1] < 0.5 * hist[0], hist
+
+    def test_fsspec_store_fit_and_checkpoint(self, tmp_path):
+        """file:// goes through FsspecStore (no auto-mkdir): staging AND
+        the post-fit checkpoint write must create their own dirs."""
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import load_checkpoint
+        est, model, X, y = self._fit(f"file://{tmp_path}", InlineBackend())
+        assert isinstance(est.store, FsspecStore)
+        import jax
+        ckpt = load_checkpoint(f"file://{tmp_path}")
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            ckpt["params"], model.params)
 
     def test_uneven_partitions_stay_in_sync(self, tmp_path):
         """3 shards over 2 workers (rank0 owns 2, rank1 owns 1): the

@@ -106,6 +106,10 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SELF.jsonl"))
     ap.add_argument("--once", action="store_true",
                     help="single probe+capture attempt, no loop")
+    ap.add_argument("--tune-tiles", action="store_true",
+                    help="after the FIRST successful capture, run the "
+                         "flash-tile sweep (tools/tune_tiles.py --quick) "
+                         "so the shipped table gains measured entries")
     args = ap.parse_args(argv)
 
     t0 = time.time()
@@ -132,6 +136,20 @@ def main(argv=None) -> int:
                 usable = usable or any("error" not in r for r in records)
             # A cycle where the relay wedged mid-run (every record an
             # error) must NOT count: keep watching for a real heal.
+            if usable and captures == 0 and args.tune_tiles:
+                print("# running flash-tile sweep (quick)...", flush=True)
+                try:
+                    r = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "tune_tiles.py"),
+                         "--quick"],
+                        timeout=args.bench_timeout, capture_output=True,
+                        text=True, cwd=REPO)
+                    print(r.stdout.strip() or r.stderr.strip()[-400:],
+                          flush=True)
+                except subprocess.TimeoutExpired:
+                    print("# tile sweep timed out (relay wedged "
+                          "mid-sweep?)", flush=True)
             captures += 1 if usable else 0
             if captures >= args.max_captures:
                 print(f"# done: {captures} capture(s) -> {args.out}",
