@@ -34,19 +34,28 @@ def main():
                     help="store path or fsspec URL (default: a temp dir)")
     args = ap.parse_args()
 
+    import contextlib
+
+    from horovod_tpu.data.store import Store
+
+    # ExitStack: the temp store is removed even when training or an
+    # assertion below fails.
+    with contextlib.ExitStack() as stack:
+        if args.store is None:
+            args.store = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="hvd_store_"))
+        _run_demo(args, Store.create(args.store))
+
+
+def _run_demo(args, store):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from horovod_tpu.cluster import LocalProcessBackend
-    from horovod_tpu.data.store import Store, read_meta
+    from horovod_tpu.data.store import read_meta
     from horovod_tpu.spark import JaxEstimator, load_checkpoint
-
-    tmp = None
-    if args.store is None:
-        tmp = tempfile.TemporaryDirectory(prefix="hvd_store_")
-        args.store = tmp.name
-    store = Store.create(args.store)
 
     class MLP(nn.Module):
         @nn.compact
@@ -86,8 +95,6 @@ def main():
         ckpt["params"], model.params)
     pred = model.predict(X[:4])
     print(f"reloaded checkpoint matches; predictions {np.round(pred, 2)}")
-    if tmp is not None:
-        tmp.cleanup()
 
 
 if __name__ == "__main__":

@@ -60,6 +60,23 @@ def _unflatten(flat, tree):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _adamw_chunk_update(g, state: ShardedAdamWState, p, learning_rate,
+                        b1, b2, eps, weight_decay):
+    """The elementwise AdamW kernel over one owned chunk — shared by
+    ZeRO-1 (:func:`sharded_adamw`) and ZeRO-3
+    (:func:`horovod_tpu.parallel.fsdp.fsdp_adamw`), so the Adam math has
+    exactly one definition. Returns ``(update, (step, mu, nu))``."""
+    step = state.step + 1
+    stepf = step.astype(jnp.float32)[0]
+    mu = b1 * state.mu + (1 - b1) * g
+    nu = b2 * state.nu + (1 - b2) * jnp.square(g)
+    mu_hat = mu / (1 - b1 ** stepf)
+    nu_hat = nu / (1 - b2 ** stepf)
+    upd = -learning_rate * (mu_hat / (jnp.sqrt(nu_hat) + eps)
+                            + weight_decay * p)
+    return upd, (step, mu, nu)
+
+
 def sharded_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
                   eps: float = 1e-8, weight_decay: float = 0.0,
                   axis_name: Optional[str] = None
@@ -106,14 +123,9 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         flat_p = jnp.pad(_flatten(params), (0, pad))
         p_chunk = lax.dynamic_slice(flat_p, (rank * c,), (c,))
 
-        step = state.step + 1
-        stepf = step.astype(jnp.float32)[0]
-        mu = b1 * state.mu + (1 - b1) * g_chunk
-        nu = b2 * state.nu + (1 - b2) * jnp.square(g_chunk)
-        mu_hat = mu / (1 - b1 ** stepf)
-        nu_hat = nu / (1 - b2 ** stepf)
-        upd_chunk = -learning_rate * (
-            mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * p_chunk)
+        upd_chunk, (step, mu, nu) = _adamw_chunk_update(
+            g_chunk, state, p_chunk, learning_rate, b1, b2, eps,
+            weight_decay)
 
         # All-gather the updated chunks back to a full update pytree.
         full = lax.all_gather(upd_chunk, ax, tiled=True)[:L]

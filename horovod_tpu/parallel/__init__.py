@@ -4,6 +4,10 @@ parallelism. TPU-native replacement for the reference's rank-topology layer
 named mesh dimensions and XLA places the collectives.
 """
 
+from horovod_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_adamw, fsdp_apply, fsdp_scan_blocks, fsdp_shard_params,
+    stack_layer_shards,
+)
 from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     chunkable_loss, pipeline_1f1b, pipeline_apply, pipeline_loss,

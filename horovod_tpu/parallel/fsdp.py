@@ -1,0 +1,239 @@
+"""FSDP / ZeRO-3: parameters sharded across data-parallel ranks, gathered
+just-in-time per block.
+
+Extends the weight-update sharding ladder (PAPERS.md "Automatic
+Cross-Replica Sharding of Weight Update"; ZeRO-1 lives in
+``optimizer_sharded.py``) to the full ZeRO-3 form the reference ecosystem
+reaches through DeepSpeed-on-hvd: parameter storage is ``1/n`` per device,
+each block's weights are **all-gathered just in time** for its forward,
+dropped, re-gathered during backward (gather-is-the-remat), and the
+parameter cotangents leave the block as a **single fused
+``psum_scatter``** — the data-parallel gradient sync and the re-sharding
+are the same collective. Peak parameter memory is ``|params|/n + max_block``
+instead of ``|params|``; wire volume per step matches plain DP allreduce
+(AG + RS = 2·|p|·(n-1)/n).
+
+TPU shape: everything is explicit inside ``shard_map`` — the shard is a
+flat fp32 ``(c,)`` chunk per device (same flat-chunk layout as
+``sharded_adamw``), ``lax.all_gather(tiled=True)`` materialises a block,
+and ``lax.scan`` over stacked per-layer shards gives the layer loop one
+compiled body. No parameter ever exists unsharded outside the block that
+is executing.
+
+Usage (inside ``hvd.spmd``)::
+
+    shards = fsdp_shard_params(params)        # eager: (n*c,) — shard P(ax)
+    def step(shard, opt_state, batch):
+        def loss(shard):
+            y = fsdp_apply(block_fn, params_struct, shard, batch)
+            return loss_fn(y)
+        l, g_shard = jax.value_and_grad(loss)(shard)   # (c,) via RS
+        upd, opt_state = fsdp_adamw(...).update(g_shard, opt_state, shard)
+        return optax.apply_updates(shard, upd), opt_state, l
+
+The optimizer never leaves the shard domain — ZeRO-3's third win: no
+update all-gather at all (the next forward's block gathers pick up the
+new values).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from horovod_tpu import core
+from horovod_tpu.optimizer_sharded import (ShardedAdamWState, _flatten,
+                                           _unflatten)
+
+__all__ = ["fsdp_shard_params", "fsdp_apply", "fsdp_scan_blocks",
+           "fsdp_adamw", "flat_size", "stack_layer_shards"]
+
+
+def flat_size(tree) -> int:
+    """Total element count of a pytree (the flat fp32 length)."""
+    return sum(int(np.prod(l.shape)) if l.shape else 1
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def _chunk(L: int, n: int) -> int:
+    return -(-L // n)
+
+
+def fsdp_shard_params(params, *, num_shards: Optional[int] = None
+                      ) -> jnp.ndarray:
+    """Eager: flatten ``params`` to a padded fp32 ``(n*c,)`` vector.
+
+    Shard it over the communicator with ``P(axis)`` so each device holds
+    its ``(c,)`` chunk inside ``shard_map``. The original pytree (or its
+    ``jax.eval_shape`` struct) is the template every ``fsdp_apply`` needs
+    to rebuild block weights. ``num_shards`` (keyword-only) overrides the
+    communicator size for sub-mesh layouts.
+    """
+    n = num_shards or core.size()
+    flat = _flatten(params)
+    c = _chunk(flat.shape[0], n)
+    return jnp.pad(flat, (0, n * c - flat.shape[0]))
+
+
+def _unshard(shard: jnp.ndarray, template, axis_name: str):
+    """(c,) shard -> full params pytree (all_gather, slice off padding)."""
+    full = lax.all_gather(shard, axis_name, tiled=True)
+    return _unflatten(full[:flat_size(template)], template)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 4))
+def _fsdp_call(block_fn, template, shard, x, axis_name):
+    return block_fn(_unshard(shard, template.tree, axis_name), x)
+
+
+def _fsdp_fwd(block_fn, template, shard, x, axis_name):
+    # Residuals are the SHARD + activations — never the gathered block
+    # (that is the whole memory point; backward re-gathers).
+    y = block_fn(_unshard(shard, template.tree, axis_name), x)
+    return y, (shard, x)
+
+
+def _fsdp_bwd(block_fn, template, axis_name, res, ct):
+    shard, x = res
+    n = lax.psum(1, axis_name)
+
+    # Re-gather + recompute the block under vjp (gather-is-the-remat),
+    # then transpose. d(all_gather)/d(shard) would be a dynamic slice of
+    # the full cotangent; fused with the DP mean it becomes one
+    # psum_scatter — the gradient sync and the re-sharding are the same
+    # collective, so we bypass vjp-through-_unshard and do it explicitly.
+    def run_full(full_flat, x_):
+        L = flat_size(template.tree)
+        return block_fn(_unflatten(full_flat[:L], template.tree), x_)
+
+    full = lax.all_gather(shard, axis_name, tiled=True)
+    _, vjp = jax.vjp(run_full, full, x)
+    g_full, g_x = vjp(ct)
+    g_shard = lax.psum_scatter(g_full, axis_name, scatter_dimension=0,
+                               tiled=True) / n
+    return g_shard, g_x
+
+
+_fsdp_call.defvjp(_fsdp_fwd, _fsdp_bwd)
+
+
+def _as_struct(template):
+    """Real params -> ShapeDtypeStruct pytree: the template travels as a
+    custom_vjp nondiff argument, which must not contain jax arrays."""
+    return jax.tree_util.tree_map(
+        lambda a: (a if isinstance(a, jax.ShapeDtypeStruct)
+                   else jax.ShapeDtypeStruct(jnp.shape(a),
+                                             jnp.result_type(a))),
+        template)
+
+
+class _HashableStruct:
+    """Wrap the struct pytree so jax can cache the custom_vjp by value."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        self._key = (treedef, tuple((tuple(l.shape), str(l.dtype))
+                                    for l in leaves))
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableStruct) and \
+            self._key == other._key
+
+
+def fsdp_apply(block_fn: Callable, template: Any, shard: jnp.ndarray,
+               x, axis_name: Optional[str] = None):
+    """Apply ``block_fn(params, x)`` with params stored as this device's
+    ``(c,)`` flat shard; call inside ``shard_map``.
+
+    The gradient w.r.t. ``shard`` returned by autodiff is ALREADY the
+    data-parallel-mean, re-sharded — feed it straight to
+    :func:`fsdp_adamw` (no separate allreduce).
+
+    Args:
+      block_fn: ``(params_pytree, x) -> y`` (pure).
+      template: pytree matching the original params (shapes/dtypes — the
+        real params or ``jax.eval_shape`` structs).
+      shard: (c,) fp32 chunk from :func:`fsdp_shard_params`.
+      x: activations.
+      axis_name: mesh axis the params are sharded over (default: the
+        communicator axis).
+    """
+    ax = axis_name or core.axis_name()
+    return _fsdp_call(block_fn, _HashableStruct(_as_struct(template)),
+                      shard, x, ax)
+
+
+def stack_layer_shards(stacked_params, *,
+                       num_shards: Optional[int] = None) -> jnp.ndarray:
+    """Eager: flatten a layer-stacked pytree (every leaf ``(L, ...)``) to
+    per-layer padded flat rows ``(L, n*c)`` — shard with ``P(None, axis)``
+    so the scan gathers ONE layer at a time."""
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    L = leaves[0].shape[0]
+    per_layer = [
+        jax.tree_util.tree_map(lambda a, i=i: a[i], stacked_params)
+        for i in range(L)]
+    rows = [fsdp_shard_params(p, num_shards=num_shards)
+            for p in per_layer]
+    return jnp.stack(rows)
+
+
+def fsdp_scan_blocks(block_fn: Callable, template: Any,
+                     layer_shards: jnp.ndarray, x,
+                     axis_name: Optional[str] = None):
+    """Run a stack of identical blocks over ``x`` with per-layer FSDP
+    gathering inside one ``lax.scan``.
+
+    ``layer_shards`` is this device's ``(L, c)`` slice of
+    :func:`stack_layer_shards`'s output; ``template`` describes ONE
+    layer's params. Backward re-gathers layer by layer — peak parameter
+    memory is one block regardless of depth.
+    """
+    ax = axis_name or core.axis_name()
+    struct = _HashableStruct(_as_struct(template))
+
+    def body(h, row):
+        return _fsdp_call(block_fn, struct, row, h, ax), None
+
+    y, _ = lax.scan(body, x, layer_shards)
+    return y
+
+
+def fsdp_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+               eps: float = 1e-8, weight_decay: float = 0.0
+               ) -> optax.GradientTransformation:
+    """AdamW over the flat shard domain: state, gradient, update and
+    PARAMETERS are all ``(c,)`` — ZeRO-3's no-update-allgather property
+    (the next forward's block gathers read the new values).
+
+    ``init`` runs eagerly on the global ``(n*c,)`` vector (shard its
+    output like the params); ``update`` runs inside ``shard_map``.
+    """
+
+    def init(flat_params):
+        return ShardedAdamWState(
+            step=jnp.zeros((core.size(),), jnp.int32),
+            mu=jnp.zeros_like(flat_params),
+            nu=jnp.zeros_like(flat_params))
+
+    def update(g, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError(
+                "fsdp_adamw with weight_decay requires params in update()")
+        from horovod_tpu.optimizer_sharded import _adamw_chunk_update
+        upd, (step, mu, nu) = _adamw_chunk_update(
+            g, state, params if params is not None else 0.0,
+            learning_rate, b1, b2, eps, weight_decay)
+        return upd, ShardedAdamWState(step=step, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

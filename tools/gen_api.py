@@ -42,6 +42,7 @@ MODULES = [
     "horovod_tpu.runner.launcher",
     "horovod_tpu.parallel",
     "horovod_tpu.parallel.pipeline",
+    "horovod_tpu.parallel.fsdp",
     "horovod_tpu.models",
     "horovod_tpu.models.gpt2_pipeline",
     "horovod_tpu.ops.attention",
