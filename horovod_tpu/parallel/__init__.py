@@ -10,8 +10,8 @@ from horovod_tpu.parallel.fsdp import (  # noqa: F401
 )
 from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
-    chunkable_loss, pipeline_1f1b, pipeline_apply, pipeline_loss,
-    pipeline_loss_interleaved,
+    chunkable_loss, pipeline_1f1b, pipeline_apply,
+    pipeline_interleaved_1f1b, pipeline_loss, pipeline_loss_interleaved,
 )
 from horovod_tpu.parallel.sharding import (  # noqa: F401
     PartitionRules, apply_rules, shard_pytree,

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["pipeline_apply", "pipeline_loss", "pipeline_loss_interleaved",
-           "pipeline_1f1b", "chunkable_loss"]
+           "pipeline_1f1b", "pipeline_interleaved_1f1b", "chunkable_loss"]
 
 
 def _graft_last_stage_loss(local, is_last, axis_name):
@@ -265,6 +265,28 @@ def pipeline_loss_interleaved(stage_fn: Callable, stage_params: Any,
     return _graft_last_stage_loss(local, d == S - 1, axis_name)
 
 
+def _mb_loss_cond(per_mb_loss, loss_params, y, m, M, pred):
+    """Loss-head vjp under ``lax.cond`` — shared by BOTH 1F1B executors so
+    the head's scaling/dtype contract has one definition: fires only when
+    ``pred`` (a live last-stage slot), seeds the cotangent with ``1/M``,
+    returns ``(loss_f32, g_loss_params, gy)`` (zeros when gated off)."""
+
+    def _loss_slot(args):
+        lp, yy, mm = args
+        l, l_vjp = jax.vjp(
+            lambda lp_, yy_: per_mb_loss(lp_, yy_, mm), lp, yy)
+        g_lp, gy = l_vjp(jnp.asarray(1.0 / M, l.dtype))
+        return l.astype(jnp.float32), g_lp, gy.astype(yy.dtype)
+
+    def _no_loss(args):
+        lp, yy, _ = args
+        return (jnp.float32(0.0),
+                jax.tree_util.tree_map(jnp.zeros_like, lp),
+                jnp.zeros_like(yy))
+
+    return lax.cond(pred, _loss_slot, _no_loss, (loss_params, y, m))
+
+
 def chunkable_loss(loss_fn):
     """Explicitly mark ``loss_fn`` as taking the two-argument
     ``(outputs, mb_start)`` chunking form.
@@ -445,23 +467,8 @@ def pipeline_1f1b(stage_fn: Callable, per_mb_loss: Callable,
             # would (r3 weak 3). per_mb_loss must therefore contain no
             # collectives: the predicate differs across devices.
             is_loss_slot = active_b & (stage == S - 1)
-
-            def _loss_slot(args):
-                lp, yy, m = args
-                l, l_vjp = jax.vjp(
-                    lambda lp_, yy_: per_mb_loss(lp_, yy_, m), lp, yy)
-                g_lp, gy = l_vjp(jnp.asarray(1.0 / M, l.dtype))
-                return l.astype(jnp.float32), g_lp, gy.astype(yy.dtype)
-
-            def _no_loss_slot(args):
-                lp, yy, _ = args
-                return (jnp.float32(0.0),
-                        jax.tree_util.tree_map(jnp.zeros_like, lp),
-                        jnp.zeros_like(yy))
-
-            l, g_lp_m, gy_seed = lax.cond(
-                is_loss_slot, _loss_slot, _no_loss_slot,
-                (loss_params, y, mb_idx))
+            l, g_lp_m, gy_seed = _mb_loss_cond(
+                per_mb_loss, loss_params, y, mb_idx, M, is_loss_slot)
             g_in = jnp.where(stage == S - 1, gy_seed, cot_in)
 
             slot_b = jnp.remainder(mb_idx, W)
@@ -510,6 +517,208 @@ def pipeline_1f1b(stage_fn: Callable, per_mb_loss: Callable,
         # loss_acc is nonzero on the last stage only; the psum replicates
         # it, so the returned loss is identical on every stage.
         loss = lax.psum(loss_acc, axis_name)
+        return loss, (g_stage, g_loss, g_x)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B: virtual stages x hand-scheduled backward
+# ---------------------------------------------------------------------------
+
+def pipeline_interleaved_1f1b(stage_fn: Callable, per_mb_loss: Callable,
+                              axis_name: str, rounds: int) -> Callable:
+    """Megatron's interleaved 1F1B: ``R`` virtual stages per device AND the
+    hand-scheduled O(in-flight) activation stash — the composition of
+    :func:`pipeline_loss_interleaved` (bubble shrinks ~R-fold) and
+    :func:`pipeline_1f1b` (stash bounded by the schedule's peak in-flight
+    count instead of ``M * R`` residual sets under autodiff).
+
+    TPU shape: the schedule is STATIC DATA — a host-side dependency
+    simulation (``schedule_sim.build_interleaved_1f1b``) emits
+    per-(device, tick) slot/traffic/buffer tables, verified structurally
+    before compile, and the scan body is a dumb table-driven machine: one
+    masked F slot, one masked B slot, one forward and one backward
+    ``ppermute`` per tick. Activations/cotangents wait in ``(R, S)``
+    buffers (round x mb-mod-S — the simulator proves no collision);
+    vjp residuals live in a ``n_slots``-ring with param-only leaves
+    deduplicated PER ROUND (each round's weights appear once, not once
+    per in-flight microbatch).
+
+    Requires ``M % S == 0`` (Megatron's microbatch-group constraint) and
+    ``stage_params`` leaves shaped ``(R, ...)`` per device (the
+    ``stack_block_params_interleaved`` layout after pp-sharding).
+
+    Same return contract as :func:`pipeline_1f1b`: ``fn(stage_params,
+    loss_params, microbatches) -> (loss, (g_stage, g_loss_params,
+    g_microbatches))`` with ``loss`` already replicated,
+    ``g_loss_params`` nonzero on the last device only, ``g_microbatches``
+    on device 0 only, ``g_stage`` stage-local. ``per_mb_loss`` must not
+    contain collectives (it runs under ``lax.cond``).
+    """
+    from horovod_tpu.parallel.schedule_sim import build_interleaved_1f1b
+
+    def fn(stage_params, loss_params, microbatches):
+        S = lax.psum(1, axis_name)
+        d = lax.axis_index(axis_name)
+        R = rounds
+        M = microbatches.shape[0]
+        mb_shape = microbatches.shape[1:]
+        dtype = microbatches.dtype
+
+        # psum of a literal over a shard_map axis is concrete at trace
+        # time (the flat 1F1B's perm construction relies on the same).
+        S_static = int(S)
+        sched = build_interleaved_1f1b(S_static, R, M)
+        T, n_slots = sched.T, sched.n_slots
+
+        def rows(tab):   # (S, T) -> (T, S) scanned xs
+            return jnp.asarray(tab.T, jnp.int32)
+
+        xs = (rows(sched.f_round), rows(sched.f_mb), rows(sched.f_slot),
+              rows(sched.fy_slot),
+              rows(sched.b_round), rows(sched.b_mb), rows(sched.b_slot),
+              rows(sched.by_slot),
+              rows(sched.recv_round), rows(sched.recv_mb),
+              rows(sched.brecv_round), rows(sched.brecv_mb))
+
+        fwd_perm = [(i, (i + 1) % S_static) for i in range(S_static)]
+        bwd_perm = [(i, (i - 1) % S_static) for i in range(S_static)]
+
+        x_struct = jax.ShapeDtypeStruct(mb_shape, dtype)
+        p0 = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        dep_mask = _x_dependent_leaf_mask(stage_fn, p0, x_struct)
+        res_structs = jax.eval_shape(
+            lambda p, xx: jax.tree_util.tree_leaves(
+                jax.vjp(stage_fn, p, xx)[1]),
+            p0, x_struct)
+
+        def pick(row):
+            return lax.dynamic_index_in_dim(row, d, 0, keepdims=False)
+
+        def tick(carry, xrow):
+            (act_buf, cot_buf, ring, round_res, y_buf, g_stage, g_loss,
+             g_x, loss_acc) = carry
+            (fr, fm, fs, fy, br, bm, bs, by, rr, rm, qr, qm) = \
+                [pick(r) for r in xrow]
+
+            # ---- F slot --------------------------------------------------
+            active_f = fm >= 0
+            fr_c = jnp.clip(fr, 0, R - 1)
+            fm_c = jnp.clip(fm, 0, M - 1)
+            fs_c = jnp.clip(fs, 0, n_slots - 1)
+            p_r = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, fr_c, 0,
+                                                   keepdims=False),
+                stage_params)
+            feed = lax.dynamic_index_in_dim(microbatches, fm_c, 0,
+                                            keepdims=False)
+            buf_x = act_buf[fr_c, jnp.remainder(fm_c, S)]
+            x = jnp.where((d == 0) & (fr_c == 0), feed, buf_x)
+            y, vjp_fn = jax.vjp(stage_fn, p_r, x)
+            cur_leaves, res_treedef = jax.tree_util.tree_flatten(vjp_fn)
+            new_ring, new_round = [], []
+            for ringl, roundl, leaf, dep in zip(ring, round_res,
+                                                cur_leaves, dep_mask):
+                if dep:
+                    old = lax.dynamic_index_in_dim(ringl, fs_c, 0,
+                                                   keepdims=False)
+                    new_ring.append(lax.dynamic_update_index_in_dim(
+                        ringl, jnp.where(active_f, leaf, old), fs_c, 0))
+                    new_round.append(roundl)
+                else:
+                    oldr = lax.dynamic_index_in_dim(roundl, fr_c, 0,
+                                                    keepdims=False)
+                    new_round.append(lax.dynamic_update_index_in_dim(
+                        roundl, jnp.where(active_f, leaf, oldr), fr_c, 0))
+                    new_ring.append(ringl)
+            ring, round_res = new_ring, new_round
+            # Loss-head outputs: a compact secondary ring, only the last
+            # device's final-round slots are assigned (fy >= 0) — y
+            # storage scales with the loss stage's in-flight peak, not
+            # n_slots on every device.
+            fy_c = jnp.clip(fy, 0, y_buf.shape[0] - 1)
+            oldy = lax.dynamic_index_in_dim(y_buf, fy_c, 0, keepdims=False)
+            y_buf = lax.dynamic_update_index_in_dim(
+                y_buf, jnp.where(fy >= 0, y, oldy), fy_c, 0)
+
+            # ---- B slot --------------------------------------------------
+            active_b = bm >= 0
+            br_c = jnp.clip(br, 0, R - 1)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            bs_c = jnp.clip(bs, 0, n_slots - 1)
+            res_b = [
+                lax.dynamic_index_in_dim(ringl, bs_c, 0, keepdims=False)
+                if dep else
+                lax.dynamic_index_in_dim(roundl, br_c, 0, keepdims=False)
+                for ringl, roundl, dep in zip(ring, round_res, dep_mask)]
+            vjp_b = jax.tree_util.tree_unflatten(res_treedef, res_b)
+            is_last = (br_c == R - 1) & (d == S - 1)
+            is_loss_slot = active_b & is_last
+            by_c = jnp.clip(by, 0, y_buf.shape[0] - 1)
+            y_loss = lax.dynamic_index_in_dim(y_buf, by_c, 0,
+                                              keepdims=False)
+            l, g_lp_m, gy_seed = _mb_loss_cond(
+                per_mb_loss, loss_params, y_loss, bm_c, M, is_loss_slot)
+            g_in = jnp.where(is_last, gy_seed,
+                             cot_buf[br_c, jnp.remainder(bm_c, S)])
+            gp, gx = vjp_b(g_in)
+
+            g_stage = jax.tree_util.tree_map(
+                lambda gs, g: lax.dynamic_update_index_in_dim(
+                    gs,
+                    lax.dynamic_index_in_dim(gs, br_c, 0, keepdims=False)
+                    + jnp.where(active_b, g, jnp.zeros_like(g)),
+                    br_c, 0),
+                g_stage, gp)
+            g_loss = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(is_loss_slot, g,
+                                           jnp.zeros_like(g)),
+                g_loss, g_lp_m)
+            loss_acc = loss_acc + jnp.where(
+                is_loss_slot, l / M, 0.0)
+            gx_mask = active_b & (br_c == 0) & (d == 0)
+            gx_cur = lax.dynamic_index_in_dim(g_x, bm_c, 0, keepdims=False)
+            g_x = lax.dynamic_update_index_in_dim(
+                g_x, jnp.where(gx_mask, gx_cur + gx, gx_cur), bm_c, 0)
+
+            # ---- hops: consume-before-receive ordering holds because the
+            # buffer reads above used the PRE-hop carry.
+            act_recv = lax.ppermute(y, axis_name, fwd_perm)
+            rr_c = jnp.clip(rr, 0, R - 1)
+            rm_c = jnp.clip(rm, 0, M - 1)
+            slot_a = (rr_c, jnp.remainder(rm_c, S))
+            act_buf = act_buf.at[slot_a].set(
+                jnp.where(rm >= 0, act_recv, act_buf[slot_a]))
+            cot_recv = lax.ppermute(gx, axis_name, bwd_perm)
+            qr_c = jnp.clip(qr, 0, R - 1)
+            qm_c = jnp.clip(qm, 0, M - 1)
+            slot_c = (qr_c, jnp.remainder(qm_c, S))
+            cot_buf = cot_buf.at[slot_c].set(
+                jnp.where(qm >= 0, cot_recv, cot_buf[slot_c]))
+
+            return (act_buf, cot_buf, ring, round_res, y_buf, g_stage,
+                    g_loss, g_x, loss_acc), None
+
+        ring0 = [jnp.zeros((n_slots,) + st.shape, st.dtype) if dep
+                 else jnp.zeros((), jnp.float32)
+                 for st, dep in zip(res_structs, dep_mask)]
+        round0 = [jnp.zeros((R,) + st.shape, st.dtype) if not dep
+                  else jnp.zeros((), jnp.float32)
+                  for st, dep in zip(res_structs, dep_mask)]
+        carry0 = (jnp.zeros((R, S_static) + mb_shape, dtype),
+                  jnp.zeros((R, S_static) + mb_shape, dtype),
+                  ring0, round0,
+                  jnp.zeros((sched.n_y_slots,) + mb_shape, dtype),
+                  jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+                  jax.tree_util.tree_map(jnp.zeros_like, loss_params),
+                  jnp.zeros((M,) + mb_shape, dtype),
+                  jnp.zeros((), jnp.float32))
+        carry0 = jax.tree_util.tree_map(
+            lambda a: _vary_over(axis_name, a)[0], carry0)
+        (_, _, _, _, _, g_stage, g_loss, g_x, loss_acc), _ = lax.scan(
+            tick, carry0, xs)
+        loss = lax.psum(loss_acc, axis_name)   # replicated, like 1F1B
         return loss, (g_stage, g_loss, g_x)
 
     return fn

@@ -568,6 +568,205 @@ class Test1F1B:
             (g_blocks, g_rest), (ref_blocks, ref_rest))
 
 
+class TestInterleaved1F1B:
+    """Megatron's interleaved 1F1B: virtual stages x hand-scheduled
+    backward with a bounded stash — the schedule is static data from a
+    verified host-side simulator (parallel/schedule_sim.py)."""
+
+    R = 2
+
+    @pytest.mark.parametrize("groups", [1, 2])
+    def test_mlp_matches_sequential(self, rng, groups):
+        """groups=2 (M = 2S) exercises the multi-group paths: the
+        (round, mb mod S) buffer keying and residual-slot reuse."""
+        from horovod_tpu.parallel.pipeline import pipeline_interleaved_1f1b
+        S, M1, D1 = N, groups * N, 8
+        L = self.R * S
+        W = rng.standard_normal((L, D1, D1)).astype(np.float32) * 0.3
+        b = rng.standard_normal((L, D1)).astype(np.float32) * 0.1
+        x = rng.standard_normal((M1, MB, D1)).astype(np.float32)
+        Wd = np.stack([np.stack([W[r * S + d] for r in range(self.R)])
+                       for d in range(S)])
+        bd = np.stack([np.stack([b[r * S + d] for r in range(self.R)])
+                       for d in range(S)])
+
+        def sfn(p, h):
+            Wl, bl = p
+            return jax.nn.relu(h @ Wl + bl)
+
+        core = pipeline_interleaved_1f1b(
+            sfn, lambda lp, y, m: jnp.mean(y ** 2), "hvd", rounds=self.R)
+
+        def body(Wd, bd, xs):
+            loss, (gs, gl, gx) = core((Wd[0], bd[0]), jnp.zeros(()), xs)
+            return loss, (gs[0][None], gs[1][None]), gx
+
+        fn = hvd.spmd(body, in_specs=(P("hvd"), P("hvd"), P()),
+                      out_specs=(P(), (P("hvd"), P("hvd")), P()))
+        loss, (gW, gb), g_x = fn(Wd, bd, x)
+
+        def ref(Wall, ball, xx):
+            h = xx
+            for l in range(L):
+                h = jax.nn.relu(h @ Wall[l] + ball[l])
+            return jnp.mean(h ** 2)
+
+        rl, (rW, rb, rX) = jax.value_and_grad(ref, argnums=(0, 1, 2))(
+            jnp.asarray(W), jnp.asarray(b), jnp.asarray(x))
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        rWd = np.stack([np.stack(
+            [np.asarray(rW)[r * S + d] for r in range(self.R)])
+            for d in range(S)])
+        rbd = np.stack([np.stack(
+            [np.asarray(rb)[r * S + d] for r in range(self.R)])
+            for d in range(S)])
+        np.testing.assert_allclose(np.asarray(gW), rWd, rtol=2e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gb), rbd, rtol=2e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_x), np.asarray(rX),
+                                   rtol=2e-3, atol=1e-5)
+
+    def test_m_not_multiple_of_s_raises(self, rng):
+        from horovod_tpu.parallel.schedule_sim import build_interleaved_1f1b
+        with pytest.raises(ValueError, match="M % S"):
+            build_interleaved_1f1b(4, 2, 6)
+
+    def test_gpt2_interleaved_1f1b_matches_single_device(self):
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+        from horovod_tpu.models.gpt2_pipeline import (
+            gpt2_pp_interleaved_1f1b_loss_and_grad,
+            stack_block_params_interleaved)
+        R = self.R
+        cfg = GPT2Config(vocab_size=128, max_seq_len=32, num_layers=N * R,
+                         num_heads=2, d_model=32, dtype=jnp.float32)
+        M1, mb, T = N, 1, 16          # M == S (one microbatch group)
+        rng = np.random.default_rng(29)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (M1, mb, T)), jnp.int32)
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            tokens.reshape(M1 * mb, T))["params"]
+
+        blocks, rest = stack_block_params_interleaved(params, N, R)
+        step = gpt2_pp_interleaved_1f1b_loss_and_grad(cfg, rounds=R,
+                                                      axis_name="hvd")
+        fn = hvd.spmd(step, in_specs=(P("hvd"), P(), P()),
+                      out_specs=(P(), P("hvd"), P()))
+        loss, g_blocks, g_rest = fn(blocks, rest, tokens)
+
+        def ref(params):
+            logits = model.apply({"params": params},
+                                 tokens.reshape(M1 * mb, T))
+            return loss_fn(logits, tokens.reshape(M1 * mb, T))
+
+        ref_loss, ref_grads = jax.value_and_grad(ref)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5)
+        rblocks, rrest = stack_block_params_interleaved(ref_grads, N, R)
+        jax.tree_util.tree_map(
+            lambda a, r: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), rtol=2e-3, atol=2e-5),
+            (g_blocks, g_rest), (rblocks, rrest))
+
+    def test_memory_below_interleaved_gpipe(self, rng):
+        """Compiled peak temp memory of interleaved 1F1B is below the
+        autodiff interleaved (GPipe) schedule at M = 2S (the stash bound
+        vs M*R residual sets)."""
+        from horovod_tpu.parallel.pipeline import (
+            pipeline_interleaved_1f1b, pipeline_loss_interleaved)
+        S, R, D1 = N, 2, 32
+        M1 = 2 * S
+        L = R * S
+        W = rng.standard_normal((L, D1, D1)).astype(np.float32) * 0.3
+        b = rng.standard_normal((L, D1)).astype(np.float32) * 0.1
+        x = rng.standard_normal((M1, 4, D1)).astype(np.float32)
+        Wd = np.stack([np.stack([W[r * S + d] for r in range(R)])
+                       for d in range(S)])
+        bd = np.stack([np.stack([b[r * S + d] for r in range(R)])
+                       for d in range(S)])
+
+        def sfn(p, h):
+            Wl, bl = p
+            return jax.nn.relu(h @ Wl + bl)
+
+        core = pipeline_interleaved_1f1b(
+            sfn, lambda lp, y, m: jnp.mean(y ** 2), "hvd", rounds=R)
+
+        def body_1f1b(Wd, bd, xs):
+            loss, (gs, _, _) = core((Wd[0], bd[0]), jnp.zeros(()), xs)
+            return loss, (gs[0][None], gs[1][None])
+
+        def body_gpipe(Wd, bd, xs):
+            def loss(Wl, bl):
+                return pipeline_loss_interleaved(
+                    lambda p, h: sfn(p, h),
+                    (Wl, bl), xs,
+                    lambda out, mb_start: jnp.mean(out ** 2),
+                    axis_name="hvd")
+            l, g = jax.value_and_grad(loss, argnums=(0, 1))(Wd[0], bd[0])
+            return l, (g[0][None], g[1][None])
+
+        def temp_bytes(body):
+            fn = hvd.spmd(body, in_specs=(P("hvd"), P("hvd"), P()),
+                          out_specs=(P(), (P("hvd"), P("hvd"))))
+            mem = fn.lower(Wd, bd, x).compile().memory_analysis()
+            if mem is None:
+                pytest.skip("memory analysis unavailable")
+            return mem.temp_size_in_bytes
+
+        assert temp_bytes(body_1f1b) < temp_bytes(body_gpipe)
+
+
+    def test_gpt2_interleaved_1f1b_tp_matches_single_device(self):
+        """The deepest composition: interleaved 1F1B x Megatron tp."""
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+        from horovod_tpu.models.gpt2_pipeline import (
+            block_specs_tp, gpt2_pp_tp_interleaved_1f1b_loss_and_grad,
+            make_pp_tp_params_interleaved)
+        from horovod_tpu.parallel import make_mesh
+
+        S, TP, R = 4, 2, 2
+        cfg = GPT2Config(vocab_size=128, max_seq_len=32,
+                         num_layers=S * R, num_heads=4, d_model=32,
+                         dtype=jnp.float32)
+        M1, mb, T = S, 1, 16
+        rng = np.random.default_rng(31)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (M1, mb, T)), jnp.int32)
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            tokens.reshape(M1 * mb, T))["params"]
+
+        blocks, rest = make_pp_tp_params_interleaved(params, S, R,
+                                                     cfg.num_heads)
+        specs = block_specs_tp("pp", "tp", extra_dims=1)
+        mesh = make_mesh({"pp": S, "tp": TP})
+        step = gpt2_pp_tp_interleaved_1f1b_loss_and_grad(
+            cfg, rounds=R, pp_axis="pp", tp_axis="tp")
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(specs, P(), P()),
+            out_specs=(P(), specs, P()),
+            check_vma=False))
+        loss, g_blocks, g_rest = fn(blocks, rest, tokens)
+
+        def ref(params):
+            logits = model.apply({"params": params},
+                                 tokens.reshape(M1 * mb, T))
+            return loss_fn(logits, tokens.reshape(M1 * mb, T))
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        ref_blocks, ref_rest = make_pp_tp_params_interleaved(
+            ref_g, S, R, cfg.num_heads)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+            (g_blocks, g_rest), (ref_blocks, ref_rest))
+
+
 class TestInterleavedChunking:
     """M > S on the interleaved schedule: automatic chunk-and-accumulate
     (VERDICT r2 weak 5 — the framework folds the chunking in)."""
