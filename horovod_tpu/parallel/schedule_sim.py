@@ -163,8 +163,7 @@ def build_interleaved_1f1b(S: int, R: int, M: int,
                 ct_ok = (sigma == V - 1) or \
                     have_cot[d].get((r, m), t) < t
                 # 1F1B alternation: B runs only once warmup Fs are done.
-                warm_ok = fi[d] >= min(warmup + bi[d] + 1, len(f_seq)) or \
-                    fi[d] >= len(f_seq)
+                warm_ok = fi[d] >= min(warmup + bi[d] + 1, len(f_seq))
                 if own_f and ct_ok and warm_ok:
                     col["br"][d], col["bm"][d] = r, m
             if fi[d] < len(f_seq):

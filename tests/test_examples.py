@@ -74,6 +74,11 @@ class TestExamples:
                    devices=2, timeout=600)
         assert "worker:" in out
 
+    def test_fsdp_gpt2(self):
+        out = _run("fsdp_gpt2.py", "--steps", "3", timeout=600)
+        assert "FSDP OK" in out
+        assert "1/8" in out          # params really stored sharded
+
     def test_estimator_store(self):
         out = _run("estimator_store.py", "--workers", "2", "--epochs", "3",
                    devices=2, timeout=600)
