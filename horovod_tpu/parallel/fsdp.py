@@ -34,6 +34,14 @@ Usage (inside ``hvd.spmd``)::
 The optimizer never leaves the shard domain — ZeRO-3's third win: no
 update all-gather at all (the next forward's block gathers pick up the
 new values).
+
+Composition: FSDP shards over ONE mesh axis (usually ``dp``); the block
+body may use other axes freely — e.g. Megatron-split matmuls over ``tp``
+— but tp reductions inside the block must use the conjugate custom-VJP
+operators (``gpt2_pipeline._fwd_psum``/``_bwd_psum``), not bare
+``lax.psum``: under ``check_vma=False`` a bare psum transposes to
+another psum and multiplies cotangents by the tp size
+(``test_fsdp.TestFsdpTp`` pins the working pattern).
 """
 
 from __future__ import annotations

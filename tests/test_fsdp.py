@@ -100,6 +100,72 @@ class TestFsdpApply:
                                    rtol=1e-4, atol=1e-4)
 
 
+class TestFsdpTp:
+    def test_fsdp_composes_with_tensor_parallelism(self, rng):
+        """2-D layout: params FSDP-sharded over dp within each tp fiber,
+        Megatron-split matmuls inside the gathered block (conjugate g
+        operator, NOT bare psum — its transpose under check_vma=False
+        would multiply cotangents by TP). Loss and per-fiber grads must
+        match the single-device model."""
+        from jax import lax
+
+        from horovod_tpu.models.gpt2_pipeline import _fwd_psum
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.fsdp import (flat_size, fsdp_apply,
+                                               fsdp_shard_params)
+
+        DP, TP, F = 4, 2, 16
+        W1 = rng.standard_normal((D, F)).astype(np.float32) * 0.3
+        W2 = rng.standard_normal((F, D)).astype(np.float32) * 0.3
+        x = rng.standard_normal((DP, 4, D)).astype(np.float32)
+        W1t = np.stack([W1[:, i * F // TP:(i + 1) * F // TP]
+                        for i in range(TP)])
+        W2t = np.stack([W2[i * F // TP:(i + 1) * F // TP, :]
+                        for i in range(TP)])
+        shards = np.stack([np.asarray(fsdp_shard_params(
+            {"w1": jnp.asarray(W1t[i]), "w2": jnp.asarray(W2t[i])},
+            num_shards=DP)) for i in range(TP)])
+        template = {
+            "w1": jax.ShapeDtypeStruct((D, F // TP), jnp.float32),
+            "w2": jax.ShapeDtypeStruct((F // TP, D), jnp.float32)}
+        g_tp = _fwd_psum("tp")
+
+        def block(p, h):
+            return h + g_tp(jax.nn.relu(h @ p["w1"]) @ p["w2"])
+
+        def body(shard, xs):
+            def loss(s):
+                y = fsdp_apply(block, template, s[0], xs[0],
+                               axis_name="dp")
+                return jnp.mean(y ** 2)
+            l, g = jax.value_and_grad(loss)(shard)
+            return lax.pmean(l, "dp"), g
+
+        mesh = make_mesh({"dp": DP, "tp": TP})
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P("tp", "dp"), P("dp")),
+            out_specs=(P(), P("tp", "dp")), check_vma=False))
+        l, g = fn(jnp.asarray(shards), jnp.asarray(x))
+
+        def ref_loss(W1f, W2f):
+            per = [jnp.mean((jnp.asarray(x[i])
+                             + jax.nn.relu(jnp.asarray(x[i]) @ W1f)
+                             @ W2f) ** 2) for i in range(DP)]
+            return sum(per) / DP
+
+        rl, (rW1, rW2) = jax.value_and_grad(ref_loss, argnums=(0, 1))(
+            jnp.asarray(W1), jnp.asarray(W2))
+        np.testing.assert_allclose(float(l), float(rl), rtol=1e-5)
+        g = np.asarray(g)
+        for i in range(TP):
+            Lloc = flat_size({"w1": W1t[i], "w2": W2t[i]})
+            flat = g[i].ravel()[:Lloc]
+            want = np.concatenate(
+                [np.asarray(rW1)[:, i * F // TP:(i + 1) * F // TP].ravel(),
+                 np.asarray(rW2)[i * F // TP:(i + 1) * F // TP, :].ravel()])
+            np.testing.assert_allclose(flat, want, rtol=2e-4, atol=1e-6)
+
+
 class TestFsdpTraining:
     def test_training_matches_plain_dp(self, rng):
         """Full ZeRO-3 loop (shard -> grad -> shard-domain adamw) tracks a
