@@ -24,8 +24,12 @@ import numpy as np
 
 __all__ = ["DistributedSampler", "ShardedBatchIterator", "shard_arrays",
            "Store", "LocalStore", "FsspecStore", "write_dataset",
-           "read_meta", "ShardedDatasetReader"]
+           "read_meta", "ShardedDatasetReader", "BackgroundIterator",
+           "prefetch_to_device"]
 
+from horovod_tpu.data.prefetch import (  # noqa: E402,F401
+    BackgroundIterator, prefetch_to_device,
+)
 from horovod_tpu.data.store import (  # noqa: E402,F401
     FsspecStore, LocalStore, ShardedDatasetReader, Store, read_meta,
     write_dataset,

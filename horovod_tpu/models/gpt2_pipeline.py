@@ -284,48 +284,13 @@ def block_specs_tp(pp_axis: str = "pp", tp_axis: str = "tp",
     }
 
 
-def _bwd_psum(axis_name: str):
-    """Megatron's ``f`` operator: identity forward, psum-over-tp backward.
-    A column-parallel matmul's input is replicated over tp but each member
-    back-propagates only its local heads'/features' contribution — the
-    cotangent must be summed across tp or the residual stream's gradient
-    (and every upstream parameter grad) silently loses all but one shard's
-    share."""
-
-    @jax.custom_vjp
-    def f(x):
-        return x
-
-    def fwd(x):
-        return x, None
-
-    def bwd(_, g):
-        return (lax.psum(g, axis_name),)
-
-    f.defvjp(fwd, bwd)
-    return f
-
-
-def _fwd_psum(axis_name: str):
-    """Megatron's ``g`` operator: psum forward, **identity** backward (the
-    row-parallel output reduction). A plain ``lax.psum`` would transpose to
-    another psum under ``check_vma=False`` (replication is untracked), so
-    the replicated cotangent gets multiplied by the tp size at every
-    reduction and the error compounds 2^(2L) through the blocks; each
-    member's partial must instead receive the cotangent unchanged."""
-
-    @jax.custom_vjp
-    def g(x):
-        return lax.psum(x, axis_name)
-
-    def fwd(x):
-        return lax.psum(x, axis_name), None
-
-    def bwd(_, ct):
-        return (ct,)
-
-    g.defvjp(fwd, bwd)
-    return g
+# Megatron's f/g conjugate operators — public home is
+# parallel.conjugate (the FSDP x tp docs point there); these aliases keep
+# this module's historical names working.
+from horovod_tpu.parallel.conjugate import (  # noqa: E402
+    identity_fwd_psum_bwd as _bwd_psum,
+    psum_fwd_identity_bwd as _fwd_psum,
+)
 
 
 def _stage_fn_tp(cfg: GPT2Config, tp_axis: str = "tp"):

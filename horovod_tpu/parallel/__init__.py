@@ -4,6 +4,9 @@ parallelism. TPU-native replacement for the reference's rank-topology layer
 named mesh dimensions and XLA places the collectives.
 """
 
+from horovod_tpu.parallel.conjugate import (  # noqa: F401
+    identity_fwd_psum_bwd, psum_fwd_identity_bwd,
+)
 from horovod_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_adamw, fsdp_apply, fsdp_scan_blocks, fsdp_shard_params,
     stack_layer_shards,

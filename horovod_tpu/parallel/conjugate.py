@@ -1,0 +1,64 @@
+"""Megatron's conjugate communication operators, as public API.
+
+Under ``shard_map(check_vma=False)`` (the framework's SPMD mode — see
+``hvd.spmd``) a bare ``lax.psum`` TRANSPOSES to another psum, because
+replication is untracked: every tensor-parallel reduction in a
+differentiated block silently multiplies its cotangents by the tp size,
+compounding through depth. The fix is the conjugate custom-VJP pair
+Megatron calls f and g (arXiv:1909.08053 §3):
+
+- :func:`identity_fwd_psum_bwd` (``f``): place at a column-parallel
+  region's INPUT — identity forward, psum-over-axis backward (each
+  member back-propagates only its shard's contribution; the cotangent
+  must be summed).
+- :func:`psum_fwd_identity_bwd` (``g``): place at a row-parallel
+  region's OUTPUT — psum forward, identity backward (the replicated
+  cotangent must reach each member's partial unchanged).
+
+Used by the GPT-2 tp stage bodies (``models/gpt2_pipeline``) and the
+documented FSDP x tp composition (``parallel/fsdp``,
+``test_fsdp.TestFsdpTp``).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["identity_fwd_psum_bwd", "psum_fwd_identity_bwd"]
+
+
+def identity_fwd_psum_bwd(axis_name: str):
+    """Megatron's ``f``: identity forward, psum-over-``axis_name``
+    backward. Apply to the replicated input of a column-parallel block."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def psum_fwd_identity_bwd(axis_name: str):
+    """Megatron's ``g``: psum forward, identity backward. Apply to the
+    partial output of a row-parallel block."""
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis_name)
+
+    def fwd(x):
+        return lax.psum(x, axis_name), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g

@@ -38,10 +38,11 @@ new values).
 Composition: FSDP shards over ONE mesh axis (usually ``dp``); the block
 body may use other axes freely — e.g. Megatron-split matmuls over ``tp``
 — but tp reductions inside the block must use the conjugate custom-VJP
-operators (``gpt2_pipeline._fwd_psum``/``_bwd_psum``), not bare
-``lax.psum``: under ``check_vma=False`` a bare psum transposes to
-another psum and multiplies cotangents by the tp size
-(``test_fsdp.TestFsdpTp`` pins the working pattern).
+operators (``parallel.conjugate.psum_fwd_identity_bwd`` /
+``identity_fwd_psum_bwd``), not bare ``lax.psum``: under
+``check_vma=False`` a bare psum transposes to another psum and
+multiplies cotangents by the tp size (``test_fsdp.TestFsdpTp`` pins the
+working pattern).
 """
 
 from __future__ import annotations

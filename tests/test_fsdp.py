@@ -109,8 +109,7 @@ class TestFsdpTp:
         match the single-device model."""
         from jax import lax
 
-        from horovod_tpu.models.gpt2_pipeline import _fwd_psum
-        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel import make_mesh, psum_fwd_identity_bwd
         from horovod_tpu.parallel.fsdp import (flat_size, fsdp_apply,
                                                fsdp_shard_params)
 
@@ -128,7 +127,7 @@ class TestFsdpTp:
         template = {
             "w1": jax.ShapeDtypeStruct((D, F // TP), jnp.float32),
             "w2": jax.ShapeDtypeStruct((F // TP, D), jnp.float32)}
-        g_tp = _fwd_psum("tp")
+        g_tp = psum_fwd_identity_bwd("tp")
 
         def block(p, h):
             return h + g_tp(jax.nn.relu(h @ p["w1"]) @ p["w2"])

@@ -43,6 +43,7 @@ MODULES = [
     "horovod_tpu.parallel",
     "horovod_tpu.parallel.pipeline",
     "horovod_tpu.parallel.fsdp",
+    "horovod_tpu.parallel.conjugate",
     "horovod_tpu.models",
     "horovod_tpu.models.gpt2_pipeline",
     "horovod_tpu.ops.attention",
