@@ -50,8 +50,11 @@ class Config:
     timeline_mark_cycles: bool = False
     # Autotune: HOROVOD_AUTOTUNE enables the online tuner;
     # HOROVOD_AUTOTUNE_LOG mirrors upstream's tuning log path.
+    # HOROVOD_AUTOTUNE_MODE picks the search: "ladder" (candidate walk) or
+    # "bayes" (GP + expected improvement, upstream horovod/runner/autotune).
     autotune: bool = False
     autotune_log: Optional[str] = None
+    autotune_mode: str = "ladder"
     # Stall inspector (stall_inspector.cc): warning threshold + disable.
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
@@ -93,6 +96,8 @@ def refresh() -> Config:
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
         autotune=_env_bool("HOROVOD_AUTOTUNE"),
         autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG") or None,
+        autotune_mode=(os.environ.get("HOROVOD_AUTOTUNE_MODE", "ladder")
+                       .strip().lower() or "ladder"),
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),

@@ -237,5 +237,6 @@ def build_info() -> dict:
         # no TPU mechanism.
         "fusion_threshold_bytes": cfg.fusion_threshold_bytes,
         "autotune": cfg.autotune,
+        "autotune_mode": cfg.autotune_mode,
         "inert_env": dict(cfg.inert),
     }

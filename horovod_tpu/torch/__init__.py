@@ -535,8 +535,18 @@ class _DistributedOptimizer:
         self._last_step_t = None
         self._autotune_synced = False
         if get_config().autotune:
-            from horovod_tpu.autotune import Autotuner
-            self._autotuner = Autotuner()
+            from horovod_tpu.autotune import Autotuner, BayesianAutotuner
+            mode = get_config().autotune_mode
+            if mode == "bayes":
+                self._autotuner = BayesianAutotuner()
+            elif mode == "bayes-compression":
+                self._autotuner = BayesianAutotuner(tune_compression=True)
+            elif mode == "ladder":
+                self._autotuner = Autotuner()
+            else:
+                raise ValueError(
+                    f"HOROVOD_AUTOTUNE_MODE={mode!r}: expected 'ladder', "
+                    "'bayes', or 'bayes-compression'")
 
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_opt"), name)
@@ -557,15 +567,31 @@ class _DistributedOptimizer:
             if self._last_step_t is not None:
                 self._autotuner.record(now - self._last_step_t)
             self._last_step_t = now
+            if getattr(self._autotuner, "pending_sync", False):
+                # Bayesian mode: GP proposals are computed from LOCAL
+                # step timings, so every new probe point must be agreed
+                # before it feeds the collective signature — take rank
+                # 0's (upstream runs the tuner in the coordinator and
+                # ships proposals to workers for the same reason).
+                self._autotuner.set_current_point(tuple(broadcast_object(
+                    self._autotuner.current_point(), root_rank=0)))
             if self._autotuner.converged and not self._autotune_synced:
                 # Convergence lands at the same step count on every
                 # process (one record per synchronize), but each argmin is
                 # over *local* timings — agree on rank 0's pick, otherwise
                 # the thresholds (part of the negotiation signature) would
                 # diverge and every later collective would raise.
-                best = int(broadcast_object(
-                    int(self._autotuner.current_threshold()), root_rank=0))
+                comp = getattr(self._autotuner, "current_compression",
+                               lambda: "none")()
+                best, comp = broadcast_object(
+                    (int(self._autotuner.current_threshold()), comp),
+                    root_rank=0)
+                best = int(best)
                 self._autotuner._best = best
+                if hasattr(self._autotuner, "_best_compression"):
+                    self._autotuner._best_compression = comp
+                if comp == "fp16":     # apply the tuned wire compression
+                    self._compression = Compression.fp16
                 self._autotune_synced = True
                 from horovod_tpu.config import get_config
                 log = get_config().autotune_log
@@ -573,8 +599,8 @@ class _DistributedOptimizer:
                     import json
                     with open(log, "a") as f:
                         f.write(json.dumps(
-                            {"converged_fusion_threshold_bytes": best}) +
-                            "\n")
+                            {"converged_fusion_threshold_bytes": best,
+                             "converged_compression": comp}) + "\n")
             kwargs["fusion_threshold_bytes"] = \
                 self._autotuner.current_threshold()
         h = grouped_allreduce_async(

@@ -80,6 +80,143 @@ class TestAutotune:
         assert tuner.current_threshold() == 200
 
 
+@pytest.fixture
+def clean_env(monkeypatch):
+    import horovod_tpu.config as hconfig
+    yield monkeypatch
+    monkeypatch.undo()     # undo BEFORE refresh so patches don't re-cache
+    hconfig.refresh()
+
+
+class TestBayesianAutotuner:
+    """GP-guided online tuner (upstream horovod/runner/autotune)."""
+
+    @staticmethod
+    def _quadratic(thr_bytes, opt_log2=24.5, base=0.01, a=0.002):
+        return base + a * (np.log2(thr_bytes) - opt_log2) ** 2
+
+    def test_converges_near_optimum(self):
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=6, samples_per_probe=3)
+        n = 0
+        while not tuner.converged:
+            tuner.record(self._quadratic(tuner.current_threshold()))
+            n += 1
+        # deterministic convergence step count — the torch path's rank-0
+        # broadcast sync depends on every process converging together
+        assert n == 6 * 3
+        # optimum is 2^24.5 (~23 MB); the GP should land within one
+        # octave either side
+        assert 8 * (1 << 20) <= tuner.current_threshold() <= 64 * (1 << 20)
+        assert "best" in tuner.summary()
+
+    def test_beats_ladder_probe_count(self):
+        """Same objective: the GP reaches a within-noise pick in 6 probes;
+        the ladder spends 5 candidates x samples to walk its rungs."""
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=6, samples_per_probe=1)
+        while not tuner.converged:
+            tuner.record(self._quadratic(tuner.current_threshold()))
+        best_t = self._quadratic(tuner.current_threshold())
+        opt_t = self._quadratic(2 ** 24.5)
+        assert best_t <= opt_t * 1.5
+
+    def test_median_filters_noise_spikes(self):
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=6, samples_per_probe=5)
+        i = 0
+        while not tuner.converged:
+            t = self._quadratic(tuner.current_threshold())
+            # every 5th sample is a 50x straggler spike
+            tuner.record(t * 50 if i % 5 == 4 else t)
+            i += 1
+        assert 4 * (1 << 20) <= tuner.current_threshold() <= 128 * (1 << 20)
+
+    def test_deterministic_across_processes(self):
+        """Identical timing streams -> identical probe sequence and pick
+        (SPMD requirement: thresholds feed the negotiation signature)."""
+        from horovod_tpu.autotune import BayesianAutotuner
+        a = BayesianAutotuner(probes=5, samples_per_probe=2)
+        b = BayesianAutotuner(probes=5, samples_per_probe=2)
+        while not a.converged:
+            assert a.current_threshold() == b.current_threshold()
+            t = self._quadratic(a.current_threshold())
+            a.record(t)
+            b.record(t)
+        assert b.converged
+        assert a.current_threshold() == b.current_threshold()
+
+    def test_probe_sync_protocol_under_timing_jitter(self):
+        """Ranks see DIFFERENT timings, so GP proposals diverge; the
+        pending_sync/current_point/set_current_point handshake (rank 0's
+        pick broadcast, as the torch synchronize path does) must keep
+        every rank probing the same threshold — it feeds the negotiation
+        signature."""
+        from horovod_tpu.autotune import BayesianAutotuner
+        r0 = BayesianAutotuner(probes=6, samples_per_probe=2)
+        r1 = BayesianAutotuner(probes=6, samples_per_probe=2)
+        rng = np.random.default_rng(7)
+        while not r0.converged:
+            # emulate the broadcast each rank performs in synchronize()
+            for t in (r0, r1):
+                if t.pending_sync:
+                    t.set_current_point(r0.current_point())
+            assert r0.current_threshold() == r1.current_threshold()
+            base = self._quadratic(r0.current_threshold())
+            r0.record(base * (1 + 0.05 * rng.random()))
+            r1.record(base * (1 + 0.05 * rng.random()))
+        assert r1.converged
+        # final picks come from local argmins and still need the existing
+        # converged broadcast; emulate it the way synchronize() does
+        r1._best = r0.current_threshold()
+        assert r0.current_threshold() == r1.current_threshold()
+
+    def test_tunes_compression_category(self):
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=8, samples_per_probe=1,
+                                  tune_compression=True)
+        while not tuner.converged:
+            t = self._quadratic(tuner.current_threshold())
+            if tuner.current_compression() == "fp16":
+                t *= 0.7          # half the wire bytes, 30% faster steps
+            tuner.record(t)
+        assert tuner.current_compression() == "fp16"
+
+    def test_mode_env_selects_bayes(self, clean_env):
+        torch = pytest.importorskip("torch")
+        import horovod_tpu.config as hconfig
+        import horovod_tpu.torch as hvt
+        from horovod_tpu.autotune import BayesianAutotuner
+        clean_env.setenv("HOROVOD_AUTOTUNE", "1")
+        clean_env.setenv("HOROVOD_AUTOTUNE_MODE", "bayes")
+        hconfig.refresh()
+        model = torch.nn.Linear(4, 1)
+        opt = hvt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1))
+        assert isinstance(opt._autotuner, BayesianAutotuner)
+        assert hvd.build_info()["autotune_mode"] == "bayes"
+        # the drop-in surface drives the existing synchronize loop
+        opt._autotuner = BayesianAutotuner(probes=3, samples_per_probe=1)
+        for _ in range(6):
+            opt.zero_grad()
+            model(torch.ones(2, 4)).sum().backward()
+            opt.step()
+        assert opt._autotuner.converged and opt._autotune_synced
+
+    def test_mode_env_rejects_unknown(self, clean_env):
+        pytest.importorskip("torch")
+        import torch
+        import horovod_tpu.config as hconfig
+        import horovod_tpu.torch as hvt
+        clean_env.setenv("HOROVOD_AUTOTUNE", "1")
+        clean_env.setenv("HOROVOD_AUTOTUNE_MODE", "anneal")
+        hconfig.refresh()
+        model = torch.nn.Linear(2, 1)
+        with pytest.raises(ValueError, match="anneal"):
+            hvt.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1))
+
+
 class TestRunner:
     def test_parse_hosts_string(self):
         specs = parse_hosts("h1:4,h2:2,h3")

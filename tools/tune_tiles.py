@@ -17,8 +17,12 @@ and the per-hop ring shard shapes.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
+
+# Runnable from any cwd (the selfbench watcher invokes this by path).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # (head_dim, seq, batch, heads, causal, kind)
 # Ring probes run causal=False: all but one of a ring's n hops carry
