@@ -163,8 +163,11 @@ def bench_gpt2(on_tpu):
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
     if on_tpu:
         import dataclasses
-        cfg = dataclasses.replace(GPT2Config.medium(), attention="flash",
-                                  remat=True)
+        # HOROVOD_BENCH_REMAT=dots -> selective remat (save MXU outputs,
+        # recompute elementwise only); default "full" block remat.
+        cfg = dataclasses.replace(
+            GPT2Config.medium(), attention="flash", remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "full"))
         B, T, steps = 8, 1024, 10
     else:
         cfg = GPT2Config.tiny()
@@ -184,8 +187,9 @@ def bench_bert(on_tpu):
     from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss
     if on_tpu:
         import dataclasses
-        cfg = dataclasses.replace(BertConfig.large(), attention="flash",
-                                  remat=True)
+        cfg = dataclasses.replace(
+            BertConfig.large(), attention="flash", remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "full"))
         B, T, steps = 8, 512, 10
     else:
         cfg = BertConfig.tiny()

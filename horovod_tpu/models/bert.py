@@ -26,6 +26,9 @@ class BertConfig:
     type_vocab_size: int = 2
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # "full" | "dots" (see GPT2Config.remat_policy): "dots" saves MXU
+    # outputs and recomputes only elementwise/norm work in backward.
+    remat_policy: str = "full"
     # "dense" | "flash" (fused pallas kernel; the key-padding mask rides the
     # kernel's key_bias input).
     attention: str = "dense"
@@ -89,7 +92,17 @@ class Bert(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
         layer = EncoderLayer
         if cfg.remat:
-            layer = nn.remat(EncoderLayer)
+            if cfg.remat_policy == "dots":
+                layer = nn.remat(
+                    EncoderLayer,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            elif cfg.remat_policy == "full":
+                layer = nn.remat(EncoderLayer)
+            else:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}: "
+                    "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
             x = layer(cfg, name=f"layer{i}")(x, attention_mask)
         # MLM head: tied embeddings, fp32 logits.

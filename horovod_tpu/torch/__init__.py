@@ -575,6 +575,15 @@ class _DistributedOptimizer:
                 # ships proposals to workers for the same reason).
                 self._autotuner.set_current_point(tuple(broadcast_object(
                     self._autotuner.current_point(), root_rank=0)))
+            if getattr(self._autotuner, "_tune_comp", False):
+                # bayes-compression: the probed wire format must be LIVE
+                # during its probe or the GP's compression dimension fits
+                # noise; the point is rank-agreed (fixed design or the
+                # broadcast above), so the signature stays consistent.
+                self._compression = (
+                    Compression.fp16
+                    if self._autotuner.current_compression() == "fp16"
+                    else Compression.none)
             if self._autotuner.converged and not self._autotune_synced:
                 # Convergence lands at the same step count on every
                 # process (one record per synchronize), but each argmin is

@@ -203,6 +203,39 @@ class TestBayesianAutotuner:
             opt.step()
         assert opt._autotuner.converged and opt._autotune_synced
 
+    def test_bayes_compression_probes_live_wire(self, clean_env):
+        """The probed compression must be ACTIVE during its probe — the
+        GP's compression dimension is fit to these timings."""
+        torch = pytest.importorskip("torch")
+        import horovod_tpu.config as hconfig
+        import horovod_tpu.torch as hvt
+        from horovod_tpu.autotune import BayesianAutotuner
+        from horovod_tpu.compression import Compression
+        clean_env.setenv("HOROVOD_AUTOTUNE", "1")
+        clean_env.setenv("HOROVOD_AUTOTUNE_MODE", "bayes-compression")
+        hconfig.refresh()
+        model = torch.nn.Linear(4, 1)
+        opt = hvt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1))
+        assert opt._autotuner._tune_comp
+        opt._autotuner = BayesianAutotuner(probes=4, samples_per_probe=1,
+                                           tune_compression=True)
+        seen = set()
+        for _ in range(8):
+            opt.zero_grad()
+            model(torch.ones(2, 4)).sum().backward()
+            opt.step()
+            if not opt._autotuner.converged:
+                # the live wire format tracks the probed category
+                want = opt._autotuner.current_compression()
+                got = ("fp16" if opt._compression is Compression.fp16
+                       else "none")
+                assert got == want
+            seen.add(opt._autotuner.current_compression())
+        # the fixed design cycles categories, so both were actually probed
+        assert seen >= {"none", "fp16"}
+        assert opt._autotune_synced
+
     def test_mode_env_rejects_unknown(self, clean_env):
         pytest.importorskip("torch")
         import torch

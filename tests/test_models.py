@@ -109,6 +109,41 @@ class TestGPT2:
         cfg = GPT2Config.medium()
         assert (cfg.num_layers, cfg.num_heads, cfg.d_model) == (24, 16, 1024)
 
+    def test_remat_policy_grads_match(self):
+        """remat_policy='dots' changes WHAT backward recomputes, never the
+        math: grads must equal the full-remat (and no-remat) model's."""
+        import dataclasses
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(
+                0, GPT2Config.tiny().vocab_size, (2, 16)), jnp.int32)
+
+        def grads_for(**kw):
+            cfg = dataclasses.replace(GPT2Config.tiny(), **kw)
+            m = GPT2(cfg)
+            params = m.init(jax.random.PRNGKey(0), toks)["params"]
+            return jax.grad(
+                lambda p: loss_fn(m.apply({"params": p}, toks), toks))(
+                    params)
+
+        g_none = grads_for(remat=False)
+        g_full = grads_for(remat=True, remat_policy="full")
+        g_dots = grads_for(remat=True, remat_policy="dots")
+        for a, b in ((g_full, g_none), (g_dots, g_none)):
+            for x, y in zip(jax.tree_util.tree_leaves(a),
+                            jax.tree_util.tree_leaves(b)):
+                np.testing.assert_allclose(np.asarray(x, np.float32),
+                                           np.asarray(y, np.float32),
+                                           rtol=2e-3, atol=2e-3)
+
+    def test_remat_policy_unknown_raises(self):
+        import dataclasses
+        cfg = dataclasses.replace(GPT2Config.tiny(), remat=True,
+                                  remat_policy="everything")
+        m = GPT2(cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="remat_policy"):
+            m.init(jax.random.PRNGKey(0), toks)
+
 
 class TestGraftEntry:
     def test_dryrun_multichip_8(self):
@@ -142,6 +177,29 @@ class TestBert:
         from horovod_tpu.models.bert import BertConfig
         cfg = BertConfig.large()
         assert (cfg.num_layers, cfg.num_heads, cfg.d_model) == (24, 16, 1024)
+
+    def test_remat_policy_grads_match(self):
+        import dataclasses
+        from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss
+        toks = jnp.asarray(
+            np.random.default_rng(2).integers(
+                0, BertConfig.tiny().vocab_size, (2, 16)), jnp.int32)
+        mask = jnp.zeros((2, 16)).at[:, :3].set(1.0)
+
+        def grads_for(**kw):
+            cfg = dataclasses.replace(BertConfig.tiny(), **kw)
+            m = Bert(cfg)
+            params = m.init(jax.random.PRNGKey(0), toks)["params"]
+            return jax.grad(lambda p: mlm_loss(
+                m.apply({"params": p}, toks)[0], toks, mask))(params)
+
+        g_none = grads_for(remat=False)
+        g_dots = grads_for(remat=True, remat_policy="dots")
+        for x, y in zip(jax.tree_util.tree_leaves(g_dots),
+                        jax.tree_util.tree_leaves(g_none)):
+            np.testing.assert_allclose(np.asarray(x, np.float32),
+                                       np.asarray(y, np.float32),
+                                       rtol=2e-3, atol=2e-3)
 
 
 class TestViT:

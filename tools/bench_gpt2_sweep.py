@@ -1,4 +1,4 @@
-"""GPT-2 medium throughput sweep: batch size x remat x attention impl."""
+"""GPT-2 medium throughput sweep: batch size x remat policy x attention."""
 import os, sys, time, dataclasses
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from functools import partial
@@ -7,9 +7,10 @@ import jax, jax.numpy as jnp, numpy as np, optax
 def sync(x):
     np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0])).ravel()[:1]
 
-def run_one(B, T, remat, attention, steps=8):
+def run_one(B, T, remat, attention, policy="full", steps=8):
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
-    cfg = dataclasses.replace(GPT2Config.medium(), attention=attention, remat=remat)
+    cfg = dataclasses.replace(GPT2Config.medium(), attention=attention,
+                              remat=remat, remat_policy=policy)
     model = GPT2(cfg)
     tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
@@ -23,6 +24,7 @@ def run_one(B, T, remat, attention, steps=8):
         u, opt_state = tx.update(g, opt_state, params)
         return optax.apply_updates(params, u), opt_state
 
+    tag = f"B={B:3d} T={T} remat={int(remat)}/{policy:4s} {attention:6s}"
     try:
         c = step.lower(params, opt_state).compile().cost_analysis()
         if isinstance(c, list): c = c[0]
@@ -34,16 +36,26 @@ def run_one(B, T, remat, attention, steps=8):
             state = step(*state)
         sync(state)
         dt = (time.perf_counter() - t0) / steps
-        print(f"B={B:3d} T={T} remat={int(remat)} {attention:6s} "
-              f"step={dt*1e3:8.1f}ms tok/s={B*T/dt:9.0f} "
-              f"TF/s={fl/dt/1e12:6.1f} MFU={fl/dt/1e12/197*100:5.1f}%",
-              flush=True)
+        line = (f"{tag} step={dt*1e3:8.1f}ms tok/s={B*T/dt:9.0f} "
+                f"TF/s={fl/dt/1e12:6.1f} MFU={fl/dt/1e12/197*100:5.1f}%")
     except Exception as e:
-        print(f"B={B:3d} T={T} remat={int(remat)} {attention}: FAILED "
-              f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+        line = f"{tag}: FAILED {type(e).__name__}: {str(e)[:120]}"
+    print(line, flush=True)
+    # survive a relay wedge mid-sweep: every finished config is durable
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SWEEP_GPT2.txt"), "a") as f:
+        f.write(line + "\n")
 
 if __name__ == "__main__":
-    for B, remat, att in [(8, True, "flash"), (16, True, "flash"),
-                          (32, True, "flash"), (16, False, "flash"),
-                          (16, True, "dense"), (32, False, "flash")]:
-        run_one(B, 1024, remat, att)
+    # priority order: the configs most likely to move MFU come first, so a
+    # relay wedge mid-sweep still answers the main questions.
+    for B, remat, att, pol in [
+            (8, True, "flash", "dots"),    # selective remat at bench config
+            (8, True, "flash", "full"),    # tuned-tile reference point
+            (16, True, "flash", "dots"),
+            (16, True, "flash", "full"),
+            (8, False, "flash", "full"),   # no remat at all
+            (32, True, "flash", "dots"),
+            (16, True, "dense", "full"),   # flash vs XLA-fused dense
+            (32, False, "flash", "full")]:
+        run_one(B, 1024, remat, att, pol)

@@ -58,13 +58,14 @@ def git_rev() -> str:
         return "unknown"
 
 
-def run_bench(model: str, timeout_s: float):
+def run_bench(model: str, timeout_s: float, env_extra=None):
     """One bench child; returns the parsed JSON records it printed."""
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
            "--model", model, "--inner"]
+    env = dict(os.environ, **env_extra) if env_extra else None
     try:
         r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
-                           text=True, cwd=REPO)
+                           text=True, cwd=REPO, env=env)
     except subprocess.TimeoutExpired:
         return [{"model": model, "error": f"timeout after {timeout_s:.0f}s "
                                           "(relay wedged mid-run?)"}]
@@ -82,13 +83,17 @@ def run_bench(model: str, timeout_s: float):
     return records
 
 
-def append_records(out_path: str, model: str, records, rev: str) -> None:
+def append_records(out_path: str, model: str, records, rev: str,
+                  variant: str = None) -> None:
     now = datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds")
     with open(out_path, "a") as f:
         for rec in records:
-            f.write(json.dumps({"ts": now, "git": rev, "model": model,
-                                **rec}) + "\n")
+            row = {"ts": now, "git": rev, "model": model}
+            if variant:
+                row["variant"] = variant
+            row.update(rec)
+            f.write(json.dumps(row) + "\n")
 
 
 def main(argv=None) -> int:
