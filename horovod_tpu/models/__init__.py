@@ -1,7 +1,8 @@
 """Model zoo covering the reference's benchmark configs (BASELINE.json):
 MNIST CNN, ResNet-50, BERT-large, GPT-2 medium, ViT-B/16 — implemented in
 flax for TPU (bf16 compute, MXU-friendly shapes), not ported from the
-reference's TF/torch example scripts.
+reference's TF/torch example scripts. Plus the Llama family (RoPE +
+RMSNorm + SwiGLU + GQA) for modern-LLM migrations.
 """
 
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
@@ -27,4 +28,14 @@ def get_model(name: str, **kw):
     if name in ("vit", "vit_b16", "vit-b/16"):
         from horovod_tpu.models.vit import ViT, ViTConfig
         return ViT(ViTConfig.b16() if name != "vit" else ViTConfig(**kw))
+    if name in ("llama", "llama7b", "llama_small"):
+        from horovod_tpu.models.llama import Llama, LlamaConfig
+        if name == "llama7b":
+            return Llama(LlamaConfig.llama7b())
+        # bare "llama" follows the zoo convention of a base-size default
+        # (LlamaConfig() *defaults* are the 7B shape — too big to init
+        # casually on a host or single chip)
+        if kw:
+            return Llama(LlamaConfig(**kw))
+        return Llama(LlamaConfig.small())
     raise ValueError(f"unknown model {name}")

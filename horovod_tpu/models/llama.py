@@ -1,0 +1,265 @@
+"""Llama-family decoder — RoPE + RMSNorm + SwiGLU + grouped-query attention.
+
+Widens the zoo beyond the five BASELINE configs to the architecture users
+actually migrate with (upstream Horovod's role here is its framework-native
+example models, ``horovod/examples``; the zoo plays that part on TPU). The
+TPU-first choices mirror ``gpt2.py``: bf16 compute with fp32 norms and
+logits, the shared fused attention op (``ops/attention.py`` /
+``ops/flash_attention.py``), ring / Ulysses sequence parallelism on the
+same mesh axes, Megatron tensor-parallel partition rules with one psum per
+attention/MLP pair, and selective rematerialization policies.
+
+Grouped-query attention is computed by expanding K/V heads to the query
+head count (``jnp.repeat`` on the head axis) right before the attention
+op: the expansion happens AFTER the kv projections, so the parameter and
+optimizer memory savings of GQA are real, while the attention kernels see
+plain MHA shapes — one code path for dense, flash, ring, and Ulysses.
+XLA turns the repeat into a broadcast inside the fused attention when it
+can; the kv-cache-bandwidth win GQA exists for is an inference concern
+that doesn't bind a training framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.models.gpt2 import loss_fn  # same next-token CE  # noqa: F401
+from horovod_tpu.parallel.sharding import PartitionRules
+
+__all__ = ["Llama", "LlamaConfig", "loss_fn", "partition_rules",
+           "apply_rope"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000          # already a 128 multiple
+    max_seq_len: int = 2048
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32           # < num_heads = grouped-query attention
+    d_model: int = 4096
+    d_ff: int = 11008                # SwiGLU hidden width
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+    remat_policy: str = "full"       # "full" | "dots" (GPT2Config docs)
+    use_ring_attention: bool = False
+    ring_layout: str = "contiguous"  # "contiguous" | "striped" (gpt2 docs)
+    sp_impl: str = "ring"            # "ring" | "ulysses"
+    attention: str = "dense"         # "dense" | "flash"
+    flash_blocks: Optional[tuple] = None
+
+    @staticmethod
+    def llama7b() -> "LlamaConfig":
+        return LlamaConfig()         # the defaults ARE 7B
+
+    @staticmethod
+    def small() -> "LlamaConfig":
+        """~110M-class config for single-chip experiments."""
+        return LlamaConfig(num_layers=12, num_heads=12, num_kv_heads=4,
+                           d_model=768, d_ff=2048, max_seq_len=1024)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, max_seq_len=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2, d_model=64,
+                           d_ff=128, **kw)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float) -> jnp.ndarray:
+    """Rotary position embedding over (B, T, H, D) with (T,) positions.
+
+    Pair-rotation ("rotate half") form in fp32, cast back to x.dtype.
+    Positions are explicit so sequence-parallel shards pass their GLOBAL
+    token positions (contiguous offset or striped interleave) and rotation
+    commutes with the ring: every shard rotates its own K before any hop.
+    """
+    d2 = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    ang = positions.astype(jnp.float32)[:, None] * freq[None, :]  # (T, d2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    """fp32 root-mean-square norm with a learned scale (no mean removal)."""
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                               + 1e-6)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.cfg
+        B, T, D = x.shape
+        H, Hkv = cfg.num_heads, cfg.num_kv_heads
+        hd = D // H
+        q = nn.Dense(H * hd, use_bias=False, dtype=cfg.dtype,
+                     name="wq")(x).reshape(B, T, H, hd)
+        k = nn.Dense(Hkv * hd, use_bias=False, dtype=cfg.dtype,
+                     name="wk")(x).reshape(B, T, Hkv, hd)
+        v = nn.Dense(Hkv * hd, use_bias=False, dtype=cfg.dtype,
+                     name="wv")(x).reshape(B, T, Hkv, hd)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        if Hkv != H:                 # GQA: expand kv heads to MHA shapes
+            q_per_kv = H // Hkv
+            k = jnp.repeat(k, q_per_kv, axis=2)
+            v = jnp.repeat(v, q_per_kv, axis=2)
+        if cfg.use_ring_attention:
+            if cfg.sp_impl == "ulysses":
+                from horovod_tpu.ops.sequence import ulysses_attention
+                blocks = {}
+                if cfg.flash_blocks is not None:
+                    blocks = {"block_q": int(cfg.flash_blocks[0]),
+                              "block_k": int(cfg.flash_blocks[1])}
+                o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
+                                      impl=cfg.attention, **blocks)
+            elif cfg.attention == "flash":
+                from horovod_tpu.ops.ring_flash import ring_flash_attention
+                o = ring_flash_attention(q, k, v, axis_name="sp",
+                                         causal=True,
+                                         layout=cfg.ring_layout)
+            elif cfg.attention == "dense":
+                from horovod_tpu.ops.ring_attention import ring_attention
+                o = ring_attention(q, k, v, axis_name="sp", causal=True,
+                                   layout=cfg.ring_layout)
+            else:
+                raise ValueError(
+                    f"unknown attention impl {cfg.attention!r} for the "
+                    "ring path; expected 'dense' or 'flash'")
+        else:
+            from horovod_tpu.ops.attention import multihead_attention
+            o = multihead_attention(q, k, v, impl=cfg.attention,
+                                    causal=True, out_dtype=cfg.dtype,
+                                    flash_blocks=cfg.flash_blocks)
+        return nn.Dense(D, use_bias=False, dtype=cfg.dtype,
+                        name="wo")(o.reshape(B, T, D))
+
+
+class SwiGLU(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        g = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     name="gate")(x)
+        u = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     name="up")(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="down")(nn.silu(g) * u)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(name="norm_attn")(x), positions, deterministic)
+        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(name="norm_mlp")(x))
+        return x
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        if cfg.num_heads % cfg.num_kv_heads:
+            raise ValueError(
+                f"num_kv_heads={cfg.num_kv_heads} must divide "
+                f"num_heads={cfg.num_heads}")
+        if cfg.use_ring_attention and cfg.attention not in ("dense",
+                                                            "flash"):
+            raise ValueError(
+                f"unknown attention impl {cfg.attention!r} for the ring "
+                "path; expected 'dense' or 'flash'")
+        if cfg.use_ring_attention and cfg.sp_impl not in ("ring",
+                                                          "ulysses"):
+            raise ValueError(
+                f"unknown sp_impl {cfg.sp_impl!r}; expected 'ring' or "
+                "'ulysses'")
+        if cfg.use_ring_attention and cfg.ring_layout not in (
+                "contiguous", "striped"):
+            # A typo here would silently fall back to contiguous positions
+            # against striped-ordered tokens — wrong logits, no error.
+            raise ValueError(
+                f"unknown ring_layout {cfg.ring_layout!r}; expected "
+                "'contiguous' or 'striped'")
+        if cfg.use_ring_attention and cfg.sp_impl == "ulysses" and \
+                cfg.ring_layout == "striped":
+            raise ValueError(
+                "ulysses sequence parallelism gathers the full sequence "
+                "per head — positions are globally contiguous; use "
+                "ring_layout='contiguous' (striped RoPE positions would "
+                "mask the wrong pairs: wrong logits, no error)")
+        B, T = tokens.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        pos = jnp.arange(T)
+        if cfg.use_ring_attention:
+            # global positions for this sp shard (gpt2.py's wpe logic,
+            # expressed through RoPE's explicit position input)
+            if cfg.ring_layout == "striped":
+                n = jax.lax.psum(1, "sp")
+                pos = jax.lax.axis_index("sp") + n * pos
+            else:
+                pos = pos + jax.lax.axis_index("sp") * T
+        x = wte[tokens].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            if cfg.remat_policy == "dots":
+                block = nn.remat(
+                    Block, static_argnums=(3,),
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            elif cfg.remat_policy == "full":
+                block = nn.remat(Block, static_argnums=(3,))
+            else:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}: "
+                    "expected 'full' or 'dots'")
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"h{i}")(x, pos, deterministic)
+        x = RMSNorm(name="norm_f")(x)
+        # Untied lm head (Llama convention), fp32 logits.
+        wlm = self.param("lm_head", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wlm)
+
+
+def partition_rules() -> PartitionRules:
+    """Megatron tp sharding (SURVEY §2 row 26): column-parallel q/k/v and
+    gate/up (shard output features), row-parallel wo/down (shard input
+    features) — one psum per attention/MLP pair under GSPMD; embeddings
+    and lm head shard the vocab axis."""
+    return PartitionRules([
+        (r"wte$", P("tp", None)),
+        (r"lm_head$", P("tp", None)),
+        (r"(wq|wk|wv|gate|up)/kernel$", P(None, "tp")),
+        (r"(wo|down)/kernel$", P("tp", None)),
+        (r"scale$", P()),
+    ])

@@ -74,6 +74,10 @@ class TestExamples:
                    devices=2, timeout=600)
         assert "worker:" in out
 
+    def test_llama_train(self):
+        out = _run("llama_train.py", "--steps", "4")
+        assert "GQA kv heads at 50%" in out
+
     def test_fsdp_gpt2(self):
         out = _run("fsdp_gpt2.py", "--steps", "3", timeout=600)
         assert "FSDP OK" in out

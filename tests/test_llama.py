@@ -1,0 +1,238 @@
+"""Llama family: RoPE/RMSNorm/SwiGLU/GQA correctness, flash parity,
+sequence-parallel parity vs the single-device model, tp grad parity."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.llama import (
+    Llama, LlamaConfig, apply_rope, loss_fn, partition_rules,
+)
+
+
+def _tokens(B=2, T=16, vocab=256, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, (B, T)), jnp.int32)
+
+
+class TestRope:
+    def test_norm_preserving_and_position_zero_identity(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((1, 4, 2, 8)),
+            jnp.float32)
+        y = apply_rope(x, jnp.arange(4), 10000.0)
+        # rotation preserves the per-pair norm
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+        # position 0 is the identity rotation
+        np.testing.assert_allclose(np.asarray(y[:, 0]),
+                                   np.asarray(x[:, 0]), rtol=1e-6)
+
+    def test_relative_phase(self):
+        """q(m)·k(n) after RoPE depends on m-n only (the defining
+        property): shifting both positions by a constant changes
+        nothing."""
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+
+        def dot(m, n):
+            qm = apply_rope(q, jnp.array([m]), 10000.0)
+            kn = apply_rope(k, jnp.array([n]), 10000.0)
+            return float(jnp.sum(qm * kn))
+
+        assert dot(3, 1) == pytest.approx(dot(10, 8), rel=1e-5)
+        assert dot(5, 5) == pytest.approx(dot(0, 0), rel=1e-5)
+
+
+class TestLlama:
+    def test_forward_and_loss_decreases(self):
+        cfg = LlamaConfig.tiny()
+        m = Llama(cfg)
+        toks = _tokens()
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+        logits = m.apply({"params": params}, toks)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+        opt = optax.adam(1e-2)
+        st = opt.init(params)
+
+        @jax.jit
+        def step(p, st):
+            l, g = jax.value_and_grad(
+                lambda p: loss_fn(m.apply({"params": p}, toks), toks))(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, l
+
+        losses = []
+        p = params
+        for _ in range(8):
+            p, st, l = step(p, st)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_gqa_equals_manual_head_expansion(self):
+        """GQA must equal MHA run on the repeated kv projections — same
+        params, kv weights tiled across the query-head groups."""
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)     # kv 2, q 4
+        assert cfg.num_kv_heads < cfg.num_heads
+        m = Llama(cfg)
+        toks = _tokens()
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+
+        mha_cfg = dataclasses.replace(cfg, num_kv_heads=cfg.num_heads)
+        hd = cfg.d_model // cfg.num_heads
+        group = cfg.num_heads // cfg.num_kv_heads
+
+        def expand(kernel):
+            # (D, Hkv*hd) -> (D, H*hd), repeating each head's block
+            D = kernel.shape[0]
+            return jnp.repeat(
+                kernel.reshape(D, cfg.num_kv_heads, hd), group,
+                axis=1).reshape(D, cfg.num_heads * hd)
+
+        params2 = jax.tree_util.tree_map(lambda x: x, params)
+        for i in range(cfg.num_layers):
+            attn = params2[f"h{i}"]["attn"]
+            attn["wk"] = {"kernel": expand(attn["wk"]["kernel"])}
+            attn["wv"] = {"kernel": expand(attn["wv"]["kernel"])}
+        got = m.apply({"params": params}, toks)
+        want = Llama(mha_cfg).apply({"params": params2}, toks)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_flash_matches_dense(self):
+        cfg_d = LlamaConfig.tiny(dtype=jnp.float32)
+        cfg_f = LlamaConfig.tiny(dtype=jnp.float32, attention="flash",
+                                 flash_blocks=(16, 16))
+        toks = _tokens()
+        params = Llama(cfg_d).init(jax.random.PRNGKey(0), toks)["params"]
+        dense = Llama(cfg_d).apply({"params": params}, toks)
+        flash = Llama(cfg_f).apply({"params": params}, toks)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_remat_policy_grads_match(self):
+        toks = _tokens()
+
+        def grads_for(**kw):
+            cfg = LlamaConfig.tiny(dtype=jnp.float32, **kw)
+            m = Llama(cfg)
+            params = m.init(jax.random.PRNGKey(0), toks)["params"]
+            return jax.grad(
+                lambda p: loss_fn(m.apply({"params": p}, toks), toks))(
+                    params)
+
+        g_none = grads_for()
+        for policy in ("full", "dots"):
+            g = grads_for(remat=True, remat_policy=policy)
+            for x, y in zip(jax.tree_util.tree_leaves(g),
+                            jax.tree_util.tree_leaves(g_none)):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                           rtol=2e-3, atol=2e-3)
+
+    def test_kv_heads_must_divide(self):
+        cfg = dataclasses.replace(LlamaConfig.tiny(), num_kv_heads=3)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            Llama(cfg).init(jax.random.PRNGKey(0), _tokens())
+
+    @pytest.mark.parametrize("kw,match", [
+        (dict(attention="sparse"), "ring path"),
+        (dict(sp_impl="ulises"), "sp_impl"),
+        (dict(ring_layout="stripd"), "ring_layout"),
+        (dict(sp_impl="ulysses", ring_layout="striped"), "contiguous"),
+    ])
+    def test_ring_config_guards(self, kw, match):
+        cfg = LlamaConfig.tiny(use_ring_attention=True, **kw)
+        with pytest.raises(ValueError, match=match):
+            Llama(cfg).init(jax.random.PRNGKey(0), _tokens())
+
+    def test_get_model_bare_llama_is_small(self):
+        from horovod_tpu.models import get_model
+        m = get_model("llama")
+        assert m.cfg.num_layers == 12 and m.cfg.d_model == 768
+        assert get_model("llama7b").cfg.d_model == 4096
+        assert get_model("llama", num_layers=1, num_heads=2,
+                         num_kv_heads=2, d_model=32,
+                         d_ff=64).cfg.num_layers == 1
+
+
+class TestLlamaParallel:
+    def test_ring_sp_matches_single_device(self):
+        """Both ring variants == the single-device full-sequence model
+        (global RoPE positions per shard are the failure mode a pairwise
+        check would miss)."""
+        toks = _tokens(B=2, T=32)
+        base = LlamaConfig.tiny(dtype=jnp.float32)
+        params = Llama(base).init(jax.random.PRNGKey(0),
+                                  toks[:, :8])
+        want = np.asarray(Llama(base).apply(params, toks))
+
+        for attention in ("dense", "flash"):
+            cfg = LlamaConfig.tiny(dtype=jnp.float32,
+                                   use_ring_attention=True,
+                                   attention=attention)
+            model = Llama(cfg)
+            hvd.init(axis_name="sp")
+            try:
+                fwd = hvd.spmd(lambda p, t: model.apply(p, t),
+                               in_specs=(P(), P(None, "sp")),
+                               out_specs=P(None, "sp"))
+                got = np.asarray(fwd(params, toks))
+            finally:
+                hvd.init()
+            np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3,
+                                       err_msg=attention)
+
+    def test_ulysses_sp_matches_single_device(self):
+        toks = _tokens(B=2, T=32)
+        base = LlamaConfig.tiny(dtype=jnp.float32)
+        params = Llama(base).init(jax.random.PRNGKey(0), toks[:, :8])
+        want = np.asarray(Llama(base).apply(params, toks))
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, use_ring_attention=True,
+                               sp_impl="ulysses")
+        model = Llama(cfg)
+        hvd.init(axis_name="sp")
+        try:
+            fwd = hvd.spmd(lambda p, t: model.apply(p, t),
+                           in_specs=(P(), P(None, "sp")),
+                           out_specs=P(None, "sp"))
+            got = np.asarray(fwd(params, toks))
+        finally:
+            hvd.init()
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_tp_grads_match_single_device(self):
+        """Megatron-sharded grads == single-device grads (GSPMD inserts
+        the psums from partition_rules' shardings)."""
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.sharding import shard_pytree
+        toks = _tokens(B=4, T=16)
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        m = Llama(cfg)
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+
+        def loss(p, t):
+            return loss_fn(m.apply({"params": p}, t), t)
+
+        want = jax.grad(loss)(params, toks)
+
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        sharded = shard_pytree(params, mesh, partition_rules())
+        got = jax.jit(jax.grad(loss))(sharded, toks)
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(got),
+                       key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_leaves_with_path(want),
+                       key=lambda kv: str(kv[0]))):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(a)), np.asarray(b),
+                rtol=2e-3, atol=2e-3, err_msg=str(ka))

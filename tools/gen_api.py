@@ -46,6 +46,7 @@ MODULES = [
     "horovod_tpu.parallel.conjugate",
     "horovod_tpu.models",
     "horovod_tpu.models.gpt2_pipeline",
+    "horovod_tpu.models.llama",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",

@@ -112,9 +112,12 @@ def main(argv=None) -> int:
                         stderr=subprocess.DEVNULL)
                     sweep_pending = False
                 except subprocess.TimeoutExpired:
-                    print("# sweep timed out (wedge mid-sweep?); partial "
-                          "configs are in SWEEP_GPT2.txt", flush=True)
-                    sweep_pending = False   # partials are durable; done
+                    # finished configs are durable in SWEEP_GPT2.txt, but
+                    # the un-run ones are not: re-fire on the next heal
+                    # (re-running the finished ones again is just extra
+                    # rows in the log)
+                    print("# sweep timed out (wedge mid-sweep?); "
+                          "re-fires on next heal", flush=True)
             if not remaining and not sweep_pending:
                 print("# agenda complete", flush=True)
                 return 0
