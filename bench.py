@@ -163,11 +163,13 @@ def bench_gpt2(on_tpu):
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
     if on_tpu:
         import dataclasses
-        # HOROVOD_BENCH_REMAT=dots -> selective remat (save MXU outputs,
-        # recompute elementwise only); default "full" block remat.
+        # HOROVOD_BENCH_REMAT=full -> full block remat; the default is the
+        # selective "dots" policy (save MXU outputs, recompute elementwise
+        # only), measured +19 % tokens/sec on-chip (ROOFLINE round-4 second
+        # heal) and fits bs8 HBM.
         cfg = dataclasses.replace(
             GPT2Config.medium(), attention="flash", remat=True,
-            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "full"))
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "dots"))
         B, T, steps = 8, 1024, 10
     else:
         cfg = GPT2Config.tiny()

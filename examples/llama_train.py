@@ -78,17 +78,18 @@ def main():
 
     with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else mesh:
         step = jax.jit(train_step, donate_argnums=(0, 1))
-        first = None
+        first = l = None
         for i in range(args.steps):
             params, opt_state, l = step(params, opt_state, tokens)
             l = float(l)
             first = first if first is not None else l
             print(f"step {i}: loss {l:.4f}", flush=True)
-    if hvd.rank() == 0:
+    if hvd.rank() == 0 and l is not None:
         kv_frac = cfg.num_kv_heads / cfg.num_heads
         print(f"final loss {l:.4f} (first {first:.4f}); "
               f"GQA kv heads at {kv_frac:.0%} of MHA")
-        assert l < first, "loss did not decrease"
+        if args.steps > 1:
+            assert l < first, "loss did not decrease"
 
 
 if __name__ == "__main__":

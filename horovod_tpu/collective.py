@@ -356,26 +356,53 @@ def _ragged_allgather_leaf(x, num_valid, ps: ProcessSet):
 def _ragged_alltoall_leaf(x, splits, ps: ProcessSet):
     """In-jit alltoall with per-destination row counts (upstream
     ``hvd.alltoall(tensor, splits)``). ``x`` is (T, ...) with the rows for
-    destination ``j`` at offset ``cumsum(splits)[:j]``; ``splits`` is a (k,)
-    int vector summing to <= T. Returns ``((k, T, ...) received buffers,
+    destination ``j`` (set-rank order for subsets) at offset
+    ``cumsum(splits)[:j]``; ``splits`` is a (k,) int vector summing to
+    <= T, k = set size. Returns ``((k, T, ...) received buffers,
     (k,) recv_splits)`` — received rows from source ``j`` are
     ``out[j, :recv_splits[j]]``, pad rows are zero. Static worst-case T per
-    peer is the price of ragged under XLA's static shapes."""
-    if ps.ranks is not None:
-        raise NotImplementedError(
-            "alltoall(splits=...) supports the global process set only")
+    peer is the price of ragged under XLA's static shapes.
+
+    Subsets: XLA's AllToAll cannot take uneven replica subsets, so the
+    blocks ride a member ring — rotation ``s`` hands each member its block
+    for the member ``s`` positions ahead, k-1 ``ppermute`` hops of one
+    (T, ...) block each ((k-1)*T traffic among members only; non-members
+    carry nothing and end with zeros)."""
     T = x.shape[0]
+    k = ps.size()
     splits = jnp.asarray(splits, jnp.int32)
+    if splits.shape[0] != k:
+        raise ValueError(
+            f"splits must have one entry per set member ({k}), got shape "
+            f"{splits.shape}")
     offs = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(splits)[:-1]])
     idx = jnp.clip(offs[:, None] + jnp.arange(T)[None, :], 0, T - 1)
     send = jnp.take(x, idx, axis=0)                       # (k, T, ...)
     mask = (jnp.arange(T)[None, :] < splits[:, None]).reshape(
-        splits.shape[0], T, *([1] * (x.ndim - 1)))
+        k, T, *([1] * (x.ndim - 1)))
     send = jnp.where(mask, send, jnp.zeros_like(send))
-    recv = lax.all_to_all(send, ps.axis, split_axis=0, concat_axis=0)
-    recv_splits = lax.all_to_all(splits, ps.axis, split_axis=0,
-                                 concat_axis=0, tiled=True)
+    if ps.ranks is None:
+        recv = lax.all_to_all(send, ps.axis, split_axis=0, concat_axis=0)
+        recv_splits = lax.all_to_all(splits, ps.axis, split_axis=0,
+                                     concat_axis=0, tiled=True)
+        return recv, recv_splits
+    member, setrank = _member_and_setrank(ps)
+    send = jnp.where(member, send, jnp.zeros_like(send))
+    recv = jnp.zeros_like(send)
+    self_blk = lax.dynamic_index_in_dim(send, setrank, 0, keepdims=True)
+    recv = lax.dynamic_update_slice_in_dim(recv, self_blk, setrank, 0)
+    for s in range(1, k):
+        perm = [(ps.ranks[i], ps.ranks[(i + s) % k]) for i in range(k)]
+        blk = lax.dynamic_index_in_dim(send, jnp.mod(setrank + s, k), 0,
+                                       keepdims=True)
+        got = lax.ppermute(blk, ps.axis, perm)
+        recv = lax.dynamic_update_slice_in_dim(
+            recv, got, jnp.mod(setrank - s, k), 0)
+    g = _set_gather(splits, ps)                           # (k, k) src x dst
+    recv_splits = lax.dynamic_index_in_dim(g, setrank, 1, keepdims=False)
+    recv = jnp.where(member, recv, jnp.zeros_like(recv))
+    recv_splits = jnp.where(member, recv_splits, jnp.zeros_like(recv_splits))
     return recv, recv_splits
 
 
@@ -848,12 +875,20 @@ def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
       — rows from source ``j`` are ``out[j, :recv_splits[j]]``; pad rows
       zero. Static shapes force the worst-case T per peer.
     * **Eager**: ``tensor`` is a length-n sequence (entry r = rank r's
-      array), ``splits`` an (n, n) matrix (row r = rank r's send counts).
-      Returns the per-rank list of concatenated received rows, exactly
-      upstream's semantics. Multi-process: entries for other processes'
-      ranks are ``None`` (their rows live on their processes); the torch
-      frontend's ``alltoall(tensor, splits)`` wraps this with the
-      per-process size exchange.
+      array), ``splits`` a (k, k) matrix, k = set size (row j = member j's
+      send counts in set-rank order; k = n for the global set). Returns
+      the per-rank list of concatenated received rows, exactly upstream's
+      semantics. Multi-process: entries for other processes' ranks are
+      ``None`` (their rows live on their processes); the torch frontend's
+      ``alltoall(tensor, splits)`` wraps this with the per-process size
+      exchange.
+
+    Subset process sets are supported on both paths: blocks ride a member
+    ring (k-1 ``ppermute`` hops among members only); non-member entries of
+    the eager result list are ``None``. (The torch frontend's wrapper
+    supports subsets on the single-controller path; its one-round size
+    exchange spans every process, so multi-process subsets go through this
+    core API directly.)
     """
     ps = _resolve_ps(process_set)
     if splits is None:
@@ -906,25 +941,29 @@ def _ragged_allgather_eager(tensors, ps: ProcessSet):
 
 
 def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
-    if ps.ranks is not None:
-        raise NotImplementedError(
-            "alltoall(splits=...) supports the global process set only")
     n = core.size()
     arrs = _check_ragged_list(tensors, n)
+    members = list(range(n)) if ps.ranks is None else list(ps.ranks)
+    k = len(members)
     sp = np.asarray(splits, np.int64)
-    if sp.shape != (n, n):
-        raise ValueError(f"splits must be ({n}, {n}) (row r = rank r's send "
-                         f"counts), got {sp.shape}")
-    for r, a in enumerate(arrs):
-        if int(sp[r].sum()) != a.shape[0]:
+    if sp.shape != (k, k):
+        raise ValueError(f"splits must be ({k}, {k}) (row j = member j's "
+                         f"send counts in set-rank order), got {sp.shape}")
+    for j, r in enumerate(members):
+        if int(sp[j].sum()) != arrs[r].shape[0]:
             raise ValueError(
-                f"rank {r}: splits row sums to {int(sp[r].sum())} but tensor "
-                f"has {a.shape[0]} rows")
-    T = max(max((a.shape[0] for a in arrs), default=1), 1)
-    stacked = jnp.stack([_pad0(a, T) for a in arrs])
-    sp_dev = jnp.asarray(sp, jnp.int32)
+                f"rank {r}: splits row sums to {int(sp[j].sum())} but tensor "
+                f"has {arrs[r].shape[0]} rows")
+    # Non-member entries are ignored by the member ring; truncate them to
+    # the member max so every row pads to the same static shape.
+    T = max(max((arrs[r].shape[0] for r in members), default=1), 1)
+    stacked = jnp.stack([_pad0(a[:T], T) for a in arrs])
+    sp_full = np.zeros((n, k), np.int32)
+    for j, r in enumerate(members):
+        sp_full[r] = sp[j]
     recv, rsplits = _eager_run(
-        "ragged_alltoall", (stacked, sp_dev), (ps,), (_ps_key(ps),),
+        "ragged_alltoall", (stacked, jnp.asarray(sp_full)), (ps,),
+        (_ps_key(ps),),
         negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))))
     if jax.process_count() > 1:
         # Only this process's row of the stacked outputs is addressable;
@@ -933,16 +972,21 @@ def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
         # rows live on their processes, exactly upstream's locality.
         from horovod_tpu.frontend_bridge import from_stacked
         me = core.rank()
-        recv_local = from_stacked(recv)          # (n, T, ...)
-        rsp_local = from_stacked(rsplits)        # (n,)
-        segs = [recv_local[j, : int(rsp_local[j])] for j in range(n)]
+        if me not in members:
+            return [None] * n
+        recv_local = from_stacked(recv)          # (k, T, ...)
+        rsp_local = from_stacked(rsplits)        # (k,)
+        segs = [recv_local[j, : int(rsp_local[j])] for j in range(k)]
         mine = (np.concatenate(segs) if segs
                 else recv_local[0, :0])
         return [mine if r == me else None for r in range(n)]
-    rsplits = np.asarray(rsplits)               # (n, n)
+    rsplits = np.asarray(rsplits)               # (n, k)
     outs = []
     for r in range(n):
-        segs = [recv[r, j, : int(rsplits[r, j])] for j in range(n)]
+        if r not in members:
+            outs.append(None)
+            continue
+        segs = [recv[r, j, : int(rsplits[r, j])] for j in range(k)]
         outs.append(jnp.concatenate(segs) if segs else stacked[r, :0])
     return outs
 

@@ -13,7 +13,9 @@ import numpy as np
 
 from horovod_tpu import core
 
-__all__ = ["to_stacked", "from_stacked", "resolve_reduce_op"]
+__all__ = ["to_stacked", "from_stacked", "resolve_reduce_op",
+           "per_rank", "exchange_sizes_i32", "ragged_allgather_job",
+           "alltoall_splits_job"]
 
 
 def resolve_reduce_op(op, average):
@@ -74,3 +76,113 @@ def from_stacked(stacked) -> np.ndarray:
             "on this process (unexpected output sharding "
             f"{stacked.sharding})")
     return np.asarray(stacked[core.rank()]).copy()
+
+
+def per_rank(per_process: list) -> list:
+    """Expand a one-entry-per-PROCESS list (``allgather_object``'s shape)
+    to one entry per RANK: rank ``r`` lives on process ``r // local_size``
+    and — in the frontends' one-host-tensor-per-process model — every
+    local rank carries that process's value. Without this expansion,
+    indexing a per-process list with ranks breaks the moment a process
+    drives more than one device (a 4-chip TPU host)."""
+    ls = core.local_size()
+    return [v for v in per_process for _ in range(ls)]
+
+
+def exchange_sizes_i32(row):
+    """One FIXED-SHAPE host round exchanging per-process int32 size rows
+    (upstream folds size negotiation into the single controller round;
+    ``allgather_object`` would cost two-plus rounds of pickled max-length
+    padding — r3 weak 5). Returns the (process_count, len(row)) matrix."""
+    from horovod_tpu.collective import _host_allgather_i32
+    row = np.asarray(row, np.int64).reshape(-1)
+    # The pickled exchange this replaces was exact for any Python int; an
+    # int32 wraparound would silently truncate peer shapes. A LOCAL raise
+    # before the collective would wedge the peers already inside it, so
+    # the validity flag rides the round in-band and every process raises
+    # together.
+    bad = int(bool((row < 0).any() or (row >= 2 ** 31).any()))
+    wire = np.concatenate([np.clip(row, 0, 2 ** 31 - 1), [bad]])
+    rows = _host_allgather_i32(wire.astype(np.int32))
+    if rows[:, -1].any():
+        offenders = [int(i) for i in np.nonzero(rows[:, -1])[0]]
+        raise ValueError(
+            f"ragged sizes/splits must be in [0, 2^31) on every process; "
+            f"process(es) {offenders} sent out-of-range values"
+            + (f" (local row: {row.tolist()})" if bad else ""))
+    return rows[:, :-1]
+
+
+def ragged_allgather_job(arr, process_set):
+    """Numpy-level body for a frontend ragged allgather: exchange
+    per-process dim-0 sizes (upstream's controller size negotiation),
+    build the core eager per-rank list, return the concatenated numpy
+    result. Shared by the torch and tensorflow frontends.
+
+    Multi-process: rows for other processes feed the process-local shard
+    assembly and are never read, so size-matched zeros stand in. Single
+    controller: every simulated rank holds this process's value (the
+    ``to_stacked`` convention), so all entries are the real tensor."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    n = core.size()
+    me = jax.process_index()
+    ls = core.local_size()
+    if jax.process_count() > 1:
+        sizes = per_rank(
+            [int(s) for s in exchange_sizes_i32([arr.shape[0]])[:, 0]])
+        entries = [arr if r // ls == me else
+                   np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
+                   for r in range(n)]
+    else:
+        entries = [arr] * n
+    return np.asarray(hvd.ragged_allgather(entries,
+                                           process_set=process_set))
+
+
+def alltoall_splits_job(arr, splits_row, process_set):
+    """Numpy-level body for frontend ``alltoall(tensor, splits)``:
+    exchange the per-rank split rows, run the core ragged alltoall,
+    return this rank's received rows + received splits (both numpy).
+    Shared by the torch and tensorflow frontends."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    n = core.size()
+    members = (list(range(n)) if process_set is None
+               or process_set.ranks is None else list(process_set.ranks))
+    k = len(members)
+    sp_row = np.asarray(splits_row, np.int64).reshape(-1)
+    if sp_row.shape[0] != k:
+        raise ValueError(f"splits must have one entry per set member ({k}), "
+                         f"got {sp_row.shape[0]}")
+    if int(sp_row.sum()) != arr.shape[0]:
+        raise ValueError(f"splits sum to {int(sp_row.sum())} but tensor has "
+                         f"{arr.shape[0]} rows")
+    if jax.process_count() > 1:
+        if k != n:
+            raise NotImplementedError(
+                "alltoall(splits=...) on a subset process set is "
+                "single-controller only for now: the frontend's one-round "
+                "size exchange spans every process. Use the core "
+                "horovod_tpu.alltoall for multi-process subsets.")
+        me = jax.process_index()
+        ls = core.local_size()
+        rows = per_rank(list(exchange_sizes_i32(sp_row)))
+        sp = np.asarray(rows, np.int64)          # (size, size) after expand
+        entries = [arr if r // ls == me else
+                   np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
+                   for r in range(n)]
+    else:
+        if core.rank() not in members:
+            raise ValueError(
+                f"this process (rank {core.rank()}) is not a member of the "
+                f"process set {members}")
+        sp = np.tile(sp_row, (k, 1))
+        entries = [arr] * n
+    outs = hvd.alltoall(entries, splits=sp, process_set=process_set)
+    return (np.asarray(outs[core.rank()]),
+            sp[:, members.index(core.rank())].copy())

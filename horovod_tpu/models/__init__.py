@@ -29,13 +29,13 @@ def get_model(name: str, **kw):
         from horovod_tpu.models.vit import ViT, ViTConfig
         return ViT(ViTConfig.b16() if name != "vit" else ViTConfig(**kw))
     if name in ("llama", "llama7b", "llama_small"):
+        import dataclasses
+
         from horovod_tpu.models.llama import Llama, LlamaConfig
-        if name == "llama7b":
-            return Llama(LlamaConfig.llama7b())
-        # bare "llama" follows the zoo convention of a base-size default
-        # (LlamaConfig() *defaults* are the 7B shape — too big to init
-        # casually on a host or single chip)
-        if kw:
-            return Llama(LlamaConfig(**kw))
-        return Llama(LlamaConfig.small())
+        # kwargs override fields of the NAMED preset; they never fall back
+        # to the raw LlamaConfig defaults (the 7B shape — too big to init
+        # casually on a host or single chip).
+        base = (LlamaConfig.llama7b() if name == "llama7b"
+                else LlamaConfig.small())
+        return Llama(dataclasses.replace(base, **kw) if kw else base)
     raise ValueError(f"unknown model {name}")

@@ -56,6 +56,62 @@ def broadcast(tensor, root_rank: int = 0, **kwargs):
     return _tf.constant(from_stacked(out))
 
 
+def allgather(tensor, name=None, process_set=None, **kwargs):
+    """``hvd.tensorflow.allgather``: concatenate every rank's tensor along
+    dim 0 (first dims may DIFFER per rank, upstream's size negotiation —
+    the numpy-level ragged job is shared with the torch frontend)."""
+    _require_tf()
+    from horovod_tpu.frontend_bridge import ragged_allgather_job
+    out = ragged_allgather_job(tensor.numpy(), process_set)
+    return _tf.constant(out)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """``hvd.tensorflow.alltoall``: scatter dim-0 slices to every member,
+    gather theirs. With ``splits`` returns ``(received, received_splits)``
+    matching upstream's two-value return."""
+    _require_tf()
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import from_stacked, to_stacked
+    if splits is not None:
+        from horovod_tpu.frontend_bridge import alltoall_splits_job
+        sp = splits.numpy() if hasattr(splits, "numpy") else np.asarray(
+            splits)
+        out, rsplits = alltoall_splits_job(tensor.numpy(), sp, process_set)
+        return _tf.constant(out), _tf.constant(rsplits.astype(np.int32))
+    out = hvd.alltoall(to_stacked(tensor.numpy()), process_set=process_set)
+    return _tf.constant(from_stacked(out))
+
+
+def reducescatter(tensor, op=None, average=None, process_set=None,
+                  **kwargs):
+    """``hvd.tensorflow.reducescatter``: reduce then scatter dim-0 chunks
+    (this rank's chunk back as a tf tensor)."""
+    _require_tf()
+    import horovod_tpu as hvd
+    from horovod_tpu.frontend_bridge import (from_stacked,
+                                             resolve_reduce_op, to_stacked)
+    op = resolve_reduce_op(op, average)
+    out = hvd.reducescatter(to_stacked(tensor.numpy()), op=op,
+                            process_set=process_set, **kwargs)
+    return _tf.constant(from_stacked(out))
+
+
+def grouped_allreduce(tensors, op=None, average=None,
+                      compression=Compression.none, prescale_factor=1.0,
+                      postscale_factor=1.0, process_set=None):
+    """Fused: one collective for the whole list (rides the fusion buffer);
+    ``None`` entries and ``tf.IndexedSlices`` handled like the tape path."""
+    _require_tf()
+    from horovod_tpu.frontend_bridge import resolve_reduce_op
+    op = resolve_reduce_op(op, average)
+    return _allreduce_tf_list(list(tensors), op, compression,
+                              prescale_factor, postscale_factor,
+                              process_set)
+
+
 def broadcast_variables(variables, root_rank: int = 0):
     """Sync a list of ``tf.Variable`` from ``root_rank`` — works eagerly
     and inside ``@tf.function`` (upstream scripts call it from the first

@@ -139,68 +139,13 @@ def grouped_allreduce(tensors: Iterable, op: Optional[int] = None,
     return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
 
 
-def _per_rank(per_process: list) -> list:
-    """Expand a one-entry-per-PROCESS list (``allgather_object``'s shape)
-    to one entry per RANK: rank ``r`` lives on process ``r // local_size``
-    and — in the torch frontend's one-host-tensor-per-process model — every
-    local rank carries that process's value. Without this expansion,
-    indexing a per-process list with ranks breaks the moment a process
-    drives more than one device (a 4-chip TPU host)."""
-    ls = local_size()
-    return [v for v in per_process for _ in range(ls)]
-
-
-def _exchange_sizes_i32(row):
-    """One FIXED-SHAPE host round exchanging per-process int32 size rows
-    (upstream folds size negotiation into the single controller round;
-    ``allgather_object`` would cost two-plus rounds of pickled max-length
-    padding — r3 weak 5). Returns the (process_count, len(row)) matrix."""
-    import numpy as np
-
-    from horovod_tpu.collective import _host_allgather_i32
-    row = np.asarray(row, np.int64).reshape(-1)
-    # The pickled exchange this replaces was exact for any Python int; an
-    # int32 wraparound would silently truncate peer shapes. A LOCAL raise
-    # before the collective would wedge the peers already inside it, so
-    # the validity flag rides the round in-band and every process raises
-    # together.
-    bad = int(bool((row < 0).any() or (row >= 2 ** 31).any()))
-    wire = np.concatenate([np.clip(row, 0, 2 ** 31 - 1), [bad]])
-    rows = _host_allgather_i32(wire.astype(np.int32))
-    if rows[:, -1].any():
-        offenders = [int(i) for i in np.nonzero(rows[:, -1])[0]]
-        raise ValueError(
-            f"ragged sizes/splits must be in [0, 2^31) on every process; "
-            f"process(es) {offenders} sent out-of-range values"
-            + (f" (local row: {row.tolist()})" if bad else ""))
-    return rows[:, :-1]
-
-
-def _ragged_allgather_job(arr, process_set):
-    """Dispatch-thread body for a ragged allgather: exchange per-process
-    dim-0 sizes (upstream's controller size negotiation), build the core
-    eager per-rank list, return the concatenated numpy result.
-
-    Multi-process: rows for other processes feed the process-local shard
-    assembly and are never read, so size-matched zeros stand in. Single
-    controller: every simulated rank holds this process's value (the
-    ``to_stacked`` convention), so all entries are the real tensor."""
-    import jax
-    import numpy as np
-
-    n = size()
-    me = jax.process_index()
-    ls = local_size()
-    if jax.process_count() > 1:
-        sizes = _per_rank(
-            [int(s) for s in _exchange_sizes_i32([arr.shape[0]])[:, 0]])
-        entries = [arr if r // ls == me else
-                   np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
-                   for r in range(n)]
-    else:
-        entries = [arr] * n
-    return np.asarray(_hvd.ragged_allgather(entries,
-                                            process_set=process_set))
+# Numpy-level ragged jobs live in frontend_bridge (shared with the TF
+# frontend); the torch frontend runs them on its ordered dispatch thread.
+from horovod_tpu.frontend_bridge import (  # noqa: E402
+    alltoall_splits_job as _alltoall_splits_job,
+    ragged_allgather_job as _ragged_allgather_job,
+    per_rank as _per_rank,
+)
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
@@ -219,36 +164,6 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
     out = _run_sync(lambda: _hvd.allgather(stacked,
                                            process_set=process_set))
     return _from_stacked(out, tensor)
-
-
-def _alltoall_splits_job(arr, splits_row, process_set):
-    """Dispatch-thread body for ``alltoall(tensor, splits)``: exchange the
-    per-rank split rows, run the core ragged alltoall, return this rank's
-    received rows + received splits (both numpy)."""
-    import jax
-    import numpy as np
-
-    n = size()
-    sp_row = np.asarray(splits_row, np.int64).reshape(-1)
-    if sp_row.shape[0] != n:
-        raise ValueError(f"splits must have one entry per rank ({n}), got "
-                         f"{sp_row.shape[0]}")
-    if int(sp_row.sum()) != arr.shape[0]:
-        raise ValueError(f"splits sum to {int(sp_row.sum())} but tensor has "
-                         f"{arr.shape[0]} rows")
-    if jax.process_count() > 1:
-        me = jax.process_index()
-        ls = local_size()
-        rows = _per_rank(list(_exchange_sizes_i32(sp_row)))
-        sp = np.asarray(rows, np.int64)          # (size, size) after expand
-        entries = [arr if r // ls == me else
-                   np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
-                   for r in range(n)]
-    else:
-        sp = np.tile(sp_row, (n, 1))
-        entries = [arr] * n
-    outs = _hvd.alltoall(entries, splits=sp, process_set=process_set)
-    return np.asarray(outs[rank()]), sp[:, rank()].copy()
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,

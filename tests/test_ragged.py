@@ -67,10 +67,11 @@ class TestRaggedAllgatherInJit:
 class TestRaggedAlltoall:
     def _numpy_ref(self, xs, splits):
         # out[r] = concat over sources j of the rows j sent to r
+        k = len(xs)
         outs = []
-        for r in range(N):
+        for r in range(k):
             segs = []
-            for j in range(N):
+            for j in range(k):
                 off = int(splits[j, :r].sum())
                 segs.append(xs[j][off: off + int(splits[j, r])])
             outs.append(np.concatenate(segs) if segs else xs[r][:0])
@@ -126,11 +127,80 @@ class TestRaggedAlltoall:
             hvd.alltoall(xs, splits=bad)
         ps = hvd.add_process_set([0, 1])
         try:
-            ok = np.full((N, N), 0, np.int64)
-            with pytest.raises(NotImplementedError):
-                hvd.alltoall([x[:0] for x in xs], splits=ok, process_set=ps)
+            # Subset splits must be (k, k) in set-rank order, not (n, n).
+            with pytest.raises(ValueError):
+                hvd.alltoall(xs, splits=np.ones((N, N), np.int64),
+                             process_set=ps)
         finally:
             hvd.remove_process_set(ps)
+
+    def test_eager_splits_subset(self, rng):
+        members = [1, 4, 6]
+        k = len(members)
+        splits = rng.integers(0, 4, (k, k))
+        xs = []
+        for r in range(N):
+            if r in members:
+                m = int(splits[members.index(r)].sum())
+            else:
+                m = 3  # non-member payloads are ignored
+            xs.append(rng.standard_normal((m, 2)).astype(np.float32))
+        ps = hvd.add_process_set(members)
+        try:
+            outs = hvd.alltoall(xs, splits=splits, process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+        assert len(outs) == N
+        member_xs = [xs[r] for r in members]
+        refs = self._numpy_ref(member_xs, splits)
+        for r in range(N):
+            if r not in members:
+                assert outs[r] is None
+                continue
+            want = refs[members.index(r)]
+            assert outs[r].shape == want.shape
+            np.testing.assert_allclose(np.asarray(outs[r]), want, rtol=1e-6)
+
+    def test_in_jit_splits_subset(self, rng):
+        members = [0, 3, 5, 6]
+        k = len(members)
+        splits = rng.integers(0, 3, (k, k)).astype(np.int32)
+        T = int(splits.sum(1).max())
+        xs_full = rng.standard_normal((N, T, 2)).astype(np.float32)
+        member_xs = []
+        for j, r in enumerate(members):
+            rows = xs_full[r, : int(splits[j].sum())].copy()
+            member_xs.append(rows)
+        sp_full = np.zeros((N, k), np.int32)
+        for j, r in enumerate(members):
+            sp_full[r] = splits[j]
+        ps = hvd.add_process_set(members)
+        try:
+            def body(x, sp):
+                recv, rsplits = hvd.alltoall(x[0], splits=sp[0],
+                                             process_set=ps)
+                return recv[None], rsplits[None]
+
+            fn = hvd.spmd(body, in_specs=(P("hvd"), P("hvd")),
+                          out_specs=(P("hvd"), P("hvd")))
+            recv, rsplits = fn(jnp.asarray(xs_full), jnp.asarray(sp_full))
+        finally:
+            hvd.remove_process_set(ps)
+        recv, rsplits = np.asarray(recv), np.asarray(rsplits)
+        assert recv.shape == (N, k, T, 2)
+        refs = self._numpy_ref(member_xs, splits)
+        for r in range(N):
+            if r not in members:
+                np.testing.assert_array_equal(recv[r], 0.0)
+                np.testing.assert_array_equal(rsplits[r], 0)
+                continue
+            j = members.index(r)
+            np.testing.assert_array_equal(rsplits[r], splits[:, j])
+            got = np.concatenate(
+                [recv[r, i, : rsplits[r, i]] for i in range(k)])
+            np.testing.assert_allclose(got, refs[j], rtol=1e-6)
+            for i in range(k):
+                np.testing.assert_array_equal(recv[r, i, rsplits[r, i]:], 0.0)
 
 
 class TestRingSubsetGather:

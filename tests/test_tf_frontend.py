@@ -21,6 +21,46 @@ class TestTFCollectives:
         hvd_tf.broadcast_variables([v], root_rank=0)
         np.testing.assert_allclose(v.numpy(), [1.0, 2.0, 3.0], rtol=1e-6)
 
+    def test_allgather(self):
+        n = hvd_tf.size()
+        x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+        out = hvd_tf.allgather(x)
+        # single controller: every simulated rank holds this tensor
+        assert out.shape == (2 * n, 2)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.tile(x.numpy(), (n, 1)), rtol=1e-6)
+
+    def test_alltoall(self):
+        n = hvd_tf.size()
+        x = tf.constant(np.arange(float(n))[:, None].astype(np.float32))
+        out = hvd_tf.alltoall(x)
+        # rank 0's received rows: row 0 from every (identical) rank
+        np.testing.assert_allclose(out.numpy(), np.zeros((n, 1)), rtol=1e-6)
+
+    def test_alltoall_with_splits(self):
+        n = hvd_tf.size()
+        splits = tf.constant([3] + [1] * (n - 2) + [0], tf.int64)
+        t = tf.constant(np.arange(float(n + 1), dtype=np.float32))
+        out, rsplits = hvd_tf.alltoall(t, splits=splits)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.tile(t.numpy()[:3], n), rtol=1e-6)
+        np.testing.assert_array_equal(rsplits.numpy(), np.full(n, 3))
+
+    def test_reducescatter(self):
+        n = hvd_tf.size()
+        x = tf.constant(np.ones((2 * n, 3), np.float32))
+        out = hvd_tf.reducescatter(x, op=hvd_tf.Sum)
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 3), n),
+                                   rtol=1e-6)
+
+    def test_grouped_allreduce(self):
+        xs = [tf.constant([1.0, 2.0]), None, tf.constant([[3.0]])]
+        outs = hvd_tf.grouped_allreduce(xs)
+        assert outs[1] is None
+        np.testing.assert_allclose(outs[0].numpy(), [1.0, 2.0], rtol=1e-6)
+        np.testing.assert_allclose(outs[2].numpy(), [[3.0]], rtol=1e-6)
+
 
 class TestDistributedGradientTape:
     def test_gradients_flow_and_reduce(self):

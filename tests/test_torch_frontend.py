@@ -173,22 +173,48 @@ class TestRaggedSurfaces:
         import torch
         import horovod_tpu.torch as hvt
         n = hvt.size()
-        with pytest.raises(ValueError, match="one entry per rank"):
+        with pytest.raises(ValueError, match="one entry per set member"):
             hvt.alltoall(torch.arange(4.), splits=torch.ones(n - 1).long())
         with pytest.raises(ValueError, match="sum"):
             hvt.alltoall(torch.arange(4.),
                          splits=torch.ones(n).long() * 2)
 
+    def test_alltoall_with_splits_subset(self):
+        """Subset process set through the torch wrapper (single-controller
+        path): splits are (k,) in set-rank order; this process (rank 0)
+        must be a member."""
+        import torch
+        import horovod_tpu as hvd
+        import horovod_tpu.torch as hvt
+        ps = hvd.add_process_set([0, 2, 5])
+        try:
+            splits = torch.tensor([2, 1, 0])
+            t = torch.arange(3.)
+            out, rsplits = hvt.alltoall(t, splits=splits, process_set=ps)
+            # every simulated member sends the same first-2 rows to rank 0
+            assert torch.allclose(out, torch.cat([t[:2]] * 3)), out
+            assert torch.equal(rsplits.long(), torch.full((3,), 2).long())
+            nonmember = hvd.add_process_set([2, 5])
+            try:
+                with pytest.raises(ValueError, match="not a member"):
+                    hvt.alltoall(t, splits=torch.tensor([2, 1]),
+                                 process_set=nonmember)
+            finally:
+                hvd.remove_process_set(nonmember)
+        finally:
+            hvd.remove_process_set(ps)
+
     def test_per_rank_expansion(self, monkeypatch):
         """allgather_object returns one entry per PROCESS; the ragged jobs
         index per RANK. On a 4-chip-per-host topology the lists differ —
-        _per_rank repeats each process's entry local_size times (advisor
-        r3 medium finding)."""
-        import horovod_tpu.torch as hvt
-        monkeypatch.setattr(hvt, "local_size", lambda: 4)
-        assert hvt._per_rank(["a", "b"]) == ["a"] * 4 + ["b"] * 4
-        monkeypatch.setattr(hvt, "local_size", lambda: 1)
-        assert hvt._per_rank([1, 2, 3]) == [1, 2, 3]
+        per_rank repeats each process's entry local_size times (advisor
+        r3 medium finding). The job lives in frontend_bridge (shared by
+        the torch and tf frontends)."""
+        from horovod_tpu import frontend_bridge as fb
+        monkeypatch.setattr(fb.core, "local_size", lambda: 4)
+        assert fb.per_rank(["a", "b"]) == ["a"] * 4 + ["b"] * 4
+        monkeypatch.setattr(fb.core, "local_size", lambda: 1)
+        assert fb.per_rank([1, 2, 3]) == [1, 2, 3]
 
     def test_alltoall_async_with_splits(self):
         import torch

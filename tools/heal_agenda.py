@@ -112,12 +112,19 @@ def main(argv=None) -> int:
                         stderr=subprocess.DEVNULL)
                     sweep_pending = False
                 except subprocess.TimeoutExpired:
-                    # finished configs are durable in SWEEP_GPT2.txt, but
-                    # the un-run ones are not: re-fire on the next heal
-                    # (re-running the finished ones again is just extra
-                    # rows in the log)
-                    print("# sweep timed out (wedge mid-sweep?); "
-                          "re-fires on next heal", flush=True)
+                    # finished configs are durable in SWEEP_GPT2.txt. A
+                    # wedge mid-sweep should re-fire on the next heal; a
+                    # healthy-but-slow sweep should NOT loop every
+                    # interval until the deadline — probe to tell them
+                    # apart.
+                    if probe(args.probe_timeout) == "ok":
+                        print("# sweep hit its time budget with the relay "
+                              "up; keeping the finished configs",
+                              flush=True)
+                        sweep_pending = False
+                    else:
+                        print("# sweep timed out (wedge mid-sweep); "
+                              "re-fires on next heal", flush=True)
             if not remaining and not sweep_pending:
                 print("# agenda complete", flush=True)
                 return 0
