@@ -15,7 +15,7 @@ from horovod_tpu import core
 
 __all__ = ["to_stacked", "from_stacked", "resolve_reduce_op",
            "per_rank", "exchange_sizes_i32", "ragged_allgather_job",
-           "alltoall_splits_job"]
+           "grouped_ragged_allgather_job", "alltoall_splits_job"]
 
 
 def resolve_reduce_op(op, average):
@@ -117,7 +117,15 @@ def ragged_allgather_job(arr, process_set):
     """Numpy-level body for a frontend ragged allgather: exchange
     per-process dim-0 sizes (upstream's controller size negotiation),
     build the core eager per-rank list, return the concatenated numpy
-    result. Shared by the torch and tensorflow frontends.
+    result. Shared by the torch and tensorflow frontends."""
+    return grouped_ragged_allgather_job([arr], process_set)[0]
+
+
+def grouped_ragged_allgather_job(arrs, process_set):
+    """Grouped form of :func:`ragged_allgather_job`: ONE fixed-shape size
+    round covers every tensor in the group (the row of
+    :func:`exchange_sizes_i32` is the per-tensor dim-0 list), instead of
+    one blocking cross-host round per tensor.
 
     Multi-process: rows for other processes feed the process-local shard
     assembly and are never read, so size-matched zeros stand in. Single
@@ -128,18 +136,23 @@ def ragged_allgather_job(arr, process_set):
     import horovod_tpu as hvd
 
     n = core.size()
-    me = jax.process_index()
-    ls = core.local_size()
     if jax.process_count() > 1:
-        sizes = per_rank(
-            [int(s) for s in exchange_sizes_i32([arr.shape[0]])[:, 0]])
-        entries = [arr if r // ls == me else
-                   np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
-                   for r in range(n)]
-    else:
-        entries = [arr] * n
-    return np.asarray(hvd.ragged_allgather(entries,
-                                           process_set=process_set))
+        me = jax.process_index()
+        ls = core.local_size()
+        all_sizes = exchange_sizes_i32(
+            [a.shape[0] for a in arrs])          # (process_count, G)
+        outs = []
+        for gi, arr in enumerate(arrs):
+            sizes = per_rank([int(s) for s in all_sizes[:, gi]])
+            entries = [arr if r // ls == me else
+                       np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
+                       for r in range(n)]
+            outs.append(np.asarray(
+                hvd.ragged_allgather(entries, process_set=process_set)))
+        return outs
+    return [np.asarray(hvd.ragged_allgather([arr] * n,
+                                            process_set=process_set))
+            for arr in arrs]
 
 
 def alltoall_splits_job(arr, splits_row, process_set):

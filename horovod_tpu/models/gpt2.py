@@ -89,32 +89,8 @@ class Attention(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        if cfg.use_ring_attention:
-            if cfg.sp_impl == "ulysses":
-                from horovod_tpu.ops.sequence import ulysses_attention
-                blocks = {}
-                if cfg.flash_blocks is not None:
-                    blocks = {"block_q": int(cfg.flash_blocks[0]),
-                              "block_k": int(cfg.flash_blocks[1])}
-                o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
-                                      impl=cfg.attention, **blocks)
-            elif cfg.attention == "flash":
-                from horovod_tpu.ops.ring_flash import ring_flash_attention
-                o = ring_flash_attention(q, k, v, axis_name="sp", causal=True,
-                                         layout=cfg.ring_layout)
-            elif cfg.attention == "dense":
-                from horovod_tpu.ops.ring_attention import ring_attention
-                o = ring_attention(q, k, v, axis_name="sp", causal=True,
-                                   layout=cfg.ring_layout)
-            else:
-                raise ValueError(
-                    f"unknown attention impl {cfg.attention!r} for the ring "
-                    "path; expected 'dense' or 'flash'")
-        else:
-            from horovod_tpu.ops.attention import multihead_attention
-            o = multihead_attention(q, k, v, impl=cfg.attention, causal=True,
-                                    out_dtype=cfg.dtype,
-                                    flash_blocks=cfg.flash_blocks)
+        from horovod_tpu.ops.attention import sp_attention
+        o = sp_attention(q, k, v, cfg)
         o = o.reshape(B, T, D)
         return nn.Dense(D, dtype=cfg.dtype, name="out")(o)
 
@@ -156,44 +132,17 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True):
         cfg = self.cfg
-        if cfg.use_ring_attention and cfg.attention not in ("dense",
-                                                            "flash"):
-            raise ValueError(
-                f"unknown attention impl {cfg.attention!r} for the ring "
-                "path; expected 'dense' or 'flash'")
-        if cfg.use_ring_attention and cfg.sp_impl not in ("ring",
-                                                          "ulysses"):
-            raise ValueError(
-                f"unknown sp_impl {cfg.sp_impl!r}; expected 'ring' or "
-                "'ulysses'")
-        if cfg.use_ring_attention and cfg.ring_layout not in (
-                "contiguous", "striped"):
-            # A typo here would silently fall back to contiguous positions
-            # against striped-ordered tokens — wrong logits, no error.
-            raise ValueError(
-                f"unknown ring_layout {cfg.ring_layout!r}; expected "
-                "'contiguous' or 'striped'")
-        if cfg.use_ring_attention and cfg.sp_impl == "ulysses" and \
-                cfg.ring_layout == "striped":
-            raise ValueError(
-                "ulysses sequence parallelism gathers the full sequence "
-                "per head — positions are globally contiguous; use "
-                "ring_layout='contiguous'")
+        from horovod_tpu.ops.attention import (sp_global_positions,
+                                               validate_sp_config)
+        validate_sp_config(cfg)
         B, T = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        pos = jnp.arange(T)
-        if cfg.use_ring_attention:
-            # Sequence-parallel: wpe must be indexed with this shard's
-            # *global* positions — rank-major for the contiguous layout,
-            # rank-offset stride-n for the striped one.
-            if cfg.ring_layout == "striped":
-                n = jax.lax.psum(1, "sp")
-                pos = jax.lax.axis_index("sp") + n * pos
-            else:
-                pos = pos + jax.lax.axis_index("sp") * T
+        # Sequence-parallel: wpe is indexed with this shard's *global*
+        # positions.
+        pos = sp_global_positions(T, cfg)
         x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
         block = Block
         if cfg.remat:

@@ -125,33 +125,8 @@ class Attention(nn.Module):
             q_per_kv = H // Hkv
             k = jnp.repeat(k, q_per_kv, axis=2)
             v = jnp.repeat(v, q_per_kv, axis=2)
-        if cfg.use_ring_attention:
-            if cfg.sp_impl == "ulysses":
-                from horovod_tpu.ops.sequence import ulysses_attention
-                blocks = {}
-                if cfg.flash_blocks is not None:
-                    blocks = {"block_q": int(cfg.flash_blocks[0]),
-                              "block_k": int(cfg.flash_blocks[1])}
-                o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
-                                      impl=cfg.attention, **blocks)
-            elif cfg.attention == "flash":
-                from horovod_tpu.ops.ring_flash import ring_flash_attention
-                o = ring_flash_attention(q, k, v, axis_name="sp",
-                                         causal=True,
-                                         layout=cfg.ring_layout)
-            elif cfg.attention == "dense":
-                from horovod_tpu.ops.ring_attention import ring_attention
-                o = ring_attention(q, k, v, axis_name="sp", causal=True,
-                                   layout=cfg.ring_layout)
-            else:
-                raise ValueError(
-                    f"unknown attention impl {cfg.attention!r} for the "
-                    "ring path; expected 'dense' or 'flash'")
-        else:
-            from horovod_tpu.ops.attention import multihead_attention
-            o = multihead_attention(q, k, v, impl=cfg.attention,
-                                    causal=True, out_dtype=cfg.dtype,
-                                    flash_blocks=cfg.flash_blocks)
+        from horovod_tpu.ops.attention import sp_attention
+        o = sp_attention(q, k, v, cfg)
         return nn.Dense(D, use_bias=False, dtype=cfg.dtype,
                         name="wo")(o.reshape(B, T, D))
 
@@ -192,42 +167,15 @@ class Llama(nn.Module):
             raise ValueError(
                 f"num_kv_heads={cfg.num_kv_heads} must divide "
                 f"num_heads={cfg.num_heads}")
-        if cfg.use_ring_attention and cfg.attention not in ("dense",
-                                                            "flash"):
-            raise ValueError(
-                f"unknown attention impl {cfg.attention!r} for the ring "
-                "path; expected 'dense' or 'flash'")
-        if cfg.use_ring_attention and cfg.sp_impl not in ("ring",
-                                                          "ulysses"):
-            raise ValueError(
-                f"unknown sp_impl {cfg.sp_impl!r}; expected 'ring' or "
-                "'ulysses'")
-        if cfg.use_ring_attention and cfg.ring_layout not in (
-                "contiguous", "striped"):
-            # A typo here would silently fall back to contiguous positions
-            # against striped-ordered tokens — wrong logits, no error.
-            raise ValueError(
-                f"unknown ring_layout {cfg.ring_layout!r}; expected "
-                "'contiguous' or 'striped'")
-        if cfg.use_ring_attention and cfg.sp_impl == "ulysses" and \
-                cfg.ring_layout == "striped":
-            raise ValueError(
-                "ulysses sequence parallelism gathers the full sequence "
-                "per head — positions are globally contiguous; use "
-                "ring_layout='contiguous' (striped RoPE positions would "
-                "mask the wrong pairs: wrong logits, no error)")
+        from horovod_tpu.ops.attention import (sp_global_positions,
+                                               validate_sp_config)
+        validate_sp_config(cfg)
         B, T = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
-        pos = jnp.arange(T)
-        if cfg.use_ring_attention:
-            # global positions for this sp shard (gpt2.py's wpe logic,
-            # expressed through RoPE's explicit position input)
-            if cfg.ring_layout == "striped":
-                n = jax.lax.psum(1, "sp")
-                pos = jax.lax.axis_index("sp") + n * pos
-            else:
-                pos = pos + jax.lax.axis_index("sp") * T
+        # Global positions for this sp shard feed RoPE's explicit
+        # position input (the same role as gpt2's wpe indexing).
+        pos = sp_global_positions(T, cfg)
         x = wte[tokens].astype(cfg.dtype)
         block = Block
         if cfg.remat:

@@ -14,7 +14,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["multihead_attention", "ATTENTION_IMPLS"]
+__all__ = ["multihead_attention", "ATTENTION_IMPLS", "validate_sp_config",
+           "sp_global_positions", "sp_attention"]
 
 ATTENTION_IMPLS = ("dense", "flash")
 
@@ -77,3 +78,92 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         any_visible = jnp.any(key_mask, axis=-1)[:, None, None, None]
         p = jnp.where(any_visible, p, 0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def validate_sp_config(cfg) -> None:
+    """Shared config guards for the sequence-parallel attention dispatch.
+
+    Reads ``use_ring_attention / attention / sp_impl / ring_layout`` off any
+    model config (GPT-2, Llama). Raises on typos rather than silently
+    training on the wrong path — a bad ``ring_layout`` in particular would
+    index contiguous positions against striped-ordered tokens: wrong
+    logits, no error.
+    """
+    if not cfg.use_ring_attention:
+        return
+    if cfg.attention not in ("dense", "flash"):
+        raise ValueError(
+            f"unknown attention impl {cfg.attention!r} for the ring "
+            "path; expected 'dense' or 'flash'")
+    if cfg.sp_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown sp_impl {cfg.sp_impl!r}; expected 'ring' or "
+            "'ulysses'")
+    if cfg.ring_layout not in ("contiguous", "striped"):
+        raise ValueError(
+            f"unknown ring_layout {cfg.ring_layout!r}; expected "
+            "'contiguous' or 'striped'")
+    if cfg.sp_impl == "ulysses" and cfg.ring_layout == "striped":
+        raise ValueError(
+            "ulysses sequence parallelism gathers the full sequence "
+            "per head — positions are globally contiguous; use "
+            "ring_layout='contiguous' (striped positions would mask the "
+            "wrong pairs: wrong logits, no error)")
+
+
+def sp_global_positions(T: int, cfg, axis_name: str = "sp") -> jnp.ndarray:
+    """Global token positions for this sequence-parallel shard: (T,) int.
+
+    Positional state (GPT-2's wpe rows, Llama's RoPE angles) must follow
+    the shard's *global* positions — rank-major for the contiguous layout,
+    rank-offset stride-n for the striped one. Without sequence parallelism
+    this is just ``arange(T)``.
+    """
+    pos = jnp.arange(T)
+    if not cfg.use_ring_attention:
+        return pos
+    if cfg.ring_layout == "striped":
+        n = jax.lax.psum(1, axis_name)
+        return jax.lax.axis_index(axis_name) + n * pos
+    return pos + jax.lax.axis_index(axis_name) * T
+
+
+def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
+                 axis_name: str = "sp") -> jnp.ndarray:
+    """One dispatch for the zoo's causal self-attention paths.
+
+    ``cfg`` carries the selection (``use_ring_attention / sp_impl /
+    attention / ring_layout / flash_blocks / dtype``):
+
+    * no sp            -> ``multihead_attention`` (dense or pallas flash)
+    * sp_impl="ring"   -> ring attention over ``axis_name`` (dense or
+                          flash backward-ring, contiguous/striped layouts)
+    * sp_impl="ulysses"-> all-to-all heads<->sequence, then local attention
+
+    Used by GPT-2 and Llama so the dispatch cannot diverge between model
+    families (the configs validate via :func:`validate_sp_config`).
+    """
+    if cfg.use_ring_attention:
+        if cfg.sp_impl == "ulysses":
+            from horovod_tpu.ops.sequence import ulysses_attention
+            blocks = {}
+            if cfg.flash_blocks is not None:
+                blocks = {"block_q": int(cfg.flash_blocks[0]),
+                          "block_k": int(cfg.flash_blocks[1])}
+            return ulysses_attention(q, k, v, axis_name=axis_name,
+                                     causal=True, impl=cfg.attention,
+                                     **blocks)
+        if cfg.attention == "flash":
+            from horovod_tpu.ops.ring_flash import ring_flash_attention
+            return ring_flash_attention(q, k, v, axis_name=axis_name,
+                                        causal=True, layout=cfg.ring_layout)
+        if cfg.attention == "dense":
+            from horovod_tpu.ops.ring_attention import ring_attention
+            return ring_attention(q, k, v, axis_name=axis_name, causal=True,
+                                  layout=cfg.ring_layout)
+        raise ValueError(
+            f"unknown attention impl {cfg.attention!r} for the ring "
+            "path; expected 'dense' or 'flash'")
+    return multihead_attention(q, k, v, impl=cfg.attention, causal=True,
+                               out_dtype=cfg.dtype,
+                               flash_blocks=cfg.flash_blocks)

@@ -21,6 +21,7 @@ except ImportError:
 
 from horovod_tpu.collective import (  # noqa: F401
     Average, Sum, Min, Max, Product, Adasum,
+    allgather_object, broadcast_object, join,
 )
 from horovod_tpu.compression import Compression  # noqa: F401
 from horovod_tpu.core import (  # noqa: F401

@@ -32,9 +32,11 @@ __all__ = [
     "local_size", "cross_rank", "cross_size",
     "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
     "alltoall", "reducescatter", "grouped_allreduce",
+    "grouped_allgather", "grouped_reducescatter",
     "allreduce_async", "allreduce_async_", "allgather_async",
     "broadcast_async", "broadcast_async_", "alltoall_async",
     "reducescatter_async", "grouped_allreduce_async",
+    "grouped_allgather_async", "grouped_reducescatter_async",
     "synchronize", "poll", "join",
     "broadcast_object", "allgather_object",
     "broadcast_parameters", "broadcast_optimizer_state",
@@ -139,12 +141,38 @@ def grouped_allreduce(tensors: Iterable, op: Optional[int] = None,
     return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
 
 
+def grouped_allgather(tensors: Iterable, name: Optional[str] = None,
+                      process_set=None):
+    """``hvd.grouped_allgather``: one dispatch submission for the whole
+    list (program order preserved across the group); first dims may
+    DIFFER per rank — each entry rides the shared ragged job."""
+    tensors = list(tensors)
+    arrs = [t.detach().cpu().numpy() for t in tensors]
+    outs = _run_sync(
+        lambda: _grouped_ragged_allgather_job(arrs, process_set))
+    torch = _torch()
+    return [torch.from_numpy(o).to(t.dtype)
+            for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors: Iterable, op: Optional[int] = None,
+                          average=None, process_set=None):
+    """``hvd.grouped_reducescatter``: reduce+scatter every tensor in one
+    ordered submission."""
+    op = _resolve_op(op, average)
+    tensors = list(tensors)
+    stacked = [_to_jax_stacked(t) for t in tensors]
+    outs = _run_sync(lambda: _hvd.grouped_reducescatter(
+        stacked, op=op, process_set=process_set))
+    return [_from_stacked(o, t) for o, t in zip(outs, tensors)]
+
+
 # Numpy-level ragged jobs live in frontend_bridge (shared with the TF
 # frontend); the torch frontend runs them on its ordered dispatch thread.
 from horovod_tpu.frontend_bridge import (  # noqa: E402
     alltoall_splits_job as _alltoall_splits_job,
+    grouped_ragged_allgather_job as _grouped_ragged_allgather_job,
     ragged_allgather_job as _ragged_allgather_job,
-    per_rank as _per_rank,
 )
 
 
@@ -386,6 +414,36 @@ def reducescatter_async(tensor, op: int = Average,
     fut = _submit(lambda: _hvd.reducescatter(stacked, op=op,
                                              process_set=process_set))
     return _AsyncHandle(fut, tensor)
+
+
+def grouped_allgather_async(tensors: Iterable, name: Optional[str] = None,
+                            process_set=None):
+    """Async ``grouped_allgather``; ``synchronize`` returns the list of
+    gathered tensors."""
+    tensors = list(tensors)
+    arrs = [t.detach().cpu().numpy() for t in tensors]
+    dtypes = [t.dtype for t in tensors]
+
+    def job():
+        torch = _torch()
+        outs = _grouped_ragged_allgather_job(arrs, process_set)
+        return [torch.from_numpy(o).to(dt)
+                for o, dt in zip(outs, dtypes)]
+
+    return _AsyncHandle(_submit(job), None, raw=True)
+
+
+def grouped_reducescatter_async(tensors: Iterable,
+                                op: Optional[int] = None, average=None,
+                                process_set=None):
+    """Async ``grouped_reducescatter``; ``synchronize`` returns the list
+    of scattered chunks."""
+    op = _resolve_op(op, average)
+    tensors = list(tensors)
+    stacked = [_to_jax_stacked(t) for t in tensors]
+    fut = _submit(lambda: _hvd.grouped_reducescatter(
+        stacked, op=op, process_set=process_set))
+    return _AsyncHandle(fut, tensors, grouped=True)
 
 
 def join() -> int:

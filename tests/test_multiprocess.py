@@ -11,7 +11,9 @@ import pytest
 
 _WORKER = textwrap.dedent("""
     import os, sys
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                               + os.environ.get("HVT_TEST_LOCAL_DEVICES",
+                                                "1"))
     import jax
     jax.config.update("jax_platforms", "cpu")
     pid, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
@@ -83,6 +85,41 @@ _WORKER = textwrap.dedent("""
                                                           expr), pid
         assert hvt.poll(h2)
         print(f"proc {{pid}} TORCH-OK", flush=True)
+    elif mode == "torch_ls2":
+        # 2 processes x 2 local devices (size=4, local_size=2): the
+        # topology the advisor's r3 medium finding showed the 2x1 tests
+        # cannot cover. In the frontend model every local rank carries its
+        # process's host tensor.
+        import torch
+        import horovod_tpu.torch as hvt
+        assert hvt.size() == 4 and hvt.local_size() == 2, (
+            hvt.size(), hvt.local_size())
+        avg = hvt.allreduce(torch.full((3,), float(pid)))
+        assert torch.allclose(avg, torch.full((3,), 0.5)), avg
+        # Ragged allgather: per-PROCESS sizes differ (1 vs 2 rows); the
+        # per-rank expansion duplicates each process's rows local_size
+        # times.
+        rg = hvt.allgather(torch.arange(float(pid + 1)) + 10 * pid)
+        want = torch.tensor([0., 0., 10., 11., 10., 11.])
+        assert torch.allclose(rg, want), (pid, rg)
+        # alltoall(splits=): per-rank split rows expand per process; this
+        # process reads its first local rank's column.
+        sp = torch.ones(4).long() * (pid + 1)
+        t = torch.arange(4.0 * (pid + 1)) + 10 * pid
+        out, rsp = hvt.alltoall(t, splits=sp)
+        expo = torch.tensor([0., 0., 10., 11., 10., 11.]) if pid == 0 \
+            else torch.tensor([2., 2., 14., 15., 14., 15.])
+        assert torch.allclose(out, expo), (pid, out)
+        assert torch.equal(rsp.long(), torch.tensor([1, 1, 2, 2])), \
+            (pid, rsp)
+        # grouped ragged gather: ONE size round for the pair of tensors.
+        g1, g2 = hvt.grouped_allgather(
+            [torch.full((1,), float(pid)), torch.arange(float(2 - pid))])
+        assert torch.allclose(
+            g1, torch.tensor([0., 0., 1., 1.])), (pid, g1)
+        assert torch.allclose(
+            g2, torch.tensor([0., 1., 0., 1., 0., 0.])), (pid, g2)
+        print(f"proc {{pid}} TORCH-LS2-OK", flush=True)
     elif mode == "stall":
         # End-to-end stall inspection: rank 1 delays its collective; rank
         # 0's watchdog thread reads the pending-op table mid-negotiation.
@@ -203,15 +240,18 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_pair(mode: str):
+def _run_pair(mode: str, local_devices: int = 1):
+    import os
     import pathlib
     repo = str(pathlib.Path(__file__).resolve().parent.parent)
     script = _WORKER.format(repo=repo)
     port = _free_port()
+    env = dict(os.environ,
+               HVT_TEST_LOCAL_DEVICES=str(local_devices))
     procs = [subprocess.Popen(
         [sys.executable, "-c", script, str(pid), str(port), mode],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=None) for pid in range(2)]
+        env=env) for pid in range(2)]
     outs = [p.communicate(timeout=180)[0] for p in procs]
     return [(p.returncode, o) for p, o in zip(procs, outs)]
 
@@ -279,6 +319,15 @@ def test_two_process_subset_barrier():
     for rc, out in _run_pair("subset_barrier"):
         assert rc == 0, out
         assert "SUBSET-BARRIER-OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_two_local_devices_frontend_paths():
+    """size=4 over 2 processes x 2 virtual devices: the per-rank expansion
+    topology (4-chip-TPU-host shape) that 2x1 runs cannot exercise."""
+    for rc, out in _run_pair("torch_ls2", local_devices=2):
+        assert rc == 0, out
+        assert "TORCH-LS2-OK" in out
 
 
 @pytest.mark.slow

@@ -54,6 +54,14 @@ class TestTFCollectives:
         np.testing.assert_allclose(out.numpy(), np.full((2, 3), n),
                                    rtol=1e-6)
 
+    def test_object_collectives_and_join_reexported(self):
+        # upstream horovod.tensorflow exposes these at module level
+        obj = {"a": 1, "b": [2.0, 3.0]}
+        assert hvd_tf.broadcast_object(obj, root_rank=0) == obj
+        gathered = hvd_tf.allgather_object(obj)
+        assert len(gathered) >= 1 and gathered[0] == obj
+        assert callable(hvd_tf.join)
+
     def test_grouped_allreduce(self):
         xs = [tf.constant([1.0, 2.0]), None, tf.constant([[3.0]])]
         outs = hvd_tf.grouped_allreduce(xs)

@@ -216,6 +216,38 @@ class TestRaggedSurfaces:
         monkeypatch.setattr(fb.core, "local_size", lambda: 1)
         assert fb.per_rank([1, 2, 3]) == [1, 2, 3]
 
+    def test_grouped_allgather(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        ts = [torch.arange(2.0), torch.ones((3, 2))]
+        outs = hvt.grouped_allgather(ts)
+        assert outs[0].shape == (2 * n,) and outs[1].shape == (3 * n, 2)
+        assert torch.allclose(outs[0], torch.arange(2.0).repeat(n))
+
+    def test_grouped_reducescatter(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        ts = [torch.ones(2 * n), torch.full((n, 2), 3.0)]
+        outs = hvt.grouped_reducescatter(ts, op=hvt.Sum)
+        assert outs[0].shape == (2,) and outs[1].shape == (1, 2)
+        assert torch.allclose(outs[0], torch.full((2,), float(n)))
+        assert torch.allclose(outs[1], torch.full((1, 2), 3.0 * n))
+
+    def test_grouped_async_variants(self):
+        import torch
+        import horovod_tpu.torch as hvt
+        n = hvt.size()
+        h1 = hvt.grouped_allgather_async([torch.arange(3.0)])
+        h2 = hvt.grouped_reducescatter_async([torch.ones(n)],
+                                             op=hvt.Average)
+        outs2 = hvt.synchronize(h2)
+        outs1 = hvt.synchronize(h1)
+        assert torch.allclose(outs1[0], torch.arange(3.0).repeat(n))
+        assert torch.allclose(outs2[0], torch.ones(1))
+        assert hvt.poll(h1) and hvt.poll(h2)
+
     def test_alltoall_async_with_splits(self):
         import torch
         import horovod_tpu.torch as hvt
