@@ -885,10 +885,10 @@ def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
 
     Subset process sets are supported on both paths: blocks ride a member
     ring (k-1 ``ppermute`` hops among members only); non-member entries of
-    the eager result list are ``None``. (The torch frontend's wrapper
-    supports subsets on the single-controller path; its one-round size
-    exchange spans every process, so multi-process subsets go through this
-    core API directly.)
+    the eager result list are ``None``. (The torch/tf wrappers support
+    subsets too — multi-process, every process still calls, non-member
+    processes with a zero-row tensor; see
+    ``frontend_bridge.alltoall_splits_job``.)
     """
     ps = _resolve_ps(process_set)
     if splits is None:
@@ -966,20 +966,23 @@ def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
         (_ps_key(ps),),
         negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))))
     if jax.process_count() > 1:
-        # Only this process's row of the stacked outputs is addressable;
-        # read it off the local shard (a direct np.asarray of the sharded
-        # result would raise). Foreign ranks' entries are None — their
-        # rows live on their processes, exactly upstream's locality.
-        from horovod_tpu.frontend_bridge import from_stacked
-        me = core.rank()
-        if me not in members:
-            return [None] * n
-        recv_local = from_stacked(recv)          # (k, T, ...)
-        rsp_local = from_stacked(rsplits)        # (k,)
-        segs = [recv_local[j, : int(rsp_local[j])] for j in range(k)]
-        mine = (np.concatenate(segs) if segs
-                else recv_local[0, :0])
-        return [mine if r == me else None for r in range(n)]
+        # Only this process's rows of the stacked outputs are addressable;
+        # read them off the local shard (a direct np.asarray of the
+        # sharded result would raise). Every LOCAL member rank's row is
+        # returned (a process may own several member ranks, and none of
+        # its member ranks need be its first rank — e.g. members [1, 2]
+        # on a 2-rank-per-process topology); foreign ranks' entries are
+        # None — their rows live on their processes, upstream's locality.
+        from horovod_tpu.frontend_bridge import (from_stacked,
+                                                 local_member_ranks)
+        by_rank: dict = {}
+        for mr in local_member_ranks(members):
+            recv_local = from_stacked(recv, row=mr)    # (k, T, ...)
+            rsp_local = from_stacked(rsplits, row=mr)  # (k,)
+            segs = [recv_local[j, : int(rsp_local[j])] for j in range(k)]
+            by_rank[mr] = (np.concatenate(segs) if segs
+                           else recv_local[0, :0])
+        return [by_rank.get(r) for r in range(n)]
     rsplits = np.asarray(rsplits)               # (n, k)
     outs = []
     for r in range(n):

@@ -14,8 +14,9 @@ import numpy as np
 from horovod_tpu import core
 
 __all__ = ["to_stacked", "from_stacked", "resolve_reduce_op",
-           "per_rank", "exchange_sizes_i32", "ragged_allgather_job",
-           "grouped_ragged_allgather_job", "alltoall_splits_job"]
+           "per_rank", "exchange_sizes_i32", "local_member_ranks",
+           "ragged_allgather_job", "grouped_ragged_allgather_job",
+           "alltoall_splits_job"]
 
 
 def resolve_reduce_op(op, average):
@@ -51,8 +52,11 @@ def to_stacked(array_like) -> np.ndarray:
     return np.broadcast_to(arr, (core.size(),) + arr.shape).copy()
 
 
-def from_stacked(stacked) -> np.ndarray:
-    """Stacked result -> this process's value: row ``core.rank()``.
+def from_stacked(stacked, row: int | None = None) -> np.ndarray:
+    """Stacked result -> this process's value: row ``core.rank()`` (or an
+    explicit ``row`` — any rank whose slice is addressable here, e.g. a
+    process's non-first local rank that is the one belonging to a subset
+    process set).
 
     Single controller: the result is fully addressable and every simulated
     rank is local; the process is rank 0 by convention (``core.rank()``
@@ -64,7 +68,7 @@ def from_stacked(stacked) -> np.ndarray:
     """
     import jax
     if isinstance(stacked, jax.Array) and not stacked.is_fully_addressable:
-        r = core.rank()
+        r = core.rank() if row is None else row
         for sh in stacked.addressable_shards:
             s0 = sh.index[0] if sh.index else slice(None)
             start = s0.start or 0
@@ -75,7 +79,7 @@ def from_stacked(stacked) -> np.ndarray:
             f"rank {r}'s row of a stacked eager result is not addressable "
             "on this process (unexpected output sharding "
             f"{stacked.sharding})")
-    return np.asarray(stacked[core.rank()]).copy()
+    return np.asarray(stacked[core.rank() if row is None else row]).copy()
 
 
 def per_rank(per_process: list) -> list:
@@ -113,6 +117,15 @@ def exchange_sizes_i32(row):
     return rows[:, :-1]
 
 
+def local_member_ranks(members) -> list:
+    """Ranks of THIS process that belong to ``members`` (a process-set
+    rank list), in rank order. Multi-process topology only — on a single
+    controller every rank is local and membership is judged on
+    ``core.rank()`` alone."""
+    me = core.rank()
+    return [r for r in range(me, me + core.local_size()) if r in members]
+
+
 def ragged_allgather_job(arr, process_set):
     """Numpy-level body for a frontend ragged allgather: exchange
     per-process dim-0 sizes (upstream's controller size negotiation),
@@ -147,11 +160,13 @@ def grouped_ragged_allgather_job(arrs, process_set):
             entries = [arr if r // ls == me else
                        np.zeros((sizes[r],) + arr.shape[1:], arr.dtype)
                        for r in range(n)]
-            outs.append(np.asarray(
+            # np.array (not asarray): a WRITABLE copy — torch.from_numpy
+            # on an alias of a jax buffer is undefined-behavior territory.
+            outs.append(np.array(
                 hvd.ragged_allgather(entries, process_set=process_set)))
         return outs
-    return [np.asarray(hvd.ragged_allgather([arr] * n,
-                                            process_set=process_set))
+    return [np.array(hvd.ragged_allgather([arr] * n,
+                                          process_set=process_set))
             for arr in arrs]
 
 
@@ -159,7 +174,14 @@ def alltoall_splits_job(arr, splits_row, process_set):
     """Numpy-level body for frontend ``alltoall(tensor, splits)``:
     exchange the per-rank split rows, run the core ragged alltoall,
     return this rank's received rows + received splits (both numpy).
-    Shared by the torch and tensorflow frontends."""
+    Shared by the torch and tensorflow frontends.
+
+    Subset process sets: ``splits_row`` is (k,) in set-rank order.
+    Multi-process, EVERY process still calls (the eager engine negotiates
+    globally; same convention as every other subset eager collective) —
+    non-member processes pass a zero-row tensor and a zero ``splits_row``
+    and receive ``(empty, zeros(k))``.
+    """
     import jax
 
     import horovod_tpu as hvd
@@ -168,34 +190,46 @@ def alltoall_splits_job(arr, splits_row, process_set):
     members = (list(range(n)) if process_set is None
                or process_set.ranks is None else list(process_set.ranks))
     k = len(members)
+    ls = core.local_size()
+    me0 = core.rank()
+    if jax.process_count() > 1:
+        lm = local_member_ranks(members)
+        local_member = lm[0] if lm else None
+    else:
+        # Single controller simulates every rank but IS rank 0 by
+        # convention — membership is judged on that rank alone.
+        local_member = me0 if me0 in members else None
     sp_row = np.asarray(splits_row, np.int64).reshape(-1)
     if sp_row.shape[0] != k:
         raise ValueError(f"splits must have one entry per set member ({k}), "
                          f"got {sp_row.shape[0]}")
-    if int(sp_row.sum()) != arr.shape[0]:
+    if local_member is not None and int(sp_row.sum()) != arr.shape[0]:
         raise ValueError(f"splits sum to {int(sp_row.sum())} but tensor has "
                          f"{arr.shape[0]} rows")
     if jax.process_count() > 1:
-        if k != n:
-            raise NotImplementedError(
-                "alltoall(splits=...) on a subset process set is "
-                "single-controller only for now: the frontend's one-round "
-                "size exchange spans every process. Use the core "
-                "horovod_tpu.alltoall for multi-process subsets.")
         me = jax.process_index()
-        ls = core.local_size()
-        rows = per_rank(list(exchange_sizes_i32(sp_row)))
-        sp = np.asarray(rows, np.int64)          # (size, size) after expand
+        # One fixed-shape round: (process_count, k) split rows; non-member
+        # processes contribute zeros.
+        wire = sp_row if local_member is not None else np.zeros(k, np.int64)
+        rows_by_proc = exchange_sizes_i32(wire)
+        rows = per_rank(list(rows_by_proc))       # (size, k) after expand
+        sp_full = np.asarray(rows, np.int64)
+        # Core wants the (k, k) matrix in set-rank order.
+        sp = np.stack([sp_full[m] for m in members])
         entries = [arr if r // ls == me else
-                   np.zeros((int(sp[r].sum()),) + arr.shape[1:], arr.dtype)
+                   np.zeros((int(sp_full[r].sum()),) + arr.shape[1:],
+                            arr.dtype)
                    for r in range(n)]
     else:
-        if core.rank() not in members:
+        if local_member is None:
             raise ValueError(
-                f"this process (rank {core.rank()}) is not a member of the "
+                f"this process (rank {me0}) is not a member of the "
                 f"process set {members}")
         sp = np.tile(sp_row, (k, 1))
         entries = [arr] * n
     outs = hvd.alltoall(entries, splits=sp, process_set=process_set)
-    return (np.asarray(outs[core.rank()]),
-            sp[:, members.index(core.rank())].copy())
+    if local_member is None:
+        return (np.zeros((0,) + arr.shape[1:], arr.dtype),
+                np.zeros(k, np.int64))
+    return (np.asarray(outs[local_member]),
+            sp[:, members.index(local_member)].copy())

@@ -119,7 +119,40 @@ _WORKER = textwrap.dedent("""
             g1, torch.tensor([0., 0., 1., 1.])), (pid, g1)
         assert torch.allclose(
             g2, torch.tensor([0., 1., 0., 1., 0., 0.])), (pid, g2)
+        # Cross-process subset alltoall(splits=): members are one rank
+        # from EACH process ([0, 2]); every process calls (global
+        # negotiation), results come back via the local member rank.
+        from horovod_tpu.process_set import add_process_set
+        ps = add_process_set([0, 2])
+        ssp = torch.tensor([1, 2]) if pid == 0 else torch.tensor([2, 1])
+        st = torch.arange(3.0) + 10 * pid
+        sout, srsp = hvt.alltoall(st, splits=ssp, process_set=ps)
+        sexpo = torch.tensor([0., 10., 11.]) if pid == 0 \
+            else torch.tensor([1., 2., 12.])
+        sexpr = torch.tensor([1, 2]) if pid == 0 else torch.tensor([2, 1])
+        assert torch.allclose(sout, sexpo), (pid, sout)
+        assert torch.equal(srsp.long(), sexpr), (pid, srsp)
         print(f"proc {{pid}} TORCH-LS2-OK", flush=True)
+    elif mode == "subset_a2a":
+        # Subset with a WHOLLY non-member process: the non-member still
+        # calls (global negotiation) with a zero-row tensor and zero
+        # splits, and receives (empty, zeros).
+        import torch
+        import horovod_tpu.torch as hvt
+        from horovod_tpu.process_set import add_process_set
+        ps = add_process_set([1])
+        if pid == 1:
+            out, rsp = hvt.alltoall(torch.tensor([10., 11.]),
+                                    splits=torch.tensor([2]),
+                                    process_set=ps)
+            assert torch.allclose(out, torch.tensor([10., 11.])), out
+            assert torch.equal(rsp.long(), torch.tensor([2])), rsp
+        else:
+            out, rsp = hvt.alltoall(torch.zeros((0,)),
+                                    splits=torch.tensor([0]),
+                                    process_set=ps)
+            assert out.shape == (0,) and int(rsp.sum()) == 0, (out, rsp)
+        print(f"proc {{pid}} SUBSET-A2A-OK", flush=True)
     elif mode == "stall":
         # End-to-end stall inspection: rank 1 delays its collective; rank
         # 0's watchdog thread reads the pending-op table mid-negotiation.
@@ -328,6 +361,13 @@ def test_two_process_two_local_devices_frontend_paths():
     for rc, out in _run_pair("torch_ls2", local_devices=2):
         assert rc == 0, out
         assert "TORCH-LS2-OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_subset_alltoall_with_nonmember_process():
+    for rc, out in _run_pair("subset_a2a"):
+        assert rc == 0, out
+        assert "SUBSET-A2A-OK" in out
 
 
 @pytest.mark.slow
