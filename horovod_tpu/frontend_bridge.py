@@ -231,5 +231,7 @@ def alltoall_splits_job(arr, splits_row, process_set):
     if local_member is None:
         return (np.zeros((0,) + arr.shape[1:], arr.dtype),
                 np.zeros(k, np.int64))
-    return (np.asarray(outs[local_member]),
+    # np.array: a WRITABLE copy (torch.from_numpy on a jax-buffer alias
+    # is undefined behavior).
+    return (np.array(outs[local_member]),
             sp[:, members.index(local_member)].copy())

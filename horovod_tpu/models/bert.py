@@ -34,6 +34,13 @@ class BertConfig:
     attention: str = "dense"
     # Optional (block_q, block_k) flash tiling override (autotuned).
     flash_blocks: Optional[tuple] = None
+    # Sequence parallelism for long-context encoding (non-causal ring /
+    # ulysses over an "sp" mesh axis; same dispatch as GPT-2/Llama).
+    # Requires attention_mask=None — full-length packed sequences, the
+    # long-context pretraining regime.
+    use_ring_attention: bool = False
+    sp_impl: str = "ring"            # "ring" | "ulysses"
+    ring_layout: str = "contiguous"  # "contiguous" | "striped"
 
     @staticmethod
     def large() -> "BertConfig":
@@ -58,11 +65,18 @@ class EncoderLayer(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        from horovod_tpu.ops.attention import multihead_attention
-        att = multihead_attention(q, k, v, impl=cfg.attention, causal=False,
-                                  key_mask=mask, out_dtype=cfg.dtype,
-                                  flash_blocks=cfg.flash_blocks
-                                  ).reshape(B, T, D)
+        if cfg.use_ring_attention:
+            # Long-context sp: mask is validated to be trivial (None at
+            # the model entry), so the shared non-causal dispatch applies.
+            from horovod_tpu.ops.attention import sp_attention
+            att = sp_attention(q, k, v, cfg, causal=False).reshape(B, T, D)
+        else:
+            from horovod_tpu.ops.attention import multihead_attention
+            att = multihead_attention(q, k, v, impl=cfg.attention,
+                                      causal=False, key_mask=mask,
+                                      out_dtype=cfg.dtype,
+                                      flash_blocks=cfg.flash_blocks
+                                      ).reshape(B, T, D)
         att = nn.Dense(D, dtype=cfg.dtype, name="out")(att)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + att)
         h = nn.Dense(4 * D, dtype=cfg.dtype, name="fc")(x)
@@ -77,10 +91,18 @@ class Bert(nn.Module):
     @nn.compact
     def __call__(self, tokens, token_types=None, attention_mask=None):
         cfg = self.cfg
+        from horovod_tpu.ops.attention import (sp_global_positions,
+                                               validate_sp_config)
+        validate_sp_config(cfg)
+        if cfg.use_ring_attention and attention_mask is not None:
+            raise ValueError(
+                "sequence-parallel BERT supports full-length packed "
+                "sequences only (attention_mask=None); a key-padding "
+                "mask would need per-shard key masking in the ring")
         B, T = tokens.shape
         if token_types is None:
             token_types = jnp.zeros_like(tokens)
-        if attention_mask is None:
+        if attention_mask is None and not cfg.use_ring_attention:
             attention_mask = jnp.ones((B, T), bool)
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -88,7 +110,10 @@ class Bert(nn.Module):
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
         wtt = self.param("wtt", nn.initializers.normal(0.02),
                          (cfg.type_vocab_size, cfg.d_model), jnp.float32)
-        x = (wte[tokens] + wpe[:T][None] + wtt[token_types]).astype(cfg.dtype)
+        # Under sp, wpe follows this shard's *global* positions.
+        pos = sp_global_positions(T, cfg)
+        x = (wte[tokens] + wpe[pos][None] + wtt[token_types]).astype(
+            cfg.dtype)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
         layer = EncoderLayer
         if cfg.remat:
@@ -105,11 +130,18 @@ class Bert(nn.Module):
                     "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
             x = layer(cfg, name=f"layer{i}")(x, attention_mask)
-        # MLM head: tied embeddings, fp32 logits.
+        # MLM head: tied embeddings, fp32 logits (per-shard rows under sp).
         mlm = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
-        # NSP head on [CLS].
+        # NSP head on [CLS]. Under sp, global position 0 lives on shard 0
+        # in BOTH layouts (contiguous: rank-major; striped: pos = r + n*i);
+        # replicate it to every shard so the head computes identically.
+        cls = x[:, 0]
+        if cfg.use_ring_attention:
+            r = jax.lax.axis_index("sp")
+            cls = jax.lax.psum(
+                jnp.where(r == 0, cls, jnp.zeros_like(cls)), "sp")
         pooled = nn.tanh(nn.Dense(cfg.d_model, dtype=jnp.float32,
-                                  name="pooler")(x[:, 0].astype(jnp.float32)))
+                                  name="pooler")(cls.astype(jnp.float32)))
         nsp = nn.Dense(2, dtype=jnp.float32, name="nsp")(pooled)
         return mlm, nsp
 

@@ -129,8 +129,9 @@ def sp_global_positions(T: int, cfg, axis_name: str = "sp") -> jnp.ndarray:
 
 
 def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
-                 axis_name: str = "sp") -> jnp.ndarray:
-    """One dispatch for the zoo's causal self-attention paths.
+                 axis_name: str = "sp", causal: bool = True) -> jnp.ndarray:
+    """One dispatch for the zoo's self-attention paths (causal decoders
+    and, with ``causal=False``, bidirectional encoders).
 
     ``cfg`` carries the selection (``use_ring_attention / sp_impl /
     attention / ring_layout / flash_blocks / dtype``):
@@ -151,19 +152,20 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                 blocks = {"block_q": int(cfg.flash_blocks[0]),
                           "block_k": int(cfg.flash_blocks[1])}
             return ulysses_attention(q, k, v, axis_name=axis_name,
-                                     causal=True, impl=cfg.attention,
+                                     causal=causal, impl=cfg.attention,
                                      **blocks)
         if cfg.attention == "flash":
             from horovod_tpu.ops.ring_flash import ring_flash_attention
             return ring_flash_attention(q, k, v, axis_name=axis_name,
-                                        causal=True, layout=cfg.ring_layout)
+                                        causal=causal,
+                                        layout=cfg.ring_layout)
         if cfg.attention == "dense":
             from horovod_tpu.ops.ring_attention import ring_attention
-            return ring_attention(q, k, v, axis_name=axis_name, causal=True,
-                                  layout=cfg.ring_layout)
+            return ring_attention(q, k, v, axis_name=axis_name,
+                                  causal=causal, layout=cfg.ring_layout)
         raise ValueError(
             f"unknown attention impl {cfg.attention!r} for the ring "
             "path; expected 'dense' or 'flash'")
-    return multihead_attention(q, k, v, impl=cfg.attention, causal=True,
+    return multihead_attention(q, k, v, impl=cfg.attention, causal=causal,
                                out_dtype=cfg.dtype,
                                flash_blocks=cfg.flash_blocks)

@@ -178,6 +178,69 @@ class TestBert:
         cfg = BertConfig.large()
         assert (cfg.num_layers, cfg.num_heads, cfg.d_model) == (24, 16, 1024)
 
+    @pytest.mark.parametrize("sp", [
+        ("ring", "dense", "contiguous"), ("ring", "flash", "contiguous"),
+        ("ring", "dense", "striped"), ("ring", "flash", "striped"),
+        ("ulysses", "dense", "contiguous"),
+        ("ulysses", "flash", "contiguous")])
+    def test_sequence_parallel_matches_single_device(self, sp):
+        """Long-context encoder sp (non-causal ring / ulysses, both
+        layouts) == the single-device full-sequence model — wpe global
+        positions and the shard-0 [CLS] pooling are the failure modes a
+        pairwise check would miss."""
+        import dataclasses
+
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.models.bert import Bert, BertConfig
+        sp_impl, attention, layout = sp
+        T, n = 32, 8
+        toks = jnp.asarray(
+            np.random.default_rng(3).integers(
+                0, BertConfig.tiny().vocab_size, (2, T)), jnp.int32)
+        base = dataclasses.replace(BertConfig.tiny(), dtype=jnp.float32)
+        params = Bert(base).init(jax.random.PRNGKey(0), toks[:, :8])
+        mlm_want, nsp_want = Bert(base).apply(params, toks)
+        cfg = dataclasses.replace(base, use_ring_attention=True,
+                                  sp_impl=sp_impl, attention=attention,
+                                  ring_layout=layout)
+        model = Bert(cfg)
+        # Striped: shard r holds global positions r, r+n, r+2n, ... —
+        # the contiguous split of the fed array must already BE in that
+        # order, and the concatenated output maps back the same way.
+        tl = T // n
+        c2g = np.array([(c // tl) + n * (c % tl) for c in range(T)])
+        feed = toks[:, c2g] if layout == "striped" else toks
+        hvd.init(axis_name="sp")
+        try:
+            fwd = hvd.spmd(lambda p, t: model.apply(p, t),
+                           in_specs=(P(), P(None, "sp")),
+                           out_specs=(P(None, "sp"), P()))
+            mlm_got, nsp_got = fwd(params, feed)
+        finally:
+            hvd.init()
+        mlm_got = np.asarray(mlm_got)
+        if layout == "striped":
+            unperm = np.empty((2, T, mlm_got.shape[-1]), mlm_got.dtype)
+            unperm[:, c2g] = mlm_got
+            mlm_got = unperm
+        np.testing.assert_allclose(mlm_got, np.asarray(mlm_want),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(nsp_got),
+                                   np.asarray(nsp_want),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_sequence_parallel_rejects_padding_mask(self):
+        import dataclasses
+
+        from horovod_tpu.models.bert import Bert, BertConfig
+        cfg = dataclasses.replace(BertConfig.tiny(),
+                                  use_ring_attention=True)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="packed"):
+            Bert(cfg).init(jax.random.PRNGKey(0), toks,
+                           attention_mask=jnp.ones((1, 8), bool))
+
     def test_remat_policy_grads_match(self):
         import dataclasses
         from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss

@@ -132,6 +132,17 @@ _WORKER = textwrap.dedent("""
         sexpr = torch.tensor([1, 2]) if pid == 0 else torch.tensor([2, 1])
         assert torch.allclose(sout, sexpo), (pid, sout)
         assert torch.equal(srsp.long(), sexpr), (pid, srsp)
+        # Members [1, 2]: process 0's member rank is its SECOND local
+        # device — the result row comes back via from_stacked(row=1),
+        # not the process's first rank.
+        ps2 = add_process_set([1, 2])
+        nsp = torch.tensor([1, 1])
+        nt = torch.arange(2.0) + 10 * pid
+        nout, nrsp = hvt.alltoall(nt, splits=nsp, process_set=ps2)
+        nexpo = torch.tensor([0., 10.]) if pid == 0 \
+            else torch.tensor([1., 11.])
+        assert torch.allclose(nout, nexpo), (pid, nout)
+        assert torch.equal(nrsp.long(), torch.tensor([1, 1])), (pid, nrsp)
         print(f"proc {{pid}} TORCH-LS2-OK", flush=True)
     elif mode == "subset_a2a":
         # Subset with a WHOLLY non-member process: the non-member still
