@@ -338,7 +338,36 @@ def bench_allreduce(on_tpu):
     return rec
 
 
+def bench_gpt2_long(on_tpu):
+    """Long-context single-chip config: GPT-2 medium at 4096 tokens
+    (flash + selective remat — dense attention at this length would
+    materialise a 16M-score tensor per head). The long-sequence regime is
+    the reference fork's north star; this is its single-chip anchor
+    (multi-chip sp scales it further via ring/ulysses)."""
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+    if on_tpu:
+        import dataclasses
+        cfg = dataclasses.replace(
+            GPT2Config.medium(), max_seq_len=4096, attention="flash",
+            remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "dots"))
+        B, T, steps = 2, 4096, 10
+    else:
+        cfg = GPT2Config.tiny()
+        B, T, steps = 1, 64, 3
+    model = GPT2(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return _bench_lm(
+        params, tokens,
+        lambda p: loss_fn(model.apply({"params": p}, tokens), tokens),
+        steps, "gpt2_medium_4k_tokens_per_sec_per_chip")
+
+
 _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
+            "gpt2_long": bench_gpt2_long,
             "bert": bench_bert, "vit": bench_vit, "mnist": bench_mnist,
             "allreduce": bench_allreduce}
 
@@ -362,6 +391,7 @@ def _inner_main(args):
 _HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
                     "all": "resnet50_images_per_sec_per_chip",
                     "gpt2": "gpt2_medium_tokens_per_sec_per_chip",
+                    "gpt2_long": "gpt2_medium_4k_tokens_per_sec_per_chip",
                     "bert": "bert_large_tokens_per_sec_per_chip",
                     "vit": "vit_b16_images_per_sec_per_chip",
                     "mnist": "mnist_images_per_sec_per_chip",
