@@ -382,7 +382,7 @@ def _inner_main(args):
     if args.model == "all":
         # headline (resnet50) last so single-line parsers read it.
         for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
-                     "resnet50"):
+                     "gpt2_long", "resnet50"):
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)

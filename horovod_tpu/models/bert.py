@@ -36,8 +36,9 @@ class BertConfig:
     flash_blocks: Optional[tuple] = None
     # Sequence parallelism for long-context encoding (non-causal ring /
     # ulysses over an "sp" mesh axis; same dispatch as GPT-2/Llama).
-    # Requires attention_mask=None — full-length packed sequences, the
-    # long-context pretraining regime.
+    # Key-padding masks ride the dense ring (rotating with k/v) and
+    # ulysses (allgathered bool) paths; the flash ring requires
+    # attention_mask=None (full-length packed sequences).
     use_ring_attention: bool = False
     sp_impl: str = "ring"            # "ring" | "ulysses"
     ring_layout: str = "contiguous"  # "contiguous" | "striped"
@@ -66,10 +67,12 @@ class EncoderLayer(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
-            # Long-context sp: mask is validated to be trivial (None at
-            # the model entry), so the shared non-causal dispatch applies.
+            # Long-context sp through the shared non-causal dispatch; the
+            # shard's key-padding mask (if any) rides the ring/ulysses
+            # paths (flash ring rejects masks at the model entry).
             from horovod_tpu.ops.attention import sp_attention
-            att = sp_attention(q, k, v, cfg, causal=False).reshape(B, T, D)
+            att = sp_attention(q, k, v, cfg, causal=False,
+                               key_mask=mask).reshape(B, T, D)
         else:
             from horovod_tpu.ops.attention import multihead_attention
             att = multihead_attention(q, k, v, impl=cfg.attention,
@@ -94,11 +97,14 @@ class Bert(nn.Module):
         from horovod_tpu.ops.attention import (sp_global_positions,
                                                validate_sp_config)
         validate_sp_config(cfg)
-        if cfg.use_ring_attention and attention_mask is not None:
+        if (cfg.use_ring_attention and attention_mask is not None
+                and cfg.sp_impl == "ring" and cfg.attention == "flash"):
             raise ValueError(
-                "sequence-parallel BERT supports full-length packed "
-                "sequences only (attention_mask=None); a key-padding "
-                "mask would need per-shard key masking in the ring")
+                "the flash ring path supports full-length packed "
+                "sequences only (attention_mask=None); use "
+                "attention='dense' or sp_impl='ulysses' for padded "
+                "sp batches. Under sp the mask is this shard's "
+                "(batch, t_local) slice, sharded like the tokens.")
         B, T = tokens.shape
         if token_types is None:
             token_types = jnp.zeros_like(tokens)

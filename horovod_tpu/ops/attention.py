@@ -129,7 +129,8 @@ def sp_global_positions(T: int, cfg, axis_name: str = "sp") -> jnp.ndarray:
 
 
 def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
-                 axis_name: str = "sp", causal: bool = True) -> jnp.ndarray:
+                 axis_name: str = "sp", causal: bool = True,
+                 key_mask=None) -> jnp.ndarray:
     """One dispatch for the zoo's self-attention paths (causal decoders
     and, with ``causal=False``, bidirectional encoders).
 
@@ -141,8 +142,13 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                           flash backward-ring, contiguous/striped layouts)
     * sp_impl="ulysses"-> all-to-all heads<->sequence, then local attention
 
-    Used by GPT-2 and Llama so the dispatch cannot diverge between model
-    families (the configs validate via :func:`validate_sp_config`).
+    ``key_mask`` is this shard's (B, t_local) bool key-padding mask;
+    supported on every path except the flash ring (whose custom-VJP ring
+    would have to rotate a bias block — use dense ring or ulysses for
+    padded sp batches).
+
+    Used by GPT-2, Llama and BERT so the dispatch cannot diverge between
+    model families (the configs validate via :func:`validate_sp_config`).
     """
     if cfg.use_ring_attention:
         if cfg.sp_impl == "ulysses":
@@ -153,8 +159,13 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                           "block_k": int(cfg.flash_blocks[1])}
             return ulysses_attention(q, k, v, axis_name=axis_name,
                                      causal=causal, impl=cfg.attention,
-                                     **blocks)
+                                     key_mask=key_mask, **blocks)
         if cfg.attention == "flash":
+            if key_mask is not None:
+                raise NotImplementedError(
+                    "key-padding masks are not supported on the flash "
+                    "ring path; use attention='dense' (ring) or "
+                    "sp_impl='ulysses' for padded sp batches")
             from horovod_tpu.ops.ring_flash import ring_flash_attention
             return ring_flash_attention(q, k, v, axis_name=axis_name,
                                         causal=causal,
@@ -162,10 +173,11 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
         if cfg.attention == "dense":
             from horovod_tpu.ops.ring_attention import ring_attention
             return ring_attention(q, k, v, axis_name=axis_name,
-                                  causal=causal, layout=cfg.ring_layout)
+                                  causal=causal, layout=cfg.ring_layout,
+                                  key_mask=key_mask)
         raise ValueError(
             f"unknown attention impl {cfg.attention!r} for the ring "
             "path; expected 'dense' or 'flash'")
     return multihead_attention(q, k, v, impl=cfg.attention, causal=causal,
-                               out_dtype=cfg.dtype,
+                               key_mask=key_mask, out_dtype=cfg.dtype,
                                flash_blocks=cfg.flash_blocks)

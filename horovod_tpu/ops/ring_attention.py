@@ -30,7 +30,8 @@ _NEG_INF = -1e30
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = True,
                    scale: Optional[float] = None,
-                   layout: str = "contiguous") -> jnp.ndarray:
+                   layout: str = "contiguous",
+                   key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Exact attention with q/k/v sharded on sequence across ``axis_name``.
 
     Args:
@@ -39,6 +40,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       axis_name: mesh axis the sequence is sharded over (inside shard_map).
       causal: apply the global causal mask (correct across shards).
       scale: logit scale; defaults to head_dim**-0.5.
+      key_mask: optional (B, t_local) bool — this shard's key-padding mask
+        (False keys masked out). It rotates around the ring with its k/v
+        block. Fully-masked query rows return zeros.
       layout: how local row ``j`` maps to a global position —
 
         * ``"contiguous"`` (rank-major): device r holds
@@ -77,8 +81,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    km = key_mask
+    if km is not None and km.shape != (B, Tk):
+        raise ValueError(
+            f"key_mask must be (batch, t_local) = ({B}, {Tk}), got "
+            f"{km.shape}")
+
     def step(carry, i):
-        o, m, l, k, v = carry
+        o, m, l, k, v, km = carry
         src = (rank - i) % n              # whose k/v block we hold this step
         if layout == "striped":
             k_pos = src + n * jnp.arange(Tk)
@@ -88,6 +98,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
             logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        if km is not None:
+            logits = jnp.where(km[:, None, None, :], logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         # Guard: a fully-masked block keeps m at -inf; exp underflows to 0.
         p = jnp.exp(logits - m_new[..., None])
@@ -98,9 +110,19 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         m = m_new
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (o, m, l, k, v), None
+        if km is not None:
+            km = lax.ppermute(km, axis_name, perm)
+        return (o, m, l, k, v, km), None
 
-    (o, m, l, k, v), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    (o, m, l, k, v, km), _ = lax.scan(step, (o, m, l, k, v, km),
+                                      jnp.arange(n))
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
+    if key_mask is not None:
+        # A row that never saw a visible key keeps m at exactly _NEG_INF
+        # (the online softmax accumulates p=1 garbage there, but any real
+        # block wipes it via corr=0; only the never-visible case
+        # survives): return zeros, matching multihead_attention.
+        visible = (m > _NEG_INF / 2).transpose(0, 2, 1)[..., None]
+        out = jnp.where(visible, out, 0.0)
     return out.astype(q.dtype)

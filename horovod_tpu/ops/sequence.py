@@ -23,12 +23,17 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = True,
                       scale: Optional[float] = None,
                       impl: str = "dense", block_q: Optional[int] = None,
-                      block_k: Optional[int] = None) -> jnp.ndarray:
+                      block_k: Optional[int] = None,
+                      key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Attention with q/k/v sequence-sharded on ``axis_name``
     (shapes (B, t_local, H, D)). When the axis size does not divide the
     head count, heads are zero-padded up to the next multiple (the padded
     heads ride the all-to-alls and are sliced off the output — a small
     compute tax instead of a hard constraint).
+
+    ``key_mask`` is this shard's (B, t_local) bool key-padding mask
+    (False keys masked out); it is allgathered to the full sequence for
+    the local attention — a bool vector, so the extra wire is negligible.
 
     ``impl="flash"`` runs the local full-sequence attention through the
     fused pallas kernel — after the all-to-all this is ordinary single-
@@ -59,10 +64,18 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               tiled=True)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # (B, T, H'/n, D)
+    km_global = None
+    if key_mask is not None:
+        km_global = lax.all_gather(key_mask, axis_name, axis=1,
+                                   tiled=True)              # (B, T)
     if impl == "flash":
         from horovod_tpu.ops.flash_attention import flash_attention
+        key_bias = None
+        if km_global is not None:
+            key_bias = jnp.where(km_global, 0.0, -1e30).astype(jnp.float32)
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                              block_q=block_q, block_k=block_k)
+                              block_q=block_q, block_k=block_k,
+                              key_bias=key_bias)
         return head2seq(out)[:, :, :H]
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}; expected "
@@ -70,9 +83,16 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     T = qh.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
                         kh.astype(jnp.float32)) * scale
+    if km_global is not None:
+        logits = jnp.where(km_global[:, None, None, :], logits, -1e30)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    if km_global is not None:
+        # Rows with every key masked softmax to uniform garbage; zero
+        # them, matching multihead_attention's contract.
+        any_visible = jnp.any(km_global, axis=-1)[:, None, None, None]
+        probs = jnp.where(any_visible, probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh.astype(jnp.float32))
     return head2seq(out.astype(q.dtype))[:, :, :H]

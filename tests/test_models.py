@@ -230,12 +230,56 @@ class TestBert:
                                    np.asarray(nsp_want),
                                    rtol=2e-3, atol=2e-3)
 
-    def test_sequence_parallel_rejects_padding_mask(self):
+    @pytest.mark.parametrize("sp", [("ring", "dense"),
+                                    ("ulysses", "dense"),
+                                    ("ulysses", "flash")])
+    def test_sequence_parallel_with_padding_mask(self, sp):
+        """Padded batches under sp: the shard's key mask rides the dense
+        ring (rotating with k/v) or ulysses (allgathered); logits over
+        the visible positions == the single-device masked model."""
+        import dataclasses
+
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.models.bert import Bert, BertConfig
+        sp_impl, attention = sp
+        T = 32
+        rng = np.random.default_rng(5)
+        toks = jnp.asarray(rng.integers(
+            0, BertConfig.tiny().vocab_size, (2, T)), jnp.int32)
+        mask = jnp.asarray(np.arange(T)[None, :] <
+                           np.array([[20], [27]]))      # per-row padding
+        base = dataclasses.replace(BertConfig.tiny(), dtype=jnp.float32)
+        params = Bert(base).init(jax.random.PRNGKey(0), toks[:, :8])
+        mlm_want, nsp_want = Bert(base).apply(params, toks,
+                                              attention_mask=mask)
+        cfg = dataclasses.replace(base, use_ring_attention=True,
+                                  sp_impl=sp_impl, attention=attention)
+        model = Bert(cfg)
+        hvd.init(axis_name="sp")
+        try:
+            fwd = hvd.spmd(
+                lambda p, t, m: model.apply(p, t, attention_mask=m),
+                in_specs=(P(), P(None, "sp"), P(None, "sp")),
+                out_specs=(P(None, "sp"), P()))
+            mlm_got, nsp_got = fwd(params, toks, mask)
+        finally:
+            hvd.init()
+        vis = np.asarray(mask)[:, :, None]
+        np.testing.assert_allclose(np.asarray(mlm_got) * vis,
+                                   np.asarray(mlm_want) * vis,
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(nsp_got),
+                                   np.asarray(nsp_want),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_flash_ring_rejects_padding_mask(self):
         import dataclasses
 
         from horovod_tpu.models.bert import Bert, BertConfig
         cfg = dataclasses.replace(BertConfig.tiny(),
-                                  use_ring_attention=True)
+                                  use_ring_attention=True,
+                                  attention="flash")
         toks = jnp.zeros((1, 8), jnp.int32)
         with pytest.raises(ValueError, match="packed"):
             Bert(cfg).init(jax.random.PRNGKey(0), toks,

@@ -36,14 +36,18 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 from selfbench import append_records, git_rev, probe, run_bench  # noqa: E402
 
+# Second-wave agenda (the first wave's gpt2 / gpt2+dots / bn_stats=bf16 /
+# stem=s2d records are already in BENCH_SELF.jsonl at git a973b65): the
+# remaining BN combo, HEAD-revision re-captures (the bench default is now
+# remat=dots + tuned tiles), the new 4k long-context config, and the zoo.
 AGENDA = [
-    ("gpt2", {}, None),
-    ("gpt2", {"HOROVOD_BENCH_REMAT": "dots"}, "remat=dots"),
-    ("resnet50", {"HOROVOD_BENCH_BN_STATS": "bf16"}, "bn_stats=bf16"),
-    ("resnet50", {"HOROVOD_BENCH_STEM": "s2d"}, "stem=s2d"),
     ("resnet50", {"HOROVOD_BENCH_BN_STATS": "bf16",
                   "HOROVOD_BENCH_STEM": "s2d"}, "bn=bf16+stem=s2d"),
+    ("gpt2", {}, None),
+    ("gpt2_long", {}, None),
+    ("resnet50", {}, None),
     ("bert", {}, None),
+    ("bert", {"HOROVOD_BENCH_REMAT": "dots"}, "remat=dots"),
     ("vit", {}, None),
     ("mnist", {}, None),
 ]
