@@ -36,9 +36,9 @@ class BertConfig:
     flash_blocks: Optional[tuple] = None
     # Sequence parallelism for long-context encoding (non-causal ring /
     # ulysses over an "sp" mesh axis; same dispatch as GPT-2/Llama).
-    # Key-padding masks ride the dense ring (rotating with k/v) and
-    # ulysses (allgathered bool) paths; the flash ring requires
-    # attention_mask=None (full-length packed sequences).
+    # Key-padding masks ride every path: the rings rotate the shard's
+    # mask with its k/v block, ulysses allgathers the bool. Under sp the
+    # mask is this shard's (batch, t_local) slice, sharded like tokens.
     use_ring_attention: bool = False
     sp_impl: str = "ring"            # "ring" | "ulysses"
     ring_layout: str = "contiguous"  # "contiguous" | "striped"
@@ -68,8 +68,8 @@ class EncoderLayer(nn.Module):
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
             # Long-context sp through the shared non-causal dispatch; the
-            # shard's key-padding mask (if any) rides the ring/ulysses
-            # paths (flash ring rejects masks at the model entry).
+            # shard's key-padding mask (if any) rides every path (the
+            # rings rotate it with k/v, ulysses allgathers it).
             from horovod_tpu.ops.attention import sp_attention
             att = sp_attention(q, k, v, cfg, causal=False,
                                key_mask=mask).reshape(B, T, D)
@@ -97,14 +97,6 @@ class Bert(nn.Module):
         from horovod_tpu.ops.attention import (sp_global_positions,
                                                validate_sp_config)
         validate_sp_config(cfg)
-        if (cfg.use_ring_attention and attention_mask is not None
-                and cfg.sp_impl == "ring" and cfg.attention == "flash"):
-            raise ValueError(
-                "the flash ring path supports full-length packed "
-                "sequences only (attention_mask=None); use "
-                "attention='dense' or sp_impl='ulysses' for padded "
-                "sp batches. Under sp the mask is this shard's "
-                "(batch, t_local) slice, sharded like the tokens.")
         B, T = tokens.shape
         if token_types is None:
             token_types = jnp.zeros_like(tokens)

@@ -142,10 +142,9 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                           flash backward-ring, contiguous/striped layouts)
     * sp_impl="ulysses"-> all-to-all heads<->sequence, then local attention
 
-    ``key_mask`` is this shard's (B, t_local) bool key-padding mask;
-    supported on every path except the flash ring (whose custom-VJP ring
-    would have to rotate a bias block — use dense ring or ulysses for
-    padded sp batches).
+    ``key_mask`` is this shard's (B, t_local) bool key-padding mask,
+    supported on every path (the rings rotate it with its K/V block;
+    ulysses allgathers the bool).
 
     Used by GPT-2, Llama and BERT so the dispatch cannot diverge between
     model families (the configs validate via :func:`validate_sp_config`).
@@ -161,15 +160,11 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                                      causal=causal, impl=cfg.attention,
                                      key_mask=key_mask, **blocks)
         if cfg.attention == "flash":
-            if key_mask is not None:
-                raise NotImplementedError(
-                    "key-padding masks are not supported on the flash "
-                    "ring path; use attention='dense' (ring) or "
-                    "sp_impl='ulysses' for padded sp batches")
             from horovod_tpu.ops.ring_flash import ring_flash_attention
             return ring_flash_attention(q, k, v, axis_name=axis_name,
                                         causal=causal,
-                                        layout=cfg.ring_layout)
+                                        layout=cfg.ring_layout,
+                                        key_mask=key_mask)
         if cfg.attention == "dense":
             from horovod_tpu.ops.ring_attention import ring_attention
             return ring_attention(q, k, v, axis_name=axis_name,

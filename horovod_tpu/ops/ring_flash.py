@@ -47,9 +47,12 @@ def _unpack(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _block_fwd(q, k, v, causal, scale, bq, bk, offset=0):
-    """One flash forward on packed arrays → (o f32 (bh,t,d), lse (bh,t))."""
-    o, lse = _fa_fwd(q, k, v, None, 1, scale, causal, bq, bk, offset=offset)
+def _block_fwd(q, k, v, bias, h, causal, scale, bq, bk, offset=0):
+    """One flash forward on packed arrays → (o f32 (bh,t,d), lse (bh,t)).
+    ``bias`` is the resident K block's (b, tk, 1) additive logit bias
+    (key-padding) — the kernel broadcasts it over the h heads folded into
+    the packed batch rows — or None."""
+    o, lse = _fa_fwd(q, k, v, bias, h, scale, causal, bq, bk, offset=offset)
     return o.astype(jnp.float32), lse[..., 0]
 
 
@@ -64,9 +67,11 @@ def _safe_merge(o_acc, lse_acc, o_b, lse_b):
     return o_new, lse_new
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring(q, k, v, axis_name, causal, scale, bq, bk, striped):
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk, striped)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _ring(q, k, v, bias, axis_name, causal, scale, bq, bk, striped, h,
+          want_dbias):
+    o, _ = _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq, bk,
+                          striped, h)
     return o
 
 
@@ -83,50 +88,58 @@ def _mode_of(striped, causal, src, rank):
     return jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk, striped):
+def _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq, bk,
+                   striped, h=1):
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     bh, tq, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def full_b(q, k, v):
-        return _block_fwd(q, k, v, False, scale, bq, bk)
+    def full_b(q, k, v, bias):
+        return _block_fwd(q, k, v, bias, h, False, scale, bq, bk)
 
-    def causal_b(q, k, v):
-        return _block_fwd(q, k, v, True, scale, bq, bk)
+    def causal_b(q, k, v, bias):
+        return _block_fwd(q, k, v, bias, h, True, scale, bq, bk)
 
-    def skip_b(q, k, v):
+    def skip_b(q, k, v, bias):
         return (jnp.zeros((bh, tq, d), jnp.float32),
                 jnp.full((bh, tq), _NEG_INF, jnp.float32))
 
-    def strict_b(q, k, v):
-        return _block_fwd(q, k, v, True, scale, bq, bk, offset=-1)
+    def strict_b(q, k, v, bias):
+        return _block_fwd(q, k, v, bias, h, True, scale, bq, bk,
+                          offset=-1)
 
     def step(carry, i):
-        o_acc, lse_acc, k, v = carry
+        o_acc, lse_acc, k, v, bias = carry
         src = (rank - i) % n
         mode = _mode_of(striped, causal, src, rank)
         o_b, lse_b = lax.switch(mode, [full_b, causal_b, skip_b, strict_b],
-                                q, k, v)
+                                q, k, v, bias)
         o_acc, lse_acc = _safe_merge(o_acc, lse_acc, o_b, lse_b)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (o_acc, lse_acc, k, v), None
+        if bias is not None:
+            # the key-padding bias travels with its K block
+            bias = lax.ppermute(bias, axis_name, perm)
+        return (o_acc, lse_acc, k, v, bias), None
 
     o0 = jnp.zeros((bh, tq, d), jnp.float32)
     lse0 = jnp.full((bh, tq), _NEG_INF, jnp.float32)
-    (o, lse, k, v), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    (o, lse, k, v, bias), _ = lax.scan(step, (o0, lse0, k, v, bias),
+                                       jnp.arange(n))
     return o.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, axis_name, causal, scale, bq, bk, striped):
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk,
-                            striped)
-    return o, (q, k, v, o, lse)
+def _ring_fwd(q, k, v, bias, axis_name, causal, scale, bq, bk, striped,
+              h, want_dbias):
+    o, lse = _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq,
+                            bk, striped, h)
+    return o, (q, k, v, bias, o, lse)
 
 
-def _ring_bwd(axis_name, causal, scale, bq, bk, striped, res, do):
-    q, k, v, o, lse = res
+def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
+              res, do):
+    q, k, v, bias, o, lse = res
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -137,53 +150,73 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, res, do):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    def grads_block(q, k, v, causal_mode, offset=0):
+    track_db = bias is not None and want_dbias
+
+    def grads_block(q, k, v, bias, causal_mode, offset=0):
         # Reuse the flash backward kernels with the *global* lse and the
         # precomputed global delta: p then equals the globally-normalised
         # attention prob of this block.
-        dq, dk, dv, _ = _fa_bwd(
-            1, scale, causal_mode, bq, bk, (q, k, v, None, o, lse_in), do,
+        dq, dk, dv, db = _fa_bwd(
+            h, scale, causal_mode, bq, bk, (q, k, v, bias, o, lse_in), do,
             delta=delta, offset=offset)
-        return dq.astype(jnp.float32), dk.astype(jnp.float32), \
-            dv.astype(jnp.float32)
+        if not track_db:
+            db = None
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32),
+                None if db is None else db.astype(jnp.float32))
 
-    def full_b(q, k, v):
-        return grads_block(q, k, v, False)
+    def full_b(q, k, v, bias):
+        return grads_block(q, k, v, bias, False)
 
-    def causal_b(q, k, v):
-        return grads_block(q, k, v, True)
+    def causal_b(q, k, v, bias):
+        return grads_block(q, k, v, bias, True)
 
-    def skip_b(q, k, v):
+    def skip_b(q, k, v, bias):
         return (jnp.zeros(q.shape, jnp.float32),
                 jnp.zeros(k.shape, jnp.float32),
-                jnp.zeros(v.shape, jnp.float32))
+                jnp.zeros(v.shape, jnp.float32),
+                None if not track_db else jnp.zeros(bias.shape,
+                                                    jnp.float32))
 
-    def strict_b(q, k, v):
-        return grads_block(q, k, v, True, offset=-1)
+    def strict_b(q, k, v, bias):
+        return grads_block(q, k, v, bias, True, offset=-1)
 
     def step(carry, i):
-        dq_acc, k, v, dk_acc, dv_acc = carry
+        dq_acc, k, v, bias, dk_acc, dv_acc, db_acc = carry
         src = (rank - i) % n
         mode = _mode_of(striped, causal, src, rank)
-        dq_b, dk_b, dv_b = lax.switch(mode,
-                                      [full_b, causal_b, skip_b, strict_b],
-                                      q, k, v)
+        dq_b, dk_b, dv_b, db_b = lax.switch(
+            mode, [full_b, causal_b, skip_b, strict_b], q, k, v, bias)
         dq_acc = dq_acc + dq_b
         dk_acc = dk_acc + dk_b
         dv_acc = dv_acc + dv_b
-        # dK/dV partial sums travel with their K/V block; after n hops the
-        # block (and its completed gradient) is home again.
+        # dK/dV (and dBias) partial sums travel with their K/V block;
+        # after n hops the block (and its completed gradient) is home
+        # again.
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
-        return (dq_acc, k, v, dk_acc, dv_acc), None
+        if bias is not None:
+            bias = lax.ppermute(bias, axis_name, perm)
+        if track_db:
+            # the bias cotangent ships home with its block, like dK/dV
+            db_acc = db_acc + db_b
+            db_acc = lax.ppermute(db_acc, axis_name, perm)
+        return (dq_acc, k, v, bias, dk_acc, dv_acc, db_acc), None
 
     z = jnp.zeros(q.shape, jnp.float32)
     zk = jnp.zeros(k.shape, jnp.float32)
-    (dq, k, v, dk, dv), _ = lax.scan(
-        step, (z, k, v, zk, jnp.zeros_like(zk)), jnp.arange(n))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    db0 = None if not track_db else jnp.zeros(bias.shape, jnp.float32)
+    (dq, k, v, bias, dk, dv, db), _ = lax.scan(
+        step, (z, k, v, bias, zk, jnp.zeros_like(zk), db0), jnp.arange(n))
+    # A mask-derived bias (want_dbias=False) gets a zero cotangent — it
+    # dies into jnp.where constants anyway; skipping the accumulate +
+    # per-hop ppermute keeps the hot masked-sp path free of dead traffic.
+    if bias is not None and db is None:
+        db = jnp.zeros(bias.shape, jnp.float32)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            db)
 
 
 _ring.defvjp(_ring_fwd, _ring_bwd)
@@ -194,7 +227,9 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          scale: Optional[float] = None,
                          block_q: Optional[int] = None,
                          block_k: Optional[int] = None,
-                         layout: str = "contiguous") -> jnp.ndarray:
+                         layout: str = "contiguous",
+                         key_mask: Optional[jnp.ndarray] = None
+                         ) -> jnp.ndarray:
     """Exact attention with q/k/v sequence-sharded across ``axis_name``.
 
     Same contract as ``ring_attention`` (including the ``layout`` arg),
@@ -220,6 +255,11 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         kind="ring": the per-hop sequence is the local shard and the
         backward is a second explicit ring, so the VMEM profile differs
         from single-device flash).
+      key_mask: optional (batch, t_local) bool — this shard's key-padding
+        mask (False keys masked out). It becomes the kernel's additive
+        key bias and travels around the ring with its K/V block (the
+        backward ships the bias cotangent home the same way, so a
+        future differentiable bias rides for free).
 
     Returns (batch, t_local, heads, head_dim), dtype of ``q``.
     """
@@ -233,7 +273,18 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if layout not in ("contiguous", "striped"):
         raise ValueError(f"unknown layout {layout!r}; expected "
                          "'contiguous' or 'striped'")
-    o = _ring(_pack(q), _pack(k), _pack(v), axis_name, bool(causal),
+    bias = None
+    if key_mask is not None:
+        if key_mask.shape != (b, t):
+            raise ValueError(
+                f"key_mask must be (batch, t_local) = ({b}, {t}), got "
+                f"{key_mask.shape}")
+        # (b, tk, 1): the kernel's bias spec broadcasts over the h heads
+        # folded into the packed batch rows, so the ring only ever ships
+        # the per-batch bias, not h copies.
+        bias = jnp.where(key_mask, 0.0, _NEG_INF
+                         ).astype(jnp.float32)[..., None]
+    o = _ring(_pack(q), _pack(k), _pack(v), bias, axis_name, bool(causal),
               float(scale), int(block_q), int(block_k),
-              layout == "striped")
+              layout == "striped", h, False)
     return _unpack(o, b, h)

@@ -231,6 +231,7 @@ class TestBert:
                                    rtol=2e-3, atol=2e-3)
 
     @pytest.mark.parametrize("sp", [("ring", "dense"),
+                                    ("ring", "flash"),
                                     ("ulysses", "dense"),
                                     ("ulysses", "flash")])
     def test_sequence_parallel_with_padding_mask(self, sp):
@@ -273,17 +274,66 @@ class TestBert:
                                    np.asarray(nsp_want),
                                    rtol=2e-3, atol=2e-3)
 
-    def test_flash_ring_rejects_padding_mask(self):
+    def test_masked_flash_ring_grads_match_single_device(self):
+        """Backward through the masked flash ring (the bias cotangent
+        ships around the ring with dK/dV) == single-device masked
+        grads."""
         import dataclasses
 
-        from horovod_tpu.models.bert import Bert, BertConfig
-        cfg = dataclasses.replace(BertConfig.tiny(),
-                                  use_ring_attention=True,
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.models.bert import Bert, BertConfig, mlm_loss
+        T = 32
+        rng = np.random.default_rng(11)
+        toks = jnp.asarray(rng.integers(
+            0, BertConfig.tiny().vocab_size, (2, T)), jnp.int32)
+        mask = jnp.asarray(np.arange(T)[None, :] <
+                           np.array([[22], [29]]))
+        mpos = (jnp.asarray(np.arange(T)[None, :] % 5 == 0) * mask
+                ).astype(jnp.float32)
+        base = dataclasses.replace(BertConfig.tiny(), dtype=jnp.float32)
+        params = Bert(base).init(jax.random.PRNGKey(0),
+                                 toks[:, :8])["params"]
+
+        def loss_single(p):
+            mlm, _ = Bert(base).apply({"params": p}, toks,
+                                      attention_mask=mask)
+            return mlm_loss(mlm, toks, mpos)
+
+        g_want = jax.grad(loss_single)(params)
+        cfg = dataclasses.replace(base, use_ring_attention=True,
                                   attention="flash")
-        toks = jnp.zeros((1, 8), jnp.int32)
-        with pytest.raises(ValueError, match="packed"):
-            Bert(cfg).init(jax.random.PRNGKey(0), toks,
-                           attention_mask=jnp.ones((1, 8), bool))
+        model = Bert(cfg)
+
+        def body(p, t, m, mp):
+            # Global denominator is a constant wrt params; differentiate
+            # only the LOCAL partial loss and psum the grads (grad
+            # THROUGH a psum would pick up a factor of n).
+            den = jnp.maximum(jax.lax.psum(mp.sum(), "sp"), 1)
+
+            def loss(pp):
+                mlm, _ = model.apply({"params": pp}, t,
+                                     attention_mask=m)
+                logp = jax.nn.log_softmax(mlm, axis=-1)
+                ll = jnp.take_along_axis(logp, t[..., None],
+                                         axis=-1)[..., 0]
+                return -(ll * mp).sum() / den
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x, "sp"), g)
+
+        hvd.init(axis_name="sp")
+        try:
+            fn = hvd.spmd(body, in_specs=(P(), P(None, "sp"),
+                                          P(None, "sp"), P(None, "sp")),
+                          out_specs=P())
+            g_got = fn(params, toks, mask, mpos)
+        finally:
+            hvd.init()
+        for a, b in zip(jax.tree_util.tree_leaves(g_got),
+                        jax.tree_util.tree_leaves(g_want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
 
     def test_remat_policy_grads_match(self):
         import dataclasses
