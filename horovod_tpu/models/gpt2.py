@@ -80,7 +80,7 @@ class Attention(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, segment_ids=None, deterministic=True):
         cfg = self.cfg
         B, T, D = x.shape
         H = cfg.num_heads
@@ -90,7 +90,7 @@ class Attention(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         from horovod_tpu.ops.attention import sp_attention
-        o = sp_attention(q, k, v, cfg)
+        o = sp_attention(q, k, v, cfg, segment_ids=segment_ids)
         o = o.reshape(B, T, D)
         return nn.Dense(D, dtype=cfg.dtype, name="out")(o)
 
@@ -117,10 +117,11 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, segment_ids=None, deterministic=True):
         cfg = self.cfg
         ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + Attention(cfg, name="attn")(ln1, deterministic)
+        x = x + Attention(cfg, name="attn")(ln1, segment_ids,
+                                            deterministic)
         ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MLP(cfg, name="mlp")(ln2, deterministic)
         return x
@@ -130,9 +131,16 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 segment_ids=None, positions=None):
+        """``segment_ids`` (B, T) int enables sequence packing: attention
+        is blocked across document boundaries and (by default) wpe rows
+        restart per document. ``positions`` overrides the position ids
+        (required for packed sp shards, where pos-in-segment needs the
+        global view the shard doesn't have)."""
         cfg = self.cfg
-        from horovod_tpu.ops.attention import (sp_global_positions,
+        from horovod_tpu.ops.attention import (packed_positions,
+                                               sp_global_positions,
                                                validate_sp_config)
         validate_sp_config(cfg)
         B, T = tokens.shape
@@ -140,25 +148,35 @@ class GPT2(nn.Module):
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        # Sequence-parallel: wpe is indexed with this shard's *global*
-        # positions.
-        pos = sp_global_positions(T, cfg)
+        if positions is not None:
+            pos = positions
+        elif segment_ids is not None:
+            if cfg.use_ring_attention:
+                raise ValueError(
+                    "packed sequences under sp need explicit positions= "
+                    "(per-shard pos-in-segment; the shard cannot see "
+                    "where its documents started)")
+            pos = packed_positions(segment_ids)          # (B, T)
+        else:
+            # Sequence-parallel: wpe is indexed with this shard's
+            # *global* positions.
+            pos = sp_global_positions(T, cfg)
         x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
         block = Block
         if cfg.remat:
             if cfg.remat_policy == "dots":
                 block = nn.remat(
-                    Block, static_argnums=(2,),
+                    Block, static_argnums=(3,),
                     policy=jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable)
             elif cfg.remat_policy == "full":
-                block = nn.remat(Block, static_argnums=(2,))
+                block = nn.remat(Block, static_argnums=(3,))
             else:
                 raise ValueError(
                     f"unknown remat_policy {cfg.remat_policy!r}: "
                     "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"h{i}")(x, deterministic)
+            x = block(cfg, name=f"h{i}")(x, segment_ids, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied lm head in fp32 (logits precision matters for loss).
         return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
@@ -188,13 +206,19 @@ def partition_rules() -> PartitionRules:
     ])
 
 
-def loss_fn(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Next-token cross entropy."""
+def loss_fn(logits: jnp.ndarray, tokens: jnp.ndarray,
+            segment_ids: jnp.ndarray = None) -> jnp.ndarray:
+    """Next-token cross entropy. With ``segment_ids`` (sequence packing),
+    targets that cross a document boundary (the last token of each packed
+    document predicting the next document's first) are excluded."""
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if segment_ids is None:
+        return -jnp.mean(ll)
+    w = (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(ll.dtype)
+    return -(ll * w).sum() / jnp.maximum(w.sum(), 1)
 
 
 def striped_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray,

@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["multihead_attention", "ATTENTION_IMPLS", "validate_sp_config",
-           "sp_global_positions", "sp_attention"]
+           "sp_global_positions", "sp_attention", "packed_positions",
+           "segment_mask", "reject_segment_flash"]
 
 ATTENTION_IMPLS = ("dense", "flash")
 
@@ -25,6 +26,7 @@ _NEG_INF = -1e30
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         *, impl: str, causal: bool,
                         key_mask: Optional[jnp.ndarray] = None,
+                        segment_ids: Optional[jnp.ndarray] = None,
                         out_dtype: Optional[jnp.dtype] = None,
                         flash_blocks: Optional[tuple] = None) -> jnp.ndarray:
     """softmax(q k^T / sqrt(d) [+ masks]) v over (B, T, H, D) tensors.
@@ -36,6 +38,10 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       causal: autoregressive mask.
       key_mask: optional (B, T_kv) bool; False keys are masked out
         (key-padding).
+      segment_ids: optional (B, T) int — sequence-packing segment ids;
+        attention is blocked across segment boundaries (q attends only
+        to keys with the SAME id). Dense impl only: the flash kernel's
+        bias input is per-key, not per-(q, k) pair.
       out_dtype: dtype of the returned tensor (defaults to q.dtype).
       flash_blocks: optional (block_q, block_k) tiling override for the
         flash kernel — feed ``autotune_flash_blocks``'s pick for this
@@ -51,6 +57,7 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     d = q.shape[-1]
 
     if impl == "flash":
+        reject_segment_flash(segment_ids)
         from horovod_tpu.ops.flash_attention import flash_attention
         key_bias = None
         if key_mask is not None:
@@ -67,6 +74,9 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if key_mask is not None:
         s = jnp.where(key_mask[:, None, None, :], s, _NEG_INF)
+    if segment_ids is not None:
+        s = jnp.where(segment_mask(segment_ids, segment_ids)[:, None],
+                      s, _NEG_INF)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((tq, tk), bool))
@@ -128,9 +138,42 @@ def sp_global_positions(T: int, cfg, axis_name: str = "sp") -> jnp.ndarray:
     return pos + jax.lax.axis_index(axis_name) * T
 
 
+def segment_mask(seg_q: jnp.ndarray, seg_k: jnp.ndarray) -> jnp.ndarray:
+    """(B, Tq, Tk) bool — True where q and k belong to the same packing
+    segment. THE definition of cross-document blocking; every dense path
+    (local, ring step, ulysses) masks through this one helper."""
+    return seg_q[:, :, None] == seg_k[:, None, :]
+
+
+def reject_segment_flash(segment_ids) -> None:
+    """Shared guard: the pallas flash kernel's bias input is per-key, not
+    per-(q, k) pair, so packing masks can't ride it."""
+    if segment_ids is not None:
+        raise NotImplementedError(
+            "segment_ids (sequence packing) needs a per-(q, k) mask; "
+            "the flash kernel's key_bias is per-key only — use the "
+            "dense attention impl for packed batches")
+
+
+def packed_positions(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """(B, T) positions that restart at 0 at every segment boundary.
+
+    Sequence packing gives each packed document its own positional
+    indices (wpe rows / RoPE angles); segments must be contiguous runs
+    (the packed layout). Feed the result to a model's ``positions``
+    input alongside ``segment_ids``.
+    """
+    T = segment_ids.shape[1]
+    ar = jnp.broadcast_to(jnp.arange(T)[None, :], segment_ids.shape)
+    prev = jnp.concatenate(
+        [segment_ids[:, :1] - 1, segment_ids[:, :-1]], axis=1)
+    starts = jax.lax.cummax(jnp.where(segment_ids != prev, ar, 0), axis=1)
+    return ar - starts
+
+
 def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                  axis_name: str = "sp", causal: bool = True,
-                 key_mask=None) -> jnp.ndarray:
+                 key_mask=None, segment_ids=None) -> jnp.ndarray:
     """One dispatch for the zoo's self-attention paths (causal decoders
     and, with ``causal=False``, bidirectional encoders).
 
@@ -144,7 +187,10 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
 
     ``key_mask`` is this shard's (B, t_local) bool key-padding mask,
     supported on every path (the rings rotate it with its K/V block;
-    ulysses allgathers the bool).
+    ulysses allgathers the bool). ``segment_ids`` (B, t_local) int blocks
+    attention across sequence-packing boundaries — dense paths only (the
+    flash kernel's bias input is per-key; packed flash batches should
+    simply not cross documents per shard, or use the dense ring).
 
     Used by GPT-2, Llama and BERT so the dispatch cannot diverge between
     model families (the configs validate via :func:`validate_sp_config`).
@@ -158,8 +204,10 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                           "block_k": int(cfg.flash_blocks[1])}
             return ulysses_attention(q, k, v, axis_name=axis_name,
                                      causal=causal, impl=cfg.attention,
-                                     key_mask=key_mask, **blocks)
+                                     key_mask=key_mask,
+                                     segment_ids=segment_ids, **blocks)
         if cfg.attention == "flash":
+            reject_segment_flash(segment_ids)
             from horovod_tpu.ops.ring_flash import ring_flash_attention
             return ring_flash_attention(q, k, v, axis_name=axis_name,
                                         causal=causal,
@@ -169,10 +217,12 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
             from horovod_tpu.ops.ring_attention import ring_attention
             return ring_attention(q, k, v, axis_name=axis_name,
                                   causal=causal, layout=cfg.ring_layout,
-                                  key_mask=key_mask)
+                                  key_mask=key_mask,
+                                  segment_ids=segment_ids)
         raise ValueError(
             f"unknown attention impl {cfg.attention!r} for the ring "
             "path; expected 'dense' or 'flash'")
     return multihead_attention(q, k, v, impl=cfg.attention, causal=causal,
-                               key_mask=key_mask, out_dtype=cfg.dtype,
+                               key_mask=key_mask, segment_ids=segment_ids,
+                               out_dtype=cfg.dtype,
                                flash_blocks=cfg.flash_blocks)

@@ -300,7 +300,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
             db_ref[0] = db_acc[:][:, None]
 
 
-def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
+def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
+         offset=0, want_db=True):
     q, k, v, bias, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -346,6 +347,7 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
         ]
         return sp
 
+    track_db = bias is not None and want_db
     if bias is None:
         dq_kernel = _drop_bias(dq_kernel)
         _dkv = dkv_kernel
@@ -358,6 +360,17 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
         extra = ()
     else:
         extra = (bias,)
+        if not track_db:
+            # Mask-derived bias whose cotangent the caller discards: keep
+            # the bias INPUT (scores must mask) but skip the db output,
+            # scratch, and per-q-block accumulation entirely.
+            _dkv_b = dkv_kernel
+
+            def dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+                return _dkv_b(q_ref, k_ref, v_ref, bias_ref, do_ref,
+                              lse_ref, delta_ref, dk_ref, dv_ref, None,
+                              dk_acc, dv_acc, None)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -381,7 +394,7 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
         pltpu.VMEM((bk, d), jnp.float32),
         pltpu.VMEM((bk, d), jnp.float32),
     ]
-    if bias is not None:
+    if track_db:
         # Per-(batch*head) bias gradient; heads are reduced below.
         out_specs.append(pl.BlockSpec((1, bk, 1),
                                       lambda b, j, i: (b, j, 0)))
@@ -398,12 +411,12 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None, offset=0):
         interpret=_use_interpret(),
     )(q, k, v, *extra, do, lse, delta)
 
-    if bias is None:
-        dk, dv = outs
-        dbias = None
-    else:
+    if track_db:
         dk, dv, db = outs
         dbias = db.reshape(bh // h, h, tk, 1).sum(axis=1)
+    else:
+        dk, dv = outs
+        dbias = None
     return dq, dk, dv, dbias
 
 

@@ -31,7 +31,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = True,
                    scale: Optional[float] = None,
                    layout: str = "contiguous",
-                   key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                   key_mask: Optional[jnp.ndarray] = None,
+                   segment_ids: Optional[jnp.ndarray] = None
+                   ) -> jnp.ndarray:
     """Exact attention with q/k/v sharded on sequence across ``axis_name``.
 
     Args:
@@ -43,6 +45,11 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       key_mask: optional (B, t_local) bool — this shard's key-padding mask
         (False keys masked out). It rotates around the ring with its k/v
         block. Fully-masked query rows return zeros.
+      segment_ids: optional (B, t_local) int — this shard's
+        sequence-packing segment ids; attention is blocked across
+        segment boundaries. The key-side ids rotate around the ring with
+        their k/v block and each step masks q-segment vs the resident
+        block's k-segments.
       layout: how local row ``j`` maps to a global position —
 
         * ``"contiguous"`` (rank-major): device r holds
@@ -86,9 +93,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(
             f"key_mask must be (batch, t_local) = ({B}, {Tk}), got "
             f"{km.shape}")
+    seg = segment_ids
+    if seg is not None and seg.shape != (B, Tk):
+        raise ValueError(
+            f"segment_ids must be (batch, t_local) = ({B}, {Tk}), got "
+            f"{seg.shape}")
+    seg_k0 = seg
 
     def step(carry, i):
-        o, m, l, k, v, km = carry
+        o, m, l, k, v, km, seg_k = carry
         src = (rank - i) % n              # whose k/v block we hold this step
         if layout == "striped":
             k_pos = src + n * jnp.arange(Tk)
@@ -100,6 +113,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             logits = jnp.where(mask[None, None], logits, _NEG_INF)
         if km is not None:
             logits = jnp.where(km[:, None, None, :], logits, _NEG_INF)
+        if seg_k is not None:
+            from horovod_tpu.ops.attention import segment_mask
+            logits = jnp.where(segment_mask(seg, seg_k)[:, None],
+                               logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         # Guard: a fully-masked block keeps m at -inf; exp underflows to 0.
         p = jnp.exp(logits - m_new[..., None])
@@ -112,10 +129,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         v = lax.ppermute(v, axis_name, perm)
         if km is not None:
             km = lax.ppermute(km, axis_name, perm)
-        return (o, m, l, k, v, km), None
+        if seg_k is not None:
+            seg_k = lax.ppermute(seg_k, axis_name, perm)
+        return (o, m, l, k, v, km, seg_k), None
 
-    (o, m, l, k, v, km), _ = lax.scan(step, (o, m, l, k, v, km),
-                                      jnp.arange(n))
+    (o, m, l, k, v, km, seg_k0), _ = lax.scan(
+        step, (o, m, l, k, v, km, seg_k0), jnp.arange(n))
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
     if key_mask is not None:

@@ -158,9 +158,7 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
         # attention prob of this block.
         dq, dk, dv, db = _fa_bwd(
             h, scale, causal_mode, bq, bk, (q, k, v, bias, o, lse_in), do,
-            delta=delta, offset=offset)
-        if not track_db:
-            db = None
+            delta=delta, offset=offset, want_db=track_db)
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),
                 dv.astype(jnp.float32),
                 None if db is None else db.astype(jnp.float32))

@@ -24,7 +24,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       scale: Optional[float] = None,
                       impl: str = "dense", block_q: Optional[int] = None,
                       block_k: Optional[int] = None,
-                      key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                      key_mask: Optional[jnp.ndarray] = None,
+                      segment_ids: Optional[jnp.ndarray] = None
+                      ) -> jnp.ndarray:
     """Attention with q/k/v sequence-sharded on ``axis_name``
     (shapes (B, t_local, H, D)). When the axis size does not divide the
     head count, heads are zero-padded up to the next multiple (the padded
@@ -34,6 +36,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``key_mask`` is this shard's (B, t_local) bool key-padding mask
     (False keys masked out); it is allgathered to the full sequence for
     the local attention — a bool vector, so the extra wire is negligible.
+    ``segment_ids`` (B, t_local) int blocks attention across
+    sequence-packing boundaries the same way (dense impl only: the flash
+    kernel's bias input is per-key, not per-(q, k) pair).
 
     ``impl="flash"`` runs the local full-sequence attention through the
     fused pallas kernel — after the all-to-all this is ordinary single-
@@ -68,6 +73,13 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if key_mask is not None:
         km_global = lax.all_gather(key_mask, axis_name, axis=1,
                                    tiled=True)              # (B, T)
+    seg_global = None
+    if segment_ids is not None:
+        from horovod_tpu.ops.attention import reject_segment_flash
+        if impl != "dense":
+            reject_segment_flash(segment_ids)
+        seg_global = lax.all_gather(segment_ids, axis_name, axis=1,
+                                    tiled=True)             # (B, T)
     if impl == "flash":
         from horovod_tpu.ops.flash_attention import flash_attention
         key_bias = None
@@ -85,6 +97,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         kh.astype(jnp.float32)) * scale
     if km_global is not None:
         logits = jnp.where(km_global[:, None, None, :], logits, -1e30)
+    if seg_global is not None:
+        from horovod_tpu.ops.attention import segment_mask
+        logits = jnp.where(segment_mask(seg_global, seg_global)[:, None],
+                           logits, -1e30)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
