@@ -74,18 +74,24 @@ class LlamaConfig:
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
                theta: float) -> jnp.ndarray:
-    """Rotary position embedding over (B, T, H, D) with (T,) positions.
+    """Rotary position embedding over (B, T, H, D) with (T,) or (B, T)
+    positions.
 
     Pair-rotation ("rotate half") form in fp32, cast back to x.dtype.
     Positions are explicit so sequence-parallel shards pass their GLOBAL
     token positions (contiguous offset or striped interleave) and rotation
     commutes with the ring: every shard rotates its own K before any hop.
+    (B, T) positions carry per-row packing offsets (pos-in-document).
     """
     d2 = x.shape[-1] // 2
     freq = theta ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
-    ang = positions.astype(jnp.float32)[:, None] * freq[None, :]  # (T, d2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freq  # (..., T, d2)
+    if ang.ndim == 2:                                      # (T, d2)
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:                                                  # (B, T, d2)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :d2], xf[..., d2:]
     return jnp.concatenate(
@@ -108,7 +114,7 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic=True):
+    def __call__(self, x, positions, segment_ids=None, deterministic=True):
         cfg = self.cfg
         B, T, D = x.shape
         H, Hkv = cfg.num_heads, cfg.num_kv_heads
@@ -126,7 +132,7 @@ class Attention(nn.Module):
             k = jnp.repeat(k, q_per_kv, axis=2)
             v = jnp.repeat(v, q_per_kv, axis=2)
         from horovod_tpu.ops.attention import sp_attention
-        o = sp_attention(q, k, v, cfg)
+        o = sp_attention(q, k, v, cfg, segment_ids=segment_ids)
         return nn.Dense(D, use_bias=False, dtype=cfg.dtype,
                         name="wo")(o.reshape(B, T, D))
 
@@ -149,10 +155,11 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic=True):
+    def __call__(self, x, positions, segment_ids=None, deterministic=True):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="norm_attn")(x), positions, deterministic)
+            RMSNorm(name="norm_attn")(x), positions, segment_ids,
+            deterministic)
         x = x + SwiGLU(cfg, name="mlp")(RMSNorm(name="norm_mlp")(x))
         return x
 
@@ -161,37 +168,54 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 segment_ids=None, positions=None):
+        """``segment_ids`` (B, T) int enables sequence packing (see
+        GPT2.__call__): cross-document attention is blocked and RoPE
+        angles restart per document. ``positions`` overrides the RoPE
+        position ids (required for packed sp shards)."""
         cfg = self.cfg
         if cfg.num_heads % cfg.num_kv_heads:
             raise ValueError(
                 f"num_kv_heads={cfg.num_kv_heads} must divide "
                 f"num_heads={cfg.num_heads}")
-        from horovod_tpu.ops.attention import (sp_global_positions,
+        from horovod_tpu.ops.attention import (packed_positions,
+                                               sp_global_positions,
                                                validate_sp_config)
         validate_sp_config(cfg)
         B, T = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
-        # Global positions for this sp shard feed RoPE's explicit
-        # position input (the same role as gpt2's wpe indexing).
-        pos = sp_global_positions(T, cfg)
+        if positions is not None:
+            pos = positions
+        elif segment_ids is not None:
+            if cfg.use_ring_attention:
+                raise ValueError(
+                    "packed sequences under sp need explicit positions= "
+                    "(per-shard pos-in-segment; the shard cannot see "
+                    "where its documents started)")
+            pos = packed_positions(segment_ids)          # (B, T)
+        else:
+            # Global positions for this sp shard feed RoPE's explicit
+            # position input (the same role as gpt2's wpe indexing).
+            pos = sp_global_positions(T, cfg)
         x = wte[tokens].astype(cfg.dtype)
         block = Block
         if cfg.remat:
             if cfg.remat_policy == "dots":
                 block = nn.remat(
-                    Block, static_argnums=(3,),
+                    Block, static_argnums=(4,),
                     policy=jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable)
             elif cfg.remat_policy == "full":
-                block = nn.remat(Block, static_argnums=(3,))
+                block = nn.remat(Block, static_argnums=(4,))
             else:
                 raise ValueError(
                     f"unknown remat_policy {cfg.remat_policy!r}: "
                     "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"h{i}")(x, pos, deterministic)
+            x = block(cfg, name=f"h{i}")(x, pos, segment_ids,
+                                         deterministic)
         x = RMSNorm(name="norm_f")(x)
         # Untied lm head (Llama convention), fp32 logits.
         wlm = self.param("lm_head", nn.initializers.normal(0.02),
