@@ -40,8 +40,8 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         (key-padding).
       segment_ids: optional (B, T) int — sequence-packing segment ids;
         attention is blocked across segment boundaries (q attends only
-        to keys with the SAME id). Dense impl only: the flash kernel's
-        bias input is per-key, not per-(q, k) pair.
+        to keys with the SAME id). Both impls: the flash kernels mask
+        score tiles to same-segment pairs.
       out_dtype: dtype of the returned tensor (defaults to q.dtype).
       flash_blocks: optional (block_q, block_k) tiling override for the
         flash kernel — feed ``autotune_flash_blocks``'s pick for this
@@ -57,7 +57,6 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     d = q.shape[-1]
 
     if impl == "flash":
-        reject_segment_flash(segment_ids)
         from horovod_tpu.ops.flash_attention import flash_attention
         key_bias = None
         if key_mask is not None:
@@ -68,6 +67,7 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       "block_k": int(flash_blocks[1])}
         return flash_attention(q, k, v, causal=causal,
                                key_bias=key_bias,
+                               segment_ids=segment_ids,
                                **blocks).astype(out_dtype)
 
     scale = d ** -0.5
@@ -82,10 +82,12 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         mask = jnp.tril(jnp.ones((tq, tk), bool))
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(out_dtype)
-    if key_mask is not None:
+    if key_mask is not None or segment_ids is not None:
         # A row whose keys are all masked softmaxes to uniform garbage;
         # return zeros instead, matching the flash kernel's contract.
-        any_visible = jnp.any(key_mask, axis=-1)[:, None, None, None]
+        # Visibility comes from the COMBINED scores (key mask AND segment
+        # mask can each empty a row that the other leaves populated).
+        any_visible = (s.max(axis=-1) > _NEG_INF / 2)[..., None]
         p = jnp.where(any_visible, p, 0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
@@ -146,13 +148,15 @@ def segment_mask(seg_q: jnp.ndarray, seg_k: jnp.ndarray) -> jnp.ndarray:
 
 
 def reject_segment_flash(segment_ids) -> None:
-    """Shared guard: the pallas flash kernel's bias input is per-key, not
-    per-(q, k) pair, so packing masks can't ride it."""
+    """Shared guard for the flash RING path: the ring's per-hop kernel
+    calls would need the resident block's segment ids threaded through
+    the custom-VJP ring (like the key bias); until then, packed sp rides
+    the dense ring or ulysses (whose local flash DOES take segments)."""
     if segment_ids is not None:
         raise NotImplementedError(
-            "segment_ids (sequence packing) needs a per-(q, k) mask; "
-            "the flash kernel's key_bias is per-key only — use the "
-            "dense attention impl for packed batches")
+            "segment_ids are not threaded through the flash RING yet — "
+            "use attention='dense' (ring) or sp_impl='ulysses' for "
+            "packed sp batches")
 
 
 def packed_positions(segment_ids: jnp.ndarray) -> jnp.ndarray:
@@ -188,9 +192,10 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
     ``key_mask`` is this shard's (B, t_local) bool key-padding mask,
     supported on every path (the rings rotate it with its K/V block;
     ulysses allgathers the bool). ``segment_ids`` (B, t_local) int blocks
-    attention across sequence-packing boundaries — dense paths only (the
-    flash kernel's bias input is per-key; packed flash batches should
-    simply not cross documents per shard, or use the dense ring).
+    attention across sequence-packing boundaries — supported everywhere
+    except the flash ring (the local flash kernel masks score tiles to
+    same-segment pairs; the ring would need the ids threaded through its
+    custom VJP).
 
     Used by GPT-2, Llama and BERT so the dispatch cannot diverge between
     model families (the configs validate via :func:`validate_sp_config`).

@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -54,7 +55,7 @@ def _block_sizes(tq: int, tk: int, block_q: int, block_k: int):
 
 
 def _mask_scores(s, q_blk, kv_blk, *, block_q, block_k, tq, tk, causal,
-                 offset=0, bias=None):
+                 offset=0, bias=None, seg_q=None, seg_k=None):
     """Apply causal / ragged-edge / key-bias masking to a score block.
 
     Shared by the forward and both backward kernels so the mask definition
@@ -66,6 +67,9 @@ def _mask_scores(s, q_blk, kv_blk, *, block_q, block_k, tq, tk, causal,
     need_pos = causal or tq % block_q or tk % block_k
     if bias is not None:
         s = s + bias
+    if seg_q is not None:
+        # sequence packing: visible iff q and k share a segment id
+        s = jnp.where(seg_q == seg_k.reshape(1, -1), s, _NEG_INF)
     if need_pos:
         q_pos = (q_blk * block_q +
                  jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
@@ -103,9 +107,10 @@ def _causal_skip(causal: bool, q_blk, kv_idx, block_q: int, block_k: int,
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                offset: int, block_q: int, block_k: int, tq: int, tk: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref, o_ref,
+                lse_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                causal: bool, offset: int, block_q: int, block_k: int,
+                tq: int, tk: int):
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
 
@@ -124,9 +129,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         k = _zero_oob_rows(k_ref[0].astype(jnp.float32), kv_idx, block_k, tk)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
+        seg_q = None if segq_ref is None else segq_ref[0]
+        seg_k = None if segk_ref is None else segk_ref[0]
         s = _mask_scores(s, q_blk, kv_idx, block_q=block_q, block_k=block_k,
                          tq=tq, tk=tk, causal=causal, offset=offset,
-                         bias=bias)
+                         bias=bias, seg_q=seg_q, seg_k=seg_k)
 
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -151,14 +158,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, None]
 
 
-def _bias_spec(h: int, bk: int):
-    # key_bias is (B, Tk, 1) — keys on the sublane dim so the block is legal
-    # for exactly the block_k values that are legal for K itself; grid axis 0
-    # runs over batch*heads.
+def _per_key_spec(h: int, bk: int):
+    # A (B, Tk, 1) per-key input (key_bias, k-side segment ids) — keys on
+    # the sublane dim so the block is legal for exactly the block_k values
+    # that are legal for K itself; grid axis 0 runs over batch*heads,
+    # b // h broadcasts over the heads folded into it.
     return pl.BlockSpec((1, bk, 1), lambda b, i, j, h=h: (b // h, j, 0))
 
 
-def _fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset=0):
+def _per_q_spec(h: int, bq: int):
+    # A (B, Tq, 1) per-query input (q-side segment ids), following the
+    # q tile.
+    return pl.BlockSpec((1, bq, 1), lambda b, i, j, h=h: (b // h, i, 0))
+
+
+_bias_spec = _per_key_spec
+_seg_k_spec = _per_key_spec
+
+
+def _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
+         offset=0):
     bh, tq, d = q.shape
     tk = k.shape[1]
     bq, bk = _block_sizes(tq, tk, block_q, block_k)
@@ -176,8 +195,14 @@ def _fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset=0):
     if bias is not None:
         in_specs.append(_bias_spec(h, bk))
         args.append(bias)
-    else:
-        kernel = _drop_bias(kernel)
+    if seg is not None:
+        # (B, T, 1) int32, consumed twice: as this q tile's ids and as
+        # the resident k tile's ids (self-attention: tq == tk).
+        in_specs.append(_per_q_spec(h, bq))
+        in_specs.append(_per_key_spec(h, bk))
+        args.append(seg)
+        args.append(seg)
+    kernel = _fill_optionals(kernel, bias is not None, seg is not None)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -201,11 +226,25 @@ def _fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset=0):
     return o, lse
 
 
-def _drop_bias(kernel):
-    """Adapt a kernel expecting a bias ref to the no-bias call signature."""
+def _fill_optionals(kernel, has_bias, has_seg):
+    """Adapt the canonical (q, k, v, bias, segq, segk, *rest) kernel to a
+    call signature where absent optional refs are not passed (pallas hands
+    over exactly the refs named in in_specs)."""
+    if has_bias and has_seg:
+        return kernel
+
     @functools.wraps(kernel)
     def wrapped(q_ref, k_ref, v_ref, *rest):
-        return kernel(q_ref, k_ref, v_ref, None, *rest)
+        i = 0
+        bias_ref = segq_ref = segk_ref = None
+        if has_bias:
+            bias_ref = rest[i]
+            i += 1
+        if has_seg:
+            segq_ref, segk_ref = rest[i], rest[i + 1]
+            i += 2
+        return kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                      *rest[i:])
     return wrapped
 
 
@@ -213,10 +252,10 @@ def _drop_bias(kernel):
 # Backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *, scale: float, causal: bool,
-                   offset: int, block_q: int, block_k: int, tq: int,
-                   tk: int):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
+                   scale: float, causal: bool, offset: int, block_q: int,
+                   block_k: int, tq: int, tk: int):
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
 
@@ -233,9 +272,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         k = _zero_oob_rows(k_ref[0].astype(jnp.float32), kv_idx, block_k, tk)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
+        seg_q = None if segq_ref is None else segq_ref[0]
+        seg_k = None if segk_ref is None else segk_ref[0]
         s = _mask_scores(s, q_blk, kv_idx, block_q=block_q, block_k=block_k,
                          tq=tq, tk=tk, causal=causal, offset=offset,
-                         bias=bias)
+                         bias=bias, seg_q=seg_q, seg_k=seg_k)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         do = _zero_oob_rows(do_ref[0].astype(jnp.float32), q_blk, block_q, tq)
@@ -251,10 +292,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, db_ref, dk_acc, dv_acc, db_acc, *,
-                    scale: float, causal: bool, offset: int, block_q: int,
-                    block_k: int, tq: int, tk: int):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, db_ref,
+                    dk_acc, dv_acc, db_acc, *, scale: float, causal: bool,
+                    offset: int, block_q: int, block_k: int, tq: int,
+                    tk: int):
     q_idx = pl.program_id(2)
     num_q = pl.num_programs(2)
 
@@ -274,9 +316,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         k = _zero_oob_rows(k_ref[0].astype(jnp.float32), kv_blk, block_k, tk)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         bias = None if bias_ref is None else bias_ref[0].reshape(1, -1)
+        seg_q = None if segq_ref is None else segq_ref[0]
+        seg_k = None if segk_ref is None else segk_ref[0]
         s = _mask_scores(s, q_idx, kv_blk, block_q=block_q, block_k=block_k,
                          tq=tq, tk=tk, causal=causal, offset=offset,
-                         bias=bias)
+                         bias=bias, seg_q=seg_q, seg_k=seg_k)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         do = _zero_oob_rows(do_ref[0].astype(jnp.float32), q_idx, block_q, tq)
@@ -302,7 +346,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
          offset=0, want_db=True):
-    q, k, v, bias, o, lse = res
+    q, k, v, bias, seg, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
     bq, bk = _block_sizes(tq, tk, block_q, block_k)
@@ -340,6 +384,11 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
         if bias is not None:
             sp.append(pl.BlockSpec(
                 (1, bk, 1), lambda *idx: (idx[0] // h, bias_j(*idx), 0)))
+        if seg is not None:
+            sp.append(pl.BlockSpec(
+                (1, bq, 1), lambda *idx: (idx[0] // h, qi(*idx)[1], 0)))
+            sp.append(pl.BlockSpec(
+                (1, bk, 1), lambda *idx: (idx[0] // h, bias_j(*idx), 0)))
         sp += [
             pl.BlockSpec((1, bq, d), qv),
             pl.BlockSpec((1, bq, 1), qv),
@@ -348,29 +397,25 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
         return sp
 
     track_db = bias is not None and want_db
-    if bias is None:
-        dq_kernel = _drop_bias(dq_kernel)
-        _dkv = dkv_kernel
+    extra = () if bias is None else (bias,)
+    if seg is not None:
+        extra = extra + (seg, seg)
+    dq_kernel = _fill_optionals(dq_kernel, bias is not None,
+                                seg is not None)
+    if not track_db:
+        # No db output/scratch: either there is no bias at all, or the
+        # caller discards the mask-derived cotangent — keep the bias
+        # INPUT (scores must mask) but skip the db work entirely.
+        _dkv_canon = dkv_kernel
 
-        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc):
-            return _dkv(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                        delta_ref, dk_ref, dv_ref, None, dk_acc, dv_acc,
-                        None)
-        extra = ()
-    else:
-        extra = (bias,)
-        if not track_db:
-            # Mask-derived bias whose cotangent the caller discards: keep
-            # the bias INPUT (scores must mask) but skip the db output,
-            # scratch, and per-q-block accumulation entirely.
-            _dkv_b = dkv_kernel
-
-            def dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                           delta_ref, dk_ref, dv_ref, dk_acc, dv_acc):
-                return _dkv_b(q_ref, k_ref, v_ref, bias_ref, do_ref,
-                              lse_ref, delta_ref, dk_ref, dv_ref, None,
-                              dk_acc, dv_acc, None)
+        def dkv_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                       do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                       dk_acc, dv_acc):
+            return _dkv_canon(q_ref, k_ref, v_ref, bias_ref, segq_ref,
+                              segk_ref, do_ref, lse_ref, delta_ref,
+                              dk_ref, dv_ref, None, dk_acc, dv_acc, None)
+    dkv_kernel = _fill_optionals(dkv_kernel, bias is not None,
+                                 seg is not None)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -424,21 +469,28 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, bias, h, scale, causal, block_q, block_k, offset):
-    o, _ = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, seg, h, scale, causal, block_q, block_k, offset):
+    o, _ = _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
                 offset=offset)
     return o
 
 
-def _flash_fwd(q, k, v, bias, h, scale, causal, block_q, block_k, offset):
-    o, lse = _fwd(q, k, v, bias, h, scale, causal, block_q, block_k,
+def _flash_fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
+               offset):
+    o, lse = _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
                   offset=offset)
-    return o, (q, k, v, bias, o, lse)
+    return o, (q, k, v, bias, seg, o, lse)
 
 
 def _flash_bwd(h, scale, causal, block_q, block_k, offset, res, do):
-    return _bwd(h, scale, causal, block_q, block_k, res, do, offset=offset)
+    dq, dk, dv, dbias = _bwd(h, scale, causal, block_q, block_k, res, do,
+                             offset=offset)
+    seg = res[4]
+    # Integer segment ids take a symbolic-zero (float0) cotangent.
+    dseg = (None if seg is None
+            else np.zeros(seg.shape, dtype=jax.dtypes.float0))
+    return dq, dk, dv, dbias, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -447,6 +499,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, scale: Optional[float] = None,
                     key_bias: Optional[jnp.ndarray] = None,
+                    segment_ids: Optional[jnp.ndarray] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     causal_offset: int = 0) -> jnp.ndarray:
@@ -462,6 +515,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         heads and queries — key-padding masks are ``where(pad, -1e30, 0)``,
         ALiBi-style learned biases also fit. Differentiated (the dK/dV
         kernel accumulates ``dbias_k = sum_q dS``).
+      segment_ids: optional (batch, t) int — sequence-packing segment
+        ids (self-attention: t_q == t_kv required); the kernels mask
+        score tiles to same-segment (q, k) pairs, so packed documents
+        cannot attend across boundaries at any sequence length.
       causal_offset: shifts the causal diagonal — visible iff
         ``i + causal_offset >= j`` (−1 = strict causal; used by striped
         ring layouts). Only meaningful with ``causal=True``.
@@ -500,8 +557,17 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             raise ValueError(f"key_bias must be (batch, t_kv) = ({b}, {tk}), "
                              f"got {key_bias.shape}")
         key_bias = key_bias.astype(jnp.float32).reshape(b, tk, 1)
+    seg = None
+    if segment_ids is not None:
+        if tq != tk:
+            raise ValueError("segment_ids require self-attention shapes "
+                             f"(t_q == t_kv), got {tq} != {tk}")
+        if segment_ids.shape != (b, tq):
+            raise ValueError(f"segment_ids must be (batch, t) = "
+                             f"({b}, {tq}), got {segment_ids.shape}")
+        seg = segment_ids.astype(jnp.int32).reshape(b, tq, 1)
 
-    o = _flash(pack(q), pack(k), pack(v), key_bias, h, float(scale),
+    o = _flash(pack(q), pack(k), pack(v), key_bias, seg, h, float(scale),
                bool(causal), int(block_q), int(block_k),
                int(causal_offset))
     return o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -52,7 +52,8 @@ def _block_fwd(q, k, v, bias, h, causal, scale, bq, bk, offset=0):
     ``bias`` is the resident K block's (b, tk, 1) additive logit bias
     (key-padding) — the kernel broadcasts it over the h heads folded into
     the packed batch rows — or None."""
-    o, lse = _fa_fwd(q, k, v, bias, h, scale, causal, bq, bk, offset=offset)
+    o, lse = _fa_fwd(q, k, v, bias, None, h, scale, causal, bq, bk,
+                     offset=offset)
     return o.astype(jnp.float32), lse[..., 0]
 
 
@@ -157,8 +158,8 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
         # precomputed global delta: p then equals the globally-normalised
         # attention prob of this block.
         dq, dk, dv, db = _fa_bwd(
-            h, scale, causal_mode, bq, bk, (q, k, v, bias, o, lse_in), do,
-            delta=delta, offset=offset, want_db=track_db)
+            h, scale, causal_mode, bq, bk, (q, k, v, bias, None, o, lse_in),
+            do, delta=delta, offset=offset, want_db=track_db)
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),
                 dv.astype(jnp.float32),
                 None if db is None else db.astype(jnp.float32))

@@ -37,8 +37,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (False keys masked out); it is allgathered to the full sequence for
     the local attention — a bool vector, so the extra wire is negligible.
     ``segment_ids`` (B, t_local) int blocks attention across
-    sequence-packing boundaries the same way (dense impl only: the flash
-    kernel's bias input is per-key, not per-(q, k) pair).
+    sequence-packing boundaries the same way (both impls — the local
+    flash kernel masks score tiles to same-segment pairs).
 
     ``impl="flash"`` runs the local full-sequence attention through the
     fused pallas kernel — after the all-to-all this is ordinary single-
@@ -75,9 +75,6 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                    tiled=True)              # (B, T)
     seg_global = None
     if segment_ids is not None:
-        from horovod_tpu.ops.attention import reject_segment_flash
-        if impl != "dense":
-            reject_segment_flash(segment_ids)
         seg_global = lax.all_gather(segment_ids, axis_name, axis=1,
                                     tiled=True)             # (B, T)
     if impl == "flash":
@@ -87,7 +84,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             key_bias = jnp.where(km_global, 0.0, -1e30).astype(jnp.float32)
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
                               block_q=block_q, block_k=block_k,
-                              key_bias=key_bias)
+                              key_bias=key_bias, segment_ids=seg_global)
         return head2seq(out)[:, :, :H]
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}; expected "
@@ -105,10 +102,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         mask = jnp.tril(jnp.ones((T, T), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    if km_global is not None:
+    if km_global is not None or seg_global is not None:
         # Rows with every key masked softmax to uniform garbage; zero
-        # them, matching multihead_attention's contract.
-        any_visible = jnp.any(km_global, axis=-1)[:, None, None, None]
+        # them, matching multihead_attention's contract. Visibility comes
+        # from the COMBINED scores (key mask AND segment mask can each
+        # empty a row the other leaves populated).
+        any_visible = (logits.max(axis=-1) > -1e30 / 2)[..., None]
         probs = jnp.where(any_visible, probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh.astype(jnp.float32))
     return head2seq(out.astype(q.dtype))[:, :, :H]

@@ -117,12 +117,16 @@ class TestGPT2:
         np.testing.assert_array_equal(pos[0], [0, 1, 2, 0, 1, 0, 1, 2])
         np.testing.assert_array_equal(pos[1], np.arange(8))
 
-    def test_sequence_packing_isolates_documents(self):
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_sequence_packing_isolates_documents(self, attention):
         """A packed document's logits == running it alone: the segment
         mask blocks cross-document attention and packed_positions
-        restarts the wpe rows, so packing is exact, not approximate."""
+        restarts the wpe rows, so packing is exact, not approximate.
+        The flash variant exercises the kernel's score-tile segment
+        mask."""
         import dataclasses
-        cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+        cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32,
+                                  attention=attention)
         m = GPT2(cfg)
         rng = np.random.default_rng(17)
         d0 = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 12)),
@@ -151,16 +155,22 @@ class TestGPT2:
         l = loss_fn(logits, toks, segment_ids=seg)
         np.testing.assert_allclose(float(l), np.log(V), rtol=1e-6)
 
-    def test_packed_sp_matches_single_device(self):
-        """Sequence packing under sp (dense ring): the shard's segment
-        ids rotate with the k/v blocks; explicit positions carry
-        pos-in-segment."""
+    @pytest.mark.parametrize("sp", [("ring", "dense"),
+                                    ("ulysses", "dense"),
+                                    ("ulysses", "flash")])
+    def test_packed_sp_matches_single_device(self, sp):
+        """Sequence packing under sp: the dense ring rotates the shard's
+        segment ids with the k/v blocks; ulysses allgathers them (its
+        local flash kernel takes them natively). Explicit positions
+        carry pos-in-segment."""
         import dataclasses
 
         from jax.sharding import PartitionSpec as P
 
         from horovod_tpu.ops.attention import packed_positions
-        cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+        sp_impl, attention = sp
+        cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32,
+                                  attention=attention)
         rng = np.random.default_rng(19)
         T = 32
         toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, T)),
@@ -171,7 +181,8 @@ class TestGPT2:
         m = GPT2(cfg)
         params = m.init(jax.random.PRNGKey(0), toks)["params"]
         want = m.apply({"params": params}, toks, segment_ids=seg)
-        sp_cfg = dataclasses.replace(cfg, use_ring_attention=True)
+        sp_cfg = dataclasses.replace(cfg, use_ring_attention=True,
+                                     sp_impl=sp_impl)
         sp_m = GPT2(sp_cfg)
         hvd.init(axis_name="sp")
         try:
