@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 __all__ = ["multihead_attention", "ATTENTION_IMPLS", "validate_sp_config",
            "sp_global_positions", "sp_attention", "packed_positions",
-           "segment_mask", "reject_segment_flash"]
+           "segment_mask"]
 
 ATTENTION_IMPLS = ("dense", "flash")
 
@@ -147,18 +147,6 @@ def segment_mask(seg_q: jnp.ndarray, seg_k: jnp.ndarray) -> jnp.ndarray:
     return seg_q[:, :, None] == seg_k[:, None, :]
 
 
-def reject_segment_flash(segment_ids) -> None:
-    """Shared guard for the flash RING path: the ring's per-hop kernel
-    calls would need the resident block's segment ids threaded through
-    the custom-VJP ring (like the key bias); until then, packed sp rides
-    the dense ring or ulysses (whose local flash DOES take segments)."""
-    if segment_ids is not None:
-        raise NotImplementedError(
-            "segment_ids are not threaded through the flash RING yet — "
-            "use attention='dense' (ring) or sp_impl='ulysses' for "
-            "packed sp batches")
-
-
 def packed_positions(segment_ids: jnp.ndarray) -> jnp.ndarray:
     """(B, T) positions that restart at 0 at every segment boundary.
 
@@ -189,13 +177,11 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                           flash backward-ring, contiguous/striped layouts)
     * sp_impl="ulysses"-> all-to-all heads<->sequence, then local attention
 
-    ``key_mask`` is this shard's (B, t_local) bool key-padding mask,
-    supported on every path (the rings rotate it with its K/V block;
-    ulysses allgathers the bool). ``segment_ids`` (B, t_local) int blocks
-    attention across sequence-packing boundaries — supported everywhere
-    except the flash ring (the local flash kernel masks score tiles to
-    same-segment pairs; the ring would need the ids threaded through its
-    custom VJP).
+    ``key_mask`` is this shard's (B, t_local) bool key-padding mask and
+    ``segment_ids`` its (B, t_local) int sequence-packing ids — both
+    supported on EVERY path: the rings rotate the k-side copies with
+    their K/V block, ulysses allgathers them, and the flash kernels mask
+    score tiles natively.
 
     Used by GPT-2, Llama and BERT so the dispatch cannot diverge between
     model families (the configs validate via :func:`validate_sp_config`).
@@ -212,12 +198,12 @@ def sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg,
                                      key_mask=key_mask,
                                      segment_ids=segment_ids, **blocks)
         if cfg.attention == "flash":
-            reject_segment_flash(segment_ids)
             from horovod_tpu.ops.ring_flash import ring_flash_attention
             return ring_flash_attention(q, k, v, axis_name=axis_name,
                                         causal=causal,
                                         layout=cfg.ring_layout,
-                                        key_mask=key_mask)
+                                        key_mask=key_mask,
+                                        segment_ids=segment_ids)
         if cfg.attention == "dense":
             from horovod_tpu.ops.ring_attention import ring_attention
             return ring_attention(q, k, v, axis_name=axis_name,

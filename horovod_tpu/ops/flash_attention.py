@@ -176,7 +176,7 @@ _bias_spec = _per_key_spec
 _seg_k_spec = _per_key_spec
 
 
-def _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
+def _fwd(q, k, v, bias, seg_q, seg_k, h, scale, causal, block_q, block_k,
          offset=0):
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -195,14 +195,15 @@ def _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
     if bias is not None:
         in_specs.append(_bias_spec(h, bk))
         args.append(bias)
-    if seg is not None:
-        # (B, T, 1) int32, consumed twice: as this q tile's ids and as
-        # the resident k tile's ids (self-attention: tq == tk).
+    if seg_q is not None:
+        # (B, T, 1) int32: this q tile's ids and the resident k tile's
+        # ids (identical arrays single-device; the ring hands a rotated
+        # k-side copy).
         in_specs.append(_per_q_spec(h, bq))
         in_specs.append(_per_key_spec(h, bk))
-        args.append(seg)
-        args.append(seg)
-    kernel = _fill_optionals(kernel, bias is not None, seg is not None)
+        args.append(seg_q)
+        args.append(seg_k)
+    kernel = _fill_optionals(kernel, bias is not None, seg_q is not None)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -346,7 +347,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
 
 def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
          offset=0, want_db=True):
-    q, k, v, bias, seg, o, lse = res
+    q, k, v, bias, seg_q, seg_k, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
     bq, bk = _block_sizes(tq, tk, block_q, block_k)
@@ -384,7 +385,7 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
         if bias is not None:
             sp.append(pl.BlockSpec(
                 (1, bk, 1), lambda *idx: (idx[0] // h, bias_j(*idx), 0)))
-        if seg is not None:
+        if seg_q is not None:
             sp.append(pl.BlockSpec(
                 (1, bq, 1), lambda *idx: (idx[0] // h, qi(*idx)[1], 0)))
             sp.append(pl.BlockSpec(
@@ -398,10 +399,10 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
 
     track_db = bias is not None and want_db
     extra = () if bias is None else (bias,)
-    if seg is not None:
-        extra = extra + (seg, seg)
+    if seg_q is not None:
+        extra = extra + (seg_q, seg_k)
     dq_kernel = _fill_optionals(dq_kernel, bias is not None,
-                                seg is not None)
+                                seg_q is not None)
     if not track_db:
         # No db output/scratch: either there is no bias at all, or the
         # caller discards the mask-derived cotangent — keep the bias
@@ -415,7 +416,7 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
                               segk_ref, do_ref, lse_ref, delta_ref,
                               dk_ref, dv_ref, None, dk_acc, dv_acc, None)
     dkv_kernel = _fill_optionals(dkv_kernel, bias is not None,
-                                 seg is not None)
+                                 seg_q is not None)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -471,22 +472,22 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, bias, seg, h, scale, causal, block_q, block_k, offset):
-    o, _ = _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
-                offset=offset)
+    o, _ = _fwd(q, k, v, bias, seg, seg, h, scale, causal, block_q,
+                block_k, offset=offset)
     return o
 
 
 def _flash_fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
                offset):
-    o, lse = _fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
-                  offset=offset)
-    return o, (q, k, v, bias, seg, o, lse)
+    o, lse = _fwd(q, k, v, bias, seg, seg, h, scale, causal, block_q,
+                  block_k, offset=offset)
+    return o, (q, k, v, bias, seg, seg, o, lse)
 
 
 def _flash_bwd(h, scale, causal, block_q, block_k, offset, res, do):
     dq, dk, dv, dbias = _bwd(h, scale, causal, block_q, block_k, res, do,
                              offset=offset)
-    seg = res[4]
+    seg = res[4]  # res = (q, k, v, bias, seg, seg, o, lse)
     # Integer segment ids take a symbolic-zero (float0) cotangent.
     dseg = (None if seg is None
             else np.zeros(seg.shape, dtype=jax.dtypes.float0))

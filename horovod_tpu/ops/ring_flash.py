@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # Import from the module path directly: the package __init__ rebinds the
@@ -47,12 +48,14 @@ def _unpack(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _block_fwd(q, k, v, bias, h, causal, scale, bq, bk, offset=0):
+def _block_fwd(q, k, v, bias, seg_q, seg_k, h, causal, scale, bq, bk,
+               offset=0):
     """One flash forward on packed arrays → (o f32 (bh,t,d), lse (bh,t)).
     ``bias`` is the resident K block's (b, tk, 1) additive logit bias
     (key-padding) — the kernel broadcasts it over the h heads folded into
-    the packed batch rows — or None."""
-    o, lse = _fa_fwd(q, k, v, bias, None, h, scale, causal, bq, bk,
+    the packed batch rows — or None. ``seg_q``/``seg_k`` are the home
+    q-side and resident k-side (b, t, 1) segment ids, or None."""
+    o, lse = _fa_fwd(q, k, v, bias, seg_q, seg_k, h, scale, causal, bq, bk,
                      offset=offset)
     return o.astype(jnp.float32), lse[..., 0]
 
@@ -68,11 +71,11 @@ def _safe_merge(o_acc, lse_acc, o_b, lse_b):
     return o_new, lse_new
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
-def _ring(q, k, v, bias, axis_name, causal, scale, bq, bk, striped, h,
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _ring(q, k, v, bias, seg, axis_name, causal, scale, bq, bk, striped, h,
           want_dbias):
-    o, _ = _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq, bk,
-                          striped, h)
+    o, _ = _ring_fwd_impl(q, k, v, bias, seg, axis_name, causal, scale,
+                          bq, bk, striped, h)
     return o
 
 
@@ -89,58 +92,63 @@ def _mode_of(striped, causal, src, rank):
     return jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
 
 
-def _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq, bk,
+def _ring_fwd_impl(q, k, v, bias, seg, axis_name, causal, scale, bq, bk,
                    striped, h=1):
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     bh, tq, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def full_b(q, k, v, bias):
-        return _block_fwd(q, k, v, bias, h, False, scale, bq, bk)
+    def full_b(q, k, v, bias, seg_k):
+        return _block_fwd(q, k, v, bias, seg, seg_k, h, False, scale, bq,
+                          bk)
 
-    def causal_b(q, k, v, bias):
-        return _block_fwd(q, k, v, bias, h, True, scale, bq, bk)
+    def causal_b(q, k, v, bias, seg_k):
+        return _block_fwd(q, k, v, bias, seg, seg_k, h, True, scale, bq,
+                          bk)
 
-    def skip_b(q, k, v, bias):
+    def skip_b(q, k, v, bias, seg_k):
         return (jnp.zeros((bh, tq, d), jnp.float32),
                 jnp.full((bh, tq), _NEG_INF, jnp.float32))
 
-    def strict_b(q, k, v, bias):
-        return _block_fwd(q, k, v, bias, h, True, scale, bq, bk,
-                          offset=-1)
+    def strict_b(q, k, v, bias, seg_k):
+        return _block_fwd(q, k, v, bias, seg, seg_k, h, True, scale, bq,
+                          bk, offset=-1)
 
     def step(carry, i):
-        o_acc, lse_acc, k, v, bias = carry
+        o_acc, lse_acc, k, v, bias, seg_k = carry
         src = (rank - i) % n
         mode = _mode_of(striped, causal, src, rank)
         o_b, lse_b = lax.switch(mode, [full_b, causal_b, skip_b, strict_b],
-                                q, k, v, bias)
+                                q, k, v, bias, seg_k)
         o_acc, lse_acc = _safe_merge(o_acc, lse_acc, o_b, lse_b)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         if bias is not None:
             # the key-padding bias travels with its K block
             bias = lax.ppermute(bias, axis_name, perm)
-        return (o_acc, lse_acc, k, v, bias), None
+        if seg_k is not None:
+            # the k-side segment ids travel with their K block too
+            seg_k = lax.ppermute(seg_k, axis_name, perm)
+        return (o_acc, lse_acc, k, v, bias, seg_k), None
 
     o0 = jnp.zeros((bh, tq, d), jnp.float32)
     lse0 = jnp.full((bh, tq), _NEG_INF, jnp.float32)
-    (o, lse, k, v, bias), _ = lax.scan(step, (o0, lse0, k, v, bias),
-                                       jnp.arange(n))
+    (o, lse, k, v, bias, _), _ = lax.scan(step, (o0, lse0, k, v, bias,
+                                                 seg), jnp.arange(n))
     return o.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, bias, axis_name, causal, scale, bq, bk, striped,
-              h, want_dbias):
-    o, lse = _ring_fwd_impl(q, k, v, bias, axis_name, causal, scale, bq,
-                            bk, striped, h)
-    return o, (q, k, v, bias, o, lse)
+def _ring_fwd(q, k, v, bias, seg, axis_name, causal, scale, bq, bk,
+              striped, h, want_dbias):
+    o, lse = _ring_fwd_impl(q, k, v, bias, seg, axis_name, causal, scale,
+                            bq, bk, striped, h)
+    return o, (q, k, v, bias, seg, o, lse)
 
 
 def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
               res, do):
-    q, k, v, bias, o, lse = res
+    q, k, v, bias, seg, o, lse = res
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -153,39 +161,41 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
 
     track_db = bias is not None and want_dbias
 
-    def grads_block(q, k, v, bias, causal_mode, offset=0):
+    def grads_block(q, k, v, bias, seg_k, causal_mode, offset=0):
         # Reuse the flash backward kernels with the *global* lse and the
         # precomputed global delta: p then equals the globally-normalised
         # attention prob of this block.
         dq, dk, dv, db = _fa_bwd(
-            h, scale, causal_mode, bq, bk, (q, k, v, bias, None, o, lse_in),
+            h, scale, causal_mode, bq, bk,
+            (q, k, v, bias, seg, seg_k, o, lse_in),
             do, delta=delta, offset=offset, want_db=track_db)
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),
                 dv.astype(jnp.float32),
                 None if db is None else db.astype(jnp.float32))
 
-    def full_b(q, k, v, bias):
-        return grads_block(q, k, v, bias, False)
+    def full_b(q, k, v, bias, seg_k):
+        return grads_block(q, k, v, bias, seg_k, False)
 
-    def causal_b(q, k, v, bias):
-        return grads_block(q, k, v, bias, True)
+    def causal_b(q, k, v, bias, seg_k):
+        return grads_block(q, k, v, bias, seg_k, True)
 
-    def skip_b(q, k, v, bias):
+    def skip_b(q, k, v, bias, seg_k):
         return (jnp.zeros(q.shape, jnp.float32),
                 jnp.zeros(k.shape, jnp.float32),
                 jnp.zeros(v.shape, jnp.float32),
                 None if not track_db else jnp.zeros(bias.shape,
                                                     jnp.float32))
 
-    def strict_b(q, k, v, bias):
-        return grads_block(q, k, v, bias, True, offset=-1)
+    def strict_b(q, k, v, bias, seg_k):
+        return grads_block(q, k, v, bias, seg_k, True, offset=-1)
 
     def step(carry, i):
-        dq_acc, k, v, bias, dk_acc, dv_acc, db_acc = carry
+        dq_acc, k, v, bias, seg_k, dk_acc, dv_acc, db_acc = carry
         src = (rank - i) % n
         mode = _mode_of(striped, causal, src, rank)
         dq_b, dk_b, dv_b, db_b = lax.switch(
-            mode, [full_b, causal_b, skip_b, strict_b], q, k, v, bias)
+            mode, [full_b, causal_b, skip_b, strict_b], q, k, v, bias,
+            seg_k)
         dq_acc = dq_acc + dq_b
         dk_acc = dk_acc + dk_b
         dv_acc = dv_acc + dv_b
@@ -198,24 +208,29 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
         if bias is not None:
             bias = lax.ppermute(bias, axis_name, perm)
+        if seg_k is not None:
+            seg_k = lax.ppermute(seg_k, axis_name, perm)
         if track_db:
             # the bias cotangent ships home with its block, like dK/dV
             db_acc = db_acc + db_b
             db_acc = lax.ppermute(db_acc, axis_name, perm)
-        return (dq_acc, k, v, bias, dk_acc, dv_acc, db_acc), None
+        return (dq_acc, k, v, bias, seg_k, dk_acc, dv_acc, db_acc), None
 
     z = jnp.zeros(q.shape, jnp.float32)
     zk = jnp.zeros(k.shape, jnp.float32)
     db0 = None if not track_db else jnp.zeros(bias.shape, jnp.float32)
-    (dq, k, v, bias, dk, dv, db), _ = lax.scan(
-        step, (z, k, v, bias, zk, jnp.zeros_like(zk), db0), jnp.arange(n))
+    (dq, k, v, bias, _, dk, dv, db), _ = lax.scan(
+        step, (z, k, v, bias, seg, zk, jnp.zeros_like(zk), db0),
+        jnp.arange(n))
     # A mask-derived bias (want_dbias=False) gets a zero cotangent — it
     # dies into jnp.where constants anyway; skipping the accumulate +
     # per-hop ppermute keeps the hot masked-sp path free of dead traffic.
     if bias is not None and db is None:
         db = jnp.zeros(bias.shape, jnp.float32)
+    dseg = (None if seg is None
+            else np.zeros(seg.shape, dtype=jax.dtypes.float0))
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            db)
+            db, dseg)
 
 
 _ring.defvjp(_ring_fwd, _ring_bwd)
@@ -227,7 +242,8 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          block_q: Optional[int] = None,
                          block_k: Optional[int] = None,
                          layout: str = "contiguous",
-                         key_mask: Optional[jnp.ndarray] = None
+                         key_mask: Optional[jnp.ndarray] = None,
+                         segment_ids: Optional[jnp.ndarray] = None
                          ) -> jnp.ndarray:
     """Exact attention with q/k/v sequence-sharded across ``axis_name``.
 
@@ -259,6 +275,10 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         key bias and travels around the ring with its K/V block (the
         backward ships the bias cotangent home the same way, so a
         future differentiable bias rides for free).
+      segment_ids: optional (batch, t_local) int — this shard's
+        sequence-packing segment ids. The k-side copy travels around the
+        ring with its K/V block; each hop's kernel masks score tiles to
+        same-segment (home-q, resident-k) pairs.
 
     Returns (batch, t_local, heads, head_dim), dtype of ``q``.
     """
@@ -283,7 +303,14 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # the per-batch bias, not h copies.
         bias = jnp.where(key_mask, 0.0, _NEG_INF
                          ).astype(jnp.float32)[..., None]
-    o = _ring(_pack(q), _pack(k), _pack(v), bias, axis_name, bool(causal),
-              float(scale), int(block_q), int(block_k),
+    seg = None
+    if segment_ids is not None:
+        if segment_ids.shape != (b, t):
+            raise ValueError(
+                f"segment_ids must be (batch, t_local) = ({b}, {t}), got "
+                f"{segment_ids.shape}")
+        seg = segment_ids.astype(jnp.int32)[..., None]
+    o = _ring(_pack(q), _pack(k), _pack(v), bias, seg, axis_name,
+              bool(causal), float(scale), int(block_q), int(block_k),
               layout == "striped", h, False)
     return _unpack(o, b, h)

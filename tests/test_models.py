@@ -156,13 +156,14 @@ class TestGPT2:
         np.testing.assert_allclose(float(l), np.log(V), rtol=1e-6)
 
     @pytest.mark.parametrize("sp", [("ring", "dense"),
+                                    ("ring", "flash"),
                                     ("ulysses", "dense"),
                                     ("ulysses", "flash")])
     def test_packed_sp_matches_single_device(self, sp):
-        """Sequence packing under sp: the dense ring rotates the shard's
-        segment ids with the k/v blocks; ulysses allgathers them (its
-        local flash kernel takes them natively). Explicit positions
-        carry pos-in-segment."""
+        """Sequence packing under sp: the rings rotate the shard's
+        k-side segment ids with the k/v blocks (the flash ring threads
+        them through its custom-VJP ring); ulysses allgathers them.
+        Explicit positions carry pos-in-segment."""
         import dataclasses
 
         from jax.sharding import PartitionSpec as P
