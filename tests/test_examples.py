@@ -60,6 +60,10 @@ class TestExamples:
         out = _run("gpt2_long_context.py", "--steps", "2")
         assert "8 sp shards" in out and "OK" in out
 
+    def test_gpt2_packed(self):
+        out = _run("gpt2_packed.py", "--steps", "3")
+        assert "packed-vs-alone" in out and "packed loss" in out
+
     def test_tensorflow2_keras_mnist(self):
         out = _run("tensorflow2_keras_mnist.py", "--epochs", "2",
                    timeout=600)
