@@ -57,7 +57,7 @@ class EncoderLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, segment_ids=None):
         cfg = self.cfg
         B, T, D = x.shape
         H = cfg.num_heads
@@ -68,15 +68,16 @@ class EncoderLayer(nn.Module):
         v = v.reshape(B, T, H, D // H)
         if cfg.use_ring_attention:
             # Long-context sp through the shared non-causal dispatch; the
-            # shard's key-padding mask (if any) rides every path (the
-            # rings rotate it with k/v, ulysses allgathers it).
+            # shard's key-padding mask / packing ids ride every path (the
+            # rings rotate them with k/v, ulysses allgathers them).
             from horovod_tpu.ops.attention import sp_attention
-            att = sp_attention(q, k, v, cfg, causal=False,
-                               key_mask=mask).reshape(B, T, D)
+            att = sp_attention(q, k, v, cfg, causal=False, key_mask=mask,
+                               segment_ids=segment_ids).reshape(B, T, D)
         else:
             from horovod_tpu.ops.attention import multihead_attention
             att = multihead_attention(q, k, v, impl=cfg.attention,
                                       causal=False, key_mask=mask,
+                                      segment_ids=segment_ids,
                                       out_dtype=cfg.dtype,
                                       flash_blocks=cfg.flash_blocks
                                       ).reshape(B, T, D)
@@ -92,9 +93,16 @@ class Bert(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types=None, attention_mask=None):
+    def __call__(self, tokens, token_types=None, attention_mask=None,
+                 segment_ids=None, positions=None):
+        """``segment_ids`` (B, T) int enables sequence packing (packed
+        MLM pretraining): attention blocked across document boundaries,
+        wpe rows restart per document unless explicit ``positions`` are
+        given (required under packed sp). Note: upstream-BERT "segment
+        A/B" embeddings are ``token_types`` — a different thing."""
         cfg = self.cfg
-        from horovod_tpu.ops.attention import (sp_global_positions,
+        from horovod_tpu.ops.attention import (packed_positions,
+                                               sp_global_positions,
                                                validate_sp_config)
         validate_sp_config(cfg)
         B, T = tokens.shape
@@ -108,10 +116,22 @@ class Bert(nn.Module):
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
         wtt = self.param("wtt", nn.initializers.normal(0.02),
                          (cfg.type_vocab_size, cfg.d_model), jnp.float32)
-        # Under sp, wpe follows this shard's *global* positions.
-        pos = sp_global_positions(T, cfg)
-        x = (wte[tokens] + wpe[pos][None] + wtt[token_types]).astype(
-            cfg.dtype)
+        if positions is not None:
+            pos = positions
+        elif segment_ids is not None:
+            if cfg.use_ring_attention:
+                raise ValueError(
+                    "packed sequences under sp need explicit positions= "
+                    "(per-shard pos-in-segment; the shard cannot see "
+                    "where its documents started)")
+            pos = packed_positions(segment_ids)          # (B, T)
+        else:
+            # Under sp, wpe follows this shard's *global* positions.
+            pos = sp_global_positions(T, cfg)
+        pe = wpe[pos]
+        if pe.ndim == 2:          # (T, D): shared positions, broadcast B
+            pe = pe[None]
+        x = (wte[tokens] + pe + wtt[token_types]).astype(cfg.dtype)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
         layer = EncoderLayer
         if cfg.remat:
@@ -127,7 +147,8 @@ class Bert(nn.Module):
                     f"unknown remat_policy {cfg.remat_policy!r}: "
                     "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
-            x = layer(cfg, name=f"layer{i}")(x, attention_mask)
+            x = layer(cfg, name=f"layer{i}")(x, attention_mask,
+                                             segment_ids)
         # MLM head: tied embeddings, fp32 logits (per-shard rows under sp).
         mlm = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
         # NSP head on [CLS]. Under sp, global position 0 lives on shard 0

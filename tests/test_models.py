@@ -364,6 +364,34 @@ class TestBert:
                                    np.asarray(nsp_want),
                                    rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("attention", ["dense", "flash"])
+    def test_sequence_packing_isolates_documents(self, attention):
+        """Packed MLM rows: each packed document's mlm logits == running
+        it alone (segment mask + per-document wpe restart)."""
+        import dataclasses
+
+        from horovod_tpu.models.bert import Bert, BertConfig
+        cfg = dataclasses.replace(BertConfig.tiny(), dtype=jnp.float32,
+                                  attention=attention)
+        m = Bert(cfg)
+        rng = np.random.default_rng(29)
+        d0 = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 14)),
+                         jnp.int32)
+        d1 = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 18)),
+                         jnp.int32)
+        packed = jnp.concatenate([d0, d1], axis=1)          # (1, 32)
+        seg = jnp.asarray([[0] * 14 + [1] * 18], jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), packed)
+        got, _ = m.apply(params, packed, segment_ids=seg)
+        want0, _ = m.apply(params, d0)
+        want1, _ = m.apply(params, d1)
+        np.testing.assert_allclose(np.asarray(got[:, :14]),
+                                   np.asarray(want0), rtol=2e-4,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(got[:, 14:]),
+                                   np.asarray(want1), rtol=2e-4,
+                                   atol=2e-4)
+
     def test_masked_flash_ring_grads_match_single_device(self):
         """Backward through the masked flash ring (the bias cotangent
         ships around the ring with dK/dV) == single-device masked
