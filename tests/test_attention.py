@@ -335,6 +335,33 @@ class TestSegmentedRings:
                                    run(ring_attention),
                                    rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
+    def test_striped_packed_ring_matches_local_dense(self, qkv, impl):
+        """Striped layout x packing: segment ids follow their tokens
+        through the striped permutation, so the rotating k-side ids mask
+        exactly the global same-segment pairs."""
+        q, k, v = qkv
+        rng = np.random.default_rng(37)
+        seg_g = np.cumsum(rng.random((B, T)) < 0.08, axis=1).astype(
+            np.int32)
+        tl = T // N
+        c2g = np.array([(c // tl) + N * (c % tl) for c in range(T)])
+        fn = ring_attention if impl == "dense" else ring_flash_attention
+
+        def body(q, k, v, s):
+            return fn(q, k, v, axis_name="hvd", causal=True,
+                      layout="striped", segment_ids=s)
+
+        mapped = hvd.spmd(body, in_specs=(P(None, "hvd"),) * 4,
+                          out_specs=P(None, "hvd"))
+        got = np.asarray(mapped(q[:, c2g], k[:, c2g], v[:, c2g],
+                                jnp.asarray(seg_g[:, c2g])))
+        from horovod_tpu.ops.attention import multihead_attention
+        want = np.asarray(multihead_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), impl="dense",
+            causal=True, segment_ids=jnp.asarray(seg_g)))[:, c2g]
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
     def test_segmented_flash_ring_grads_match_dense_ring(self, qkv):
         q, k, v = qkv
         rng = np.random.default_rng(33)
