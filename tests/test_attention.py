@@ -384,3 +384,28 @@ class TestSegmentedRings:
         for a, b in zip(got, want):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+def test_ulysses_packed_and_padded_compose(rng, impl):
+    """Ulysses with BOTH key padding and packing: the allgathered mask and
+    ids compose exactly like the local dense reference."""
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    seg = np.cumsum(rng.random((B, T)) < 0.08, axis=1).astype(np.int32)
+    mask = np.arange(T)[None, :] < np.array([[T - 11], [T - 4]])
+
+    def body(q, k, v, m, s):
+        return ulysses_attention(q, k, v, axis_name="hvd", causal=False,
+                                 impl=impl, key_mask=m, segment_ids=s)
+
+    mapped = hvd.spmd(body, in_specs=(P(None, "hvd"),) * 5,
+                      out_specs=P(None, "hvd"))
+    got = np.asarray(mapped(q, k, v, jnp.asarray(mask), jnp.asarray(seg)))
+    from horovod_tpu.ops.attention import multihead_attention
+    want = np.asarray(multihead_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), impl="dense",
+        causal=False, key_mask=jnp.asarray(mask),
+        segment_ids=jnp.asarray(seg)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
