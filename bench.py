@@ -4,11 +4,24 @@
     python bench.py --model gpt2       # GPT-2 medium, tokens/s + MFU
     python bench.py --model all        # every config (headline printed last)
 
-Each line reports throughput, step time, and MFU = achieved TFLOP/s divided
-by the chip's peak bf16 TFLOP/s, where achieved FLOPs come from XLA's own
-compiled-program cost analysis (fwd+bwd+update, matmul FMA counted as 2
-FLOPs — the same accounting as the peak, so MFU is honest; see ROOFLINE.md
-for why analytic "GFLOPs/image" figures understate this by ~2x).
+Each line reports throughput, step time, and TWO utilization numbers
+(VERDICT r4 "what's weak" #1 — they diverge under rematerialization):
+
+  hfu — hardware FLOPs utilization: executed TFLOP/s over peak bf16
+        TFLOP/s, where executed FLOPs come from XLA's compiled-program
+        cost analysis (fwd+bwd+update, FMA = 2 FLOPs). Counts remat
+        RECOMPUTE, so it measures how busy the MXU is, not how much
+        useful model compute it delivers.
+  mfu — model FLOPs utilization: analytic, remat-invariant model FLOPs
+        over the same peak. For transformer LMs the PaLM-appendix-B
+        convention: 6 FLOPs per matmul parameter per token (fwd+bwd)
+        plus 12·L·T·d attention FLOPs (QK^T and AV, no causal
+        discount); embedding lookups are free, tied heads count once.
+        For the vision configs (which run without remat) executed ==
+        model FLOPs and mfu == hfu by construction.
+
+Configs should be compared on tokens/sec and mfu; hfu explains where the
+step time went (a remat config trades hfu for memory).
 
 vs_baseline for the headline divides by 600 img/s/chip — a typical Horovod
 ResNet-50/V100 fp16 figure from the reference's own benchmark suite docs.
@@ -74,8 +87,31 @@ def _measure(step, state, extra, steps):
     return dt, flops
 
 
-def _report(metric, unit, per_sec, dt, flops, vs_baseline=None):
+def _n_params(tree):
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def _lm_model_flops(n_matmul_params, n_layers, seq_len, d_attn, n_tokens):
+    """Analytic model FLOPs for one fwd+bwd step over ``n_tokens`` tokens.
+
+    PaLM Appendix-B accounting: each matmul parameter costs 2 FLOPs/token
+    forward and 4 backward (6 total); attention adds 12·L·T·d_attn per
+    token (QK^T + AV, forward 4·L·T·d, backward 2x). No causal discount —
+    the standard convention, so numbers are comparable with public MFU
+    tables. Remat-invariant by construction.
+    """
+    per_token = 6.0 * n_matmul_params + 12.0 * n_layers * seq_len * d_attn
+    return per_token * n_tokens
+
+
+def _report(metric, unit, per_sec, dt, flops, vs_baseline=None,
+            model_flops=None):
+    """``flops`` is executed (XLA cost analysis) -> hfu; ``model_flops``
+    is the analytic remat-invariant count -> mfu. When model_flops is
+    None (vision configs, no remat) the two coincide."""
     peak = _peak_tflops()
+    if model_flops is None:
+        model_flops = flops
     rec = {
         "metric": metric,
         "value": round(per_sec, 2),
@@ -84,9 +120,11 @@ def _report(metric, unit, per_sec, dt, flops, vs_baseline=None):
                         else None),
         "step_ms": round(dt * 1e3, 2),
         "achieved_tflops": round(flops / dt / 1e12, 1),
+        "model_tflops": round(model_flops / dt / 1e12, 1),
     }
     if peak:
-        rec["mfu"] = round(flops / dt / 1e12 / peak, 3)
+        rec["hfu"] = round(flops / dt / 1e12 / peak, 3)
+        rec["mfu"] = round(model_flops / dt / 1e12 / peak, 3)
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -142,7 +180,7 @@ def bench_resnet50(on_tpu):
                    vs_baseline=batch / dt / BASELINE_IMG_PER_SEC)
 
 
-def _bench_lm(params, tokens, loss_fn, steps, metric):
+def _bench_lm(params, tokens, loss_fn, steps, metric, model_flops=None):
     """loss_fn closes over its token batch (synthetic data is constant
     across steps); only the train state threads through the jit."""
     opt = hvd.DistributedOptimizer(optax.adamw(1e-4))
@@ -156,7 +194,8 @@ def _bench_lm(params, tokens, loss_fn, steps, metric):
 
     dt, flops = _measure(step, (params, opt_state), (), steps)
     n_tokens = tokens.shape[0] * tokens.shape[1]
-    return _report(metric, "tokens/sec/chip", n_tokens / dt, dt, flops)
+    return _report(metric, "tokens/sec/chip", n_tokens / dt, dt, flops,
+                   model_flops=model_flops)
 
 
 def bench_gpt2(on_tpu):
@@ -179,10 +218,15 @@ def bench_gpt2(on_tpu):
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)),
         jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # wpe is the only lookup-only table (wte counts once: the lookup is
+    # free, the tied logits matmul is not).
+    mflops = _lm_model_flops(
+        _n_params(params) - cfg.max_seq_len * cfg.d_model,
+        cfg.num_layers, T, cfg.d_model, B * T)
     return _bench_lm(
         params, tokens,
         lambda p: loss_fn(model.apply({"params": p}, tokens), tokens),
-        steps, "gpt2_medium_tokens_per_sec_per_chip")
+        steps, "gpt2_medium_tokens_per_sec_per_chip", model_flops=mflops)
 
 
 def bench_bert(on_tpu):
@@ -206,8 +250,16 @@ def bench_bert(on_tpu):
         mlm, _ = model.apply({"params": p}, tokens)
         return mlm_loss(mlm, tokens, mask_pos)
 
+    # Lookup-only tables: wpe + token-type wtt (wte is tied: lookup free,
+    # mlm-head matmul counted once). Bidirectional attention => full-T
+    # attention FLOPs are exact here, not a convention.
+    mflops = _lm_model_flops(
+        _n_params(params)
+        - (cfg.max_seq_len + cfg.type_vocab_size) * cfg.d_model,
+        cfg.num_layers, T, cfg.d_model, B * T)
     return _bench_lm(params, tokens, loss, steps,
-                     "bert_large_tokens_per_sec_per_chip")
+                     "bert_large_tokens_per_sec_per_chip",
+                     model_flops=mflops)
 
 
 def bench_vit(on_tpu):
@@ -360,14 +412,99 @@ def bench_gpt2_long(on_tpu):
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)),
         jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mflops = _lm_model_flops(
+        _n_params(params) - cfg.max_seq_len * cfg.d_model,
+        cfg.num_layers, T, cfg.d_model, B * T)
     return _bench_lm(
         params, tokens,
         lambda p: loss_fn(model.apply({"params": p}, tokens), tokens),
-        steps, "gpt2_medium_4k_tokens_per_sec_per_chip")
+        steps, "gpt2_medium_4k_tokens_per_sec_per_chip",
+        model_flops=mflops)
+
+
+def bench_llama(on_tpu):
+    """Llama-family config (GQA + RoPE + SwiGLU + RMSNorm): a ~340M
+    Llama-shaped decoder at 2048 tokens, flash attention, selective remat.
+    The flagship model family of the long-context fork needs its own perf
+    anchor (VERDICT r4 item 2); 7B does not fit one v5e chip's HBM for
+    training, so this is the largest round-number config that trains
+    comfortably at B=4 (params+AdamW fp32 ~4 GB, dots-remat activations
+    ~4.3 GB)."""
+    from horovod_tpu.models.llama import Llama, LlamaConfig, loss_fn
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, max_seq_len=2048, num_layers=24,
+            num_heads=16, num_kv_heads=4, d_model=1024, d_ff=2816,
+            attention="flash", remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "dots"))
+        B, T, steps = 4, 2048, 10
+    else:
+        cfg = LlamaConfig.tiny()
+        B, T, steps = 2, 64, 3
+    model = Llama(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # Only the embedding table is lookup-only (untied lm_head is a real
+    # matmul). GQA expands K/V to the query head count before attention,
+    # so attention FLOPs use full d_model.
+    mflops = _lm_model_flops(
+        _n_params(params) - cfg.vocab_size * cfg.d_model,
+        cfg.num_layers, T, cfg.d_model, B * T)
+    return _bench_lm(
+        params, tokens,
+        lambda p: loss_fn(model.apply({"params": p}, tokens), tokens),
+        steps, "llama_340m_gqa_tokens_per_sec_per_chip",
+        model_flops=mflops)
+
+
+def bench_gpt2_packed(on_tpu):
+    """Sequence-packed GPT-2 medium: the same compute shape as
+    ``bench_gpt2`` but every row carries several documents with segment
+    ids threading through the pallas flash kernel, packed positions, and
+    the packed loss. Measures the packing-machinery tax vs plain rows —
+    the number long-context users ask first."""
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+    from horovod_tpu.ops.attention import packed_positions
+    if on_tpu:
+        import dataclasses
+        cfg = dataclasses.replace(
+            GPT2Config.medium(), attention="flash", remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "dots"))
+        B, T, steps = 8, 1024, 10
+    else:
+        cfg = GPT2Config.tiny()
+        B, T, steps = 2, 64, 3
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    # ~4 documents per row: fixed boundaries keep shapes static and the
+    # workload reproducible; real pipelines vary them per batch.
+    bounds = np.sort(rng.integers(T // 8, T - T // 8, (B, 3)), axis=1)
+    seg = np.zeros((B, T), np.int32)
+    for b in range(B):
+        for cut in bounds[b]:
+            seg[b, cut:] += 1
+    seg = jnp.asarray(seg)
+    pos = packed_positions(seg)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mflops = _lm_model_flops(
+        _n_params(params) - cfg.max_seq_len * cfg.d_model,
+        cfg.num_layers, T, cfg.d_model, B * T)
+    return _bench_lm(
+        params, tokens,
+        lambda p: loss_fn(
+            model.apply({"params": p}, tokens, segment_ids=seg,
+                        positions=pos),
+            tokens, segment_ids=seg),
+        steps, "gpt2_medium_packed_tokens_per_sec_per_chip",
+        model_flops=mflops)
 
 
 _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
-            "gpt2_long": bench_gpt2_long,
+            "gpt2_long": bench_gpt2_long, "llama": bench_llama,
+            "gpt2_packed": bench_gpt2_packed,
             "bert": bench_bert, "vit": bench_vit, "mnist": bench_mnist,
             "allreduce": bench_allreduce}
 
@@ -379,10 +516,24 @@ def _inner_main(args):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     hvd.init()
     on_tpu = jax.default_backend() != "cpu"
+    if not on_tpu and not os.environ.get(
+            "JAX_PLATFORMS", "").startswith("cpu"):
+        # Nobody asked for CPU: jax fell back after a non-fatal relay
+        # failure. A "successful" run here would put CPU numbers under
+        # the TPU metric names — and the heal agenda would then mark the
+        # config captured at this revision and never re-bench it. Refuse.
+        print(json.dumps({
+            "metric": _HEADLINE_METRIC.get(
+                args.model, f"{args.model}_unavailable"),
+            "value": None, "unit": "unavailable", "vs_baseline": None,
+            "error": "backend fell back to cpu (TPU relay init failed "
+                     "mid-window); refusing to record CPU numbers under "
+                     "TPU metric names"}), flush=True)
+        return _RC_CPU_FALLBACK
     if args.model == "all":
         # headline (resnet50) last so single-line parsers read it.
         for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
-                     "gpt2_long", "resnet50"):
+                     "gpt2_long", "gpt2_packed", "llama", "resnet50"):
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)
@@ -392,10 +543,19 @@ _HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
                     "all": "resnet50_images_per_sec_per_chip",
                     "gpt2": "gpt2_medium_tokens_per_sec_per_chip",
                     "gpt2_long": "gpt2_medium_4k_tokens_per_sec_per_chip",
+                    "llama": "llama_340m_gqa_tokens_per_sec_per_chip",
+                    "gpt2_packed":
+                        "gpt2_medium_packed_tokens_per_sec_per_chip",
                     "bert": "bert_large_tokens_per_sec_per_chip",
                     "vit": "vit_b16_images_per_sec_per_chip",
                     "mnist": "mnist_images_per_sec_per_chip",
                     "allreduce": "allreduce_scaling_efficiency"}
+
+
+# Distinct child exit code for the "relay died between the probe and the
+# child's init, jax fell back to cpu" refusal — the supervisor must blame
+# the relay, not the code.
+_RC_CPU_FALLBACK = 3
 
 
 def _probe_backend(timeout_s: float) -> str:
@@ -431,7 +591,11 @@ def _supervise(args) -> int:
     probe_timeout = float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT", "60"))
     attempts = int(os.environ.get("HVD_BENCH_PROBE_ATTEMPTS", "5"))
     backoff = float(os.environ.get("HVD_BENCH_PROBE_BACKOFF", "90"))
-    run_timeout = float(os.environ.get("HVD_BENCH_RUN_TIMEOUT", "2700"))
+    # "all" is now 9 configs (llama + gpt2_packed joined in r5), two of
+    # them compile-heavy — give the multi-config run twice the budget so
+    # a healthy-but-slow sweep isn't mislabeled a relay wedge.
+    run_timeout = float(os.environ.get(
+        "HVD_BENCH_RUN_TIMEOUT", "5400" if args.model == "all" else "2700"))
 
     def give_up(reason, note, rc=0):
         print(json.dumps({
@@ -463,7 +627,7 @@ def _supervise(args) -> int:
                        relay_note)
 
     # Backend answers — run the real bench with a deadline in case the
-    # relay wedges mid-run (compiles + 6 configs fit well inside it).
+    # relay wedges mid-run.
     cmd = [sys.executable, os.path.abspath(__file__),
            "--model", args.model, "--inner"]
     try:
@@ -471,6 +635,11 @@ def _supervise(args) -> int:
     except subprocess.TimeoutExpired:
         return give_up(f"bench run exceeded {run_timeout:.0f}s "
                        f"(relay wedged mid-run)", relay_note)
+    if r.returncode == _RC_CPU_FALLBACK:
+        # The child itself diagnosed a mid-window relay death (cpu
+        # fallback) — that's a relay failure, not a code one.
+        return give_up("TPU relay died between the probe and the bench "
+                       "child's init (cpu fallback refused)", relay_note)
     if r.returncode != 0:
         # The probe just proved the relay reachable, so a crashing child
         # is most likely a CODE regression — say so and keep the nonzero

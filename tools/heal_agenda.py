@@ -9,16 +9,11 @@ wedge mid-agenda must RESUME the remaining items on the next heal, not
 abandon them. Items append to ``BENCH_SELF.jsonl`` (same record shape as
 ``tools/selfbench.py``) with a ``variant`` field for the BN experiments.
 
-Agenda, in order:
-  1. gpt2      — re-capture with the now-measured tile table (quantifies
-                 the tile retune vs the 28,263.7 tok/s pre-retune number)
-  2. gpt2 under HOROVOD_BENCH_REMAT=dots (selective-remat lever)
-  3. resnet50 under HOROVOD_BENCH_BN_STATS=bf16       (BN-ceiling exp 1)
-  4. resnet50 under HOROVOD_BENCH_STEM=s2d            (BN-ceiling exp 2)
-  5. resnet50 under both                              (BN-ceiling exp 3)
-  6. bert / vit / mnist — full-zoo refresh on current code
-  7. tools/bench_gpt2_sweep.py — batch x remat-policy x attention grid
-     (the sweep writes its own durable per-config log, SWEEP_GPT2.txt)
+Round-5 agenda (see ``AGENDA`` below): the full zoo at HEAD with the dual
+hfu/mfu accounting, llama + gpt2_packed first (never benched on-chip),
+then the r4 leftovers, then the gpt2 batch sweep. Restarts are idempotent:
+items with a success record in BENCH_SELF.jsonl at the current revision
+are skipped, so re-arming after editing AGENDA costs nothing.
 
 Usage: python tools/heal_agenda.py [--interval 900] [--deadline 36000]
 """
@@ -26,6 +21,7 @@ Usage: python tools/heal_agenda.py [--interval 900] [--deadline 36000]
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -36,21 +32,47 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 from selfbench import append_records, git_rev, probe, run_bench  # noqa: E402
 
-# Second-wave agenda (the first wave's gpt2 / gpt2+dots / bn_stats=bf16 /
-# stem=s2d records are already in BENCH_SELF.jsonl at git a973b65): the
-# remaining BN combo, HEAD-revision re-captures (the bench default is now
-# remat=dots + tuned tiles), the new 4k long-context config, and the zoo.
+# Round-5 agenda (VERDICT r4 item 1+2): every zoo config re-captured at
+# HEAD with the new dual hfu/mfu accounting, led by the two configs that
+# have never had an on-chip number (llama, gpt2_packed), then the r4
+# leftovers (BN combo, bert remat variants). Items already captured at
+# the CURRENT revision are skipped on restart (see _captured), so the
+# watcher can be killed and re-armed freely as HEAD moves.
 AGENDA = [
-    ("resnet50", {"HOROVOD_BENCH_BN_STATS": "bf16",
-                  "HOROVOD_BENCH_STEM": "s2d"}, "bn=bf16+stem=s2d"),
     ("gpt2", {}, None),
-    ("gpt2_long", {}, None),
+    ("llama", {}, None),
     ("resnet50", {}, None),
+    ("gpt2_long", {}, None),
+    ("gpt2_packed", {}, None),
     ("bert", {}, None),
     ("bert", {"HOROVOD_BENCH_REMAT": "dots"}, "remat=dots"),
     ("vit", {}, None),
     ("mnist", {}, None),
+    ("resnet50", {"HOROVOD_BENCH_BN_STATS": "bf16",
+                  "HOROVOD_BENCH_STEM": "s2d"}, "bn=bf16+stem=s2d"),
 ]
+
+
+def _captured(out_path: str, model: str, variant, rev: str) -> bool:
+    """True if BENCH_SELF already holds a SUCCESS record for this
+    (model, variant) at this git revision — makes agenda restarts
+    idempotent (the r4 pain point: the remaining-items list lived only in
+    process memory, so re-arming meant hand-pruning AGENDA)."""
+    try:
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (row.get("model") == model
+                        and row.get("variant") == variant
+                        and row.get("git") == rev
+                        and "error" not in row):
+                    return True
+    except OSError:
+        pass
+    return False
 
 
 def main(argv=None) -> int:
@@ -77,10 +99,14 @@ def main(argv=None) -> int:
             rev = git_rev()
             attempted = 0
             wedged = False
+            just_probed_ok = False
             while remaining:
                 # re-probe between items: a wedge mid-agenda must not
-                # burn the bench timeout once per remaining item
-                if attempted and probe(args.probe_timeout) != "ok":
+                # burn the bench timeout once per remaining item (but a
+                # probe that just passed on the failure path below counts
+                # — no back-to-back probe subprocesses in a scarce window)
+                if (attempted and not just_probed_ok
+                        and probe(args.probe_timeout) != "ok"):
                     print("# relay wedged mid-agenda; "
                           f"{len(remaining)} item(s) resume on next heal",
                           flush=True)
@@ -88,8 +114,14 @@ def main(argv=None) -> int:
                     break
                 model, env_extra, variant = remaining[0]
                 label = f"{model}" + (f" [{variant}]" if variant else "")
+                if _captured(args.out, model, variant, rev):
+                    print(f"# {label} already captured at {rev}; skipping",
+                          flush=True)
+                    remaining.pop(0)
+                    continue
                 print(f"# capturing {label}...", flush=True)
                 attempted += 1
+                just_probed_ok = False
                 recs = run_bench(model, args.bench_timeout,
                                  env_extra=env_extra)
                 append_records(args.out, model, recs, rev, variant=variant)
@@ -97,12 +129,20 @@ def main(argv=None) -> int:
                     print(r, flush=True)
                 if any("error" not in r for r in recs):
                     remaining.pop(0)   # captured; never re-run
-                # on error: keep it at the head — the next probe decides
-                # whether this was a wedge or a per-config failure
+                # on error, one probe decides: relay up = per-config
+                # failure (skip it), relay down = wedge (break; the item
+                # stays at the head and resumes on the next heal)
                 elif probe(args.probe_timeout) == "ok":
                     print(f"# {label} failed but relay is up; skipping it",
                           flush=True)
                     remaining.pop(0)
+                    just_probed_ok = True
+                else:
+                    print(f"# relay wedged during {label}; "
+                          f"{len(remaining)} item(s) resume on next heal",
+                          flush=True)
+                    wedged = True
+                    break
             if not remaining and not wedged and sweep_pending:
                 print("# running gpt2 batch sweep...", flush=True)
                 try:
