@@ -69,13 +69,25 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
                           chain: int = 8,
                           record: bool = False,
                           record_kind: Optional[str] = None,
-                          record_path=None):
+                          record_path=None,
+                          tune_backward: bool = False):
     """Measure flash-attention (block_q, block_k) tilings on this device.
 
     The best tiles depend on head_dim, sequence length and VMEM pressure
     from the backward kernels. Returns ``((block_q, block_k), trials_dict)``
     where ``trials_dict`` maps each candidate to measured seconds per
     attention invocation (fwd+bwd when ``include_backward``).
+
+    ``tune_backward=True`` adds a second, separately-priced phase: with
+    the forward tiles pinned at the phase-1 winner, each candidate is
+    re-timed as the BACKWARD tiling (``block_q_bwd``/``block_k_bwd`` of
+    ``flash_attention`` — the dQ and dK/dV kernels carry two extra fp32
+    VMEM accumulators per tile, so their optimum can differ). Returns
+    ``((bq, bk, bq_bwd, bk_bwd), trials)`` with phase-2 trials keyed
+    ``("bwd", bq, bk)``, and ``record=True`` writes a ``-fwdbwd`` entry
+    carrying all four tile dims. A joint 2-D sweep would square the
+    candidate count — over a remote PJRT relay where each differentiated
+    pallas compile is minutes, pinned-then-sweep is the practical shape.
 
     ``chain`` kernel invocations are scanned inside ONE jit (each step's
     output feeds the next step's queries), so a single dispatch carries
@@ -133,58 +145,88 @@ def autotune_flash_blocks(q_shape, dtype="bfloat16", causal: bool = True,
     q, k, v = (jnp.asarray(rng.standard_normal(q_shape), dtype)
                for _ in range(3))
 
-    trials: Dict[tuple, float] = {}
-    last_error: Optional[Exception] = None
-    for bq, bk in candidates:
-        def chained(q, k, v, bq=bq, bk=bk):
+    def _sync(out):
+        # Host fetch: block_until_ready is unreliable over some PJRT
+        # transports (see ROOFLINE.md); fetching one element of the
+        # last result bounds the serialized device queue. Slice ON
+        # DEVICE first so only one scalar crosses the transport — a
+        # full-tensor device_get would land inside the timed window.
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(leaf.ravel()[:1]))
+
+    def make_fn(bq, bk, bqb, bkb, backward):
+        def chained(q, k, v):
             def body(c, _):
                 o = flash_attention(c, k, v, causal=causal, block_q=bq,
-                                    block_k=bk)
+                                    block_k=bk, block_q_bwd=bqb,
+                                    block_k_bwd=bkb)
                 return o.astype(c.dtype), None
             out, _ = lax.scan(body, q, None, length=chain)
             return out
 
-        if include_backward:
-            fn = jax.jit(jax.grad(
-                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
-                    chained(q, k, v, bq, bk).astype(jnp.float32) ** 2),
+        if backward:
+            return jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    chained(q, k, v).astype(jnp.float32) ** 2),
                 argnums=(0, 1, 2)))
-        else:
-            fn = jax.jit(chained)
-        def _sync(out):
-            # Host fetch: block_until_ready is unreliable over some PJRT
-            # transports (see ROOFLINE.md); fetching one element of the
-            # last result bounds the serialized device queue. Slice ON
-            # DEVICE first so only one scalar crosses the transport — a
-            # full-tensor device_get would land inside the timed window.
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            np.asarray(jax.device_get(leaf.ravel()[:1]))
+        return jax.jit(chained)
 
+    last_error: Optional[Exception] = None
+
+    def time_candidate(fn):
+        nonlocal last_error
         try:
             out = fn(q, k, v)
             _sync(out)
         except Exception as e:  # tiling not compilable for this shape
             last_error = e
-            continue
+            return None
         t0 = time.perf_counter()
         for _ in range(steps_per_trial):
             out = fn(q, k, v)
         _sync(out)
-        trials[(bq, bk)] = (time.perf_counter() - t0) / steps_per_trial \
-            / max(chain, 1)
+        return (time.perf_counter() - t0) / steps_per_trial / max(chain, 1)
+
+    trials: Dict[tuple, float] = {}
+    for bq, bk in candidates:
+        t = time_candidate(make_fn(bq, bk, bq, bk, include_backward))
+        if t is not None:
+            trials[(bq, bk)] = t
     if not trials:
         raise RuntimeError(
             f"no flash tiling compiled for shape {q_shape}") from last_error
     best = min(trials, key=trials.get)
+
+    bwd_best = None
+    if tune_backward:
+        # Phase 2: forward tiles pinned at the winner; each candidate now
+        # times the BACKWARD kernels' tiling on full fwd+bwd probes.
+        fq, fk = best
+        bwd_trials: Dict[tuple, float] = {}
+        for bq, bk in candidates:
+            t = time_candidate(make_fn(fq, fk, bq, bk, True))
+            if t is not None:
+                bwd_trials[(bq, bk)] = t
+                trials[("bwd", bq, bk)] = t
+        if bwd_trials:
+            bwd_best = min(bwd_trials, key=bwd_trials.get)
+            best = (fq, fk) + bwd_best
+
     if record:
+        extra = {}
+        us = trials[best] if bwd_best is None else bwd_trials[bwd_best]
+        if bwd_best is not None:
+            extra = dict(block_q_bwd=bwd_best[0], block_k_bwd=bwd_best[1])
+            suffix = "-fwdbwd"
+        else:
+            suffix = "" if include_backward else "-fwdonly"
         tile_table.record(
             head_dim=q_shape[-1], seq=q_shape[1], dtype=dtype, kind=kind,
             block_q=best[0], block_k=best[1],
-            us_per_call=trials[best] * 1e6,
-            source=f"tuned-{jax.default_backend()}"
-                   + ("" if include_backward else "-fwdonly"),
+            us_per_call=us * 1e6,
+            source=f"tuned-{jax.default_backend()}" + suffix,
             device=jax.devices()[0].device_kind,
-            path=record_path)
+            path=record_path, **extra)
     return best, trials
 
 

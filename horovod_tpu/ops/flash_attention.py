@@ -470,23 +470,29 @@ def _bwd(h, scale, causal, block_q, block_k, res, do, delta=None,
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, bias, seg, h, scale, causal, block_q, block_k, offset):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11,
+                                                    12))
+def _flash(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
+           block_q_bwd, block_k_bwd, offset):
     o, _ = _fwd(q, k, v, bias, seg, seg, h, scale, causal, block_q,
                 block_k, offset=offset)
     return o
 
 
 def _flash_fwd(q, k, v, bias, seg, h, scale, causal, block_q, block_k,
-               offset):
+               block_q_bwd, block_k_bwd, offset):
     o, lse = _fwd(q, k, v, bias, seg, seg, h, scale, causal, block_q,
                   block_k, offset=offset)
     return o, (q, k, v, bias, seg, seg, o, lse)
 
 
-def _flash_bwd(h, scale, causal, block_q, block_k, offset, res, do):
-    dq, dk, dv, dbias = _bwd(h, scale, causal, block_q, block_k, res, do,
-                             offset=offset)
+def _flash_bwd(h, scale, causal, block_q, block_k, block_q_bwd,
+               block_k_bwd, offset, res, do):
+    # The backward kernels' VMEM profile differs from the forward's (two
+    # extra fp32 accumulators per tile), so they may want their own tiles
+    # — measured entries carry them (tile_table "tuned-*-fwdbwd").
+    dq, dk, dv, dbias = _bwd(h, scale, causal, block_q_bwd, block_k_bwd,
+                             res, do, offset=offset)
     seg = res[4]  # res = (q, k, v, bias, seg, seg, o, lse)
     # Integer segment ids take a symbolic-zero (float0) cotangent.
     dseg = (None if seg is None
@@ -503,6 +509,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     segment_ids: Optional[jnp.ndarray] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     causal_offset: int = 0) -> jnp.ndarray:
     """Fused attention ``softmax(q k^T * scale + key_bias [+ mask]) v``.
 
@@ -531,6 +539,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         fwd+bwd — 128-tiles drown in per-step grid overhead, and 512x512
         Q-blocks overflow VMEM in the backward kernels (score temporaries
         spill). Ragged edges are position-masked.
+      block_q_bwd, block_k_bwd: tile sizes for the backward (dQ and
+        dK/dV) kernels, whose VMEM profile differs from the forward's.
+        ``None`` consults the tile table (``tuned-*-fwdbwd`` entries from
+        the differentiated-kernel sweep carry measured values); entries
+        without them fall back to the forward tiles.
 
     Returns (batch, t_q, heads, head_dim), same dtype as ``q``.
     """
@@ -541,12 +554,21 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          f"got {tq} != {tk}")
     scale = d ** -0.5 if scale is None else scale
 
-    if block_q is None or block_k is None:
+    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
         from horovod_tpu.ops import tile_table
-        tq_, tk_ = tile_table.lookup(d, max(tq, tk), q.dtype,
-                                     "causal" if causal else "full")
+        tq_, tk_, tqb_, tkb_ = tile_table.lookup_full(
+            d, max(tq, tk), q.dtype, "causal" if causal else "full")
         block_q = tq_ if block_q is None else block_q
         block_k = tk_ if block_k is None else block_k
+        # Explicit fwd tiles with no explicit bwd tiles: share the fwd
+        # tiles (pre-r5 behavior) rather than mixing the caller's fwd
+        # choice with a table bwd entry tuned for different fwd tiles.
+        if block_q_bwd is None:
+            block_q_bwd = tqb_ if tq_ == block_q and tk_ == block_k \
+                else block_q
+        if block_k_bwd is None:
+            block_k_bwd = tkb_ if tq_ == block_q and tk_ == block_k \
+                else block_k
 
     # (B, T, H, D) -> (B*H, T, D): each grid row owns one head's sequence.
     def pack(x):
@@ -570,5 +592,5 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     o = _flash(pack(q), pack(k), pack(v), key_bias, seg, h, float(scale),
                bool(causal), int(block_q), int(block_k),
-               int(causal_offset))
+               int(block_q_bwd), int(block_k_bwd), int(causal_offset))
     return o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

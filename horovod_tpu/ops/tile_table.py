@@ -36,8 +36,8 @@ import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["lookup", "record", "load_table", "save_table", "table_path",
-           "DEFAULT_TILES", "KINDS"]
+__all__ = ["lookup", "lookup_full", "record", "load_table", "save_table",
+           "table_path", "DEFAULT_TILES", "KINDS"]
 
 DEFAULT_TILES = (256, 512)   # measured fastest on v5e (ROOFLINE.md r1)
 KINDS = ("causal", "full", "ring")
@@ -116,6 +116,25 @@ def _distance(e: dict, head_dim: int, seq: int, dtype: str,
     return d
 
 
+def _best_entry(head_dim: int, seq: int, dtype: str, kind: str,
+                path: Optional[os.PathLike]) -> Optional[dict]:
+    """Nearest valid entry (valid = parseable positive fwd tiles), or
+    None when the table is missing/empty/malformed."""
+    table = load_table(path)
+    best, best_d = None, float("inf")
+    for e in table.get("entries") or []:
+        try:
+            d = _distance(e, head_dim, seq, dtype, kind)
+            bq, bk = int(e["block_q"]), int(e["block_k"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if bq <= 0 or bk <= 0:
+            continue
+        if d < best_d:
+            best, best_d = e, d
+    return best
+
+
 def lookup(head_dim: int, seq: int, dtype, kind: str,
            path: Optional[os.PathLike] = None) -> Tuple[int, int]:
     """Best-known (block_q, block_k) for this attention shape.
@@ -128,34 +147,53 @@ def lookup(head_dim: int, seq: int, dtype, kind: str,
     if kind not in KINDS:
         raise ValueError(f"unknown tile kind {kind!r}; expected one of "
                          f"{KINDS}")
-    dtype = str(dtype)
-    table = load_table(path)
-    entries: List[dict] = table.get("entries") or []
-    best, best_d = None, float("inf")
-    for e in entries:
-        try:
-            d = _distance(e, head_dim, seq, dtype, kind)
-            tiles = (int(e["block_q"]), int(e["block_k"]))
-        except (KeyError, TypeError, ValueError):
-            continue
-        if tiles[0] <= 0 or tiles[1] <= 0:
-            continue
-        if d < best_d:
-            best, best_d = tiles, d
-    if best is not None:
-        return best
+    e = _best_entry(head_dim, seq, str(dtype), kind, path)
+    if e is not None:
+        return int(e["block_q"]), int(e["block_k"])
     try:
-        default = table.get("default") or {}
+        default = load_table(path).get("default") or {}
         return (int(default.get("block_q", DEFAULT_TILES[0])),
                 int(default.get("block_k", DEFAULT_TILES[1])))
     except (TypeError, ValueError, AttributeError):
         return DEFAULT_TILES
 
 
+def lookup_full(head_dim: int, seq: int, dtype, kind: str,
+                path: Optional[os.PathLike] = None
+                ) -> Tuple[int, int, int, int]:
+    """``(block_q, block_k, block_q_bwd, block_k_bwd)`` for this shape.
+
+    Backward-specific tiles exist only in ``tuned-*-fwdbwd`` entries (the
+    differentiated-kernel sweep); entries without them — or with
+    malformed bwd fields — and the table default reuse the forward tiles
+    for the backward kernels, which is the pre-r5 behavior. Entry
+    selection is shared with ``lookup`` (``_best_entry``), so the two can
+    never disagree about the forward tiles.
+    """
+    if kind not in KINDS:
+        raise ValueError(f"unknown tile kind {kind!r}; expected one of "
+                         f"{KINDS}")
+    e = _best_entry(head_dim, seq, str(dtype), kind, path)
+    if e is None:
+        bq, bk = lookup(head_dim, seq, dtype, kind, path)  # default path
+        return bq, bk, bq, bk
+    bq, bk = int(e["block_q"]), int(e["block_k"])
+    try:
+        bqb, bkb = int(e.get("block_q_bwd") or bq), \
+            int(e.get("block_k_bwd") or bk)
+        if bqb <= 0 or bkb <= 0:
+            bqb, bkb = bq, bk
+    except (TypeError, ValueError):
+        bqb, bkb = bq, bk
+    return bq, bk, bqb, bkb
+
+
 def record(head_dim: int, seq: int, dtype, kind: str, block_q: int,
            block_k: int, us_per_call: Optional[float] = None,
            source: str = "tuned", device: Optional[str] = None,
-           path: Optional[os.PathLike] = None) -> Path:
+           path: Optional[os.PathLike] = None,
+           block_q_bwd: Optional[int] = None,
+           block_k_bwd: Optional[int] = None) -> Path:
     """Insert-or-replace one measured entry and rewrite the table file."""
     if kind not in KINDS:
         raise ValueError(f"unknown tile kind {kind!r}; expected one of "
@@ -170,10 +208,15 @@ def record(head_dim: int, seq: int, dtype, kind: str, block_q: int,
         e for e in table.get("entries", [])
         if (e.get("head_dim"), e.get("seq"), e.get("dtype"),
             e.get("kind")) != key]
-    table["entries"].append({
+    entry = {
         "head_dim": int(head_dim), "seq": int(seq), "dtype": str(dtype),
         "kind": kind, "block_q": int(block_q), "block_k": int(block_k),
         "us_per_call": (None if us_per_call is None
                         else round(float(us_per_call), 2)),
-        "source": source})
+        "source": source}
+    if block_q_bwd is not None:
+        entry["block_q_bwd"] = int(block_q_bwd)
+    if block_k_bwd is not None:
+        entry["block_k_bwd"] = int(block_k_bwd)
+    table["entries"].append(entry)
     return save_table(table, p)

@@ -121,26 +121,27 @@ def test_shipped_table_is_valid():
 
 def test_flash_attention_consults_table(monkeypatch):
     """flash_attention with no explicit tiles asks the table with the
-    right key and uses the answer."""
+    right key and uses the answer (lookup_full: fwd + bwd tiles)."""
     import importlib
     fa = importlib.import_module("horovod_tpu.ops.flash_attention")
     calls = []
-    real = tile_table.lookup
+    real = tile_table.lookup_full
 
     def spy(head_dim, seq, dtype, kind, path=None):
         calls.append((head_dim, seq, str(dtype), kind))
         return real(head_dim, seq, dtype, kind, path)
 
-    monkeypatch.setattr(tile_table, "lookup", spy)
+    monkeypatch.setattr(tile_table, "lookup_full", spy)
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((1, 64, 2, 16)), jnp.float32)
     out = fa.flash_attention(q, q, q, causal=True)
     assert out.shape == q.shape
     assert calls == [(16, 64, "float32", "causal")]
 
-    # Explicit tiles bypass the table.
+    # Explicit fwd+bwd tiles bypass the table entirely.
     calls.clear()
-    fa.flash_attention(q, q, q, causal=False, block_q=32, block_k=32)
+    fa.flash_attention(q, q, q, causal=False, block_q=32, block_k=32,
+                       block_q_bwd=32, block_k_bwd=32)
     assert calls == []
 
 
@@ -154,12 +155,18 @@ def test_ring_and_ulysses_consult_table(monkeypatch):
 
     seen = []
     real = tile_table.lookup
+    real_full = tile_table.lookup_full
 
     def spy(head_dim, seq, dtype, kind, path=None):
         seen.append(kind)
         return real(head_dim, seq, dtype, kind, path)
 
+    def spy_full(head_dim, seq, dtype, kind, path=None):
+        seen.append(kind)
+        return real_full(head_dim, seq, dtype, kind, path)
+
     monkeypatch.setattr(tile_table, "lookup", spy)
+    monkeypatch.setattr(tile_table, "lookup_full", spy_full)
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.standard_normal((1, 64, 8, 8)), jnp.float32)
 
@@ -189,3 +196,64 @@ def test_autotune_records_to_table(tmp_path):
         include_backward=False, record=True, record_path=p)
     assert best in trials
     assert tile_table.lookup(16, 64, "float32", "causal", path=p) == best
+
+
+def test_lookup_full_defaults_bwd_to_fwd(tmp_table):
+    # Entries without bwd dims (the whole pre-r5 table): bwd == fwd.
+    assert tile_table.lookup_full(64, 1024, "bfloat16", "causal",
+                                  path=tmp_table) == (256, 512, 256, 512)
+
+
+def test_record_and_lookup_bwd_tiles(tmp_table):
+    tile_table.record(64, 1024, "bfloat16", "causal", 256, 512,
+                      us_per_call=9.0, source="tuned-tpu-fwdbwd",
+                      path=tmp_table, block_q_bwd=128, block_k_bwd=1024)
+    assert tile_table.lookup_full(64, 1024, "bfloat16", "causal",
+                                  path=tmp_table) == (256, 512, 128, 1024)
+    # The fwd-only lookup is unchanged by the bwd dims.
+    assert tile_table.lookup(64, 1024, "bfloat16", "causal",
+                             path=tmp_table) == (256, 512)
+    entry = [e for e in tile_table.load_table(tmp_table)["entries"]
+             if e.get("source") == "tuned-tpu-fwdbwd"]
+    assert entry and entry[0]["block_q_bwd"] == 128
+
+
+def test_flash_grads_match_across_bwd_tiles():
+    """Distinct backward tiles are a pure performance knob: gradients
+    must be identical to the shared-tile backward."""
+    import jax
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 64, 2, 16)),
+                           jnp.float32) for _ in range(3))
+
+    def loss(q, k, v, **tiles):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, **tiles) ** 2)
+
+    g_shared = jax.grad(loss, argnums=(0, 1, 2))(
+        q, k, v, block_q=32, block_k=32, block_q_bwd=32, block_k_bwd=32)
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(
+        q, k, v, block_q=32, block_k=32, block_q_bwd=16, block_k_bwd=64)
+    for a, b in zip(g_shared, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_autotune_tune_backward_records_fwdbwd_entry(tmp_path):
+    from horovod_tpu.autotune import autotune_flash_blocks
+    p = tmp_path / "tuned.json"
+    best, trials = autotune_flash_blocks(
+        (1, 64, 2, 16), dtype="float32", causal=True,
+        candidates=[(32, 32), (64, 64)], steps_per_trial=1, chain=1,
+        include_backward=False, tune_backward=True, record=True,
+        record_path=p)
+    assert len(best) == 4
+    assert any(k[0] == "bwd" for k in trials)
+    entry = tile_table.load_table(p)["entries"][0]
+    assert entry["source"].endswith("-fwdbwd")
+    assert (entry["block_q"], entry["block_k"],
+            entry["block_q_bwd"], entry["block_k_bwd"]) == best
+    assert tile_table.lookup_full(16, 64, "float32", "causal",
+                                  path=p) == best

@@ -53,6 +53,21 @@ AGENDA = [
 ]
 
 
+def _has_fwdbwd_table_entries() -> bool:
+    """True if the shipped tile table already carries any backward-swept
+    entry — a partially-finished ``tune_tiles --fwdbwd`` run still makes
+    the model-level re-capture worth doing (entries are recorded
+    incrementally, headline shape first)."""
+    p = os.path.join(REPO, "horovod_tpu", "ops", "flash_tiles.json")
+    try:
+        with open(p) as f:
+            t = json.load(f)
+        return any(str(e.get("source", "")).endswith("-fwdbwd")
+                   for e in t.get("entries", []))
+    except (OSError, ValueError):
+        return False
+
+
 def _captured(out_path: str, model: str, variant, rev: str) -> bool:
     """True if BENCH_SELF already holds a SUCCESS record for this
     (model, variant) at this git revision — makes agenda restarts
@@ -85,6 +100,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     remaining = list(AGENDA)
+    tiles_pending = True
+    tilecap_pending = True
     sweep_pending = True
     t0 = time.time()
     attempt = 0
@@ -143,6 +160,54 @@ def main(argv=None) -> int:
                           flush=True)
                     wedged = True
                     break
+            if not remaining and not wedged and tiles_pending:
+                # Backward-included tile sweep (VERDICT r4 next #3): the
+                # table gains block_q_bwd/block_k_bwd for the headline
+                # shapes, then gpt2 is re-captured so the model-level
+                # delta of the bwd tiles is measured, not assumed.
+                print("# running fwdbwd tile sweep...", flush=True)
+                try:
+                    r = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "tune_tiles.py"),
+                         "--fwdbwd"],
+                        timeout=2 * args.bench_timeout, cwd=REPO,
+                        capture_output=True, text=True)
+                    print((r.stdout or r.stderr).strip()[-600:], flush=True)
+                    tiles_pending = r.returncode != 0
+                except subprocess.TimeoutExpired:
+                    print("# fwdbwd sweep timed out", flush=True)
+                if tiles_pending and probe(args.probe_timeout) != "ok":
+                    wedged = True     # wedge mid-sweep: retry next heal
+                elif tiles_pending:
+                    # Healthy relay but the sweep failed/over-ran: don't
+                    # retry it, but keep the re-capture if any shape's
+                    # bwd tiles already landed in the table.
+                    tiles_pending = False
+                    tilecap_pending = _has_fwdbwd_table_entries()
+                    print("# fwdbwd sweep incomplete with relay up; "
+                          f"dropping (re-capture: {tilecap_pending})",
+                          flush=True)
+            if (not remaining and not wedged and not tiles_pending
+                    and tilecap_pending):
+                # Model-level delta of the bwd tiles: retried on later
+                # heals (cheap) without re-running the sweep (expensive).
+                if _captured(args.out, "gpt2", "tiles=fwdbwd", rev):
+                    tilecap_pending = False
+                else:
+                    recs = run_bench("gpt2", args.bench_timeout)
+                    append_records(args.out, "gpt2", recs, rev,
+                                   variant="tiles=fwdbwd")
+                    for r in recs:
+                        print(r, flush=True)
+                    tilecap_pending = not any("error" not in r
+                                              for r in recs)
+                    # A failed capture may be a fresh wedge: probe before
+                    # letting the batch sweep burn 2x bench_timeout
+                    # against a dead relay.
+                    if (tilecap_pending
+                            and probe(args.probe_timeout) != "ok"):
+                        wedged = True
             if not remaining and not wedged and sweep_pending:
                 print("# running gpt2 batch sweep...", flush=True)
                 try:
@@ -169,7 +234,8 @@ def main(argv=None) -> int:
                     else:
                         print("# sweep timed out (wedge mid-sweep); "
                               "re-fires on next heal", flush=True)
-            if not remaining and not sweep_pending:
+            if (not remaining and not tiles_pending
+                    and not tilecap_pending and not sweep_pending):
                 print("# agenda complete", flush=True)
                 return 0
         if time.time() - t0 + args.interval > args.deadline:
