@@ -137,9 +137,104 @@ def _shard(n_rows: int, rank: int, world: int):
     return lo, hi
 
 
+def _split_validation(columns: Dict[str, np.ndarray], validation,
+                      seed: int):
+    """Split columns into (train, val) the upstream way
+    (``horovod/spark/common/params.py`` ``validation``): a float in (0, 1)
+    holds out that fraction of rows (deterministic shuffle on ``seed``); a
+    string names a column whose truthy rows are validation (the marker
+    column is dropped from both splits). ``None`` -> no validation.
+    """
+    if validation is None:
+        return columns, None
+    n = len(next(iter(columns.values())))
+    if isinstance(validation, str):
+        if validation not in columns:
+            raise KeyError(f"validation column {validation!r} not in "
+                           f"dataset columns {sorted(columns)}")
+        mask = np.asarray(columns[validation]).astype(bool)
+        rest = {k: v for k, v in columns.items() if k != validation}
+        train = {k: v[~mask] for k, v in rest.items()}
+        val = {k: v[mask] for k, v in rest.items()}
+    elif isinstance(validation, float):
+        if not 0.0 < validation < 1.0:
+            raise ValueError(f"validation fraction must be in (0, 1), "
+                             f"got {validation}")
+        n_val = max(1, int(round(n * validation)))
+        perm = np.random.default_rng(seed).permutation(n)
+        vi, ti = np.sort(perm[:n_val]), np.sort(perm[n_val:])
+        train = {k: v[ti] for k, v in columns.items()}
+        val = {k: v[vi] for k, v in columns.items()}
+    else:
+        raise TypeError(
+            f"validation must be None, a float fraction, or a column "
+            f"name; got {type(validation)}")
+    if not len(next(iter(train.values()))):
+        # Both paths can empty the train split (fraction ~1, or an
+        # all-truthy marker column) — an untrained model with nan losses
+        # must not come back looking like success.
+        raise ValueError(f"validation={validation!r} leaves no training "
+                         f"rows (n={n})")
+    if not len(next(iter(val.values()))):
+        return train, None
+    return train, val
+
+
+def _val_partition(val_data, feature_col: str, label_col: str,
+                   rank: int, world: int):
+    """This worker's validation rows (contiguous slice of the in-memory
+    columns, or the rank's round-robin store shards). Evaluation has no
+    collectives, so empty partitions are fine — the driver weights each
+    rank's per-epoch val loss by its row count."""
+    if val_data is None:
+        return None, None
+    if isinstance(val_data, StoreDataRef):
+        from horovod_tpu.data.store import ShardedDatasetReader
+        cols = ShardedDatasetReader(val_data.store, val_data.path, rank,
+                                    world).load_columns()
+        return cols[feature_col], cols[label_col]
+    feats, labels = val_data[feature_col], val_data[label_col]
+    lo, hi = _shard(len(feats), rank, world)
+    return feats[lo:hi], labels[lo:hi]
+
+
+def _weighted_val_history(results) -> Optional[list]:
+    """Combine per-rank per-epoch val losses into one series, weighted by
+    each rank's validation row count (partitions are uneven in general)."""
+    if not any(r.get("val_history") for r in results):
+        return None
+    epochs = max(len(r["val_history"]) for r in results
+                 if r.get("val_history"))
+    out = []
+    for e in range(epochs):
+        num = den = 0.0
+        for r in results:
+            hist, rows = r.get("val_history"), r.get("val_rows", 0)
+            # rows == 0 is the only exclusion (the empty-partition nan
+            # sentinel); a rank whose loss diverged to nan/inf must
+            # poison the combined number, not silently drop out.
+            if hist and e < len(hist) and rows:
+                num += hist[e] * rows
+                den += rows
+        out.append(num / den if den else float("nan"))
+    return out
+
+
+def _epoch_metrics(results) -> Dict[str, list]:
+    """The per-epoch metrics history attached to the fitted model
+    (upstream models expose ``getHistory()``; here it's ``.history``)."""
+    rank0 = next(r for r in results if r["rank"] == 0)
+    metrics = {"train_loss": list(rank0["history"])}
+    val = _weighted_val_history(results)
+    if val is not None:
+        metrics["val_loss"] = val
+    return metrics
+
+
 def _fit_worker(model_bytes: bytes, data,
                 feature_col: str, label_col: str,
-                lr: float, epochs: int, batch_size: int, seed: int):
+                lr: float, epochs: int, batch_size: int, seed: int,
+                val_data=None):
     """Runs on every worker with hvd initialized (backend contract).
 
     The sync pattern is the upstream torch-estimator one: local backward,
@@ -209,7 +304,28 @@ def _fit_worker(model_bytes: bytes, data,
             idx = order[i * bs:(i + 1) * bs]
             yield {feature_col: feats[idx], label_col: labels[idx]}
 
+    @jax.jit
+    def eval_loss(params, x, y):
+        return loss_fn(model.apply({"params": params}, x), y)
+
+    vx, vy = _val_partition(val_data, feature_col, label_col, rank, world)
+    val_rows = 0 if vx is None else len(vx)
+
+    def val_epoch(params):
+        """Mean val loss over this rank's val rows — no collectives (the
+        driver weights ranks by row count), val rows NEVER see a
+        gradient."""
+        if not val_rows:
+            return float("nan")
+        total = 0.0
+        for i in range(0, val_rows, bs):
+            xb, yb = vx[i:i + bs], vy[i:i + bs]
+            total += float(eval_loss(params, jnp.asarray(xb),
+                                     jnp.asarray(yb))) * len(xb)
+        return total / val_rows
+
     history = []
+    val_history = []
     for epoch in range(epochs):
         losses = []
         for batch in epoch_batches(epoch):
@@ -224,10 +340,14 @@ def _fit_worker(model_bytes: bytes, data,
             params, opt_state = apply(params, opt_state, grads)
             losses.append(float(l))
         history.append(float(np.mean(losses)) if losses else float("nan"))
+        if val_data is not None:
+            val_history.append(val_epoch(params))
 
     params_np = jax.tree_util.tree_map(np.asarray, params)
     return {"rank": rank, "world": world, "params": params_np,
             "history": history,
+            "val_history": val_history if val_data is not None else None,
+            "val_rows": val_rows,
             "files_read": sorted(set(reader.files_read))
             if reader is not None else None}
 
@@ -238,11 +358,20 @@ class JaxModel:
     the model to new data."""
 
     def __init__(self, model: Any, params: Any, feature_col: str,
-                 output_col: str = "prediction"):
+                 output_col: str = "prediction",
+                 history: Optional[Dict[str, list]] = None):
         self.model = model
         self.params = params
         self.feature_col = feature_col
         self.output_col = output_col
+        # Per-epoch metrics from fit: {"train_loss": [...]} plus
+        # "val_loss" when the estimator had validation= (upstream models
+        # expose the keras History the same way).
+        self.history = history or {}
+
+    def get_history(self) -> Dict[str, list]:
+        """Upstream-style accessor for the per-epoch metrics."""
+        return self.history
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
@@ -263,33 +392,65 @@ class _StoreFitMixin:
     (upstream ``horovod/spark/common/util.prepare_data``)."""
 
     def _prepare_data(self, df: Any):
-        """With a store, materialise the columns once and hand workers a
-        :class:`StoreDataRef`; otherwise ship the columns in the payload.
-        ``df=None`` with a store reuses data already materialised under
-        this run_id (``fit_on_store``)."""
+        """Resolve ``(train_data, val_data)`` for the workers.
+
+        With a store, materialise the columns once — the train split under
+        ``train_data_path`` and (when ``validation`` asks for one) the val
+        split under ``val_data_path``, upstream's two-dataset run layout —
+        and hand workers :class:`StoreDataRef`\\ s; otherwise ship the
+        split columns in the payload. ``df=None`` with a store reuses data
+        already materialised under this run_id (``fit_on_store``),
+        including a previously-written val split if one exists.
+        """
+        validation = getattr(self, "validation", None)
         if self.store is None:
             columns = _to_columns(df)
             self._check_cols(sorted(columns))
-            return columns
+            return _split_validation(columns, validation, self.seed)
         from horovod_tpu.data import store as dstore
         path = self.store.train_data_path(self.run_id)
+        val_path = self.store.val_data_path(self.run_id)
         if df is not None:
             columns = _to_columns(df)
             self._check_cols(sorted(columns))
-            dstore.write_dataset(
-                columns, self.store, path,
-                num_shards=self.num_shards or 2 * self.backend.num_workers,
-                fmt=self.data_format)
-        else:
-            meta = dstore.read_meta(self.store, path)
-            self._check_cols(sorted(meta["columns"]))
-        return StoreDataRef(self.store, path)
+            train, val = _split_validation(columns, validation, self.seed)
+            num_shards = self.num_shards or 2 * self.backend.num_workers
+            dstore.write_dataset(train, self.store, path,
+                                 num_shards=num_shards,
+                                 fmt=self.data_format)
+            if val is not None:
+                dstore.write_dataset(val, self.store, val_path,
+                                     num_shards=num_shards,
+                                     fmt=self.data_format)
+                return (StoreDataRef(self.store, path),
+                        StoreDataRef(self.store, val_path))
+            return StoreDataRef(self.store, path), None
+        meta = dstore.read_meta(self.store, path)
+        self._check_cols(sorted(meta["columns"]))
+        if validation is None:
+            # A stale val split from an earlier run under this run_id must
+            # not sneak validation into a fit that didn't ask for one.
+            return StoreDataRef(self.store, path), None
+        # validation requested: the split must already be materialised (a
+        # fraction can't be re-derived from data written without one).
+        try:
+            dstore.read_meta(self.store, val_path)
+        except (OSError, KeyError, ValueError):
+            raise ValueError(
+                f"validation={validation!r} with fit_on_store() requires "
+                f"a materialised val split at {val_path}; this run_id's "
+                "data was written without one (re-fit with a DataFrame, "
+                "or set validation=None)") from None
+        return (StoreDataRef(self.store, path),
+                StoreDataRef(self.store, val_path))
 
     def _check_cols(self, have):
-        if self.feature_col not in have or self.label_col not in have:
+        need = [self.feature_col, self.label_col]
+        missing = [c for c in need if c not in have]
+        if missing:
             raise KeyError(
-                f"dataset must contain {self.feature_col!r} and "
-                f"{self.label_col!r}; has {have}")
+                f"dataset must contain {need}; missing {missing} "
+                f"(has {have})")
 
     def fit_on_store(self):
         """Train from data already materialised in the store under
@@ -338,6 +499,12 @@ class JaxEstimator(_StoreFitMixin):
         stream only their shard partition (upstream's Store + petastorm
         path) instead of receiving arrays through the task payload.
       run_id / num_shards / data_format: store layout knobs.
+      validation: upstream ``horovod/spark/common/params.py`` semantics —
+        a float fraction in (0, 1) held out of the dataset, or the name
+        of a column whose truthy rows are validation. Validation rows
+        never receive gradients; per-epoch val loss lands in the fitted
+        model's ``history["val_loss"]`` (and, with a store, the split is
+        materialised under ``val_data_path``).
     """
 
     def __init__(self, model: Any, loss: Callable, lr: float = 1e-2,
@@ -347,7 +514,7 @@ class JaxEstimator(_StoreFitMixin):
                  feature_col: str = "features", label_col: str = "label",
                  seed: int = 0, store: Any = None,
                  run_id: str = "default", num_shards: Optional[int] = None,
-                 data_format: str = "npz"):
+                 data_format: str = "npz", validation=None):
         self.model = model
         self.loss = loss
         self.lr = lr
@@ -357,22 +524,26 @@ class JaxEstimator(_StoreFitMixin):
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self.validation = validation
         self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> JaxModel:
         import cloudpickle
 
-        data = self._prepare_data(df)
+        data, val_data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker,
             args=(model_bytes, data, self.feature_col, self.label_col,
-                  self.lr, self.epochs, self.batch_size, self.seed))
+                  self.lr, self.epochs, self.batch_size, self.seed,
+                  val_data))
         self.last_fit_results = results
         # Rank 0's weights are the trained model (allreduced grads keep all
         # replicas identical; collecting rank 0 mirrors upstream).
         params = next(r["params"] for r in results if r["rank"] == 0)
-        self._store_checkpoint({"params": params})
-        return JaxModel(self.model, params, self.feature_col)
+        metrics = _epoch_metrics(results)
+        self._store_checkpoint({"params": params, "metrics": metrics})
+        return JaxModel(self.model, params, self.feature_col,
+                        history=metrics)

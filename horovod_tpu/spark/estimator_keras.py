@@ -12,7 +12,8 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
-from horovod_tpu.spark.estimator import (_StoreFitMixin, _to_columns,
+from horovod_tpu.spark.estimator import (_StoreFitMixin, _epoch_metrics,
+                                         _to_columns, _val_partition,
                                          _worker_partition)
 
 __all__ = ["KerasEstimator", "KerasModel"]
@@ -20,7 +21,8 @@ __all__ = ["KerasEstimator", "KerasModel"]
 
 def _fit_worker_keras(model_bytes: bytes, data,
                       feature_col: str, label_col: str,
-                      lr: float, epochs: int, batch_size: int, seed: int):
+                      lr: float, epochs: int, batch_size: int, seed: int,
+                      val_data=None):
     """Runs on every worker with hvd initialized (backend contract).
     Store-backed ``data`` loads only this rank's shard partition."""
     import cloudpickle
@@ -43,8 +45,24 @@ def _fit_worker_keras(model_bytes: bytes, data,
     # upstream contract (and guards factory randomness).
     hvd_tf.broadcast_variables(model.trainable_variables, root_rank=0)
 
+    vx, vy = _val_partition(val_data, feature_col, label_col, rank, world)
+    val_rows = 0 if vx is None else len(vx)
+
+    def val_epoch():
+        """Mean val loss on this rank's rows — inference only (no tape,
+        no allreduce); the driver weights ranks by row count."""
+        if not val_rows:
+            return float("nan")
+        total = 0.0
+        for i in range(0, val_rows, bs):
+            xb, yb = vx[i:i + bs], vy[i:i + bs]
+            total += float(loss_fn(model(tf.constant(xb), training=False),
+                                   tf.constant(yb))) * len(xb)
+        return total / val_rows
+
     n = int(feats.shape[0])
     history = []
+    val_history = []
     for epoch in range(epochs):
         order = np.random.default_rng(seed + epoch).permutation(n)
         losses = []
@@ -61,22 +79,32 @@ def _fit_worker_keras(model_bytes: bytes, data,
             opt.apply_gradients(zip(grads, model.trainable_variables))
             losses.append(float(loss))
         history.append(float(np.mean(losses)) if losses else float("nan"))
+        if val_data is not None:
+            val_history.append(val_epoch())
 
     weights = [w.astype(np.float32) if hasattr(w, "astype") else w
                for w in model.get_weights()]
     return {"rank": rank, "world": world, "weights": weights,
-            "history": history, "files_read": files_read}
+            "history": history,
+            "val_history": val_history if val_data is not None else None,
+            "val_rows": val_rows, "files_read": files_read}
 
 
 class KerasModel:
     """Trained-model transformer (upstream ``KerasModel``)."""
 
     def __init__(self, model: Any, weights, feature_col: str,
-                 output_col: str = "prediction"):
+                 output_col: str = "prediction", history=None):
         self.model = model
         self.model.set_weights(weights)
         self.feature_col = feature_col
         self.output_col = output_col
+        self.history = history or {}
+
+    def get_history(self):
+        """Per-epoch metrics from fit (train_loss, and val_loss when the
+        estimator had validation=)."""
+        return self.history
 
     def predict(self, features) -> np.ndarray:
         out = self.model(np.asarray(features), training=False)
@@ -100,7 +128,7 @@ class KerasEstimator(_StoreFitMixin):
                  feature_col: str = "features", label_col: str = "label",
                  seed: int = 0, store: Any = None, run_id: str = "default",
                  num_shards: Optional[int] = None,
-                 data_format: str = "npz", **_compat):
+                 data_format: str = "npz", validation=None, **_compat):
         try:
             import tensorflow  # noqa: F401
         except ImportError:
@@ -118,20 +146,24 @@ class KerasEstimator(_StoreFitMixin):
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self.validation = validation
         self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> KerasModel:
         import cloudpickle
 
-        data = self._prepare_data(df)
+        data, val_data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker_keras,
             args=(model_bytes, data, self.feature_col, self.label_col,
-                  self.lr, self.epochs, self.batch_size, self.seed))
+                  self.lr, self.epochs, self.batch_size, self.seed,
+                  val_data))
         self.last_fit_results = results
         weights = next(r["weights"] for r in results if r["rank"] == 0)
-        self._store_checkpoint({"weights": weights})
-        return KerasModel(self.model, weights, self.feature_col)
+        metrics = _epoch_metrics(results)
+        self._store_checkpoint({"weights": weights, "metrics": metrics})
+        return KerasModel(self.model, weights, self.feature_col,
+                          history=metrics)

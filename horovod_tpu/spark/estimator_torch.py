@@ -12,7 +12,8 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
-from horovod_tpu.spark.estimator import (_StoreFitMixin, _to_columns,
+from horovod_tpu.spark.estimator import (_StoreFitMixin, _epoch_metrics,
+                                         _to_columns, _val_partition,
                                          _worker_partition)
 
 __all__ = ["TorchEstimator", "TorchModel"]
@@ -20,7 +21,8 @@ __all__ = ["TorchEstimator", "TorchModel"]
 
 def _fit_worker_torch(model_bytes: bytes, data,
                       feature_col: str, label_col: str,
-                      lr: float, epochs: int, batch_size: int, seed: int):
+                      lr: float, epochs: int, batch_size: int, seed: int,
+                      val_data=None):
     """Runs on every worker with hvd initialized (backend contract).
     Store-backed ``data`` loads only this rank's shard partition."""
     import cloudpickle
@@ -45,8 +47,29 @@ def _fit_worker_torch(model_bytes: bytes, data,
     # factory that randomizes per process).
     hvt.broadcast_parameters(model.state_dict(), root_rank=0)
 
+    vx, vy = _val_partition(val_data, feature_col, label_col, rank, world)
+    val_rows = 0 if vx is None else len(vx)
+    if val_rows:
+        vx = torch.from_numpy(np.ascontiguousarray(vx))
+        vy = torch.from_numpy(np.ascontiguousarray(vy))
+
+    def val_epoch():
+        """Mean val loss on this rank's rows — eval mode, no_grad, no
+        allreduce; the driver weights ranks by row count."""
+        if not val_rows:
+            return float("nan")
+        model.eval()
+        total = 0.0
+        with torch.no_grad():
+            for i in range(0, val_rows, bs):
+                xb, yb = vx[i:i + bs], vy[i:i + bs]
+                total += float(loss_fn(model(xb), yb)) * len(xb)
+        model.train()
+        return total / val_rows
+
     n = len(feats)
     history = []
+    val_history = []
     for epoch in range(epochs):
         order = np.random.default_rng(seed + epoch).permutation(n)
         losses = []
@@ -61,11 +84,15 @@ def _fit_worker_torch(model_bytes: bytes, data,
             opt.step()          # allreduces grads, then inner step
             losses.append(float(loss.detach()))
         history.append(float(np.mean(losses)) if losses else float("nan"))
+        if val_data is not None:
+            val_history.append(val_epoch())
 
     state = {k: v.detach().cpu().numpy()
              for k, v in model.state_dict().items()}
     return {"rank": rank, "world": world, "state_dict": state,
-            "history": history, "files_read": files_read}
+            "history": history,
+            "val_history": val_history if val_data is not None else None,
+            "val_rows": val_rows, "files_read": files_read}
 
 
 class TorchModel:
@@ -73,7 +100,8 @@ class TorchModel:
     module + trained state_dict, applies it to new data."""
 
     def __init__(self, model: Any, state_dict: Dict[str, np.ndarray],
-                 feature_col: str, output_col: str = "prediction"):
+                 feature_col: str, output_col: str = "prediction",
+                 history=None):
         import torch
 
         self.model = model
@@ -83,6 +111,12 @@ class TorchModel:
         self.model.eval()
         self.feature_col = feature_col
         self.output_col = output_col
+        self.history = history or {}
+
+    def get_history(self):
+        """Per-epoch metrics from fit (train_loss, and val_loss when the
+        estimator had validation=)."""
+        return self.history
 
     def predict(self, features) -> np.ndarray:
         import torch
@@ -115,7 +149,7 @@ class TorchEstimator(_StoreFitMixin):
                  feature_col: str = "features", label_col: str = "label",
                  seed: int = 0, store: Any = None, run_id: str = "default",
                  num_shards: Optional[int] = None,
-                 data_format: str = "npz", **_compat):
+                 data_format: str = "npz", validation=None, **_compat):
         if model is None or loss is None:
             raise ValueError("TorchEstimator requires model= and loss=")
         self.model = model
@@ -127,20 +161,24 @@ class TorchEstimator(_StoreFitMixin):
         self.feature_col = feature_col
         self.label_col = label_col
         self.seed = seed
+        self.validation = validation
         self._init_store(store, run_id, num_shards, data_format)
         self.last_fit_results: Optional[list] = None
 
     def fit(self, df: Any) -> TorchModel:
         import cloudpickle
 
-        data = self._prepare_data(df)
+        data, val_data = self._prepare_data(df)
         model_bytes = cloudpickle.dumps((self.model, self.loss))
         self.backend.start()
         results = self.backend.run(
             _fit_worker_torch,
             args=(model_bytes, data, self.feature_col, self.label_col,
-                  self.lr, self.epochs, self.batch_size, self.seed))
+                  self.lr, self.epochs, self.batch_size, self.seed,
+                  val_data))
         self.last_fit_results = results
         state = next(r["state_dict"] for r in results if r["rank"] == 0)
-        self._store_checkpoint({"state_dict": state})
-        return TorchModel(self.model, state, self.feature_col)
+        metrics = _epoch_metrics(results)
+        self._store_checkpoint({"state_dict": state, "metrics": metrics})
+        return TorchModel(self.model, state, self.feature_col,
+                          history=metrics)

@@ -371,3 +371,226 @@ class TestEstimatorFromStore:
                            backend=InlineBackend())
         with pytest.raises(ValueError, match="store"):
             est.fit_on_store()
+
+
+class TestEstimatorValidation:
+    """VERDICT r4 next #4: validation= split + per-epoch metrics.
+
+    Upstream reference: ``horovod/spark/common/params.py`` (``validation``
+    as fraction or column) and the per-epoch train/val history upstream
+    models expose.
+    """
+
+    def _linear(self):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        def mse(pred, label):
+            return jnp.mean((pred - label) ** 2)
+
+        return Linear(), mse
+
+    def _data(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.standard_normal((n, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, -2.0, 0.5], np.float32)).astype(np.float32)
+        return X, y
+
+    def test_store_fit_with_validation_fraction(self, tmp_path):
+        """The done-criterion: val metrics exist AND val rows never
+        train — checked structurally from the materialised store."""
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator, load_checkpoint
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, lr=0.1, epochs=6, batch_size=8,
+                           store=str(tmp_path), backend=InlineBackend(),
+                           validation=0.25)
+        fitted = est.fit({"features": X, "label": y})
+
+        # Per-epoch metrics on the returned model.
+        hist = fitted.get_history()
+        assert len(hist["train_loss"]) == 6
+        assert len(hist["val_loss"]) == 6
+        assert all(np.isfinite(v) for v in hist["val_loss"])
+        assert hist["val_loss"][-1] < hist["val_loss"][0]  # it does learn
+
+        # The split is materialised under upstream's two-dataset layout.
+        store = LocalStore(str(tmp_path))
+        train_meta = read_meta(store, store.train_data_path())
+        val_meta = read_meta(store, store.val_data_path())
+        assert train_meta["total_rows"] == 48
+        assert val_meta["total_rows"] == 16
+
+        # Val rows NEVER train: the materialised splits partition the
+        # original rows exactly — no val row appears in the train data.
+        train_rows = ShardedDatasetReader(
+            store, store.train_data_path()).load_columns()["features"]
+        val_rows = ShardedDatasetReader(
+            store, store.val_data_path()).load_columns()["features"]
+        trainset = {r.tobytes() for r in train_rows}
+        valset = {r.tobytes() for r in val_rows}
+        assert not trainset & valset
+        assert trainset | valset == {r.tobytes() for r in X}
+        # ... and the worker agrees about its val row count.
+        assert est.last_fit_results[0]["val_rows"] == 16
+
+        # Metrics are persisted with the checkpoint.
+        ckpt = load_checkpoint(str(tmp_path))
+        assert ckpt["metrics"]["val_loss"] == hist["val_loss"]
+
+    def test_validation_column_in_memory(self):
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        is_val = np.zeros(64, bool)
+        is_val[::4] = True          # 16 marked rows
+        est = JaxEstimator(model, mse, lr=0.1, epochs=4, batch_size=8,
+                           backend=InlineBackend(), validation="is_val")
+        fitted = est.fit({"features": X, "label": y, "is_val": is_val})
+        hist = fitted.get_history()
+        assert len(hist["val_loss"]) == 4
+        assert est.last_fit_results[0]["val_rows"] == 16
+
+    def test_validation_column_missing_raises(self):
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, backend=InlineBackend(),
+                           validation="nope")
+        with pytest.raises(KeyError, match="nope"):
+            est.fit({"features": X, "label": y})
+
+    def test_validation_fraction_bounds(self):
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, backend=InlineBackend(),
+                           validation=1.5)
+        with pytest.raises(ValueError, match="fraction"):
+            est.fit({"features": X, "label": y})
+
+    def test_no_validation_has_no_val_loss(self):
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, epochs=2, backend=InlineBackend())
+        fitted = est.fit({"features": X, "label": y})
+        assert "val_loss" not in fitted.get_history()
+        assert len(fitted.get_history()["train_loss"]) == 2
+
+    def test_two_subprocess_val_weighting(self, tmp_path):
+        """2-process fit: per-rank val losses combine into one series
+        weighted by each rank's val rows; both ranks eval only their own
+        partition of the val split."""
+        from horovod_tpu.cluster import LocalProcessBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, lr=0.1, epochs=3, batch_size=8,
+                           store=str(tmp_path), validation=0.25,
+                           backend=LocalProcessBackend(
+                               2, coordinator_port=29810))
+        fitted = est.fit({"features": X, "label": y})
+        results = est.last_fit_results
+        assert sum(r["val_rows"] for r in results) == 16
+        assert all(len(r["val_history"]) == 3 for r in results)
+        expect = [sum(r["val_history"][e] * r["val_rows"]
+                      for r in results) / 16 for e in range(3)]
+        np.testing.assert_allclose(fitted.get_history()["val_loss"],
+                                   expect, rtol=1e-6)
+
+    def test_torch_estimator_validation(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import TorchEstimator
+
+        X, y = self._data(seed=3)
+        model = torch.nn.Sequential(torch.nn.Linear(3, 1),
+                                    torch.nn.Flatten(0))
+        est = TorchEstimator(model=model,
+                             loss=torch.nn.functional.mse_loss,
+                             lr=0.05, epochs=5, batch_size=16,
+                             backend=InlineBackend(), validation=0.25)
+        fitted = est.fit({"features": X, "label": y})
+        hist = fitted.get_history()
+        assert len(hist["val_loss"]) == 5
+        assert all(np.isfinite(v) for v in hist["val_loss"])
+        assert est.last_fit_results[0]["val_rows"] == 16
+
+    def test_keras_estimator_validation(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import KerasEstimator
+
+        X, y = self._data(seed=4)
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1),
+                                     tf.keras.layers.Flatten()])
+        model.build((None, 3))
+
+        def mse(pred, label):
+            return tf.reduce_mean((pred - tf.cast(label, pred.dtype)) ** 2)
+
+        est = KerasEstimator(model=model, loss=mse, lr=0.05, epochs=4,
+                             batch_size=16, backend=InlineBackend(),
+                             validation=0.25)
+        fitted = est.fit({"features": X, "label": y})
+        hist = fitted.get_history()
+        assert len(hist["val_loss"]) == 4
+        assert all(np.isfinite(v) for v in hist["val_loss"])
+
+    def test_fit_on_store_validation_semantics(self, tmp_path):
+        """fit_on_store honors validation=: a requested split must be
+        materialised (error otherwise); validation=None ignores a stale
+        split from an earlier run under the same run_id."""
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        kw = dict(lr=0.1, epochs=2, batch_size=8, store=str(tmp_path),
+                  backend=InlineBackend())
+        JaxEstimator(model, mse, validation=0.25, **kw).fit(
+            {"features": X, "label": y})
+
+        # Reuse: validation= (any non-None) pairs with the stored split.
+        m = JaxEstimator(model, mse, validation=0.25, **kw).fit_on_store()
+        assert len(m.get_history()["val_loss"]) == 2
+        # validation=None: the stale split is ignored.
+        m = JaxEstimator(model, mse, **kw).fit_on_store()
+        assert "val_loss" not in m.get_history()
+
+        # Data written WITHOUT a split + validation= -> explicit error.
+        store2 = str(tmp_path / "other")
+        kw2 = dict(kw, store=store2)
+        JaxEstimator(model, mse, **kw2).fit({"features": X, "label": y})
+        with pytest.raises(ValueError, match="materialised val split"):
+            JaxEstimator(model, mse, validation=0.25,
+                         **kw2).fit_on_store()
+
+    def test_all_truthy_validation_column_raises(self):
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+
+        model, mse = self._linear()
+        X, y = self._data()
+        est = JaxEstimator(model, mse, backend=InlineBackend(),
+                           validation="mark")
+        with pytest.raises(ValueError, match="no training rows"):
+            est.fit({"features": X, "label": y,
+                     "mark": np.ones(64, bool)})
