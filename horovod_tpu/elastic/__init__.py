@@ -15,7 +15,7 @@ pytrees off-device; ``restore()`` puts them back on the (new) mesh.
 import os as _os
 
 from horovod_tpu.elastic.state import (  # noqa: F401
-    JaxState, State, TensorFlowKerasState, TorchState,
+    FsdpState, JaxState, State, TensorFlowKerasState, TorchState,
 )
 
 

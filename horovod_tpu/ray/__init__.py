@@ -16,7 +16,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.cluster import ClusterBackend, LocalProcessBackend
 
-__all__ = ["RayExecutor", "RayBackend", "ray_available", "run_remote"]
+__all__ = ["RayExecutor", "RayBackend", "ElasticRayExecutor",
+           "RayHostDiscovery", "ray_available", "run_remote"]
 
 
 def run_remote(*_a, **_k):
@@ -98,6 +99,174 @@ class RayBackend(ClusterBackend):
         worker = _worker.options(**opts)
         futs += [worker.remote(coordinator, pid) for pid in range(1, n)]
         return ray.get(futs)
+
+
+class RayHostDiscovery:
+    """Slot discovery from the live ray cluster (upstream
+    ``horovod/ray/elastic_v2.py:RayHostDiscovery``): each alive node
+    contributes ``CPU // cpus_per_slot`` (or ``GPU // gpus_per_slot``)
+    worker slots.
+
+    ``nodes_fn`` is injectable — tests (and ray-less environments)
+    simulate node loss/recovery by swapping the node list; the default
+    queries ``ray.nodes()``.
+    """
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1,
+                 nodes_fn: Optional[Callable[[], list]] = None):
+        if nodes_fn is None:
+            if not ray_available():
+                raise RuntimeError(
+                    "RayHostDiscovery without the ray package needs an "
+                    "injected nodes_fn")
+
+            def nodes_fn():
+                import ray
+                return ray.nodes()
+        self._nodes_fn = nodes_fn
+        self._use_gpu = use_gpu
+        self._cpus = max(cpus_per_slot, 1)
+        self._gpus = max(gpus_per_slot, 1)
+
+    def __call__(self) -> int:
+        slots = 0
+        for node in self._nodes_fn():
+            if not node.get("Alive", False):
+                continue
+            res = node.get("Resources", {}) or {}
+            if self._use_gpu:
+                slots += int(res.get("GPU", 0)) // self._gpus
+            else:
+                slots += int(res.get("CPU", 0)) // self._cpus
+        return slots
+
+
+# Worker bootstrap for ElasticRayExecutor.run(worker_fn): the same
+# platform guard every elastic worker script needs (the image's
+# sitecustomize pre-imports jax, so the env var alone is too late), then
+# rendezvous via the run_elastic env contract and call the pickled fn.
+_ELASTIC_BOOTSTRAP = """\
+import os, sys
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+import jax
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+import cloudpickle
+with open(sys.argv[1], "rb") as f:
+    fn = cloudpickle.load(f)
+import horovod_tpu as hvd
+hvd.init()
+fn()
+"""
+
+
+class ElasticRayExecutor:
+    """``horovod.ray.ElasticRayExecutor`` parity
+    (``horovod/ray/elastic_v2.py``): an elastic job whose between-attempt
+    world size comes from ray host discovery.
+
+    Upstream keeps long-lived actors and rebuilds the NCCL ring in place;
+    on TPU a ``jax.distributed`` world cannot be re-formed inside live
+    processes, so worker/actor death tears the attempt down and
+    ``runner.run_elastic`` relaunches over however many slots
+    ``discovery`` currently reports (capped at ``max_workers``, floored
+    at ``min_workers`` — below that the job fails). Workers resume from
+    their last committed elastic ``State`` exactly as in the relaunch
+    tests (``tests/test_elastic_relaunch.py``).
+
+    ``discovery`` defaults to :class:`RayHostDiscovery` over live
+    ``ray.nodes()``; inject any zero-arg callable returning a slot count
+    to run without ray (tests simulate actor loss this way).
+    """
+
+    def __init__(self, settings: Optional[Any] = None,
+                 min_workers: int = 1, max_workers: int = 2,
+                 max_restarts: int = 3,
+                 use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1,
+                 discovery: Optional[Callable[[], int]] = None,
+                 state_dir: Optional[str] = None,
+                 coordinator_port: int = 29860):
+        if discovery is None:
+            discovery = RayHostDiscovery(use_gpu=use_gpu,
+                                         cpus_per_slot=cpus_per_slot,
+                                         gpus_per_slot=gpus_per_slot)
+        self.discovery = discovery
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.max_restarts = max_restarts
+        self.state_dir = state_dir
+        self.settings = settings
+        self._port = coordinator_port
+        self._started = False
+
+    def _slots(self, floor: bool) -> int:
+        """Discovered slots capped at max_workers. ``floor=True`` (initial
+        spawn) also floors at min_workers — at least min are attempted;
+        the RELAUNCH path must NOT floor, so a cluster that truly lost
+        capacity below min_workers fails fast via run_elastic's min_np
+        check instead of relaunching workers that have nowhere to run."""
+        slots = min(int(self.discovery()), self.max_workers)
+        return max(slots, self.min_workers) if floor else slots
+
+    def start(self) -> None:
+        """Resolve the initial world from discovery (upstream queries the
+        actor group here)."""
+        self._initial = self._slots(floor=True)
+        self._started = True
+
+    def run(self, worker_fn: Optional[Callable] = None,
+            command: Optional[list] = None,
+            extra_env: Optional[Dict[str, str]] = None,
+            timeout: Optional[float] = None) -> int:
+        """Run the elastic job; returns the restart count.
+
+        Either a picklable zero-arg ``worker_fn`` (run on every worker
+        with hvd initialized — the upstream surface) or an explicit argv
+        ``command``. Worker loss -> teardown -> relaunch over
+        ``discovery()`` slots; state recovery is the worker's job via the
+        elastic ``State`` save/load/sync contract.
+        """
+        if not self._started:
+            raise RuntimeError("ElasticRayExecutor.start() must be called "
+                               "before run() (upstream contract)")
+        if (worker_fn is None) == (command is None):
+            raise ValueError("pass exactly one of worker_fn= or command=")
+        from horovod_tpu.runner.launcher import run_elastic
+
+        import shutil
+        import sys as _sys
+        import tempfile
+        own_dir = self.state_dir is None
+        state_dir = self.state_dir or tempfile.mkdtemp(
+            prefix="hvd_tpu_elastic_ray_")
+        try:
+            if worker_fn is not None:
+                import cloudpickle
+                import os as _os
+                payload = _os.path.join(state_dir, "worker_fn.pkl")
+                with open(payload, "wb") as f:
+                    f.write(cloudpickle.dumps(worker_fn))
+                command = [_sys.executable, "-c", _ELASTIC_BOOTSTRAP,
+                           payload]
+            return run_elastic(
+                command, np=self._initial, min_np=self.min_workers,
+                max_np=self.max_workers,
+                max_restarts=self.max_restarts,
+                coordinator_port=self._port, state_dir=state_dir,
+                extra_env=extra_env, timeout=timeout,
+                discovery=lambda: self._slots(floor=False))
+        finally:
+            if own_dir:
+                # Nothing outside this call can reach an implicitly
+                # created dir (pickled closures can embed large arrays) —
+                # don't leak one per run.
+                shutil.rmtree(state_dir, ignore_errors=True)
+
+    def shutdown(self) -> None:
+        self._started = False
 
 
 class RayExecutor:

@@ -252,7 +252,7 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
                 state_dir: Optional[str] = None,
                 extra_env: Optional[Dict[str, str]] = None,
                 timeout: Optional[float] = None,
-                discovery=None) -> int:
+                discovery=None, max_np: Optional[int] = None) -> int:
     """Fault-tolerant multi-process launch (upstream
     ``horovod/runner/elastic/driver.py``).
 
@@ -271,8 +271,11 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
 
     ``discovery``: optional zero-arg callable returning the currently
     available slot count (upstream ``--host-discovery-script``); consulted
-    between attempts so recovered capacity scales the relaunch back up
-    (capped at ``np``). Without it the world only shrinks (survivors).
+    between attempts so recovered capacity scales the relaunch back up,
+    capped at ``max_np`` (default: ``np`` — slots beyond what was asked
+    for were never provisioned; elastic executors that may START below
+    their provision cap pass ``max_np`` explicitly). Without it the world
+    only shrinks (survivors).
     """
     import tempfile
     import time
@@ -346,9 +349,9 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
             # Upstream's host-discovery hook (--host-discovery-script /
             # elastic driver polling): consult it between attempts so
             # recovered capacity scales the job back UP, capped at the
-            # original np (slots beyond it were never provisioned).
+            # provision limit (max_np, defaulting to the original np).
             try:
-                world = max(world, min(int(discovery()), np))
+                world = max(world, min(int(discovery()), max_np or np))
             except Exception as e:
                 logger.warning("elastic discovery hook failed (%s); "
                                "continuing with world=%d", e, world)

@@ -221,3 +221,59 @@ class TestKerasEstimator:
         assert hist[-1] < 0.1 * hist[0], hist
         out = fitted.transform({"features": X, "label": y})
         assert out["prediction"].shape[0] == 64
+
+
+class TestRayHostDiscovery:
+    """Upstream horovod/ray/elastic_v2.py:RayHostDiscovery — slots from
+    alive nodes' resources; nodes_fn injected (no ray in this image)."""
+
+    def test_cpu_slots(self):
+        from horovod_tpu.ray import RayHostDiscovery
+        nodes = [
+            {"Alive": True, "Resources": {"CPU": 4.0}},
+            {"Alive": True, "Resources": {"CPU": 2.0}},
+            {"Alive": False, "Resources": {"CPU": 16.0}},   # dead node
+        ]
+        disc = RayHostDiscovery(cpus_per_slot=2, nodes_fn=lambda: nodes)
+        assert disc() == 3                    # 4//2 + 2//2, dead excluded
+
+    def test_gpu_slots(self):
+        from horovod_tpu.ray import RayHostDiscovery
+        nodes = [{"Alive": True, "Resources": {"CPU": 8.0, "GPU": 4.0}},
+                 {"Alive": True, "Resources": {"CPU": 8.0}}]
+        disc = RayHostDiscovery(use_gpu=True, gpus_per_slot=2,
+                                nodes_fn=lambda: nodes)
+        assert disc() == 2
+
+    def test_without_ray_requires_nodes_fn(self):
+        import horovod_tpu.ray as hray
+        if hray.ray_available():
+            pytest.skip("ray present; constructor would succeed")
+        with pytest.raises(RuntimeError, match="nodes_fn"):
+            hray.RayHostDiscovery()
+
+
+class TestElasticRayExecutor:
+    def test_requires_start(self):
+        from horovod_tpu.ray import ElasticRayExecutor
+        ex = ElasticRayExecutor(discovery=lambda: 2)
+        with pytest.raises(RuntimeError, match="start"):
+            ex.run(command=["true"])
+
+    def test_exactly_one_payload(self):
+        from horovod_tpu.ray import ElasticRayExecutor
+        ex = ElasticRayExecutor(discovery=lambda: 1, max_workers=1)
+        ex.start()
+        with pytest.raises(ValueError, match="exactly one"):
+            ex.run()
+
+    def test_start_clamps_initial_world(self):
+        from horovod_tpu.ray import ElasticRayExecutor
+        ex = ElasticRayExecutor(discovery=lambda: 64, min_workers=1,
+                                max_workers=3)
+        ex.start()
+        assert ex._initial == 3
+        ex2 = ElasticRayExecutor(discovery=lambda: 0, min_workers=2,
+                                 max_workers=4)
+        ex2.start()
+        assert ex2._initial == 2

@@ -274,3 +274,180 @@ class TestSyncAttrsMerge:
         out, _ = self._run({"step": 9, "stale": 1},
                            root_payload=({"step": 3}, []))
         assert out == {"step": 3}
+
+
+class TestFsdpState:
+    """Elastic x FSDP (VERDICT r4 next #5): a flat-shard ZeRO-3 state
+    survives a re-mesh with a different world size. The commit is
+    canonical (padding stripped, lockstep step counters collapsed), so a
+    dp=8 run that loses half its workers resumes at dp=4 with numerics
+    matching a run that never re-meshed."""
+
+    D_IN, D_H = 5, 7       # flat_len = 5*7+7+7*5+5 = 82: pads differently
+                           # at n=8 (11/chunk -> 88) and n=4 (21 -> 84)
+
+    @pytest.fixture
+    def remesh(self):
+        """Any test that shrinks the world puts the session 8-device
+        communicator back afterwards."""
+        yield
+        hvd.shutdown()
+        hvd.init()
+
+    def _template(self):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        return {
+            "w1": jax.random.normal(k1, (self.D_IN, self.D_H),
+                                    jnp.float32) * 0.4,
+            "b1": jnp.zeros((self.D_H,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.D_H, self.D_IN),
+                                    jnp.float32) * 0.4,
+            "b2": jnp.zeros((self.D_IN,), jnp.float32),
+        }
+
+    @staticmethod
+    def _block(p, x):
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        return x + h @ p["w2"] + p["b2"]
+
+    def _run_steps(self, template, shard, opt_state, X, steps):
+        """`steps` fsdp training steps on the CURRENT mesh; the global
+        batch X (8 rows) splits evenly over whatever dp size is live, and
+        mean-of-equal-sized-per-device-means == the global mean, so the
+        update is world-size-invariant."""
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.parallel.fsdp import fsdp_adamw, fsdp_apply
+        tx = fsdp_adamw(0.05)
+
+        def body(shard, opt_state, xs):
+            def loss(s):
+                return jnp.mean(
+                    fsdp_apply(self._block, template, s, xs) ** 2)
+            _, g = jax.value_and_grad(loss)(shard)
+            upd, opt_state = tx.update(g, opt_state, shard)
+            import optax
+            return optax.apply_updates(shard, upd), opt_state
+
+        step = hvd.spmd(body, in_specs=(P("hvd"), P("hvd"), P("hvd")),
+                        out_specs=(P("hvd"), P("hvd")))
+        for _ in range(steps):
+            shard, opt_state = step(shard, opt_state, X)
+        return shard, opt_state
+
+    def _fresh(self, template):
+        from horovod_tpu.parallel.fsdp import fsdp_adamw, fsdp_shard_params
+        shard = fsdp_shard_params(template)
+        return shard, fsdp_adamw(0.05).init(shard)
+
+    def test_remesh_parity_with_uninterrupted_run(self, rng, remesh):
+        from horovod_tpu.elastic import FsdpState
+        from horovod_tpu.parallel.fsdp import flat_size
+
+        template = self._template()
+        L = flat_size(template)
+        X = jnp.asarray(rng.standard_normal((8, self.D_IN)), jnp.float32)
+
+        # Reference: 6 uninterrupted steps at dp=8.
+        shard, opt = self._fresh(template)
+        ref_shard, _ = self._run_steps(template, shard, opt, X, 6)
+        ref = np.asarray(ref_shard)[:L]
+
+        # Elastic: 3 steps at dp=8, commit, lose half the workers,
+        # restore at dp=4, 3 more steps.
+        shard, opt = self._fresh(template)
+        shard, opt = self._run_steps(template, shard, opt, X, 3)
+        state = FsdpState(template, shard=shard, opt_state=opt, epoch=1)
+        state.commit()
+        assert state._saved["shard"].shape == (L,)      # canonical: no pad
+
+        hvd.shutdown()
+        hvd.init(devices=jax.devices()[:4])
+        assert hvd.size() == 4
+        state.restore()
+        c4 = -(-L // 4)
+        assert state.shard.shape == (4 * c4,)
+        assert state.opt_state.mu.shape == (4 * c4,)
+        assert state.opt_state.step.shape == (4,)
+        assert int(state.opt_state.step[0]) == 3
+        got_shard, _ = self._run_steps(template, state.shard,
+                                       state.opt_state, X, 3)
+        got = np.asarray(got_shard)[:L]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        assert state.epoch == 1                          # attrs survived
+
+    def test_save_load_across_world_sizes(self, tmp_path, rng, remesh):
+        from horovod_tpu.elastic import FsdpState
+        from horovod_tpu.parallel.fsdp import flat_size
+
+        template = self._template()
+        L = flat_size(template)
+        shard, opt = self._fresh(template)
+        X = jnp.asarray(rng.standard_normal((8, self.D_IN)), jnp.float32)
+        shard, opt = self._run_steps(template, shard, opt, X, 2)
+        state = FsdpState(template, shard=shard, opt_state=opt, step=2)
+        state.commit()
+        path = str(tmp_path / "fsdp.ckpt")
+        state.save(path)
+
+        hvd.shutdown()
+        hvd.init(devices=jax.devices()[:2])
+        fresh = FsdpState(template, step=0)
+        fresh.load(path)                 # restores for the 2-device world
+        c2 = -(-L // 2)
+        assert fresh.shard.shape == (2 * c2,)
+        np.testing.assert_allclose(np.asarray(fresh.shard)[:L],
+                                   np.asarray(state._saved["shard"]))
+        assert fresh.step == 2
+
+    def test_load_rejects_different_model(self, tmp_path):
+        from horovod_tpu.elastic import FsdpState
+
+        template = self._template()
+        state = FsdpState(template, shard=jnp.zeros((88,)), )
+        state.commit()
+        path = str(tmp_path / "fsdp.ckpt")
+        state.save(path)
+        other = FsdpState({"w": jnp.zeros((3, 3))})
+        with pytest.raises(ValueError, match="different model"):
+            other.load(path)
+
+    def test_restore_rolls_back_uncommitted(self):
+        from horovod_tpu.elastic import FsdpState
+
+        state = FsdpState(self._template(), shard=jnp.ones((88,)),
+                          epoch=0)
+        state.commit()
+        state.shard = jnp.zeros((88,))
+        state.epoch = 5
+        state.restore()
+        np.testing.assert_allclose(np.asarray(state.shard)[:82], 1.0)
+        assert state.epoch == 0
+
+    def test_stacked_rows_canonicalise(self):
+        from horovod_tpu.elastic import FsdpState
+        from horovod_tpu.parallel.fsdp import flat_size
+
+        template = self._template()
+        L = flat_size(template)
+        c8 = -(-L // 8)
+        rows = jnp.tile(jnp.arange(8 * c8, dtype=jnp.float32)[None], (3, 1))
+        state = FsdpState(template, shard=rows)
+        state.commit()
+        assert state._saved["shard"].shape == (3, L)
+        state.restore(num_shards=4)
+        c4 = -(-L // 4)
+        assert state.shard.shape == (3, 4 * c4)
+        np.testing.assert_allclose(np.asarray(state.shard)[:, :L],
+                                   np.asarray(rows)[:, :L])
+
+    def test_strip_rejects_mismatched_template(self):
+        """Full-model template with per-layer stacked rows (width below
+        the template flat length) is a contract violation, not a silent
+        padding-retaining 'canonicalisation'."""
+        from horovod_tpu.elastic import FsdpState
+
+        state = FsdpState(self._template())      # flat_len 82
+        state.shard = jnp.zeros((3, 24))         # per-layer rows, L=21ish
+        with pytest.raises(ValueError, match="ONE layer"):
+            state.commit()

@@ -180,3 +180,102 @@ def test_torch_state_survives_relaunch():
     assert result["step"] == 6
     # exactly TOTAL gradient steps of +1 each — no lost or repeated steps
     assert result["w"] == [6.0, 6.0, 6.0, 6.0]
+
+
+@pytest.mark.slow
+def test_elastic_ray_executor_actor_loss_relaunch():
+    """ElasticRayExecutor (upstream horovod/ray/elastic_v2.py): injected
+    discovery simulates a ray cluster that loses a node mid-job and gets
+    it back — the executor relaunches at the discovered capacity and the
+    workers resume from the committed state. Exercises the worker_fn
+    (cloudpickle bootstrap) surface end-to-end."""
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+
+    def worker():
+        # Runs under the bootstrap: jax+hvd already initialized.
+        import json
+        import os
+        import sys
+        sys.path.insert(0, repo)
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu import elastic
+
+        rank = jax.process_index()
+        sdir = elastic.state_dir()
+        path = os.path.join(sdir, "state.pkl")
+        state = elastic.JaxState(w=jnp.zeros((4,)), step=0)
+        if os.path.exists(path):
+            state.load(path)
+            state.sync()
+        TOTAL = 6
+        while state.step < TOTAL:
+            state.w = state.w + 1.0
+            state.step = state.step + 1
+            state.commit()
+            if rank == 0:
+                state.save(path)
+            if (elastic.restart_count() == 0 and rank == 1
+                    and state.step == 3):
+                os._exit(17)   # simulated actor/node loss
+        if rank == 0:
+            out = {"world": jax.process_count(), "step": int(state.step),
+                   "restarts": elastic.restart_count(),
+                   "w": [float(v) for v in state.w]}
+            with open(os.path.join(sdir, "result.json"), "w") as f:
+                json.dump(out, f)
+
+    with tempfile.TemporaryDirectory(prefix="hvd_elastic_ray_") as sdir:
+        # Discovery says 2 slots throughout: the lost "actor" comes back,
+        # so the relaunch scales to 2 instead of the lone survivor.
+        ex = ElasticRayExecutor(discovery=lambda: 2, min_workers=1,
+                                max_workers=2, state_dir=sdir,
+                                coordinator_port=29870)
+        ex.start()
+        assert ex._initial == 2
+        restarts = ex.run(
+            worker_fn=worker,
+            extra_env={"PYTHONPATH": repo
+                       + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            timeout=240)
+        assert restarts == 1
+        with open(os.path.join(sdir, "result.json")) as f:
+            result = json.load(f)
+    assert result["world"] == 2           # back at discovered capacity
+    assert result["step"] == 6
+    assert result["w"] == [6.0, 6.0, 6.0, 6.0]
+
+
+@pytest.mark.slow
+def test_elastic_ray_executor_scales_past_initial_world():
+    """Discovery reported 1 slot at start; capacity later grows to 2 —
+    the relaunch scales UP past the initial world (run_elastic's cap is
+    max_np=max_workers, not the initial np)."""
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = _WORKER.format(repo=repo)
+
+    calls = {"n": 0}
+
+    def discovery():
+        calls["n"] += 1
+        return 1 if calls["n"] == 1 else 2    # 1 at start(), 2 afterwards
+
+    with tempfile.TemporaryDirectory(prefix="hvd_elastic_ray2_") as sdir:
+        ex = ElasticRayExecutor(discovery=discovery, min_workers=1,
+                                max_workers=2, state_dir=sdir,
+                                coordinator_port=29880)
+        ex.start()
+        assert ex._initial == 1
+        # Single rank: rank==1 never fires, so make rank 0 die once.
+        script1 = script.replace("rank == 1", "rank == 0")
+        restarts = ex.run(command=[sys.executable, "-c", script1],
+                          timeout=240)
+        assert restarts == 1
+        with open(os.path.join(sdir, "result.json")) as f:
+            result = json.load(f)
+    assert result["world"] == 2            # grew PAST the initial world
+    assert result["step"] == 6
