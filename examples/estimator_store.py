@@ -73,16 +73,33 @@ def _run_demo(args, store):
     est = JaxEstimator(
         MLP(), mse, lr=0.05, epochs=args.epochs, batch_size=16,
         store=store, run_id="demo", num_shards=2 * args.workers,
+        validation=0.125,           # held out, materialised separately
         backend=LocalProcessBackend(args.workers, coordinator_port=29820))
 
     model = est.fit({"features": X, "label": y})
 
     meta = read_meta(store, store.train_data_path("demo"))
     print(f"staged {meta['total_rows']} rows as {len(meta['shards'])} "
-          f"{meta['format']} shards under {store.prefix}")
+          f"{meta['format']} shards under {store.prefix} "
+          f"(+ {read_meta(store, store.val_data_path('demo'))['total_rows']}"
+          f" val rows)")
     for r in est.last_fit_results:
         print(f"  rank {r['rank']}: read only {r['files_read']}, "
               f"loss {r['history'][0]:.3f} -> {r['history'][-1]:.3f}")
+    hist = model.get_history()
+    print(f"val loss per epoch: "
+          f"{[round(v, 3) for v in hist['val_loss']]}")
+    assert hist["val_loss"][-1] < hist["val_loss"][0]
+
+    # The same composed pipeline the workers trained through, user-side:
+    # background shard reads + in-flight device_puts (data/prefetch.py).
+    from horovod_tpu.data.store import ShardedDatasetReader
+    reader = ShardedDatasetReader(store, store.train_data_path("demo"))
+    with reader.prefetched_batches(16, shuffle=False) as batches:
+        dev_losses = [float(mse(model.predict(b["features"]), b["label"]))
+                      for b in batches]
+    print(f"store-side eval over {len(dev_losses)} prefetched "
+          f"device batches: {np.mean(dev_losses):.4f}")
     reads = [set(r["files_read"]) for r in est.last_fit_results]
     assert set.union(*reads) == {s["file"] for s in meta["shards"]}
     assert not set.intersection(*reads), "partitions must be disjoint"

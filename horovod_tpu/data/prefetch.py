@@ -32,7 +32,7 @@ from typing import Any, Callable, Iterator, Optional
 
 import jax
 
-__all__ = ["BackgroundIterator", "prefetch_to_device"]
+__all__ = ["BackgroundIterator", "prefetch_to_device", "prefetched"]
 
 _SENTINEL = object()
 
@@ -138,3 +138,65 @@ def prefetch_to_device(it: Iterator[Any], size: int = 2,
             yield buf.popleft()
     while buf:
         yield buf.popleft()
+
+
+class _Prefetched:
+    """Closeable view over the composed pipeline: iterating yields
+    device-resident batches; ``close()`` (or the context manager, or
+    garbage collection) releases the background producer thread even when
+    the consumer breaks early."""
+
+    def __init__(self, bg: BackgroundIterator, gen: Iterator[Any]):
+        self._bg = bg
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        self._bg.close()
+        # Also close the device-prefetch generator: its deque holds up to
+        # `size` already-device_put batches — device memory that must not
+        # stay pinned (nor be served by a later next()) after close.
+        self._gen.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetched(make_iter: Callable[[], Iterator[Any]], *,
+               capacity: int = 4, size: int = 2,
+               sharding: Optional[Any] = None,
+               max_steps: Optional[int] = None) -> _Prefetched:
+    """BOTH overlaps composed (the "typical loop" above, packaged): a
+    background thread drains ``make_iter()`` while ``size`` device_puts
+    stay in flight ahead of the consumer. This is the store -> device
+    input pipeline store-fed training should sit behind (upstream's
+    petastorm reader pipelines reads the same way in ``horovod/spark``).
+
+    ``max_steps`` bounds the HOST iterator (inside the pipeline), so a
+    consumer that only wants N batches doesn't pay read-ahead and
+    device_puts for ~capacity+size batches past the cut — pass it
+    instead of wrapping the result in ``itertools.islice``.
+    """
+    if max_steps is not None:
+        import itertools
+        inner = make_iter
+
+        def make_iter():
+            return itertools.islice(inner(), max_steps)
+    bg = BackgroundIterator(make_iter, capacity=capacity)
+    return _Prefetched(bg, prefetch_to_device(bg, size=size,
+                                              sharding=sharding))

@@ -327,3 +327,26 @@ class ShardedDatasetReader:
                          if len(tail) else None)
             if carry is not None and not drop_last:
                 yield carry
+
+    def prefetched_batches(self, batch_size: int, *, epochs: int = 1,
+                           seed: int = 0, shuffle: bool = True,
+                           drop_last: bool = True, capacity: int = 4,
+                           prefetch: int = 2, sharding=None,
+                           max_steps: Optional[int] = None):
+        """:meth:`batches` behind the composed input pipeline
+        (``data/prefetch.py``): a background thread drains shard reads
+        and decompression while ``prefetch`` ``device_put``\\ s stay in
+        flight, so store-fed training overlaps IO, H2D copies and device
+        compute instead of paying a synchronous host->device copy per
+        step — the role petastorm's pipelining reader plays in
+        ``horovod/spark``. Returns a closeable iterator: use it as a
+        context manager (or call ``close()``) when breaking early.
+        ``max_steps`` bounds the pipeline from the inside (no read-ahead
+        past the cut) — prefer it over an external ``islice``.
+        """
+        from horovod_tpu.data.prefetch import prefetched
+        return prefetched(
+            lambda: self.batches(batch_size, epochs=epochs, seed=seed,
+                                 shuffle=shuffle, drop_last=drop_last),
+            capacity=capacity, size=prefetch, sharding=sharding,
+            max_steps=max_steps)

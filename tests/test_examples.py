@@ -90,8 +90,11 @@ class TestExamples:
     def test_estimator_store(self):
         out = _run("estimator_store.py", "--workers", "2", "--epochs", "3",
                    devices=2, timeout=600)
-        assert "staged 256 rows" in out
+        assert "staged 224 rows" in out       # 256 minus the 12.5% val split
+        assert "32 val rows" in out
+        assert "val loss per epoch" in out
         assert "read only" in out
+        assert "prefetched device batches" in out
         assert "reloaded checkpoint matches" in out
 
     def test_resnet50_train(self):

@@ -138,3 +138,92 @@ class TestPrefetchToDevice:
         assert all(isinstance(b["features"], jax.Array) for b in batches)
         total = sum(float(jnp.sum(b["label"])) for b in batches)
         assert np.isfinite(total)
+
+
+class TestStorePrefetchComposition:
+    """VERDICT r4 next #7: the store reader behind the composed pipeline
+    (ShardedDatasetReader.prefetched_batches / prefetch.prefetched)."""
+
+    def _staged_reader(self, tmp_path, rows=48):
+        from horovod_tpu.data.store import (LocalStore,
+                                            ShardedDatasetReader,
+                                            write_dataset)
+        rng = np.random.default_rng(0)
+        cols = {"features": rng.standard_normal((rows, 3))
+                .astype(np.float32),
+                "label": rng.standard_normal((rows,)).astype(np.float32)}
+        store = LocalStore(str(tmp_path))
+        path = store.train_data_path("run")
+        write_dataset(cols, store, path, num_shards=4)
+        return ShardedDatasetReader(store, path)
+
+    def test_same_batches_on_device(self, tmp_path):
+        """Wiring: identical sequence to plain batches(), but each leaf
+        arrives as a device-resident jax.Array."""
+        from horovod_tpu.data.store import ShardedDatasetReader
+        reader = self._staged_reader(tmp_path)
+        plain = list(reader.batches(8, epochs=2, seed=5))
+        reader2 = ShardedDatasetReader(reader.store, reader.path)
+        with reader2.prefetched_batches(8, epochs=2, seed=5) as it:
+            pre = list(it)
+        assert len(pre) == len(plain)
+        for a, b in zip(plain, pre):
+            assert isinstance(b["features"], jax.Array)
+            np.testing.assert_array_equal(a["features"],
+                                          np.asarray(b["features"]))
+            np.testing.assert_array_equal(a["label"],
+                                          np.asarray(b["label"]))
+
+    def test_reads_overlap_consumption(self, tmp_path):
+        """The producer thread reads shards BEFORE the consumer asks for
+        anything — the overlap the composition exists for."""
+        reader = self._staged_reader(tmp_path)
+        with reader.prefetched_batches(8) as it:
+            deadline = time.monotonic() + 10
+            while not reader.files_read and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert reader.files_read, \
+                "no shard read before first next() — pipeline is lazy"
+            next(it)                        # and it still serves batches
+
+    def test_early_close_releases_producer(self, tmp_path):
+        reader = self._staged_reader(tmp_path)
+        before = threading.active_count()
+        it = reader.prefetched_batches(4, epochs=50)   # long producer
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= before
+
+    def test_sharded_placement(self, tmp_path):
+        from jax.sharding import PartitionSpec as P
+        reader = self._staged_reader(tmp_path, rows=64)
+        sharding = hvd.spmd_data_sharding()
+        with reader.prefetched_batches(16, sharding=sharding) as it:
+            b = next(it)
+        assert b["features"].sharding == sharding
+
+    def test_close_stops_serving_buffered_batches(self, tmp_path):
+        """After close(), next() raises instead of serving the stale
+        device_put batches buffered in the prefetch window."""
+        reader = self._staged_reader(tmp_path)
+        it = reader.prefetched_batches(4, epochs=10, prefetch=3)
+        next(it)
+        it.close()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_max_steps_bounds_reads_inside_pipeline(self, tmp_path):
+        """max_steps cuts the HOST iterator before the read-ahead — the
+        producer must not read (or device_put) shards past the cut."""
+        reader = self._staged_reader(tmp_path)      # 4 shards x 12 rows
+        with reader.prefetched_batches(4, epochs=4, shuffle=False,
+                                       max_steps=2) as it:
+            got = list(it)
+        assert len(got) == 2
+        # 2 batches of 4 rows fit inside the first shard; generous bound
+        # allows the one-ahead the iterator protocol needs.
+        assert len(set(reader.files_read)) <= 2, reader.files_read
