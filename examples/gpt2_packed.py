@@ -39,11 +39,15 @@ from horovod_tpu.ops.attention import packed_positions
 def pack_documents(docs, row_len, n_rows, pad_id=0):
     """Greedy first-fit packing: (tokens, segment_ids) of (n_rows, row_len).
 
-    Leftover space at a row's end becomes its own filler segment of
-    ``pad_id`` tokens — the segment mask isolates it and the packed loss
-    never trains on it (its targets stay within the filler segment and
-    carry no gradient worth keeping; real pipelines drop them via the
-    per-document ids exactly like this).
+    Leftover space at a row's end is filled with ``pad_id`` tokens, each
+    carrying its OWN distinct (negative) segment id. That makes "the
+    loss never trains on filler" literally true: the packed loss drops
+    targets whose segment changes between input and target position, and
+    with no two adjacent filler tokens sharing an id, every filler
+    target (pad->pad included) is dropped — a single shared filler
+    segment would keep its within-segment pad->pad targets at weight 1
+    and dilute the loss. Attention-wise each filler token only sees
+    itself, so real documents are untouched either way.
     """
     rows = [[] for _ in range(n_rows)]
     segs = [[] for _ in range(n_rows)]
@@ -59,7 +63,7 @@ def pack_documents(docs, row_len, n_rows, pad_id=0):
     for r in range(n_rows):
         fill = row_len - len(rows[r])
         rows[r].extend([pad_id] * fill)
-        segs[r].extend([next_seg[r]] * fill)
+        segs[r].extend(range(-1, -fill - 1, -1))
     return (jnp.asarray(rows, jnp.int32), jnp.asarray(segs, jnp.int32))
 
 

@@ -24,7 +24,8 @@ from horovod_tpu.collective import (  # noqa: F401
 )
 from horovod_tpu.compression import Compression  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
-    DistributedOptimizer, DistributedGradientTape, accumulation_has_updated,
+    AutotunedStep, DistributedOptimizer, DistributedGradientTape,
+    accumulation_has_updated,
     grad, value_and_grad, allreduce_gradients, broadcast_parameters,
     broadcast_optimizer_state, broadcast_variables,
 )

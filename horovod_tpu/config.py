@@ -55,6 +55,11 @@ class Config:
     autotune: bool = False
     autotune_log: Optional[str] = None
     autotune_mode: str = "ladder"
+    # Bayesian-mode budget: HOROVOD_AUTOTUNE_PROBES GP proposals x
+    # HOROVOD_AUTOTUNE_SAMPLES timed steps each (upstream exposes the
+    # same budget knobs on its GP tuner).
+    autotune_probes: int = 6
+    autotune_samples: int = 10
     # Stall inspector (stall_inspector.cc): warning threshold + disable.
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
@@ -98,6 +103,8 @@ def refresh() -> Config:
         autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG") or None,
         autotune_mode=(os.environ.get("HOROVOD_AUTOTUNE_MODE", "ladder")
                        .strip().lower() or "ladder"),
+        autotune_probes=int(_env_float("HOROVOD_AUTOTUNE_PROBES", 6)),
+        autotune_samples=int(_env_float("HOROVOD_AUTOTUNE_SAMPLES", 10)),
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),

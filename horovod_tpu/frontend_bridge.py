@@ -195,6 +195,20 @@ def alltoall_splits_job(arr, splits_row, process_set):
     if jax.process_count() > 1:
         lm = local_member_ranks(members)
         local_member = lm[0] if lm else None
+        if len(lm) > 1:
+            # One-result-per-process convention: the frontends hand each
+            # PROCESS one tensor, so only the first local member rank's
+            # received rows (and its splits column) come back — the
+            # other local member ranks' results have no tensor to land
+            # in. Loud, because silently dropping rows looks like a
+            # wrong answer (ADVICE r4).
+            import warnings
+            warnings.warn(
+                f"alltoall(splits=): this process owns {len(lm)} member "
+                f"ranks of the process set; only the FIRST local member "
+                f"rank ({lm[0]})'s result is returned. Run one member "
+                "rank per process for per-rank alltoall results.",
+                RuntimeWarning, stacklevel=3)
     else:
         # Single controller simulates every rank but IS rank 0 by
         # convention — membership is judged on that rank alone.

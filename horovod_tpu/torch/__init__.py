@@ -510,10 +510,16 @@ class _DistributedOptimizer:
         if get_config().autotune:
             from horovod_tpu.autotune import Autotuner, BayesianAutotuner
             mode = get_config().autotune_mode
+            cfg = get_config()
             if mode == "bayes":
-                self._autotuner = BayesianAutotuner()
+                self._autotuner = BayesianAutotuner(
+                    probes=cfg.autotune_probes,
+                    samples_per_probe=cfg.autotune_samples)
             elif mode == "bayes-compression":
-                self._autotuner = BayesianAutotuner(tune_compression=True)
+                self._autotuner = BayesianAutotuner(
+                    probes=cfg.autotune_probes,
+                    samples_per_probe=cfg.autotune_samples,
+                    tune_compression=True)
             elif mode == "ladder":
                 self._autotuner = Autotuner()
             else:

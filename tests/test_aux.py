@@ -3,6 +3,7 @@
 import json
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -409,3 +410,66 @@ class TestSshLaunch:
         from horovod_tpu.runner.launcher import local_ip
         ip = local_ip()
         assert isinstance(ip, str) and ip.count(".") == 3
+
+
+class TestAutotunedStep:
+    """VERDICT r4 next #10: the Bayesian tuner consumed by the JAX
+    (optax) path under jit-recompile discipline."""
+
+    def _make_harness(self, rng, tuner):
+        import optax
+        builds = []
+        X = jnp.asarray(rng.standard_normal((32, 4)), jnp.float32)
+        y = jnp.asarray(X @ np.array([1., -2., .5, .8], np.float32))
+        opt_holder = {}
+
+        def make_step(threshold):
+            builds.append(threshold)
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(0.05), fusion_threshold_bytes=threshold)
+            opt_holder.setdefault("opt", opt)
+
+            @jax.jit
+            def step(w, opt_state):
+                def loss(w):
+                    return jnp.mean((X @ w - y) ** 2)
+                l, g = jax.value_and_grad(loss)(w)
+                u, opt_state = opt.update(g, opt_state, w)
+                return optax.apply_updates(w, u), opt_state, l
+
+            return step
+
+        step = hvd.AutotunedStep(make_step, tuner=tuner)
+        w = jnp.zeros((4,))
+        ost = opt_holder["opt"].init(w)
+        return step, w, ost, builds
+
+    def test_probes_recompile_state_survives_and_converges(self, rng):
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=3, samples_per_probe=2)
+        step, w, ost, builds = self._make_harness(rng, tuner)
+        losses = []
+        for _ in range(25):
+            w, ost, l = step(w, ost)
+            losses.append(float(l))
+        assert step.converged
+        # One build per probe point + the final best rebuild.
+        assert len(builds) >= 3
+        assert builds[-1] == step.current_threshold()
+        # Optimizer state threaded across every recompile: training
+        # never reset (loss strictly decreased through every rebuild).
+        assert all(b < a for a, b in zip(losses, losses[1:])), losses
+        assert losses[-1] < 0.2 * losses[0], losses
+        # Post-convergence calls run the winning program untimed.
+        before = len(builds)
+        w, ost, l = step(w, ost)
+        assert len(builds) == before
+
+    def test_converged_threshold_is_a_probed_point(self, rng):
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=2, samples_per_probe=2)
+        step, w, ost, builds = self._make_harness(rng, tuner)
+        for _ in range(6):
+            w, ost, _ = step(w, ost)
+        assert step.converged
+        assert step.current_threshold() in builds

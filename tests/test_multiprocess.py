@@ -18,7 +18,7 @@ _WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     pid, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
     sys.path.insert(0, {repo!r})
-    if mode == "barrier_epoch":
+    if mode in ("barrier_epoch", "barrier_ghost"):
         os.environ["HOROVOD_BARRIER_TIMEOUT"] = "3"
     import horovod_tpu as hvd
     hvd.init(coordinator_address=f"127.0.0.1:{{port}}", num_processes=2,
@@ -237,6 +237,35 @@ _WORKER = textwrap.dedent("""
             assert took < 2.5, (late, took)
         print(f"proc {{pid}} BARRIER-EPOCH-OK fails={{fails}}",
               flush=True)
+    elif mode == "barrier_ghost":
+        # VERDICT r4 next #8: repeated FAILED attempts by one member must
+        # never release an epoch without the others. Under the old
+        # counter protocol, a failed retract + re-arrival double-counted
+        # the early member and (at m=2) released it ALONE; per-member
+        # idempotent marks make re-arrival an overwrite.
+        import time
+        from horovod_tpu.process_set import add_process_set
+        ps = add_process_set([0, 1])
+        if pid == 1:
+            time.sleep(8.0)       # sleeps through TWO of pid 0's attempts
+            t0 = time.monotonic()
+            hvd.barrier(process_set=ps)
+            assert time.monotonic() - t0 < 2.5   # pid 0's mark persisted
+        else:
+            fails = 0
+            for _ in range(2):    # two timed-out attempts, same epoch
+                try:
+                    hvd.barrier(process_set=ps)
+                except RuntimeError:
+                    fails += 1
+            assert fails == 2, \
+                "a re-arrival released the barrier without the peer"
+            hvd.barrier(process_set=ps)          # peer arrives ~8s: heals
+        hvd.allgather_object("resync")
+        t0 = time.monotonic()
+        hvd.barrier(process_set=ps)              # next epoch, clean
+        assert time.monotonic() - t0 < 2.5
+        print(f"proc {{pid}} BARRIER-GHOST-OK", flush=True)
     elif mode == "join_service":
         # VERDICT r3 item 4: rank 0 joins at step 3; rank 1 keeps
         # allreducing through step 6 with CORRECT averages (divisor
@@ -356,6 +385,17 @@ def test_two_process_barrier_epoch_survives_failure():
         assert rc == 0, out
         assert "BARRIER-EPOCH-OK" in out
         assert "fails=2" in out, out        # both failures really happened
+
+
+@pytest.mark.slow
+def test_two_process_barrier_ghost_arrival_window_closed():
+    """A member that times out TWICE at the same epoch (re-arriving each
+    time) must still fail while the peer is absent — the double-count
+    release the r4 counter protocol allowed when a retract failed — and
+    the round heals the moment the peer arrives."""
+    for rc, out in _run_pair("barrier_ghost"):
+        assert rc == 0, out
+        assert "BARRIER-GHOST-OK" in out
 
 
 @pytest.mark.slow
