@@ -61,6 +61,12 @@ class Store:
         """Base names of entries under ``path`` (files only is fine)."""
         raise NotImplementedError
 
+    def delete(self, path: str) -> None:
+        """Remove ``path`` recursively if it exists (staging invalidates
+        a superseded dataset this way — see ``spark/common/util
+        .prepare_data``)."""
+        raise NotImplementedError
+
     def join(self, *parts: str) -> str:
         return posixpath.join(*parts)
 
@@ -112,6 +118,13 @@ class LocalStore(Store):
     def listdir(self, path: str) -> List[str]:
         return sorted(os.listdir(path))
 
+    def delete(self, path: str) -> None:
+        import shutil
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
     def join(self, *parts: str) -> str:
         return os.path.join(*parts)
 
@@ -157,6 +170,10 @@ class FsspecStore(Store):
     def listdir(self, path: str) -> List[str]:
         return sorted(posixpath.basename(p.rstrip("/"))
                       for p in self.fs.ls(path, detail=False))
+
+    def delete(self, path: str) -> None:
+        if self.fs.exists(path):
+            self.fs.rm(path, recursive=True)
 
 
 # ---------------------------------------------------------------------------

@@ -30,10 +30,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.models.gpt2 import loss_fn  # same next-token CE  # noqa: F401
+from horovod_tpu.models.gpt2 import loss_fn_moe  # CE + aux  # noqa: F401
 from horovod_tpu.parallel.sharding import PartitionRules
 
-__all__ = ["Llama", "LlamaConfig", "loss_fn", "partition_rules",
-           "apply_rope"]
+__all__ = ["Llama", "LlamaConfig", "loss_fn", "loss_fn_moe",
+           "partition_rules", "apply_rope"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +55,13 @@ class LlamaConfig:
     sp_impl: str = "ring"            # "ring" | "ulysses"
     attention: str = "dense"         # "dense" | "flash"
     flash_blocks: Optional[tuple] = None
+    # num_experts > 0 swaps every SwiGLU for a Mixtral-style MoE layer:
+    # bias-free SwiGLU experts behind a top-2 router (ops/moe.py),
+    # experts sharded over the "ep" mesh axis. Add the sown "losses"
+    # aux (loss_fn_moe) to the objective.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_router: str = "top2"         # Mixtral routes top-2
 
     @staticmethod
     def llama7b() -> "LlamaConfig":
@@ -143,6 +151,17 @@ class SwiGLU(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        if cfg.num_experts > 0:
+            # Mixtral recipe: SwiGLU experts + top-2 routing; same
+            # dispatch/combine einsums as the GPT-2 MoE path, so GSPMD
+            # derives the identical ep all-to-alls.
+            from horovod_tpu.ops.moe import MoEMLP
+            out, aux = MoEMLP(cfg.num_experts, cfg.d_ff,
+                              cfg.expert_capacity_factor, cfg.dtype,
+                              router_type=cfg.moe_router,
+                              activation="swiglu", name="moe")(x)
+            self.sow("losses", "moe_aux", aux)
+            return out
         g = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
                      name="gate")(x)
         u = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
@@ -233,5 +252,6 @@ def partition_rules() -> PartitionRules:
         (r"lm_head$", P("tp", None)),
         (r"(wq|wk|wv|gate|up)/kernel$", P(None, "tp")),
         (r"(wo|down)/kernel$", P("tp", None)),
+        (r"moe/(w_gate|w_in|w_out)$", P("ep", None, None)),
         (r"scale$", P()),
     ])

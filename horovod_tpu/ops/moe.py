@@ -160,6 +160,10 @@ class MoEMLP(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     # "top1" (Switch) or "top2" (GShard); same dispatch/combine contract.
     router_type: str = "top1"
+    # "gelu": 2-matrix biased FFN experts (Switch/GShard). "swiglu":
+    # bias-free 3-matrix gated experts (the Mixtral shape — pair with
+    # router_type="top2" for the full recipe).
+    activation: str = "gelu"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -177,26 +181,43 @@ class MoEMLP(nn.Module):
         dispatch, combine, aux_loss = router_cls(
             self.num_experts, self.capacity_factor, name="router")(tokens)
 
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown activation {self.activation!r}; "
+                             "expected 'gelu' or 'swiglu'")
         w_in = self.param("w_in", nn.initializers.lecun_normal(), (e, d, f),
                           jnp.float32)
-        b_in = self.param("b_in", nn.initializers.zeros, (e, f), jnp.float32)
         w_out = self.param("w_out", nn.initializers.lecun_normal(), (e, f, d),
                            jnp.float32)
-        b_out = self.param("b_out", nn.initializers.zeros, (e, d),
-                           jnp.float32)
+        if self.activation == "swiglu":
+            w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                                (e, d, f), jnp.float32)
+        else:
+            b_in = self.param("b_in", nn.initializers.zeros, (e, f),
+                              jnp.float32)
+            b_out = self.param("b_out", nn.initializers.zeros, (e, d),
+                               jnp.float32)
 
         # Dispatch: (N, E, C) x (N, D) -> (E, C, D). Contracting the
         # token-sharded axis against expert-sharded weights is where GSPMD
         # inserts the ep all-to-all.
         expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
                                tokens.astype(self.dtype))
-        h = jnp.einsum("ecd,edf->ecf", expert_in,
-                       w_in.astype(self.dtype)) + b_in[:, None].astype(
-                           self.dtype)
-        h = nn.gelu(h)
-        expert_out = jnp.einsum("ecf,efd->ecd", h,
-                                w_out.astype(self.dtype)) + b_out[
-                                    :, None].astype(self.dtype)
+        if self.activation == "swiglu":
+            g = jnp.einsum("ecd,edf->ecf", expert_in,
+                           w_gate.astype(self.dtype))
+            u = jnp.einsum("ecd,edf->ecf", expert_in,
+                           w_in.astype(self.dtype))
+            h = nn.silu(g) * u
+            expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                    w_out.astype(self.dtype))
+        else:
+            h = jnp.einsum("ecd,edf->ecf", expert_in,
+                           w_in.astype(self.dtype)) + b_in[:, None].astype(
+                               self.dtype)
+            h = nn.gelu(h)
+            expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                    w_out.astype(self.dtype)) + b_out[
+                                        :, None].astype(self.dtype)
         # Combine back to token order; dropped tokens get zeros.
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype),
                          expert_out)

@@ -1,0 +1,64 @@
+"""DataFrame -> Store staging (upstream ``horovod/spark/common/util.py``
+``prepare_data``): materialise any DataFrame-shaped dataset under a Store's
+run layout once, so estimators (and hand-rolled training loops) can stream
+shard partitions without the driver's arrays in any task payload.
+
+Upstream converts a Spark DataFrame to parquet under the store via
+Petastorm; here the same seam accepts anything :func:`~horovod_tpu.spark
+.estimator._to_columns` understands — a pyspark DataFrame (``toPandas``),
+a pandas DataFrame, a dict of arrays, or a list of row dicts — and writes
+npz/parquet shards plus ``_meta.json``. The pyspark dependency stays
+optional: nothing here imports it; the DataFrame duck-types in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+__all__ = ["prepare_data"]
+
+
+def prepare_data(df: Any, store: Any, run_id: str = "default", *,
+                 validation=None, num_shards: int = 4,
+                 data_format: str = "parquet", seed: int = 0
+                 ) -> Tuple[Any, Optional[Any]]:
+    """Materialise ``df`` under ``store``'s run layout; returns
+    ``(train_ref, val_ref)`` :class:`~horovod_tpu.spark.estimator
+    .StoreDataRef`\\ s (``val_ref`` None without ``validation``).
+
+    ``validation`` follows the estimator semantics
+    (``horovod/spark/common/params.py``): a float fraction held out
+    deterministically on ``seed``, or a column whose truthy rows are
+    validation (marker dropped). The refs plug straight into
+    ``JaxEstimator(store=...).fit_on_store()`` — or hand
+    ``ShardedDatasetReader(ref.store, ref.path, rank, world)`` to any
+    training loop. This is also the ONE staging implementation: the
+    estimators' ``fit(df)`` store path delegates here.
+
+    Re-staging under a run_id that previously had a val split, now
+    without ``validation``, DELETES the stale split — otherwise a later
+    ``fit_on_store(validation=...)`` would compute val metrics against a
+    different dataset's rows while training on the new one.
+    """
+    from horovod_tpu.data import store as dstore
+    from horovod_tpu.data.store import Store
+    from horovod_tpu.spark.estimator import (StoreDataRef, _split_validation,
+                                             _to_columns)
+
+    if isinstance(store, str):
+        store = Store.create(store)
+    columns = _to_columns(df)
+    train, val = _split_validation(columns, validation, seed)
+    path = store.train_data_path(run_id)
+    dstore.write_dataset(train, store, path, num_shards=num_shards,
+                         fmt=data_format)
+    val_path = store.val_data_path(run_id)
+    if val is None:
+        try:
+            store.delete(val_path)      # invalidate a superseded split
+        except NotImplementedError:
+            pass
+        return StoreDataRef(store, path), None
+    dstore.write_dataset(val, store, val_path, num_shards=num_shards,
+                         fmt=data_format)
+    return StoreDataRef(store, path), StoreDataRef(store, val_path)

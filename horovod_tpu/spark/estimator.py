@@ -417,18 +417,14 @@ class _StoreFitMixin:
         if df is not None:
             columns = _to_columns(df)
             self._check_cols(sorted(columns))
-            train, val = _split_validation(columns, validation, self.seed)
-            num_shards = self.num_shards or 2 * self.backend.num_workers
-            dstore.write_dataset(train, self.store, path,
-                                 num_shards=num_shards,
-                                 fmt=self.data_format)
-            if val is not None:
-                dstore.write_dataset(val, self.store, val_path,
-                                     num_shards=num_shards,
-                                     fmt=self.data_format)
-                return (StoreDataRef(self.store, path),
-                        StoreDataRef(self.store, val_path))
-            return StoreDataRef(self.store, path), None
+            # ONE staging implementation (upstream
+            # horovod/spark/common/util.py:prepare_data) — incl. its
+            # stale-val invalidation when validation is None.
+            from horovod_tpu.spark.common.util import prepare_data
+            return prepare_data(
+                columns, self.store, self.run_id, validation=validation,
+                num_shards=self.num_shards or 2 * self.backend.num_workers,
+                data_format=self.data_format, seed=self.seed)
         meta = dstore.read_meta(self.store, path)
         self._check_cols(sorted(meta["columns"]))
         if validation is None:

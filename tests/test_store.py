@@ -594,3 +594,74 @@ class TestEstimatorValidation:
         with pytest.raises(ValueError, match="no training rows"):
             est.fit({"features": X, "label": y,
                      "mark": np.ones(64, bool)})
+
+
+class TestPrepareData:
+    """Upstream horovod/spark/common/util.py:prepare_data — stage any
+    DataFrame-shaped dataset under the store once, estimators reuse it."""
+
+    def test_stage_then_fit_on_store(self, tmp_path):
+        import pandas as pd
+        from horovod_tpu.cluster import InlineBackend
+        from horovod_tpu.spark import JaxEstimator
+        from horovod_tpu.spark.common.util import prepare_data
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, -2.0, 0.5], np.float32)).astype(np.float32)
+        df = pd.DataFrame({"features": list(X), "label": y})
+
+        train_ref, val_ref = prepare_data(
+            df, str(tmp_path), run_id="staged", validation=0.25,
+            num_shards=4)
+        assert val_ref is not None
+        meta = read_meta(train_ref.store, train_ref.path)
+        assert meta["total_rows"] == 48 and meta["format"] == "parquet"
+        assert read_meta(val_ref.store, val_ref.path)["total_rows"] == 16
+
+        # The staged data feeds fit_on_store without a DataFrame in sight.
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        est = JaxEstimator(Linear(), lambda p, l: jnp.mean((p - l) ** 2),
+                           lr=0.1, epochs=4, batch_size=8,
+                           store=str(tmp_path), run_id="staged",
+                           backend=InlineBackend(), validation=0.25)
+        fitted = est.fit_on_store()
+        hist = fitted.get_history()
+        assert len(hist["val_loss"]) == 4
+        assert hist["train_loss"][-1] < hist["train_loss"][0]
+
+    def test_no_validation_returns_single_ref(self, tmp_path):
+        from horovod_tpu.spark.common.util import prepare_data
+
+        train_ref, val_ref = prepare_data(
+            {"features": np.zeros((8, 2), np.float32),
+             "label": np.zeros(8, np.float32)},
+            str(tmp_path), num_shards=2, data_format="npz")
+        assert val_ref is None
+        assert read_meta(train_ref.store, train_ref.path)["total_rows"] == 8
+
+    def test_restaging_without_validation_invalidates_stale_split(
+            self, tmp_path):
+        """df1 staged WITH a split, df2 re-staged WITHOUT one under the
+        same run_id: df1's val rows must not survive to poison a later
+        fit_on_store(validation=...)."""
+        from horovod_tpu.spark.common.util import prepare_data
+
+        rng = np.random.default_rng(0)
+        d1 = {"features": rng.standard_normal((32, 2)).astype(np.float32),
+              "label": np.zeros(32, np.float32)}
+        _, val_ref = prepare_data(d1, str(tmp_path), run_id="r",
+                                  validation=0.25)
+        assert val_ref is not None
+        _, val_ref2 = prepare_data(d1, str(tmp_path), run_id="r")
+        assert val_ref2 is None
+        store = LocalStore(str(tmp_path))
+        with pytest.raises((OSError, KeyError, ValueError)):
+            read_meta(store, store.val_data_path("r"))
