@@ -75,9 +75,10 @@ class LlamaConfig:
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=256, max_seq_len=128, num_layers=2,
-                           num_heads=4, num_kv_heads=2, d_model=64,
-                           d_ff=128, **kw)
+        base = dict(vocab_size=256, max_seq_len=128, num_layers=2,
+                    num_heads=4, num_kv_heads=2, d_model=64, d_ff=128)
+        base.update(kw)          # overrides of the tiny defaults allowed
+        return LlamaConfig(**base)
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,

@@ -107,3 +107,14 @@ class TestExamples:
     def test_uneven_data_join(self):
         out = _run("uneven_data_join.py")
         assert "final |W - true|" in out
+
+    def test_mixtral_train(self):
+        out = _run("mixtral_train.py", "--steps", "3")
+        assert "SwiGLU experts, top-2 routed" in out
+        assert "final loss" in out
+
+    def test_fsdp_elastic(self):
+        out = _run("fsdp_elastic.py", timeout=600)
+        assert "[simulated preemption at step 5]" in out
+        assert "step 10 on 4 devices" in out       # resumed at half world
+        assert "done: 10 steps" in out
