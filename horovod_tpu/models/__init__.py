@@ -2,7 +2,9 @@
 MNIST CNN, ResNet-50, BERT-large, GPT-2 medium, ViT-B/16 — implemented in
 flax for TPU (bf16 compute, MXU-friendly shapes), not ported from the
 reference's TF/torch example scripts. Plus the Llama family (RoPE +
-RMSNorm + SwiGLU + GQA) for modern-LLM migrations.
+RMSNorm + SwiGLU + GQA, optional Mixtral-style MoE) and the T5
+encoder-decoder family for modern-LLM migrations — all three
+architecture classes (decoder-only, encoder-only, encoder-decoder).
 """
 
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
@@ -38,4 +40,7 @@ def get_model(name: str, **kw):
         base = (LlamaConfig.llama7b() if name == "llama7b"
                 else LlamaConfig.small())
         return Llama(dataclasses.replace(base, **kw) if kw else base)
+    if name in ("t5", "t5_small", "t5-small"):
+        from horovod_tpu.models.t5 import T5, T5Config
+        return T5(T5Config.small() if "small" in name else T5Config(**kw))
     raise ValueError(f"unknown model {name}")

@@ -28,8 +28,10 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         key_mask: Optional[jnp.ndarray] = None,
                         segment_ids: Optional[jnp.ndarray] = None,
                         out_dtype: Optional[jnp.dtype] = None,
-                        flash_blocks: Optional[tuple] = None) -> jnp.ndarray:
-    """softmax(q k^T / sqrt(d) [+ masks]) v over (B, T, H, D) tensors.
+                        flash_blocks: Optional[tuple] = None,
+                        bias: Optional[jnp.ndarray] = None,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """softmax(q k^T * scale [+ bias + masks]) v over (B, T, H, D).
 
     Args:
       impl: "dense" (materialised scores, fp32 softmax) or "flash" (fused
@@ -46,6 +48,13 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       flash_blocks: optional (block_q, block_k) tiling override for the
         flash kernel — feed ``autotune_flash_blocks``'s pick for this
         shape; None keeps the kernel defaults. Ignored by "dense".
+      bias: optional additive score bias, (H, T_q, T_kv) or
+        (B, H, T_q, T_kv) fp32 — T5-style per-head relative position
+        biases. DENSE ONLY: the flash kernel's fused bias is per-key
+        (``key_bias``) and cannot express a 2-D per-head tensor, so
+        passing one with impl="flash" raises.
+      scale: logit scale override; default ``1/sqrt(head_dim)`` (T5
+        famously uses 1.0 — folded into its initializer).
 
     Returns (B, T_q, H, D).
     """
@@ -57,6 +66,10 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     d = q.shape[-1]
 
     if impl == "flash":
+        if bias is not None:
+            raise ValueError(
+                "per-head 2-D attention bias requires impl='dense' (the "
+                "flash kernel's fused bias is per-key only)")
         from horovod_tpu.ops.flash_attention import flash_attention
         key_bias = None
         if key_mask is not None:
@@ -65,13 +78,16 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         if flash_blocks is not None:
             blocks = {"block_q": int(flash_blocks[0]),
                       "block_k": int(flash_blocks[1])}
-        return flash_attention(q, k, v, causal=causal,
+        return flash_attention(q, k, v, causal=causal, scale=scale,
                                key_bias=key_bias,
                                segment_ids=segment_ids,
                                **blocks).astype(out_dtype)
 
-    scale = d ** -0.5
+    scale = d ** -0.5 if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        b = bias if bias.ndim == 4 else bias[None]
+        s = s + b.astype(jnp.float32)
     if key_mask is not None:
         s = jnp.where(key_mask[:, None, None, :], s, _NEG_INF)
     if segment_ids is not None:

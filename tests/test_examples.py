@@ -118,3 +118,7 @@ class TestExamples:
         assert "[simulated preemption at step 5]" in out
         assert "step 10 on 4 devices" in out       # resumed at half world
         assert "done: 10 steps" in out
+
+    def test_t5_train(self):
+        out = _run("t5_train.py", "--steps", "3")
+        assert "final seq2seq loss" in out
