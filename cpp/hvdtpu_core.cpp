@@ -165,6 +165,41 @@ int hvd_cache_size(void* h) {
 }
 
 // -------------------------------------------------------------- fusion plan
+// First-fit-decreasing bin packing for sequence packing
+// (horovod_tpu/data/packing.py; the reference ecosystem packs in its C++
+// data-loader workers). Documents are visited in decreasing-length order
+// (ties broken by original index, matching the Python fallback exactly)
+// and placed in the first open row with space; a new row opens when none
+// fits. Writes each doc's row into row_of[i]; returns the number of rows
+// used, or -1 on a bad argument (null pointer, n <= 0, or a length
+// outside [0, row_len]). O(n * rows) first-fit scan — row counts are
+// batch-sized, not corpus-sized.
+int hvd_pack_ffd(const int64_t* lengths, int n, int64_t row_len,
+                 int32_t* row_of) {
+  if (!lengths || !row_of || n <= 0 || row_len <= 0) return -1;
+  std::vector<int> order(n);
+  for (int i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+    return lengths[a] > lengths[b];
+  });
+  std::vector<int64_t> space;
+  for (int idx : order) {
+    const int64_t len = lengths[idx];
+    if (len > row_len || len < 0) return -1;
+    int placed = -1;
+    for (size_t r = 0; r < space.size(); ++r) {
+      if (space[r] >= len) { placed = static_cast<int>(r); break; }
+    }
+    if (placed < 0) {
+      space.push_back(row_len);
+      placed = static_cast<int>(space.size()) - 1;
+    }
+    space[placed] -= len;
+    row_of[idx] = placed;
+  }
+  return static_cast<int>(space.size());
+}
+
 // Greedy assignment of tensors (by size in bytes, given order) into buckets
 // of at most threshold bytes, each tensor padded to `align` bytes (TPU lane
 // alignment). A tensor larger than the threshold gets its own bucket.

@@ -36,41 +36,16 @@ from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
 from horovod_tpu.ops.attention import packed_positions
 
 
-def pack_documents(docs, row_len, n_rows, pad_id=0):
-    """Greedy first-fit packing: (tokens, segment_ids) of (n_rows, row_len).
-
-    Leftover space at a row's end is filled with ``pad_id`` tokens, each
-    carrying its OWN distinct (negative) segment id. That makes "the
-    loss never trains on filler" literally true: the packed loss drops
-    targets whose segment changes between input and target position, and
-    with no two adjacent filler tokens sharing an id, every filler
-    target (pad->pad included) is dropped — a single shared filler
-    segment would keep its within-segment pad->pad targets at weight 1
-    and dilute the loss. Attention-wise each filler token only sees
-    itself, so real documents are untouched either way.
-    """
-    rows = [[] for _ in range(n_rows)]
-    segs = [[] for _ in range(n_rows)]
-    next_seg = [0] * n_rows
-    for doc in docs:
-        r = max(range(n_rows),
-                key=lambda i: row_len - len(rows[i]) >= len(doc))
-        if row_len - len(rows[r]) < len(doc):
-            continue                      # row full; real pipelines spill
-        rows[r].extend(doc)
-        segs[r].extend([next_seg[r]] * len(doc))
-        next_seg[r] += 1
-    for r in range(n_rows):
-        fill = row_len - len(rows[r])
-        rows[r].extend([pad_id] * fill)
-        segs[r].extend(range(-1, -fill - 1, -1))
-    return (jnp.asarray(rows, jnp.int32), jnp.asarray(segs, jnp.int32))
+# Packing is a library utility: first-fit-decreasing row assignment
+# (native C++ hvd_pack_ffd when available) + filler tokens with DISTINCT
+# negative segment ids, so the packed loss drops every filler target and
+# "never trains on filler" is literally true. See data/packing.py.
+from horovod_tpu.data import pack_documents
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--rows", type=int, default=2)
     ap.add_argument("--row-len", type=int, default=128)
     ap.add_argument("--flash", action="store_true")
     args = ap.parse_args()
@@ -85,11 +60,12 @@ def main():
     rng = np.random.default_rng(0)
     docs = [rng.integers(1, cfg.vocab_size, rng.integers(8, 60)).tolist()
             for _ in range(12)]
-    tokens, seg = pack_documents(docs, args.row_len, args.rows)
+    tokens, seg = pack_documents(docs, args.row_len)
+    tokens, seg = jnp.asarray(tokens), jnp.asarray(seg)
     pos = packed_positions(seg)
     if hvd.rank() == 0:
         n_docs = int(seg.max()) + 1
-        print(f"packed {n_docs} segments into {args.rows} rows of "
+        print(f"packed {n_docs} segments into {tokens.shape[0]} rows of "
               f"{args.row_len} tokens", flush=True)
 
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
@@ -114,10 +90,13 @@ def main():
         print(f"step {i}: packed loss {last:.4f}", flush=True)
 
     # The exactness claim, demonstrated: document 0's logits inside the
-    # packed row equal running it alone.
-    d0 = tokens[0, : int((seg[0] == 0).sum())][None]
+    # packed row equal running it alone (FFD may have placed it in any
+    # row/offset — locate it by its segment id).
+    rr, cc = np.where(np.asarray(seg) == 0)
+    row, c0, c1 = int(rr[0]), int(cc.min()), int(cc.max()) + 1
+    d0 = tokens[row, c0:c1][None]
     got = model.apply({"params": params}, tokens,
-                      segment_ids=seg, positions=pos)[0, : d0.shape[1]]
+                      segment_ids=seg, positions=pos)[row, c0:c1]
     alone = model.apply({"params": params}, d0)[0]
     err = float(jnp.abs(got - alone).max())
     print(f"packed-vs-alone max logit diff: {err:.2e}", flush=True)

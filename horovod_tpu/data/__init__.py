@@ -25,10 +25,14 @@ import numpy as np
 __all__ = ["DistributedSampler", "ShardedBatchIterator", "shard_arrays",
            "Store", "LocalStore", "FsspecStore", "write_dataset",
            "read_meta", "ShardedDatasetReader", "BackgroundIterator",
-           "prefetch_to_device"]
+           "prefetch_to_device", "prefetched", "pack_rows",
+           "pack_documents"]
 
+from horovod_tpu.data.packing import (  # noqa: E402,F401
+    pack_documents, pack_rows,
+)
 from horovod_tpu.data.prefetch import (  # noqa: E402,F401
-    BackgroundIterator, prefetch_to_device,
+    BackgroundIterator, prefetch_to_device, prefetched,
 )
 from horovod_tpu.data.store import (  # noqa: E402,F401
     FsspecStore, LocalStore, ShardedDatasetReader, Store, read_meta,

@@ -1,0 +1,117 @@
+"""Sequence packing: variable-length documents into fixed-length rows.
+
+The long-context data format (the reference fork's north star workload):
+documents are packed back-to-back into ``(rows, row_len)`` token
+matrices with per-token ``segment_ids``, and the attention/position/loss
+machinery makes packing EXACT — each document trains as if it were alone
+(``ops/attention.segment_mask`` / ``packed_positions``; every model in
+the zoo takes ``segment_ids``).
+
+Row assignment is first-fit-decreasing (within ~11/9 of the optimal row
+count, the classic bound), computed by the native C++ core when
+available (``cpp/hvdtpu_core.cpp hvd_pack_ffd`` — the reference
+ecosystem packs inside its C++ data-loader workers) with a
+byte-identical NumPy fallback. Filler positions at each row's tail get
+DISTINCT negative segment ids, so packed losses drop every filler
+target and "never trains on filler" is literally true (see
+``examples/gpt2_packed.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["pack_rows", "pack_documents"]
+
+
+def _pack_rows_py(lengths: np.ndarray, row_len: int) -> np.ndarray:
+    """NumPy first-fit-decreasing; MUST mirror hvd_pack_ffd exactly
+    (decreasing length, ties by original index, first open row with
+    space) so the native fast path is a pure speedup."""
+    order = sorted(range(len(lengths)), key=lambda i: (-lengths[i], i))
+    row_of = np.empty(len(lengths), np.int32)
+    space: List[int] = []
+    for idx in order:
+        ln = int(lengths[idx])
+        placed = -1
+        for r, s in enumerate(space):
+            if s >= ln:
+                placed = r
+                break
+        if placed < 0:
+            space.append(row_len)
+            placed = len(space) - 1
+        space[placed] -= ln
+        row_of[idx] = placed
+    return row_of
+
+
+def pack_rows(lengths: Sequence[int], row_len: int) -> np.ndarray:
+    """Row index per document (first-fit-decreasing over ``row_len``).
+
+    Native C++ when available, identical NumPy fallback otherwise.
+    Raises ``ValueError`` if any document exceeds ``row_len`` — split
+    long documents upstream; silent truncation would corrupt targets.
+    """
+    lengths = np.asarray(lengths, np.int64)
+    if lengths.size == 0:
+        return np.empty(0, np.int32)
+    if int(lengths.min()) < 0:
+        raise ValueError(
+            f"negative document length {int(lengths.min())} — lengths "
+            "must be non-negative (caller bug, not a packing limit)")
+    if int(lengths.max()) > row_len:
+        raise ValueError(
+            f"document of length {int(lengths.max())} cannot fit "
+            f"row_len={row_len}; split long documents before packing")
+    from horovod_tpu import native
+    lib = native.load()
+    if lib is not None and hasattr(lib, "hvd_pack_ffd"):
+        import ctypes
+        row_of = np.empty(lengths.size, np.int32)
+        rows = lib.hvd_pack_ffd(
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            int(lengths.size), int(row_len),
+            row_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rows >= 0:
+            return row_of
+    return _pack_rows_py(lengths, row_len)
+
+
+def pack_documents(docs: Sequence[Sequence[int]], row_len: int, *,
+                   pad_id: int = 0, max_rows: Optional[int] = None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack token documents into ``(tokens, segment_ids)`` of shape
+    ``(rows, row_len)`` (int32).
+
+    Within a row, documents keep their original relative order; segment
+    ids number documents globally in input order (so callers can map a
+    segment back to its document); row tails are ``pad_id`` filler with
+    distinct negative ids (exactness — see module docstring).
+    ``max_rows`` bounds the packing: exceeding it raises (real pipelines
+    spill the remainder into the next batch; silently dropping documents
+    here would skew training data).
+    """
+    lengths = [len(d) for d in docs]
+    row_of = pack_rows(lengths, row_len)
+    n_rows = int(row_of.max()) + 1 if row_of.size else 0
+    if max_rows is not None and n_rows > max_rows:
+        raise ValueError(
+            f"packing needs {n_rows} rows of {row_len} but max_rows="
+            f"{max_rows}; spill {n_rows - max_rows} row(s) of documents "
+            "to the next batch")
+    tokens = np.full((n_rows, row_len), pad_id, np.int32)
+    segs = np.empty((n_rows, row_len), np.int32)
+    cursor = np.zeros(n_rows, np.int64)
+    for i, doc in enumerate(docs):
+        r = int(row_of[i])
+        c = int(cursor[r])
+        tokens[r, c:c + len(doc)] = np.asarray(doc, np.int32)
+        segs[r, c:c + len(doc)] = i
+        cursor[r] += len(doc)
+    for r in range(n_rows):
+        fill = row_len - int(cursor[r])
+        segs[r, row_len - fill:] = np.arange(-1, -fill - 1, -1)
+    return tokens, segs

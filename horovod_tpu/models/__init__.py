@@ -41,6 +41,9 @@ def get_model(name: str, **kw):
                 else LlamaConfig.small())
         return Llama(dataclasses.replace(base, **kw) if kw else base)
     if name in ("t5", "t5_small", "t5-small"):
+        import dataclasses
+
         from horovod_tpu.models.t5 import T5, T5Config
-        return T5(T5Config.small() if "small" in name else T5Config(**kw))
+        base = T5Config.small() if "small" in name else T5Config()
+        return T5(dataclasses.replace(base, **kw) if kw else base)
     raise ValueError(f"unknown model {name}")

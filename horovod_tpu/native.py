@@ -70,6 +70,21 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_fusion_plan.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int64,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+        if not hasattr(lib, "hvd_pack_ffd"):
+            # Stale .so built before the packer existed: rebuild once.
+            # A still-missing symbol must not take down every OTHER
+            # native consumer — packing falls back to Python instead
+            # (pack_rows checks hasattr).
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_SO_PATH)
+                except OSError:
+                    return None
+        if hasattr(lib, "hvd_pack_ffd"):
+            lib.hvd_pack_ffd.restype = ctypes.c_int
+            lib.hvd_pack_ffd.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
         lib.hvd_stall_check.restype = ctypes.c_int
         lib.hvd_stall_check.argtypes = [ctypes.c_void_p, ctypes.c_double,
                                         ctypes.c_char_p, ctypes.c_int]
