@@ -502,9 +502,47 @@ def bench_gpt2_packed(on_tpu):
         model_flops=mflops)
 
 
+def bench_t5(on_tpu):
+    """T5-small-class encoder-decoder at 512/512: the zoo's third
+    architecture family gets its own perf anchor (dense attention by
+    construction — the per-head relative-position bias is inexpressible
+    in the flash kernel's per-key fused bias)."""
+    from horovod_tpu.models.t5 import (T5, T5Config, seq2seq_loss,
+                                       shift_right)
+    if on_tpu:
+        import dataclasses
+        cfg = dataclasses.replace(
+            T5Config.small(), remat=True,
+            remat_policy=os.environ.get("HOROVOD_BENCH_REMAT", "dots"))
+        B, T, steps = 16, 512, 10
+    else:
+        cfg = T5Config.tiny()
+        B, T, steps = 2, 32, 3
+    model = T5(cfg)
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), src,
+                        shift_right(tgt, cfg.pad_id))["params"]
+    # Analytic model FLOPs: all params are matmul weights except the
+    # lookup-only embedding table (lm_head is untied and real);
+    # attention = enc self (bidir, T_enc) + dec self (causal, T_dec) +
+    # cross (T_enc keys), each 12*L*T_kv*(H*hd) per query token.
+    d_attn = cfg.num_heads * cfg.head_dim
+    attn = 12.0 * (cfg.num_encoder_layers * T          # enc self
+                   + cfg.num_decoder_layers * T * 2)   # dec self + cross
+    mflops = (6.0 * (_n_params(params)
+                     - cfg.vocab_size * cfg.d_model)
+              + attn * d_attn) * B * T
+    return _bench_lm(
+        params, tgt,
+        lambda p: seq2seq_loss(model, p, src, tgt),
+        steps, "t5_small_tokens_per_sec_per_chip", model_flops=mflops)
+
+
 _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
             "gpt2_long": bench_gpt2_long, "llama": bench_llama,
-            "gpt2_packed": bench_gpt2_packed,
+            "gpt2_packed": bench_gpt2_packed, "t5": bench_t5,
             "bert": bench_bert, "vit": bench_vit, "mnist": bench_mnist,
             "allreduce": bench_allreduce}
 
@@ -533,7 +571,8 @@ def _inner_main(args):
     if args.model == "all":
         # headline (resnet50) last so single-line parsers read it.
         for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
-                     "gpt2_long", "gpt2_packed", "llama", "resnet50"):
+                     "gpt2_long", "gpt2_packed", "llama", "t5",
+                     "resnet50"):
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)
@@ -546,6 +585,7 @@ _HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
                     "llama": "llama_340m_gqa_tokens_per_sec_per_chip",
                     "gpt2_packed":
                         "gpt2_medium_packed_tokens_per_sec_per_chip",
+                    "t5": "t5_small_tokens_per_sec_per_chip",
                     "bert": "bert_large_tokens_per_sec_per_chip",
                     "vit": "vit_b16_images_per_sec_per_chip",
                     "mnist": "mnist_images_per_sec_per_chip",

@@ -55,7 +55,10 @@ def pack_rows(lengths: Sequence[int], row_len: int) -> np.ndarray:
     Raises ``ValueError`` if any document exceeds ``row_len`` — split
     long documents upstream; silent truncation would corrupt targets.
     """
-    lengths = np.asarray(lengths, np.int64)
+    # Contiguity matters: ctypes hands the BASE pointer to the native
+    # packer, so a strided view (lengths[::2]) would be read with the
+    # wrong layout — silently packing the wrong lengths.
+    lengths = np.ascontiguousarray(lengths, np.int64)
     if lengths.size == 0:
         return np.empty(0, np.int32)
     if int(lengths.min()) < 0:
@@ -83,10 +86,11 @@ def pack_rows(lengths: Sequence[int], row_len: int) -> np.ndarray:
 def pack_documents(docs: Sequence[Sequence[int]], row_len: int, *,
                    pad_id: int = 0, max_rows: Optional[int] = None
                    ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pack token documents into ``(tokens, segment_ids)`` of shape
-    ``(rows, row_len)`` (int32).
+    """Pack token documents into int32 ``(tokens, segment_ids)`` matrices.
 
-    Within a row, documents keep their original relative order; segment
+    Both outputs are shaped ``(rows, row_len)`` with the row count chosen
+    by first-fit-decreasing. Within a row, documents keep their original
+    relative order; segment
     ids number documents globally in input order (so callers can map a
     segment back to its document); row tails are ``pad_id`` filler with
     distinct negative ids (exactness — see module docstring).

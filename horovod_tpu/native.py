@@ -48,6 +48,18 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
             return None
+        if not hasattr(lib, "hvd_pack_ffd"):
+            # Stale .so predating the packer: rebuild + reload BEFORE any
+            # ctypes bindings are set (bindings applied to an old handle
+            # would be lost by the reload — a truncated c_int pointer
+            # return corrupts every coordinator call). If the rebuild
+            # fails, keep the OLD lib: packing falls back to Python
+            # (pack_rows checks hasattr) but every other consumer works.
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_SO_PATH)
+                except OSError:
+                    pass          # keep the old handle
         lib.hvd_coord_create.restype = ctypes.c_void_p
         lib.hvd_coord_create.argtypes = [ctypes.c_int]
         lib.hvd_coord_destroy.argtypes = [ctypes.c_void_p]
@@ -70,16 +82,6 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_fusion_plan.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int64,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
-        if not hasattr(lib, "hvd_pack_ffd"):
-            # Stale .so built before the packer existed: rebuild once.
-            # A still-missing symbol must not take down every OTHER
-            # native consumer — packing falls back to Python instead
-            # (pack_rows checks hasattr).
-            if _build():
-                try:
-                    lib = ctypes.CDLL(_SO_PATH)
-                except OSError:
-                    return None
         if hasattr(lib, "hvd_pack_ffd"):
             lib.hvd_pack_ffd.restype = ctypes.c_int
             lib.hvd_pack_ffd.argtypes = [

@@ -113,3 +113,15 @@ class TestPackDocuments:
             np.testing.assert_allclose(
                 np.asarray(packed[rr[0], cc.min():cc.max() + 1]),
                 np.asarray(alone[0]), rtol=1e-4, atol=1e-4)
+
+
+def test_strided_view_packs_correctly():
+    """ctypes hands the BASE pointer to the native packer — a strided
+    view must be made contiguous first or the wrong lengths get packed
+    (found in review; reproduced with lengths[::2])."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, 100, 400)
+    view = base[::2]
+    got = pack_rows(view, 128)
+    want = _pack_rows_py(np.ascontiguousarray(view, np.int64), 128)
+    np.testing.assert_array_equal(got, want)

@@ -47,6 +47,7 @@ MODULES = [
     "horovod_tpu.models",
     "horovod_tpu.models.gpt2_pipeline",
     "horovod_tpu.models.llama",
+    "horovod_tpu.models.t5",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",
@@ -58,7 +59,10 @@ MODULES = [
     "horovod_tpu.ops.quantized",
     "horovod_tpu.ops.tile_table",
     "horovod_tpu.data.store",
+    "horovod_tpu.data.packing",
+    "horovod_tpu.data.prefetch",
     "horovod_tpu.spark.common.store",
+    "horovod_tpu.spark.common.util",
     "horovod_tpu.torch",
     "horovod_tpu.torch.elastic",
     "horovod_tpu.tensorflow",

@@ -44,6 +44,7 @@ AGENDA = [
     ("resnet50", {}, None),
     ("gpt2_long", {}, None),
     ("gpt2_packed", {}, None),
+    ("t5", {}, None),
     ("bert", {}, None),
     ("bert", {"HOROVOD_BENCH_REMAT": "dots"}, "remat=dots"),
     ("vit", {}, None),
