@@ -33,6 +33,7 @@ class GPT2Config:
     num_heads: int = 12
     d_model: int = 768
     dropout: float = 0.0
+    ln_eps: float = 1e-6             # HF checkpoints use 1e-5 (convert.py)
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     # Rematerialization policy when remat=True. "full" recomputes the whole
@@ -119,10 +120,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, segment_ids=None, deterministic=True):
         cfg = self.cfg
-        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        ln1 = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                           name="ln1")(x)
         x = x + Attention(cfg, name="attn")(ln1, segment_ids,
                                             deterministic)
-        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        ln2 = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                           name="ln2")(x)
         x = x + MLP(cfg, name="mlp")(ln2, deterministic)
         return x
 
@@ -177,7 +180,8 @@ class GPT2(nn.Module):
                     "expected 'full' or 'dots'")
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"h{i}")(x, segment_ids, deterministic)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
         # Tied lm head in fp32 (logits precision matters for loss).
         return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
 

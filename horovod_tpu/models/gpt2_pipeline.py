@@ -140,7 +140,7 @@ def _pp_loss(cfg: GPT2Config, blocks: Any, rest: dict, tokens: jnp.ndarray,
     pos = jnp.arange(T)
     x = wte[tokens].astype(cfg.dtype) + wpe[pos].astype(cfg.dtype)
 
-    ln_f = nn.LayerNorm(dtype=jnp.float32)
+    ln_f = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32)
 
     def loss_from_outputs(outs, mb_start):
         # two-arg chunking form: outs may be a sub-range of the M
@@ -299,7 +299,7 @@ def _stage_fn_tp(cfg: GPT2Config, tp_axis: str = "tp"):
     out/proj with one psum each — exactly two tp collectives per block, the
     Megatron count. Numerics mirror :class:`~horovod_tpu.models.gpt2.Block`
     with the head axis sliced."""
-    ln = nn.LayerNorm(dtype=jnp.float32)
+    ln = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32)
     f = _bwd_psum(tp_axis)
     g = _fwd_psum(tp_axis)
 
@@ -459,7 +459,7 @@ def _make_1f1b_step(cfg: GPT2Config, stage_fn, axis_name: str,
     from horovod_tpu.parallel.pipeline import (pipeline_1f1b,
                                                pipeline_interleaved_1f1b)
 
-    ln_f = nn.LayerNorm(dtype=jnp.float32)
+    ln_f = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32)
 
     def step(blocks, rest, tokens):
         blocks_local = jax.tree_util.tree_map(

@@ -47,6 +47,7 @@ class LlamaConfig:
     d_model: int = 4096
     d_ff: int = 11008                # SwiGLU hidden width
     rope_theta: float = 10000.0
+    rms_eps: float = 1e-6            # HF Llama-2/3 ship 1e-5 (convert.py)
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     remat_policy: str = "full"       # "full" | "dots" (GPT2Config docs)
@@ -109,13 +110,15 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
 
 class RMSNorm(nn.Module):
     """fp32 root-mean-square norm with a learned scale (no mean removal)."""
+    eps: float = 1e-6
+
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
                            jnp.float32)
         xf = x.astype(jnp.float32)
         y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
-                               + 1e-6)
+                               + self.eps)
         return (y * scale).astype(x.dtype)
 
 
@@ -178,9 +181,11 @@ class Block(nn.Module):
     def __call__(self, x, positions, segment_ids=None, deterministic=True):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="norm_attn")(x), positions, segment_ids,
+            RMSNorm(cfg.rms_eps, name="norm_attn")(x), positions,
+            segment_ids,
             deterministic)
-        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(name="norm_mlp")(x))
+        x = x + SwiGLU(cfg, name="mlp")(
+            RMSNorm(cfg.rms_eps, name="norm_mlp")(x))
         return x
 
 
@@ -236,7 +241,7 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"h{i}")(x, pos, segment_ids,
                                          deterministic)
-        x = RMSNorm(name="norm_f")(x)
+        x = RMSNorm(cfg.rms_eps, name="norm_f")(x)
         # Untied lm head (Llama convention), fp32 logits.
         wlm = self.param("lm_head", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
