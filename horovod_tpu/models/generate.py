@@ -1,0 +1,206 @@
+"""Autoregressive generation with a KV cache for the decoder families.
+
+Training forwards run the flash/sequence-parallel machinery; decode is a
+different program — one token per step against cached K/V, static
+shapes, the whole loop inside ONE ``lax.scan`` so XLA compiles a single
+program with no per-token dispatch. This module implements that decode
+program directly over the zoo's parameter trees (GPT-2 and Llama,
+selected by the module type) rather than threading a ``decode`` flag
+through the training modules: the two paths want different code, and the
+parity tests pin them together — decode logits equal the training
+forward position-by-position, and greedy generation matches
+HuggingFace's ``generate`` on converted checkpoints
+(``tests/test_generate.py``).
+
+The cache is a plain pytree of ``(B, T_total, H, hd)`` arrays (one K and
+one V per layer), donated through the scan carry. Sampling: greedy at
+``temperature=0`` (the default), otherwise temperature softmax with
+optional top-k truncation; an ``eos_id`` freezes finished rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate"]
+
+
+def _layernorm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (xf - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _rmsnorm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return y * p["scale"]
+
+
+def _attend_cached(q, ck, cv, idx, scale):
+    """One query (B, H, hd) over a cache (B, T, Hkv, hd), keys <= idx.
+
+    GQA stays grouped end-to-end: the cache is stored at Hkv width (the
+    whole point of grouped heads — H/Hkv times less KV memory) and the
+    query heads fold into (Hkv, H/Hkv) groups for the score einsums
+    instead of repeat-expanding K/V."""
+    b, h, hd = q.shape
+    hkv = ck.shape[2]
+    qg = q.reshape(b, hkv, h // hkv, hd).astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck.astype(jnp.float32)) * scale
+    t = ck.shape[1]
+    s = jnp.where(jnp.arange(t)[None, None, None, :] <= idx, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,btkd->bkgd", p, cv.astype(jnp.float32))
+    return o.reshape(b, h, hd)
+
+
+def _gpt2_step(cfg, params, cache, tok, idx):
+    """tok (B,) at position idx -> (new_cache, logits (B, V))."""
+    H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+    x = params["wte"][tok] + params["wpe"][idx]          # (B, D) fp32
+    for i in range(cfg.num_layers):
+        p = params[f"h{i}"]
+        h = _layernorm(x, p["ln1"], cfg.ln_eps)
+        qkv = h @ p["attn"]["qkv"]["kernel"] + p["attn"]["qkv"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["k"], k.reshape(-1, H, hd), idx, axis=1)
+        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["v"], v.reshape(-1, H, hd), idx, axis=1)
+        o = _attend_cached(q.reshape(-1, H, hd), ck, cv, idx, hd ** -0.5)
+        x = x + (o.reshape(-1, H * hd) @ p["attn"]["out"]["kernel"]
+                 + p["attn"]["out"]["bias"])
+        h = _layernorm(x, p["ln2"], cfg.ln_eps)
+        h = jax.nn.gelu(h @ p["mlp"]["fc"]["kernel"]
+                        + p["mlp"]["fc"]["bias"])
+        x = x + (h @ p["mlp"]["proj"]["kernel"] + p["mlp"]["proj"]["bias"])
+    x = _layernorm(x, params["ln_f"], cfg.ln_eps)
+    return cache, x @ params["wte"].T                    # tied head
+
+
+def _rope_one(x, pos, theta):
+    """RoPE for a single position: x (B, H, hd) — THE training rotation
+    (``models.llama.apply_rope``) on a length-1 sequence, so decode can
+    never drift from the training convention."""
+    from horovod_tpu.models.llama import apply_rope
+    return apply_rope(x[:, None], jnp.atleast_1d(pos), theta)[:, 0]
+
+
+def _llama_step(cfg, params, cache, tok, idx):
+    H, Hkv = cfg.num_heads, cfg.num_kv_heads
+    hd = cfg.d_model // H
+    x = params["wte"][tok].astype(jnp.float32)           # (B, D)
+    for i in range(cfg.num_layers):
+        p = params[f"h{i}"]
+        h = _rmsnorm(x, p["norm_attn"], cfg.rms_eps)
+        q = (h @ p["attn"]["wq"]["kernel"]).reshape(-1, H, hd)
+        k = (h @ p["attn"]["wk"]["kernel"]).reshape(-1, Hkv, hd)
+        v = (h @ p["attn"]["wv"]["kernel"]).reshape(-1, Hkv, hd)
+        q = _rope_one(q, idx, cfg.rope_theta)
+        k = _rope_one(k, idx, cfg.rope_theta)
+        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["k"], k, idx, axis=1)
+        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["v"], v, idx, axis=1)
+        o = _attend_cached(q, ck, cv, idx, hd ** -0.5)
+        x = x + o.reshape(-1, H * hd) @ p["attn"]["wo"]["kernel"]
+        h = _rmsnorm(x, p["norm_mlp"], cfg.rms_eps)
+        g = jax.nn.silu(h @ p["mlp"]["gate"]["kernel"])
+        u = h @ p["mlp"]["up"]["kernel"]
+        x = x + (g * u) @ p["mlp"]["down"]["kernel"]
+    x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
+    return cache, x @ params["lm_head"].T                # untied head
+
+
+def _step_fn(model):
+    from horovod_tpu.models.gpt2 import GPT2
+    from horovod_tpu.models.llama import Llama
+    if isinstance(model, Llama):
+        if model.cfg.num_experts > 0:
+            raise NotImplementedError(
+                "generate() does not decode MoE configs yet")
+        return _llama_step, model.cfg.num_kv_heads
+    if isinstance(model, GPT2):
+        if model.cfg.num_experts > 0:
+            raise NotImplementedError(
+                "generate() does not decode MoE configs yet")
+        return _gpt2_step, model.cfg.num_heads
+    raise TypeError(f"generate() supports GPT2 and Llama models, got "
+                    f"{type(model).__name__}")
+
+
+def _sample(logits, temperature, top_k, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model: Any, params: Any, prompt: jnp.ndarray,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jnp.ndarray:
+    """``(B, P) prompt -> (B, P + max_new_tokens)`` token matrix.
+
+    The prompt is teacher-forced through the same cached decode steps
+    that sample the continuation (one compiled ``lax.scan``; prefill
+    optimisation is a throughput concern the training framework doesn't
+    chase). ``temperature=0`` is greedy; ``eos_id`` freezes a row once
+    it samples EOS (further positions repeat ``eos_id``).
+    """
+    step, kv_heads = _step_fn(model)
+    cfg = model.cfg
+    # Converted checkpoints arrive as numpy trees; decode indexes tables
+    # with traced token ids, which needs device arrays.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    B, P = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(
+            f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    total = P + int(max_new_tokens)
+    if total > cfg.max_seq_len:
+        raise ValueError(f"prompt {P} + {max_new_tokens} new tokens "
+                         f"exceeds max_seq_len={cfg.max_seq_len}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng=")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size="
+                         f"{cfg.vocab_size}], got {top_k}")
+    hd = cfg.d_model // cfg.num_heads
+    # GQA caches stay at kv width — the memory saving grouped heads
+    # exist for (kv_heads == num_heads for GPT-2/MHA).
+    cache = {i: {"k": jnp.zeros((B, total, kv_heads, hd), jnp.float32),
+                 "v": jnp.zeros((B, total, kv_heads, hd), jnp.float32)}
+             for i in range(cfg.num_layers)}
+    prompt = prompt.astype(jnp.int32)
+    keys = (jax.random.split(rng, total) if rng is not None
+            else jnp.zeros((total, 2), jnp.uint32))
+
+    def body(carry, t):
+        cache, tok, done = carry
+        cache, logits = step(cfg, params, cache, tok, t)
+        nxt = _sample(logits, temperature, top_k, keys[t])
+        # teacher-force inside the prompt; then sample
+        in_prompt = t + 1 < P
+        forced = prompt[:, jnp.minimum(t + 1, P - 1)]
+        nxt = jnp.where(in_prompt, forced, nxt)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | ((~in_prompt) & (nxt == eos_id))
+        return (cache, nxt, done), nxt
+
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _), out = jax.lax.scan(
+        body, (cache, prompt[:, 0], done0), jnp.arange(total - 1))
+    return jnp.concatenate([prompt[:, :1], out.T], axis=1)

@@ -1,0 +1,165 @@
+"""KV-cache generation (models/generate.py): the decode program is
+pinned to the training forward position-by-position and to HuggingFace
+generate() on converted checkpoints."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.generate import generate
+from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+from horovod_tpu.models.llama import Llama, LlamaConfig
+
+
+
+def _assert_matches_until_hf_eos(got, want, prompt_len, hf_eos):
+    """HF generate stops a row at ITS eos and pads; ours keeps going.
+    Compare token-for-token up to HF's stopping point per row."""
+    got = np.asarray(got)
+    for b in range(got.shape[0]):
+        row = want[b]
+        stop = np.where(row[prompt_len:] == hf_eos)[0] \
+            if hf_eos is not None else np.array([])
+        upto = prompt_len + (int(stop[0]) + 1 if stop.size
+                             else row.size - prompt_len)
+        np.testing.assert_array_equal(got[b, :upto], row[:upto])
+
+def _greedy_reference(model, params, prompt, n_new):
+    """Naive full-forward greedy decode — O(T^2) per step, the oracle."""
+    toks = prompt
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+        toks = jnp.concatenate([toks, nxt.astype(toks.dtype)], axis=1)
+    return toks
+
+
+class TestDecodeParity:
+    @pytest.mark.parametrize("family,kv", [("gpt2", None), ("llama", 4),
+                                           ("llama", 2)])
+    def test_greedy_matches_full_forward(self, rng, family, kv):
+        if family == "gpt2":
+            cfg = GPT2Config.tiny()
+            model = GPT2(cfg)
+        else:
+            cfg = LlamaConfig.tiny(num_kv_heads=kv)
+            model = Llama(cfg)
+        prompt = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 7)),
+                             jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        want = _greedy_reference(model, params, prompt, 9)
+        got = generate(model, params, prompt, 9)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_hf_gpt2_greedy_generation_matches(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from horovod_tpu.models.convert import gpt2_from_hf
+
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+            n_head=4)).eval()
+        model, params = gpt2_from_hf(hf)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, 256, (2, 6))
+        with torch.no_grad():
+            want = hf.generate(
+                torch.from_numpy(prompt), max_new_tokens=10,
+                do_sample=False, pad_token_id=0).numpy()
+        got = generate(model, params, jnp.asarray(prompt, jnp.int32), 10)
+        _assert_matches_until_hf_eos(got, want, 6, hf.config.eos_token_id)
+
+    def test_hf_llama_greedy_generation_matches(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from horovod_tpu.models.convert import llama_from_hf
+
+        torch.manual_seed(1)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_bias=False, tie_word_embeddings=False)).eval()
+        model, params = llama_from_hf(hf)
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, 256, (2, 5))
+        with torch.no_grad():
+            want = hf.generate(
+                torch.from_numpy(prompt), max_new_tokens=8,
+                do_sample=False, pad_token_id=0).numpy()
+        got = generate(model, params, jnp.asarray(prompt, jnp.int32), 8)
+        _assert_matches_until_hf_eos(got, want, 5, hf.config.eos_token_id)
+
+
+class TestSamplingControls:
+    def _setup(self, rng):
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        prompt = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 4)),
+                             jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        return model, params, prompt
+
+    def test_sampling_is_seeded_and_varies(self, rng):
+        model, params, prompt = self._setup(rng)
+        a = generate(model, params, prompt, 12, temperature=1.0,
+                     rng=jax.random.PRNGKey(1))
+        b = generate(model, params, prompt, 12, temperature=1.0,
+                     rng=jax.random.PRNGKey(1))
+        c = generate(model, params, prompt, 12, temperature=1.0,
+                     rng=jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_top_k_one_is_greedy(self, rng):
+        model, params, prompt = self._setup(rng)
+        greedy = generate(model, params, prompt, 8)
+        topk1 = generate(model, params, prompt, 8, temperature=0.7,
+                         top_k=1, rng=jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(topk1))
+
+    def test_eos_freezes_row(self, rng):
+        model, params, prompt = self._setup(rng)
+        out = np.asarray(generate(model, params, prompt, 16,
+                                  temperature=1.0,
+                                  rng=jax.random.PRNGKey(4), eos_id=7))
+        P = prompt.shape[1]
+        for row in out:
+            gen = row[P:]
+            hits = np.where(gen == 7)[0]
+            if hits.size:                     # everything after EOS is EOS
+                assert (gen[hits[0]:] == 7).all()
+
+    def test_sampling_without_rng_raises(self, rng):
+        model, params, prompt = self._setup(rng)
+        with pytest.raises(ValueError, match="rng"):
+            generate(model, params, prompt, 4, temperature=0.5)
+
+    def test_overlong_raises(self, rng):
+        model, params, prompt = self._setup(rng)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(model, params, prompt, 10_000)
+
+    def test_negative_new_tokens_raises(self, rng):
+        model, params, prompt = self._setup(rng)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate(model, params, prompt, -3)
+
+    def test_bad_top_k_raises(self, rng):
+        model, params, prompt = self._setup(rng)
+        for k in (0, 10_000):
+            with pytest.raises(ValueError, match="top_k"):
+                generate(model, params, prompt, 4, temperature=1.0,
+                         top_k=k, rng=jax.random.PRNGKey(0))
+
+    def test_gqa_cache_is_kv_width(self, rng):
+        """The KV cache must stay at num_kv_heads width — the memory
+        saving grouped-query attention exists for."""
+        from horovod_tpu.models.generate import _step_fn
+        cfg = LlamaConfig.tiny(num_kv_heads=2)
+        _, kv = _step_fn(Llama(cfg))
+        assert kv == 2

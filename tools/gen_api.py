@@ -49,6 +49,7 @@ MODULES = [
     "horovod_tpu.models.llama",
     "horovod_tpu.models.t5",
     "horovod_tpu.models.convert",
+    "horovod_tpu.models.generate",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",
