@@ -25,7 +25,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate"]
+__all__ = ["generate", "t5_generate"]
 
 
 def _layernorm(x, p, eps):
@@ -115,6 +115,137 @@ def _llama_step(cfg, params, cache, tok, idx):
         x = x + (g * u) @ p["mlp"]["down"]["kernel"]
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
     return cache, x @ params["lm_head"].T                # untied head
+
+
+def _t5_encode(model, cfg, params, src, src_mask):
+    """Encoder states (THE training encoder — ``T5.__call__`` with
+    ``dec_tokens=None``, shared attention dispatch and all) + per-layer
+    cross-attention K/V, computed ONCE per generation."""
+    H, hd = cfg.num_heads, cfg.head_dim
+    T = src.shape[1]
+    enc = model.apply({"params": params}, src, None,
+                      enc_mask=src_mask).astype(jnp.float32)
+    cross = []
+    for i in range(cfg.num_decoder_layers):
+        p = params[f"dec{i}"]["cross_attn"]
+        cross.append({
+            "k": (enc @ p["k"]["kernel"]).reshape(-1, T, H, hd),
+            "v": (enc @ p["v"]["kernel"]).reshape(-1, T, H, hd)})
+    return cross
+
+
+def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
+    """One decoder token against the self-attn cache + fixed cross K/V.
+
+    ``dec_bias_tbl`` is the (T_dec, H, T_dec) causal rel-bias tensor
+    precomputed outside the scan; row ``idx`` biases this query."""
+    H, hd = cfg.num_heads, cfg.head_dim
+    x = params["embedding"][tok]                          # (B, D)
+    for i in range(cfg.num_decoder_layers):
+        p = params[f"dec{i}"]
+        h = _rmsnorm(x, p["ln1"], 1e-6)
+        q = (h @ p["self_attn"]["q"]["kernel"]).reshape(-1, H, hd)
+        k = (h @ p["self_attn"]["k"]["kernel"]).reshape(-1, H, hd)
+        v = (h @ p["self_attn"]["v"]["kernel"]).reshape(-1, H, hd)
+        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["k"], k, idx, axis=1)
+        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
+            cache[i]["v"], v, idx, axis=1)
+        # T5: no 1/sqrt scaling; additive causal rel bias for this row.
+        b = jax.lax.dynamic_index_in_dim(dec_bias_tbl, idx, axis=0,
+                                         keepdims=False)   # (H, T_dec)
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) + b[None]
+        t = ck.shape[1]
+        s = jnp.where(jnp.arange(t)[None, None, :] <= idx, s, -1e30)
+        o = jnp.einsum("bht,bthd->bhd", jax.nn.softmax(s, -1),
+                       cv.astype(jnp.float32))
+        x = x + o.reshape(-1, H * hd) @ p["self_attn"]["o"]["kernel"]
+        # Cross-attention over the fixed encoder K/V; no bias, masked.
+        h = _rmsnorm(x, p["ln2"], 1e-6)
+        q = (h @ p["cross_attn"]["q"]["kernel"]).reshape(-1, H, hd)
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       cross[i]["k"].astype(jnp.float32))
+        s = jnp.where(src_mask[:, None, :], s, -1e30)
+        a = jax.nn.softmax(s, -1)
+        # Fully-padded source rows: zero the attention instead of a
+        # uniform softmax over -inf (the shared dense path's contract).
+        a = a * src_mask.any(-1)[:, None, None]
+        o = jnp.einsum("bht,bthd->bhd", a,
+                       cross[i]["v"].astype(jnp.float32))
+        x = x + o.reshape(-1, H * hd) @ p["cross_attn"]["o"]["kernel"]
+        h = _rmsnorm(x, p["ln3"], 1e-6)
+        g = jax.nn.gelu(h @ p["mlp"]["wi_0"]["kernel"])
+        u = h @ p["mlp"]["wi_1"]["kernel"]
+        x = x + (g * u) @ p["mlp"]["wo"]["kernel"]
+    x = _rmsnorm(x, params["dec_norm"], 1e-6)
+    return cache, x @ params["lm_head"].T
+
+
+def t5_generate(model: Any, params: Any, src: jnp.ndarray,
+                max_new_tokens: int, *, temperature: float = 0.0,
+                top_k: Optional[int] = None,
+                rng: Optional[jax.Array] = None,
+                eos_id: Optional[int] = None,
+                src_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Seq2seq decode: ``(B, T_src) -> (B, max_new_tokens)`` target ids.
+
+    The encoder (and every layer's cross-attention K/V) runs once; the
+    decoder starts from T5's pad/start token and scans with a cached
+    self-attention. Sampling controls as :func:`generate`.
+    """
+    from horovod_tpu.models.t5 import T5, relative_position_bucket
+    if not isinstance(model, T5):
+        raise TypeError(f"t5_generate needs a T5 model, got "
+                        f"{type(model).__name__}")
+    cfg = model.cfg
+    if max_new_tokens <= 0:
+        raise ValueError(
+            f"max_new_tokens must be > 0, got {max_new_tokens}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng=")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size="
+                         f"{cfg.vocab_size}], got {top_k}")
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    src = src.astype(jnp.int32)
+    B = src.shape[0]
+    if src_mask is None:
+        src_mask = src != cfg.pad_id
+    cross = _t5_encode(model, cfg, params, src, src_mask)
+
+    T_dec = int(max_new_tokens)
+    rel = jnp.arange(T_dec)[None, :] - jnp.arange(T_dec)[:, None]
+    buckets = relative_position_bucket(
+        rel, bidirectional=False, num_buckets=cfg.rel_buckets,
+        max_distance=cfg.rel_max_distance)
+    dec_bias = params["dec_rel"]["rel_bias"][buckets]     # (T, T, H)
+    dec_bias = dec_bias.transpose(0, 2, 1)                # (Tq, H, Tk)
+
+    cache = {i: {"k": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
+                                jnp.float32),
+                 "v": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
+                                jnp.float32)}
+             for i in range(cfg.num_decoder_layers)}
+    keys = (jax.random.split(rng, T_dec) if rng is not None
+            else jnp.zeros((T_dec, 2), jnp.uint32))
+
+    def body(carry, t):
+        cache, tok, done = carry
+        cache, logits = _t5_step(cfg, params, cache, cross, src_mask,
+                                 dec_bias, tok, t)
+        nxt = _sample(logits, temperature, top_k, keys[t])
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
+
+    start = jnp.full((B,), cfg.pad_id, jnp.int32)         # T5: pad = BOS
+    (_, _, _), out = jax.lax.scan(
+        body, (cache, start, jnp.zeros((B,), bool)), jnp.arange(T_dec))
+    return out.T
 
 
 def _step_fn(model):

@@ -215,13 +215,21 @@ class T5(nn.Module):
 
     @nn.compact
     def __call__(self, enc_tokens: jnp.ndarray,
-                 dec_tokens: jnp.ndarray,
+                 dec_tokens: Optional[jnp.ndarray] = None,
                  enc_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """``enc_tokens`` (B, T_enc) source ids, ``dec_tokens`` (B, T_dec)
         decoder INPUT ids (already shifted right — :func:`seq2seq_loss`
         shifts for you). ``enc_mask`` (B, T_enc) bool marks real (non-pad)
         source tokens; defaults to ``enc_tokens != pad_id``. Returns
-        fp32 logits (B, T_dec, vocab)."""
+        fp32 logits (B, T_dec, vocab).
+
+        ``dec_tokens=None`` runs the ENCODER ONLY and returns its
+        ``(B, T_enc, d_model)`` states — seq2seq decoding encodes once
+        this way and loops the decoder against cached K/V
+        (``models/generate.t5_generate``), reusing the shared attention
+        dispatch (masked-row zeroing included) instead of
+        re-implementing the encoder.
+        """
         cfg = self.cfg
         if enc_mask is None:
             enc_mask = enc_tokens != cfg.pad_id
@@ -238,6 +246,8 @@ class T5(nn.Module):
         for i in range(cfg.num_encoder_layers):
             x = enc_layer(cfg, name=f"enc{i}")(x, enc_bias, enc_mask)
         enc_out = RMSNorm(name="enc_norm")(x)
+        if dec_tokens is None:
+            return enc_out
 
         # Decoder: causal rel bias (own table), cross-attn without bias.
         y = emb[dec_tokens].astype(cfg.dtype)
