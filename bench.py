@@ -540,9 +540,56 @@ def bench_t5(on_tpu):
         steps, "t5_small_tokens_per_sec_per_chip", model_flops=mflops)
 
 
+def bench_gpt2_decode(on_tpu):
+    """Inference anchor: greedy KV-cache decode throughput for GPT-2
+    medium (models/generate.py — one compiled lax.scan, batch 8,
+    32-token prompt, 480 generated). Decode is memory-bandwidth-bound
+    (every step streams the full weights for one token per row), so
+    tokens/sec here tracks HBM, not the MXU — reported without
+    utilization numbers by design. Throughput counts ALL scanned decode
+    steps (the prompt is teacher-forced through the same cached step, at
+    identical cost), so the number is per-step honest rather than
+    attributing prompt steps to generated tokens."""
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    if on_tpu:
+        cfg = GPT2Config.medium()
+        B, P, N, reps = 8, 32, 480, 3
+    else:
+        cfg = GPT2Config.tiny()
+        B, P, N, reps = 2, 4, 28, 1
+    model = GPT2(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (B, P)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    if on_tpu:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params)
+
+    fn = jax.jit(lambda p, t: generate(model, p, t, N))
+    _sync(fn(params, prompt))                  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(params, prompt)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    steps = P + N - 1                          # every scan step decodes
+    rec = {
+        "metric": "gpt2_medium_decode_tokens_per_sec_per_chip",
+        "value": round(B * steps / dt, 2),
+        "unit": "tokens/sec/chip", "vs_baseline": None,
+        "step_ms": round(dt * 1e3 / steps, 3),  # per decode step
+        "batch": B, "prompt": P, "new_tokens": N,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
             "gpt2_long": bench_gpt2_long, "llama": bench_llama,
             "gpt2_packed": bench_gpt2_packed, "t5": bench_t5,
+            "gpt2_decode": bench_gpt2_decode,
             "bert": bench_bert, "vit": bench_vit, "mnist": bench_mnist,
             "allreduce": bench_allreduce}
 
@@ -572,7 +619,7 @@ def _inner_main(args):
         # headline (resnet50) last so single-line parsers read it.
         for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
                      "gpt2_long", "gpt2_packed", "llama", "t5",
-                     "resnet50"):
+                     "gpt2_decode", "resnet50"):
             _BENCHES[name](on_tpu)
     else:
         _BENCHES[args.model](on_tpu)
@@ -586,6 +633,8 @@ _HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
                     "gpt2_packed":
                         "gpt2_medium_packed_tokens_per_sec_per_chip",
                     "t5": "t5_small_tokens_per_sec_per_chip",
+                    "gpt2_decode":
+                        "gpt2_medium_decode_tokens_per_sec_per_chip",
                     "bert": "bert_large_tokens_per_sec_per_chip",
                     "vit": "vit_b16_images_per_sec_per_chip",
                     "mnist": "mnist_images_per_sec_per_chip",

@@ -51,6 +51,7 @@ AGENDA = [
     ("mnist", {}, None),
     ("resnet50", {"HOROVOD_BENCH_BN_STATS": "bf16",
                   "HOROVOD_BENCH_STEM": "s2d"}, "bn=bf16+stem=s2d"),
+    ("gpt2_decode", {}, None),
 ]
 
 
