@@ -680,9 +680,9 @@ def _supervise(args) -> int:
     probe_timeout = float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT", "60"))
     attempts = int(os.environ.get("HVD_BENCH_PROBE_ATTEMPTS", "5"))
     backoff = float(os.environ.get("HVD_BENCH_PROBE_BACKOFF", "90"))
-    # "all" is now 9 configs (llama + gpt2_packed joined in r5), two of
-    # them compile-heavy — give the multi-config run twice the budget so
-    # a healthy-but-slow sweep isn't mislabeled a relay wedge.
+    # "all" is now 11 configs (llama/t5/packed/decode joined in r5),
+    # several compile-heavy — give the multi-config run twice the budget
+    # so a healthy-but-slow sweep isn't mislabeled a relay wedge.
     run_timeout = float(os.environ.get(
         "HVD_BENCH_RUN_TIMEOUT", "5400" if args.model == "all" else "2700"))
 

@@ -266,6 +266,60 @@ _WORKER = textwrap.dedent("""
         hvd.barrier(process_set=ps)              # next epoch, clean
         assert time.monotonic() - t0 < 2.5
         print(f"proc {{pid}} BARRIER-GHOST-OK", flush=True)
+    elif mode == "autotuned_step":
+        # AutotunedStep's cross-process contract: GP proposals come from
+        # LOCAL timings, so both processes must agree (rank 0's point)
+        # before a threshold feeds any eager collective's fusion-plan
+        # signature — divergent thresholds would make the negotiation
+        # mismatch-check raise. Per-rank sleep skews local timings to
+        # force disagreement without the broadcast.
+        import time
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+        from horovod_tpu.autotune import BayesianAutotuner
+        X = jnp.asarray(np.ones((8, 4)), jnp.float32)
+        y = jnp.zeros((8,))
+
+        def make_step(threshold):
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(0.1), fusion_threshold_bytes=threshold)
+
+            def step(w, ost):
+                import jax
+                from horovod_tpu.frontend_bridge import (from_stacked,
+                                                         to_stacked)
+                l, g = jax.value_and_grad(
+                    lambda w: jnp.mean((X @ w - y) ** 2))(w)
+                # eager cross-process allreduce whose fusion plan uses
+                # the proposed threshold: signatures must agree
+                g = from_stacked(hvd.allreduce(
+                    to_stacked(np.asarray(g)),
+                    fusion_threshold_bytes=threshold))
+                u, ost = opt.update(jnp.asarray(g), ost, w)
+                return optax.apply_updates(w, u), ost, l
+            return step
+
+        import jax
+        # probes >= 4: the first 3 points are a FIXED timing-independent
+        # design; only from the 4th does a GP proposal (computed from
+        # LOCAL timings, hence rank-divergent) hit the pending_sync
+        # agreement path this test exists to prove.
+        tuner = BayesianAutotuner(probes=4, samples_per_probe=1)
+        step = hvd.AutotunedStep(make_step, tuner=tuner)
+        import optax
+        w = jnp.zeros((4,))
+        ost = optax.sgd(0.1).init(w)
+        for i in range(14):
+            time.sleep(0.01 * (pid + 1) * (i % 3))   # skew local timings
+            w, ost, _ = step(w, ost)
+            if step.converged:
+                break
+        assert step.converged
+        final = hvd.allgather_object(step.current_threshold())
+        assert final[0] == final[1], final   # agreed on ONE threshold
+        print(f"proc {{pid}} AUTOTUNED-STEP-OK thr={{final[0]}}",
+              flush=True)
     elif mode == "join_service":
         # VERDICT r3 item 4: rank 0 joins at step 3; rank 1 keeps
         # allreducing through step 6 with CORRECT averages (divisor
@@ -385,6 +439,17 @@ def test_two_process_barrier_epoch_survives_failure():
         assert rc == 0, out
         assert "BARRIER-EPOCH-OK" in out
         assert "fails=2" in out, out        # both failures really happened
+
+
+@pytest.mark.slow
+def test_two_process_autotuned_step_agrees_on_threshold():
+    """The jit-path GP tuner across real processes: skewed local
+    timings, one agreed threshold (pending_sync broadcast + converged
+    write-back) — and every eager collective's fusion signature stayed
+    consistent along the way (a mismatch would have raised)."""
+    for rc, out in _run_pair("autotuned_step"):
+        assert rc == 0, out
+        assert "AUTOTUNED-STEP-OK" in out
 
 
 @pytest.mark.slow
