@@ -451,3 +451,35 @@ class TestFsdpState:
         state.shard = jnp.zeros((3, 24))         # per-layer rows, L=21ish
         with pytest.raises(ValueError, match="ONE layer"):
             state.commit()
+
+    def test_remesh_grow_back_parity(self, rng, remesh):
+        """Recovered capacity: a dp=4 run grows back to dp=8 and stays
+        numerically identical to an uninterrupted dp=4 run (the
+        canonical form is direction-agnostic)."""
+        from horovod_tpu.elastic import FsdpState
+        from horovod_tpu.parallel.fsdp import flat_size
+
+        template = self._template()
+        L = flat_size(template)
+        X = jnp.asarray(rng.standard_normal((8, self.D_IN)), jnp.float32)
+
+        hvd.shutdown()
+        hvd.init(devices=jax.devices()[:4])
+        shard, opt = self._fresh(template)
+        ref_shard, _ = self._run_steps(template, shard, opt, X, 6)
+        ref = np.asarray(ref_shard)[:L]
+
+        shard, opt = self._fresh(template)
+        shard, opt = self._run_steps(template, shard, opt, X, 3)
+        state = FsdpState(template, shard=shard, opt_state=opt)
+        state.commit()
+
+        hvd.shutdown()
+        hvd.init()                       # back to the full 8-device world
+        assert hvd.size() == 8
+        state.restore()
+        assert state.shard.shape == (8 * (-(-L // 8)),)
+        got_shard, _ = self._run_steps(template, state.shard,
+                                       state.opt_state, X, 3)
+        np.testing.assert_allclose(np.asarray(got_shard)[:L], ref,
+                                   rtol=1e-4, atol=1e-5)

@@ -122,3 +122,8 @@ class TestExamples:
     def test_t5_train(self):
         out = _run("t5_train.py", "--steps", "3")
         assert "final seq2seq loss" in out
+
+    def test_hf_generate(self):
+        out = _run("hf_generate.py", devices=1, timeout=600)
+        assert "greedy decode == hf.generate" in out
+        assert "sampled continuation" in out
