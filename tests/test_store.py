@@ -665,3 +665,47 @@ class TestPrepareData:
         store = LocalStore(str(tmp_path))
         with pytest.raises((OSError, KeyError, ValueError)):
             read_meta(store, store.val_data_path("r"))
+
+
+class TestStoreDelete:
+    def test_local_delete_dir_and_file(self, tmp_path):
+        s = LocalStore(str(tmp_path))
+        d = s.join(str(tmp_path), "sub")
+        with s.open(s.join(d, "f.bin"), "wb") as f:
+            f.write(b"x")
+        assert s.exists(s.join(d, "f.bin"))
+        s.delete(d)
+        assert not s.exists(d)
+        # plain single-file branch too
+        f1 = s.join(str(tmp_path), "one.bin")
+        with s.open(f1, "wb") as f:
+            f.write(b"y")
+        s.delete(f1)
+        assert not s.exists(f1)
+        s.delete(s.join(str(tmp_path), "missing"))   # no-op, no raise
+
+    def test_fsspec_delete(self):
+        s = FsspecStore("memory://hvddel")
+        p = s.join(s.prefix, "dir", "f.bin")
+        with s.open(p, "wb") as f:
+            f.write(b"abc")
+        assert s.exists(p)
+        s.delete(s.join(s.prefix, "dir"))
+        assert not s.exists(p)
+        s.delete(s.join(s.prefix, "missing"))        # no-op, no raise
+
+    def test_fsspec_prepare_data_stale_val(self):
+        """The stale-val invalidation works on fsspec stores too."""
+        from horovod_tpu.spark.common.util import prepare_data
+        cols = {"features": np.zeros((8, 2), np.float32),
+                "label": np.zeros(8, np.float32)}
+        store = FsspecStore("memory://hvdprep")
+        _, val_ref = prepare_data(cols, store, run_id="r",
+                                  validation=0.25, num_shards=2,
+                                  data_format="npz")
+        assert val_ref is not None
+        _, val_ref2 = prepare_data(cols, store, run_id="r", num_shards=2,
+                                   data_format="npz")
+        assert val_ref2 is None
+        with pytest.raises((OSError, KeyError, ValueError, FileNotFoundError)):
+            read_meta(store, store.val_data_path("r"))

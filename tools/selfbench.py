@@ -105,7 +105,8 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=60)
     ap.add_argument("--bench-timeout", type=float, default=2400,
                     help="per-model bench deadline once the probe passes")
-    ap.add_argument("--models", default="resnet50,gpt2,gpt2_long",
+    ap.add_argument("--models",
+                    default="resnet50,gpt2,gpt2_long,llama,t5",
                     help="comma-separated bench.py models per capture")
     ap.add_argument("--max-captures", type=int, default=1)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SELF.jsonl"))
