@@ -255,3 +255,9 @@ class TestT5Generate:
         # the healthy row decodes exactly as without the dead neighbour
         healthy = np.asarray(t5_generate(model, params, src, 5))
         np.testing.assert_array_equal(out[1], healthy[1])
+
+    def test_moe_config_rejected(self, rng):
+        model = Llama(LlamaConfig.tiny(num_experts=4))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            generate(model, {}, prompt, 4)
