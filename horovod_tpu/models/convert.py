@@ -48,6 +48,18 @@ def gpt2_from_hf(hf_model: Any, dtype=None) -> Tuple[Any, Dict]:
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config
 
     hc = hf_model.config
+    act = getattr(hc, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        # The zoo's MLP applies tanh-approx GELU (GPT-2's own recipe);
+        # an exact-gelu or relu checkpoint would convert cleanly and
+        # compute the wrong nonlinearity.
+        raise ValueError(f"gpt2_from_hf expects the tanh-approx GELU "
+                         f"recipe; checkpoint has "
+                         f"activation_function={act!r}")
+    if getattr(hc, "n_inner", None) not in (None, 4 * hc.n_embd):
+        raise ValueError(
+            f"gpt2_from_hf expects the standard 4*d_model MLP width; "
+            f"checkpoint has n_inner={hc.n_inner}")
     cfg = GPT2Config(vocab_size=hc.vocab_size, max_seq_len=hc.n_positions,
                      num_layers=hc.n_layer, num_heads=hc.n_head,
                      d_model=hc.n_embd,
@@ -115,6 +127,14 @@ def llama_from_hf(hf_model: Any, dtype=None) -> Tuple[Any, Dict]:
             "llama_from_hf converts the bias-free Llama recipe; this "
             "checkpoint has attention_bias/mlp_bias set and its bias "
             "tensors would be silently dropped")
+    if getattr(hc, "rope_scaling", None):
+        # Llama-3.x long-context checkpoints scale the RoPE frequencies;
+        # converting without applying the scaling would silently shift
+        # every position's rotation.
+        raise ValueError(
+            "llama_from_hf does not apply rope_scaling yet; this "
+            f"checkpoint has rope_scaling={hc.rope_scaling!r} — "
+            "converting would silently mis-rotate positions")
     sd = hf_model.state_dict()
 
     def g(key):

@@ -159,3 +159,35 @@ class TestConversionGuards:
         hf = transformers.T5ForConditionalGeneration(cfg)
         with pytest.raises(ValueError, match="gated-GELU"):
             t5_from_hf(hf)
+
+    def test_gpt2_exact_gelu_rejected(self):
+        cfg = transformers.GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=32, n_layer=1,
+            n_head=4, activation_function="gelu")
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        from horovod_tpu.models.convert import gpt2_from_hf
+        with pytest.raises(ValueError, match="GELU"):
+            gpt2_from_hf(hf)
+
+    def test_gpt2_nonstandard_mlp_width_rejected(self):
+        cfg = transformers.GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=32, n_layer=1,
+            n_head=4, n_inner=96)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        from horovod_tpu.models.convert import gpt2_from_hf
+        with pytest.raises(ValueError, match="n_inner"):
+            gpt2_from_hf(hf)
+
+    def test_llama_rope_scaling_rejected(self):
+        cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4,
+            attention_bias=False, tie_word_embeddings=False,
+            rope_scaling={"rope_type": "linear", "factor": 2.0})
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(cfg)
+        from horovod_tpu.models.convert import llama_from_hf
+        with pytest.raises(ValueError, match="rope_scaling"):
+            llama_from_hf(hf)

@@ -144,6 +144,12 @@ class TestSamplingControls:
         with pytest.raises(ValueError, match="max_seq_len"):
             generate(model, params, prompt, 10_000)
 
+    def test_moe_config_rejected(self):
+        model = Llama(LlamaConfig.tiny(num_experts=4))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            generate(model, {}, prompt, 4)
+
     def test_negative_new_tokens_raises(self, rng):
         model, params, prompt = self._setup(rng)
         with pytest.raises(ValueError, match="max_new_tokens"):
@@ -255,9 +261,3 @@ class TestT5Generate:
         # the healthy row decodes exactly as without the dead neighbour
         healthy = np.asarray(t5_generate(model, params, src, 5))
         np.testing.assert_array_equal(out[1], healthy[1])
-
-    def test_moe_config_rejected(self, rng):
-        model = Llama(LlamaConfig.tiny(num_experts=4))
-        prompt = jnp.zeros((1, 4), jnp.int32)
-        with pytest.raises(NotImplementedError, match="MoE"):
-            generate(model, {}, prompt, 4)
