@@ -104,6 +104,21 @@ def _lm_model_flops(n_matmul_params, n_layers, seq_len, d_attn, n_tokens):
     return per_token * n_tokens
 
 
+def _collective_counters():
+    """Collective-level observability embedded in every BENCH_*.json line:
+    negotiation round counts (full vs cached fast path) plus per-kind
+    eager call/byte counters from the metrics registry. Cumulative over
+    the process — diff consecutive lines of an `--model all` run to
+    attribute counts to one config."""
+    try:
+        from horovod_tpu.collective import negotiation_stats
+        from horovod_tpu.metrics import collective_summary
+        return {"negotiation": negotiation_stats(),
+                "collectives": collective_summary()}
+    except Exception:
+        return {}
+
+
 def _report(metric, unit, per_sec, dt, flops, vs_baseline=None,
             model_flops=None):
     """``flops`` is executed (XLA cost analysis) -> hfu; ``model_flops``
@@ -125,6 +140,7 @@ def _report(metric, unit, per_sec, dt, flops, vs_baseline=None,
     if peak:
         rec["hfu"] = round(flops / dt / 1e12 / peak, 3)
         rec["mfu"] = round(model_flops / dt / 1e12 / peak, 3)
+    rec.update(_collective_counters())
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -386,6 +402,7 @@ def bench_allreduce(on_tpu):
         "proxy": jax.default_backend() == "cpu",
         "detail": detail,
     }
+    rec.update(_collective_counters())
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -582,6 +599,7 @@ def bench_gpt2_decode(on_tpu):
         "step_ms": round(dt * 1e3 / steps, 3),  # per decode step
         "batch": B, "prompt": P, "new_tokens": N,
     }
+    rec.update(_collective_counters())
     print(json.dumps(rec), flush=True)
     return rec
 

@@ -23,6 +23,12 @@ from horovod_tpu.collective import (  # noqa: F401
     barrier, synchronize, poll, join, broadcast_object, allgather_object,
 )
 from horovod_tpu.compression import Compression  # noqa: F401
+# ``hvd.metrics`` is the (callable) metrics submodule: ``hvd.metrics()``
+# returns the snapshot dict, and the full subsystem lives on it —
+# ``hvd.metrics.to_prometheus()``, ``hvd.metrics.start_stall_watchdog()``,
+# ``hvd.metrics.start_metrics_flusher()``, ...
+from horovod_tpu import metrics  # noqa: F401
+from horovod_tpu.metrics import reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,
     accumulation_has_updated,

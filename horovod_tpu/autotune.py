@@ -256,6 +256,9 @@ class Autotuner:
     def record(self, step_seconds: float) -> None:
         if self._best is not None:
             return
+        # Lazy: autotune stays importable without pulling the package in.
+        from horovod_tpu.metrics import event, gauge, registry
+        registry.counter("autotune_samples_total").inc()
         cur = self._candidates[self._idx]
         self._timings[cur].append(step_seconds)
         if len(self._timings[cur]) >= self._samples:
@@ -264,6 +267,12 @@ class Autotuner:
                 med = {c: sorted(v)[len(v) // 2]
                        for c, v in self._timings.items() if v}
                 self._best = min(med, key=med.get)
+                gauge("autotune_threshold_bytes").set(self._best)
+                event("autotune_converged", mode="ladder",
+                      threshold_bytes=self._best)
+            else:
+                event("autotune_probe", mode="ladder",
+                      threshold_bytes=self._candidates[self._idx])
 
 
 class BayesianAutotuner:
@@ -343,6 +352,8 @@ class BayesianAutotuner:
     def record(self, step_seconds: float) -> None:
         if self._best is not None:
             return
+        from horovod_tpu.metrics import event, gauge, registry
+        registry.counter("autotune_samples_total").inc()
         self._pending.append(step_seconds)
         if len(self._pending) < self._samples:
             return
@@ -354,11 +365,19 @@ class BayesianAutotuner:
             i = min(range(len(self._ys)), key=self._ys.__getitem__)
             self._best = self._denorm(self._xs[i][0])
             self._best_compression = self.COMPRESSION_CHOICES[self._xs[i][1]]
+            gauge("autotune_threshold_bytes").set(self._best)
+            event("autotune_converged", mode="bayes",
+                  threshold_bytes=self._best,
+                  compression=self._best_compression)
         else:
             self._cur = self._next_point()
             # points 2-3 of the initial design are timing-independent and
             # identical everywhere; GP proposals (probe 4+) are not
             self.pending_sync = len(self._xs) >= 3
+            event("autotune_probe", mode="bayes",
+                  threshold_bytes=self._denorm(self._cur[0]),
+                  compression=self.COMPRESSION_CHOICES[self._cur[1]],
+                  median_step_s=round(med, 6))
 
     def current_point(self) -> tuple:
         """The live probe point, for cross-process agreement (rank 0

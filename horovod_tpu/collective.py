@@ -29,6 +29,7 @@ shape-uniform across all devices.
 from __future__ import annotations
 
 import functools
+import time as _time_mod
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -39,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import core
 from horovod_tpu import fusion as _fusion
+from horovod_tpu import metrics as _metrics
 from horovod_tpu.adasum import adasum_allreduce, hierarchical_adasum_allreduce
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet, global_process_set
@@ -452,6 +454,11 @@ _OP_SEQ = 0
 _NEG_HASH = b"\x00" * 16
 _NEG_COORD = None          # native.Coordinator | None
 _NEG_CACHE: set = set()    # python fallback response cache
+# Since-init round counts (reset by _reset_negotiation on init/elastic
+# re-mesh). The metrics registry's negotiation_rounds_total mirrors the
+# increments but is process-lifetime — deliberately different windows:
+# negotiation_stats() answers "this communicator epoch", the registry
+# answers "this process" (what Prometheus scrapes expect).
 _NEG_STATS = {"full": 0, "fast": 0}
 
 
@@ -559,10 +566,15 @@ def _negotiate(kind: str, sig_key: tuple,
         return ()
     from horovod_tpu import timeline as _tl
     t = _tl.get_timeline()
-    if t is not None:
-        with t.activity(f"negotiate:{kind}", category="negotiation"):
-            return _negotiate_inner(kind, sig_key, service_desc)
-    return _negotiate_inner(kind, sig_key, service_desc)
+    t0 = _time_mod.perf_counter()
+    try:
+        if t is not None:
+            with t.activity(f"negotiate:{kind}", category="negotiation"):
+                return _negotiate_inner(kind, sig_key, service_desc)
+        return _negotiate_inner(kind, sig_key, service_desc)
+    finally:
+        _metrics.histogram("negotiation_seconds").observe(
+            _time_mod.perf_counter() - t0)
 
 
 def _negotiate_inner(kind: str, sig_key: tuple,
@@ -588,6 +600,7 @@ def _negotiate_inner(kind: str, sig_key: tuple,
 
     if rows[active, 4].any() or joined:
         _NEG_STATS["full"] += 1
+        _metrics.counter("negotiation_rounds_total", path="full").inc()
         # Joined peers need the descriptor to replay the collective with
         # neutral contributions; attach it only when one is listening.
         payload = ("active", sig, service_desc if joined else None)
@@ -603,6 +616,7 @@ def _negotiate_inner(kind: str, sig_key: tuple,
         _cache_add(cache_key)
     else:
         _NEG_STATS["fast"] += 1
+        _metrics.counter("negotiation_rounds_total", path="fast").inc()
         if not (rows[:, :4] == h).all():
             bad = [i for i in range(rows.shape[0])
                    if not (rows[i, :4] == h).all()]
@@ -621,14 +635,18 @@ def _negotiate_inner(kind: str, sig_key: tuple,
 
 
 def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
-               negotiate_key: tuple = (), _skip_negotiate: bool = False):
+               negotiate_key: tuple = (), _skip_negotiate: bool = False,
+               op_name: Optional[str] = None):
     """Run an eager collective. ``param_key`` keys the compile cache (static
     facts the compiled program depends on); ``negotiate_key`` carries extra
     per-call values (e.g. ragged sizes/splits) that must *match* across
     processes but travel as device inputs — they join the negotiation
     signature without fragmenting the compile cache.
     ``_skip_negotiate`` is the join-service replay path: the round already
-    happened, this call only executes the device program."""
+    happened, this call only executes the device program.
+    ``op_name`` is the user-facing tensor name (the ``name=`` argument of
+    the public ops) — observability only: it labels the pending-op entry
+    the stall watchdog reports, never the compile cache."""
     m = core.mesh()
     axis = core.axis_name()
     n = core.size()
@@ -640,6 +658,23 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
                 f"eager collectives expect per-rank values stacked on axis 0 "
                 f"(leading dim {n}), got shape {x.shape}")
     shapes = tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
+    nbytes = sum(x.size * x.dtype.itemsize for x in leaves)
+    ps_arg = next((p for p in params if isinstance(p, ProcessSet)), None)
+    pend = _metrics.collective_begin(
+        kind, name=op_name, nbytes=int(nbytes),
+        ranks=None if ps_arg is None else ps_arg.ranks)
+    t_begin = _time_mod.perf_counter()
+    try:
+        return _eager_run_inner(kind, tree, params, param_key, negotiate_key,
+                                _skip_negotiate, m, axis, n, leaves, treedef,
+                                shapes, int(nbytes), t_begin)
+    finally:
+        _metrics.collective_end(pend)
+
+
+def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
+                     _skip_negotiate, m, axis, n, leaves, treedef, shapes,
+                     nbytes, t_begin):
     joined: tuple = ()
     if not _skip_negotiate:
         desc = None
@@ -668,6 +703,7 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
             _check_join_avg_dtypes(params[0], shapes)
     key = (kind, treedef, shapes, param_key, id(m))
     fn = _EAGER_CACHE.get(key)
+    was_miss = fn is None
     if fn is None:
         def body(*shard_leaves):
             t = jax.tree_util.tree_unflatten(
@@ -675,7 +711,8 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
             out = _INTRACE[kind](t, *params)
             return tuple(o[None] for o in jax.tree_util.tree_leaves(out))
 
-        smapped = jax.shard_map(
+        from horovod_tpu.utils.compat import shard_map as _shard_map
+        smapped = _shard_map(
             body, mesh=m,
             in_specs=tuple(P(axis) for _ in leaves),
             out_specs=P(axis))
@@ -701,13 +738,23 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
     from horovod_tpu import timeline as _tl
     t = _tl.get_timeline()
     if t is not None:
-        nbytes = sum(x.size * x.dtype.itemsize for x in leaves)
-        with t.activity(kind, tensors=len(leaves), bytes=int(nbytes)):
+        with t.activity(kind, tensors=len(leaves), bytes=nbytes):
             placed = [place(x) for x in leaves]
             out_leaves = fn(*placed)
     else:
         placed = [place(x) for x in leaves]
         out_leaves = fn(*placed)
+    # Dispatch latency: negotiation + placement + program launch (jax
+    # dispatch is async, so this is host-side cost, not device runtime —
+    # exactly the layer the host controls and the timeline records).
+    dt = _time_mod.perf_counter() - t_begin
+    _metrics.counter("collective_calls_total", kind=kind).inc()
+    _metrics.counter("collective_bytes_total", kind=kind).inc(nbytes)
+    _metrics.histogram("collective_dispatch_seconds", kind=kind).observe(dt)
+    if was_miss:
+        # First dispatch of a new program: trace + XLA compile dominate.
+        _metrics.counter("collective_compile_total", kind=kind).inc()
+        _metrics.histogram("collective_compile_seconds", kind=kind).observe(dt)
     out_leaves = list(out_leaves)
     if joined and kind == "allreduce" and params[0] == ReduceOp.Average:
         # The compiled program divides by the full world size; joined
@@ -756,6 +803,10 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
     args = (op, ps, float(prescale_factor), float(postscale_factor),
             compression, int(fusion_threshold_bytes))
     if _is_traced(tensor):
+        # Trace-time telemetry: one count per compiled lowering (the
+        # in-jit analogue of collective_calls_total; steps re-USE the
+        # compiled program, so this counts programs, not steps).
+        _metrics.counter("collective_traced_total", kind="allreduce").inc()
         return _allreduce_tree(tensor, *args)
     pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
           compression.__name__, int(fusion_threshold_bytes))
@@ -764,7 +815,7 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         groups = _hierarchical_adasum_groups(ps)
         pk = pk + (None if groups is None
                    else tuple(tuple(g) for g in groups),)
-    return _eager_run("allreduce", tensor, args, pk)
+    return _eager_run("allreduce", tensor, args, pk, op_name=name)
 
 
 def allreduce_(tensor, **kwargs):
@@ -810,9 +861,10 @@ def broadcast(tensor, root_rank: int, process_set: Optional[ProcessSet] = None,
     if ps.ranks is not None and root_rank not in ps.ranks:
         raise ValueError(f"root rank {root_rank} not in process set {ps.ranks}")
     if _is_traced(tensor):
+        _metrics.counter("collective_traced_total", kind="broadcast").inc()
         return _INTRACE["broadcast"](tensor, root_rank, ps)
     return _eager_run("broadcast", tensor, (int(root_rank), ps),
-                      (int(root_rank), _ps_key(ps)))
+                      (int(root_rank), _ps_key(ps)), op_name=name)
 
 
 def broadcast_(tensor, root_rank: int, **kwargs):
@@ -827,8 +879,10 @@ def allgather(tensor, process_set: Optional[ProcessSet] = None,
     :func:`ragged_allgather`."""
     ps = _resolve_ps(process_set)
     if _is_traced(tensor):
+        _metrics.counter("collective_traced_total", kind="allgather").inc()
         return _INTRACE["allgather"](tensor, ps)
-    return _eager_run("allgather", tensor, (ps,), (_ps_key(ps),))
+    return _eager_run("allgather", tensor, (ps,), (_ps_key(ps),),
+                      op_name=name)
 
 
 def ragged_allgather(tensor, num_valid=None,
@@ -856,7 +910,7 @@ def ragged_allgather(tensor, num_valid=None,
     if num_valid is not None:
         raise ValueError("eager ragged_allgather takes a per-rank list, "
                          "not num_valid")
-    return _ragged_allgather_eager(tensor, ps)
+    return _ragged_allgather_eager(tensor, ps, op_name=name)
 
 
 def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
@@ -893,11 +947,14 @@ def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
     ps = _resolve_ps(process_set)
     if splits is None:
         if _is_traced(tensor):
+            _metrics.counter("collective_traced_total",
+                             kind="alltoall").inc()
             return _INTRACE["alltoall"](tensor, ps)
-        return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),))
+        return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),),
+                          op_name=name)
     if _is_traced(tensor) or _is_traced(splits):
         return _ragged_alltoall_leaf(tensor, splits, ps)
-    return _ragged_alltoall_eager(tensor, splits, ps)
+    return _ragged_alltoall_eager(tensor, splits, ps, op_name=name)
 
 
 def _pad0(a: jnp.ndarray, m: int) -> jnp.ndarray:
@@ -924,7 +981,8 @@ def _check_ragged_list(tensors, n: int):
     return arrs
 
 
-def _ragged_allgather_eager(tensors, ps: ProcessSet):
+def _ragged_allgather_eager(tensors, ps: ProcessSet,
+                            op_name: Optional[str] = None):
     n = core.size()
     arrs = _check_ragged_list(tensors, n)
     sizes = [int(a.shape[0]) for a in arrs]
@@ -934,13 +992,15 @@ def _ragged_allgather_eager(tensors, ps: ProcessSet):
     # the member max so every row pads to the same static shape.
     stacked = jnp.stack([_pad0(a[:T], T) for a in arrs])
     out = _eager_run("allgather", stacked, (ps,), (_ps_key(ps),),
-                     negotiate_key=("ragged", tuple(sizes)))
+                     negotiate_key=("ragged", tuple(sizes)),
+                     op_name=op_name)
     buf = out[members[0]]                       # (k*T, ...) on a member row
     segs = [buf[j * T: j * T + sizes[r]] for j, r in enumerate(members)]
     return jnp.concatenate(segs) if segs else buf[:0]
 
 
-def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
+def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet,
+                           op_name: Optional[str] = None):
     n = core.size()
     arrs = _check_ragged_list(tensors, n)
     members = list(range(n)) if ps.ranks is None else list(ps.ranks)
@@ -964,7 +1024,8 @@ def _ragged_alltoall_eager(tensors, splits, ps: ProcessSet):
     recv, rsplits = _eager_run(
         "ragged_alltoall", (stacked, jnp.asarray(sp_full)), (ps,),
         (_ps_key(ps),),
-        negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))))
+        negotiate_key=("ragged", tuple(map(tuple, sp.tolist()))),
+        op_name=op_name)
     if jax.process_count() > 1:
         # Only this process's rows of the stacked outputs are addressable;
         # read them off the local shard (a direct np.asarray of the
@@ -1000,8 +1061,11 @@ def reducescatter(tensor, op: int = Average,
     """Reduce then scatter equal chunks of axis 0 (``hvd.reducescatter``)."""
     ps = _resolve_ps(process_set)
     if _is_traced(tensor):
+        _metrics.counter("collective_traced_total",
+                         kind="reducescatter").inc()
         return _INTRACE["reducescatter"](tensor, op, ps)
-    return _eager_run("reducescatter", tensor, (op, ps), (op, _ps_key(ps)))
+    return _eager_run("reducescatter", tensor, (op, ps),
+                      (op, _ps_key(ps)), op_name=name)
 
 
 def synchronize(handle):
@@ -1138,28 +1202,37 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """
     ps = _resolve_ps(process_set)
     if jax.process_count() > 1:
-        if ps.ranks is not None:
-            devs = list(core.mesh().devices.ravel())
-            member_procs = sorted({devs[r].process_index
-                                   for r in ps.ranks})
-            me = jax.process_index()
-            if me not in member_procs:
+        # Host-side barriers never route through _eager_run, so register
+        # them in the pending table directly — a peer that never arrives
+        # is exactly what the stall watchdog exists to name.
+        pend = _metrics.collective_begin("barrier", name="barrier",
+                                         ranks=ps.ranks)
+        try:
+            if ps.ranks is not None:
+                devs = list(core.mesh().devices.ravel())
+                member_procs = sorted({devs[r].process_index
+                                       for r in ps.ranks})
+                me = jax.process_index()
+                if me not in member_procs:
+                    return
+                if len(member_procs) == 1:
+                    return
+                from horovod_tpu.config import get_config
+                timeout_s = get_config().barrier_timeout_seconds
+                _subset_barrier_wait(ps, member_procs, timeout_s)
                 return
-            if len(member_procs) == 1:
-                return
-            from horovod_tpu.config import get_config
-            timeout_s = get_config().barrier_timeout_seconds
-            _subset_barrier_wait(ps, member_procs, timeout_s)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("horovod_tpu_barrier")
             return
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("horovod_tpu_barrier")
-        return
+        finally:
+            _metrics.collective_end(pend)
     token = jnp.zeros((core.size(),), jnp.float32)
     jax.block_until_ready(_eager_run("allreduce", token,
                                      (ReduceOp.Sum, ps, 1.0, 1.0,
                                       Compression.none,
                                       _fusion.DEFAULT_FUSION_THRESHOLD_BYTES),
-                                     ("barrier", _ps_key(ps))))
+                                     ("barrier", _ps_key(ps)),
+                                     op_name="barrier"))
 
 
 def join() -> int:

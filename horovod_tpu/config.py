@@ -60,7 +60,17 @@ class Config:
     # same budget knobs on its GP tuner).
     autotune_probes: int = 6
     autotune_samples: int = 10
+    # Metrics subsystem (metrics.py): HOROVOD_METRICS_FILE enables the
+    # background snapshot flusher (.prom/.txt extension -> Prometheus text
+    # exposition, anything else JSON); HOROVOD_METRICS_INTERVAL is the
+    # write period in seconds. HOROVOD_METRICS_GRAD_NORM=1 additionally
+    # records a gradient-norm gauge from inside the training step (a
+    # host callback per step — off by default).
+    metrics_file: Optional[str] = None
+    metrics_interval_seconds: float = 10.0
+    metrics_grad_norm: bool = False
     # Stall inspector (stall_inspector.cc): warning threshold + disable.
+    # The same knobs gate metrics.StallWatchdog (auto-started by init()).
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
@@ -105,6 +115,10 @@ def refresh() -> Config:
                        .strip().lower() or "ladder"),
         autotune_probes=int(_env_float("HOROVOD_AUTOTUNE_PROBES", 6)),
         autotune_samples=int(_env_float("HOROVOD_AUTOTUNE_SAMPLES", 10)),
+        metrics_file=os.environ.get("HOROVOD_METRICS_FILE") or None,
+        metrics_interval_seconds=max(
+            0.05, _env_float("HOROVOD_METRICS_INTERVAL", 10.0)),
+        metrics_grad_norm=_env_bool("HOROVOD_METRICS_GRAD_NORM"),
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),

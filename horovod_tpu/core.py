@@ -91,6 +91,8 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
     """
     global _CTX
     import os
+    import time as _time
+    t0 = _time.perf_counter()
     if coordinator_address is None and num_processes is None and \
             os.environ.get("HVD_TPU_COORDINATOR"):
         # Launched by horovod_tpu.runner: pick up the rendezvous contract.
@@ -127,6 +129,12 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
             if _tl.get_timeline() is None:
                 _tl.start_timeline(cfg.timeline_path,
                                    mark_cycles=cfg.timeline_mark_cycles)
+        # Metrics subsystem: init span + world gauges, the snapshot
+        # flusher (HOROVOD_METRICS_FILE), and the stall watchdog (unless
+        # HOROVOD_STALL_CHECK_DISABLE).
+        from horovod_tpu import metrics as _metrics
+        _metrics.on_init(cfg, init_seconds=_time.perf_counter() - t0,
+                         world=len(devs))
 
 
 def shutdown() -> None:
@@ -143,6 +151,11 @@ def shutdown() -> None:
         _coll._EAGER_CACHE.clear()
         _coll._reset_negotiation()
         _ps._reset_for_shutdown()
+        # Stop the watchdog/flusher threads (the flusher writes one final
+        # snapshot). Metric VALUES survive shutdown — they are history,
+        # not runtime state.
+        from horovod_tpu import metrics as _metrics
+        _metrics.on_shutdown()
 
 
 def is_initialized() -> bool:

@@ -22,6 +22,7 @@ import time
 from typing import Callable, Optional
 
 import horovod_tpu as hvd
+from horovod_tpu import metrics as _metrics
 from horovod_tpu.elastic.discovery import DeviceDiscovery
 
 __all__ = ["run", "HostsUpdatedInterrupt", "WorkerNotificationManager",
@@ -79,6 +80,12 @@ class WorkerNotificationManager:
             if now != self._known:
                 self._known = now
                 self._changed.set()
+                # Membership telemetry: counted + timeline-marked the
+                # moment discovery sees the change, not when the training
+                # loop reaches its next commit boundary.
+                _metrics.gauge("elastic_devices").set(len(now))
+                _metrics.event("elastic_membership_change",
+                               devices=len(now))
 
     @property
     def changed(self) -> bool:
@@ -116,6 +123,7 @@ def run(func: Callable) -> Callable:
                     return func(state, *args, **kwargs)
                 except HostsUpdatedInterrupt:
                     resets += 1
+                    _metrics.event("elastic_reset", resets=resets)
                     if reset_limit is not None and resets > reset_limit:
                         raise RuntimeError(
                             f"elastic reset limit ({reset_limit}) exceeded")
@@ -137,6 +145,7 @@ def _reinitialize(min_size: int, discovery: Optional[DeviceDiscovery],
         devs = disco.find_available_devices()
         if len(devs) >= min_size:
             hvd.init(devices=devs)
+            _metrics.gauge("elastic_devices").set(len(devs))
             return
         if time.monotonic() > deadline:
             raise RuntimeError(

@@ -21,6 +21,8 @@ from typing import Any, Callable, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from horovod_tpu import metrics as _metrics
+
 __all__ = ["DEFAULT_FUSION_THRESHOLD_BYTES", "fuse", "unfuse", "fused_apply"]
 
 # Matches HOROVOD_FUSION_THRESHOLD default (64 MB).
@@ -77,13 +79,37 @@ def fuse(leaves: Sequence[Any],
         by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
 
     plan: List[List[int]] = []          # bucket -> leaf indices
+    causes: List[str] = []              # why each bucket was closed
     for idxs in by_dtype.values():
         sizes = [_nbytes(leaves[i]) for i in idxs]
         assignment = _plan_buckets(sizes, threshold_bytes)
         groups: dict = {}
         for i, b in zip(idxs, assignment):
             groups.setdefault(b, []).append(i)
-        plan.extend(groups[b] for b in sorted(groups))
+        ordered = [groups[b] for b in sorted(groups)]
+        plan.extend(ordered)
+        for j, g in enumerate(ordered):
+            if len(g) == 1 and _nbytes(leaves[g[0]]) > threshold_bytes:
+                causes.append("oversize_leaf")   # one leaf beats the cap
+            elif j < len(ordered) - 1:
+                causes.append("capacity")        # next leaf would overflow
+            else:
+                causes.append("end_of_group")    # dtype group / tree end
+
+    # Observability (trace-time: fuse runs under jit, so these count per
+    # COMPILATION, not per step — sizes are static python ints, never
+    # tracers). Fill ratio is bytes packed over the threshold; >1.0 means
+    # a single leaf exceeded the cap and rode its own bucket.
+    _metrics.counter("fusion_tensors_total").inc(len(leaves))
+    _metrics.counter("fusion_buckets_total").inc(len(plan))
+    for idxs, cause in zip(plan, causes):
+        b_bytes = sum(_nbytes(leaves[i]) for i in idxs)
+        _metrics.counter("fusion_flush_total", cause=cause).inc()
+        _metrics.histogram("fusion_fill_ratio",
+                           buckets=_metrics.RATIO_BUCKETS).observe(
+            b_bytes / max(threshold_bytes, 1))
+        _metrics.histogram("fusion_bucket_bytes",
+                           buckets=_metrics.SIZE_BUCKETS).observe(b_bytes)
 
     buckets = [
         leaves[idxs[0]].ravel() if len(idxs) == 1

@@ -1,0 +1,718 @@
+"""Unified metrics & telemetry: counters, gauges, histograms, exporters,
+and the collective stall watchdog.
+
+Upstream Horovod's only windows into a running job are the Chrome-trace
+timeline (``horovod/common/timeline.cc``) and the response-cache counters the
+autotuner consumes; neither is an aggregated, queryable view. This module is
+that view for the TPU rebuild: a thread-safe in-process registry instrumented
+at every layer —
+
+* ``collective.py``: per-collective call counts, bytes, dispatch latency,
+  compile spans, negotiation rounds (full vs cached fast path);
+* ``fusion.py``: fusion-buffer fill ratio and flush causes (trace-time —
+  fusion runs inside jit, so these count per *compilation*, not per step);
+* ``optimizer.py``: step-time and gradient-norm gauges;
+* ``core.py``: init spans and world-size gauges;
+* ``elastic/driver.py``: membership events;
+* ``autotune.py``: probe and convergence decisions.
+
+Public surface (also re-exported as ``hvd.metrics()`` / ``hvd.reset_metrics``):
+
+* :func:`snapshot` — one consistent dict of every registered series. The
+  module itself is callable (``hvd.metrics()``) and returns this snapshot;
+  the callable-module shim below exists because the ``hvd.metrics()``
+  function and the ``horovod_tpu.metrics`` submodule share a name.
+* :func:`to_prometheus` / :func:`to_json` — text-exposition and JSON
+  exporters; :func:`start_metrics_flusher` writes periodic snapshots to
+  ``HOROVOD_METRICS_FILE`` every ``HOROVOD_METRICS_INTERVAL`` seconds.
+* :class:`StallWatchdog` — generalizes
+  ``collective.negotiation_stall_report()``: a monitor thread that fires a
+  callback / log line / timeline marker when any collective has been pending
+  longer than a configurable timeout, naming the tensor, process set, and
+  waiting ranks. "Highly Available Data Parallel ML training on Mesh
+  Networks" (PAPERS.md) is the motivation: fast detection of stalled or
+  degraded replicas is the core of availability on TPU meshes.
+
+Metric events cross-link into the active :class:`~horovod_tpu.timeline
+.Timeline` as instant markers (``category="metrics"``) so traces and metrics
+tell one story.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import re
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "registry",
+    "counter", "gauge", "histogram", "event",
+    "snapshot", "reset_metrics", "to_prometheus", "to_json",
+    "collective_summary",
+    "start_metrics_flusher", "stop_metrics_flusher",
+    "collective_begin", "collective_end", "pending_collectives",
+    "StallWatchdog", "start_stall_watchdog", "stop_stall_watchdog",
+    "get_stall_watchdog",
+    "LATENCY_BUCKETS", "SIZE_BUCKETS", "RATIO_BUCKETS",
+]
+
+# Fixed bucket edges (upper bounds, seconds / bytes / ratio). Fixed — not
+# adaptive — so snapshots from different ranks and different times merge.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+SIZE_BUCKETS: Tuple[float, ...] = tuple(
+    float(256 << (2 * i)) for i in range(12))      # 256 B .. 512 MB
+RATIO_BUCKETS: Tuple[float, ...] = tuple(i / 10.0 for i in range(1, 11))
+
+
+class Counter:
+    """Monotonic counter (thread-safe)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: float = 1) -> None:
+        if hasattr(n, "item"):
+            n = n.item()   # numpy/jax scalar -> python: keeps JSON exportable
+        if n < 0:
+            raise ValueError(f"counters only go up (got {n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (thread-safe)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (thread-safe): per-bucket counts + sum +
+    count, Prometheus-compatible (buckets are upper bounds; an implicit
+    +Inf bucket catches the tail)."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] including the +Inf bucket."""
+        with self._lock:
+            counts = list(self._counts)
+        out, running = [], 0
+        for le, c in zip(list(self.buckets) + [float("inf")], counts):
+            running += c
+            out.append((le, running))
+        return out
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Registry:
+    """Thread-safe name+labels keyed store of counters/gauges/histograms."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Dict[tuple, Counter]] = {}
+        self._gauges: Dict[str, Dict[tuple, Gauge]] = {}
+        self._hists: Dict[str, Dict[tuple, Histogram]] = {}
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+
+    def counter(self, name: str, /, **labels) -> Counter:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            m = series.get(key)
+            if m is None:
+                m = series[key] = Counter()
+            return m
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            m = series.get(key)
+            if m is None:
+                m = series[key] = Gauge()
+            return m
+
+    def histogram(self, name: str, /,
+                  buckets: Optional[Tuple[float, ...]] = None,
+                  **labels) -> Histogram:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._hists.setdefault(name, {})
+            m = series.get(key)
+            if m is None:
+                # First registration fixes the bucket layout for the name;
+                # later series of the same name share it so exports merge.
+                bk = self._hist_buckets.setdefault(
+                    name, tuple(buckets) if buckets else LATENCY_BUCKETS)
+                m = series[key] = Histogram(bk)
+            return m
+
+    def event(self, name: str, /, **args) -> None:
+        """Count a notable occurrence and cross-link it into the active
+        timeline as an instant marker (``args`` become marker args, not
+        metric labels — high-cardinality values must not mint series)."""
+        self.counter(name + "_total").inc()
+        _timeline_marker(name, **args)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._hist_buckets.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = {n: dict(s) for n, s in self._counters.items()}
+            gauges = {n: dict(s) for n, s in self._gauges.items()}
+            hists = {n: dict(s) for n, s in self._hists.items()}
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for n, series in counters.items():
+            out["counters"][n] = [
+                {"labels": dict(k), "value": m.value}
+                for k, m in sorted(series.items())]
+        for n, series in gauges.items():
+            out["gauges"][n] = [
+                {"labels": dict(k), "value": m.value}
+                for k, m in sorted(series.items())]
+        for n, series in hists.items():
+            out["histograms"][n] = [
+                {"labels": dict(k), "count": m.count, "sum": m.sum,
+                 "buckets": [[le, c] for le, c in m.cumulative()]}
+                for k, m in sorted(series.items())]
+        out["pending_collectives"] = pending_collectives()
+        return out
+
+
+#: the process-global registry every instrumentation site writes to
+registry = Registry()
+
+# Module-level conveniences bound to the global registry.
+def counter(name: str, /, **labels) -> Counter:
+    return registry.counter(name, **labels)
+
+
+def gauge(name: str, /, **labels) -> Gauge:
+    return registry.gauge(name, **labels)
+
+
+def histogram(name: str, /, buckets: Optional[Tuple[float, ...]] = None,
+              **labels) -> Histogram:
+    return registry.histogram(name, buckets=buckets, **labels)
+
+
+def event(name: str, /, **args) -> None:
+    registry.event(name, **args)
+
+
+def snapshot() -> Dict[str, Any]:
+    """One consistent dict of every registered metric (``hvd.metrics()``)."""
+    return registry.snapshot()
+
+
+def reset_metrics() -> None:
+    """Drop every registered series (``hvd.reset_metrics()``). Pending
+    collective entries are kept — they describe in-flight work, not
+    accumulated history."""
+    registry.reset()
+
+
+def _timeline_marker(name: str, category: str = "metrics", **args) -> None:
+    """Instant marker in the active timeline, if any (metric events and
+    traces tell one story); never raises into the instrumented hot path."""
+    try:
+        from horovod_tpu import timeline as _tl
+        t = _tl.get_timeline()
+        if t is not None:
+            t.marker(name, category=category, **args)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "horovod_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    return _PREFIX + _NAME_RE.sub("_", name)
+
+
+def _prom_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{_NAME_RE.sub("_", k)}="{_escape(v)}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _prom_num(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v)) if isinstance(v, float) and not v.is_integer() \
+        else str(int(v))
+
+
+def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a snapshot in the Prometheus text exposition format
+    (version 0.0.4: ``# TYPE`` headers, ``_bucket{le=...}`` cumulative
+    histograms with ``_sum``/``_count``)."""
+    snap = snap if snap is not None else snapshot()
+    lines: List[str] = []
+    for name, series in sorted(snap.get("counters", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        for s in series:
+            lines.append(
+                f"{pname}{_prom_labels(s['labels'])} {_prom_num(s['value'])}")
+    for name, series in sorted(snap.get("gauges", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        for s in series:
+            lines.append(
+                f"{pname}{_prom_labels(s['labels'])} {_prom_num(s['value'])}")
+    for name, series in sorted(snap.get("histograms", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        for s in series:
+            for le, c in s["buckets"]:
+                le_label = f'le="{_prom_num(le)}"'
+                lines.append(
+                    f"{pname}_bucket{_prom_labels(s['labels'], le_label)}"
+                    f" {c}")
+            lines.append(f"{pname}_sum{_prom_labels(s['labels'])}"
+                         f" {repr(float(s['sum']))}")
+            lines.append(f"{pname}_count{_prom_labels(s['labels'])}"
+                         f" {s['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a snapshot as JSON (round-trips through ``json.loads``)."""
+    snap = snap if snap is not None else snapshot()
+    return json.dumps({"timestamp": time.time(), **snap})
+
+
+def collective_summary() -> Dict[str, Dict[str, Any]]:
+    """Compact per-kind collective counters for bench/report embedding:
+    ``{kind: {"calls": n, "bytes": b}}``."""
+    snap = registry.snapshot()
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, field in (("collective_calls_total", "calls"),
+                        ("collective_bytes_total", "bytes"),
+                        ("collective_traced_total", "traced_lowerings")):
+        for s in snap["counters"].get(name, []):
+            kind = s["labels"].get("kind", "unknown")
+            out.setdefault(kind, {})[field] = int(s["value"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# background snapshot flusher (HOROVOD_METRICS_FILE / HOROVOD_METRICS_INTERVAL)
+# ---------------------------------------------------------------------------
+
+_FLUSHER_LOCK = threading.Lock()
+_FLUSHER: Optional["_Flusher"] = None
+
+
+class _Flusher:
+    def __init__(self, path: str, interval_s: float):
+        self.path = path
+        self.interval_s = max(0.05, float(interval_s))
+        # Format follows the extension: .prom/.txt scrape as Prometheus
+        # textfile-collector input, anything else is JSON.
+        self._prom = path.endswith((".prom", ".txt"))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-metrics-flusher", daemon=True)
+        self._thread.start()
+
+    def write(self) -> None:
+        # Everything inside the guard: an export error (e.g. a user-held
+        # metric fed an unserializable value) must log and skip this
+        # flush, not silently kill the thread for the rest of the run.
+        try:
+            payload = to_prometheus() if self._prom else to_json()
+            # pid + thread id: stop()'s final write must never share a tmp
+            # file with a loop write that outlived the join timeout.
+            tmp = (f"{self.path}.tmp.{os.getpid()}"
+                   f".{threading.get_ident()}")
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)   # atomic: scrapers never see torn
+        except Exception:
+            logger.exception("metrics flush to %s failed", self.path)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write()
+
+    def stop(self, final_write: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if final_write:
+            self.write()
+
+
+def start_metrics_flusher(path: Optional[str] = None,
+                          interval_s: Optional[float] = None) -> None:
+    """Start (or retarget) the background snapshot writer. Defaults come
+    from ``HOROVOD_METRICS_FILE`` / ``HOROVOD_METRICS_INTERVAL`` via
+    :mod:`horovod_tpu.config`; idempotent for an unchanged target."""
+    global _FLUSHER
+    from horovod_tpu.config import get_config
+    cfg = get_config()
+    path = path or cfg.metrics_file
+    if not path:
+        raise ValueError("pass a path or set HOROVOD_METRICS_FILE")
+    interval_s = interval_s if interval_s is not None \
+        else cfg.metrics_interval_seconds
+    try:
+        import jax
+        if jax.process_count() > 1:
+            # One registry per process: every rank writing the SAME file
+            # would have scrapers read whichever rank flushed last. Fan
+            # the path out per rank (metrics.json -> metrics.r3.json).
+            root, ext = os.path.splitext(path)
+            path = f"{root}.r{jax.process_index()}{ext}"
+    except Exception:
+        pass
+    with _FLUSHER_LOCK:
+        if _FLUSHER is not None:
+            if (_FLUSHER.path == path
+                    and _FLUSHER.interval_s == max(0.05, float(interval_s))):
+                return
+            _FLUSHER.stop(final_write=False)
+        _FLUSHER = _Flusher(path, interval_s)
+
+
+def stop_metrics_flusher(final_write: bool = True) -> None:
+    global _FLUSHER
+    with _FLUSHER_LOCK:
+        if _FLUSHER is not None:
+            _FLUSHER.stop(final_write=final_write)
+            _FLUSHER = None
+
+
+# ---------------------------------------------------------------------------
+# pending-collective table + stall watchdog
+# ---------------------------------------------------------------------------
+
+_PENDING_LOCK = threading.Lock()
+_PENDING: Dict[int, Dict[str, Any]] = {}
+_PENDING_SEQ = itertools.count(1)
+
+
+def collective_begin(kind: str, name: Optional[str] = None, nbytes: int = 0,
+                     ranks: Optional[tuple] = None) -> int:
+    """Register an in-flight collective (negotiation + dispatch window);
+    returns a token for :func:`collective_end`. The stall watchdog reads
+    this table."""
+    tok = next(_PENDING_SEQ)
+    entry = {"token": tok, "kind": kind,
+             "tensor": name if name else f"{kind}#{tok}",
+             "bytes": int(nbytes),
+             "ranks": None if ranks is None else tuple(ranks),
+             "start": time.monotonic(), "fired": False}
+    with _PENDING_LOCK:
+        _PENDING[tok] = entry
+    return tok
+
+
+def collective_end(token: int) -> None:
+    with _PENDING_LOCK:
+        _PENDING.pop(token, None)
+
+
+def pending_collectives(older_than_s: float = 0.0) -> List[Dict[str, Any]]:
+    """Snapshot of in-flight collectives pending longer than
+    ``older_than_s`` seconds: tensor, kind, process set, age, bytes."""
+    now = time.monotonic()
+    out = []
+    with _PENDING_LOCK:
+        entries = list(_PENDING.values())
+    for e in entries:
+        age = now - e["start"]
+        if age >= older_than_s:
+            out.append({"tensor": e["tensor"], "kind": e["kind"],
+                        "process_set": ("global" if e["ranks"] is None
+                                        else list(e["ranks"])),
+                        "pending_s": age, "bytes": e["bytes"]})
+    return out
+
+
+class StallWatchdog:
+    """Monitor thread that fires when any collective stays pending longer
+    than ``timeout_s`` (default ``HOROVOD_STALL_CHECK_TIME_SECONDS``).
+
+    Generalizes ``collective.negotiation_stall_report()`` — which only sees
+    multi-process negotiations through the native coordinator — to every
+    eager collective on every path: each fire produces a report dict naming
+    the ``tensor``, the ``process_set``, and the ``waiting_ranks``, invokes
+    ``on_stall(report)``, logs a warning, bumps ``stall_events_total``, and
+    drops an instant marker into the active timeline. One fire per stuck
+    op; a new op stalls afresh.
+    """
+
+    def __init__(self, timeout_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 poll_s: float = 1.0):
+        if timeout_s is None:
+            from horovod_tpu.config import get_config
+            timeout_s = get_config().stall_check_time_seconds
+        self.timeout_s = float(timeout_s)
+        self._on_stall = on_stall
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._neg_fired: set = set()
+        self.stall_count = 0
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-stall-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def check_once(self) -> List[Dict[str, Any]]:
+        """One scan (also what the thread runs every ``poll_s``); returns
+        the reports fired this scan — callable directly from tests or a
+        training loop without the thread."""
+        fired: List[Dict[str, Any]] = []
+        now = time.monotonic()
+        with _PENDING_LOCK:
+            entries = [e for e in _PENDING.values()
+                       if not e["fired"] and now - e["start"] > self.timeout_s]
+            for e in entries:
+                e["fired"] = True
+        for e in entries:
+            report = {
+                "tensor": e["tensor"], "kind": e["kind"],
+                "process_set": ("global" if e["ranks"] is None
+                                else list(e["ranks"])),
+                "waiting_ranks": self._waiting_ranks(e["ranks"]),
+                "pending_s": now - e["start"], "bytes": e["bytes"],
+            }
+            fired.append(report)
+            self._fire(report)
+        # Native negotiation stall table (multi-process): names the ops and
+        # how many peers have not answered.
+        try:
+            from horovod_tpu.collective import negotiation_stall_report
+            for sig, missing in negotiation_stall_report(self.timeout_s):
+                if sig in self._neg_fired:
+                    continue
+                self._neg_fired.add(sig)
+                report = {"tensor": str(sig), "kind": "negotiation",
+                          "process_set": "global",
+                          "waiting_ranks": f"{missing} peer(s) missing",
+                          "pending_s": self.timeout_s, "bytes": 0}
+                fired.append(report)
+                self._fire(report)
+        except Exception:
+            pass
+        return fired
+
+    @staticmethod
+    def _waiting_ranks(ranks: Optional[tuple]):
+        """Best effort: the member ranks the pending op is still
+        synchronizing with (per-rank completion is not observable from one
+        host — XLA owns the device schedule)."""
+        if ranks is not None:
+            return list(ranks)
+        try:
+            from horovod_tpu import core
+            return list(range(core.size())) if core.is_initialized() else None
+        except Exception:
+            return None
+
+    def _fire(self, report: Dict[str, Any]) -> None:
+        self.stall_count += 1
+        registry.counter("stall_events_total").inc()
+        logger.warning(
+            "horovod_tpu: collective stalled: %s %r pending %.1fs on "
+            "process set %s (waiting ranks: %s, %d bytes)",
+            report["kind"], report["tensor"], report["pending_s"],
+            report["process_set"], report["waiting_ranks"], report["bytes"])
+        _timeline_marker("collective_stall", **{
+            k: v for k, v in report.items() if k != "pending_s"},
+            pending_s=round(report["pending_s"], 3))
+        if self._on_stall is not None:
+            try:
+                self._on_stall(report)
+            except Exception:
+                logger.exception("stall callback failed")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            self.check_once()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+_WATCHDOG_LOCK = threading.Lock()
+_WATCHDOG: Optional[StallWatchdog] = None
+
+
+def start_stall_watchdog(timeout_s: Optional[float] = None,
+                         on_stall: Optional[Callable] = None,
+                         poll_s: float = 1.0) -> StallWatchdog:
+    """Start (or return) the process-global stall watchdog. ``init()``
+    calls this (argument-free) unless ``HOROVOD_STALL_CHECK_DISABLE`` is
+    set. Calling again with explicit ``timeout_s``/``on_stall`` REPLACES
+    the running instance — the auto-started default must not silently
+    swallow a user's tighter timeout or alerting callback."""
+    global _WATCHDOG
+    with _WATCHDOG_LOCK:
+        if _WATCHDOG is not None:
+            if timeout_s is None and on_stall is None:
+                return _WATCHDOG
+            _WATCHDOG.stop()
+            _WATCHDOG = None
+        _WATCHDOG = StallWatchdog(timeout_s=timeout_s,
+                                  on_stall=on_stall,
+                                  poll_s=poll_s).start()
+        return _WATCHDOG
+
+
+def stop_stall_watchdog() -> None:
+    global _WATCHDOG
+    with _WATCHDOG_LOCK:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+            _WATCHDOG = None
+
+
+def get_stall_watchdog() -> Optional[StallWatchdog]:
+    return _WATCHDOG
+
+
+# ---------------------------------------------------------------------------
+# lifecycle hooks (called by core.init / core.shutdown)
+# ---------------------------------------------------------------------------
+
+def on_init(cfg, init_seconds: float, world: int) -> None:
+    registry.counter("init_total").inc()
+    registry.histogram("init_seconds").observe(init_seconds)
+    registry.gauge("world_size").set(world)
+    _timeline_marker("init", world=world,
+                     init_s=round(init_seconds, 4))
+    if cfg.metrics_file:
+        start_metrics_flusher(cfg.metrics_file, cfg.metrics_interval_seconds)
+    if not cfg.stall_check_disable:
+        # Argument-free: StallWatchdog reads HOROVOD_STALL_CHECK_TIME_*
+        # itself, and a user's later explicit start_stall_watchdog(...)
+        # must win over this auto-start.
+        start_stall_watchdog()
+
+
+def on_shutdown() -> None:
+    registry.counter("shutdown_total").inc()
+    stop_stall_watchdog()
+    stop_metrics_flusher(final_write=True)
+
+
+# ``hvd.metrics`` must be BOTH this submodule (so ``from horovod_tpu.metrics
+# import ...`` works everywhere) and the upstream-style ``hvd.metrics()``
+# snapshot call. Making the module callable avoids shadowing the submodule
+# attribute with a function — which would silently break any later
+# ``import horovod_tpu.metrics as m`` (getattr on the package would win and
+# return the function).
+import sys as _sys
+
+
+class _CallableModule(type(_sys.modules[__name__])):
+    def __call__(self, *args, **kwargs):
+        return snapshot(*args, **kwargs)
+
+
+_sys.modules[__name__].__class__ = _CallableModule

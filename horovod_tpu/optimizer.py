@@ -28,6 +28,7 @@ import optax
 
 from horovod_tpu import collective as C
 from horovod_tpu import core
+from horovod_tpu import metrics as _metrics
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet
 
@@ -139,12 +140,58 @@ class AutotunedStep:
         t0 = _time.perf_counter()
         out = self._fn(*args, **kwargs)
         jax.block_until_ready(out)   # honest step time while tuning
-        self._tuner.record(_time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        self._tuner.record(dt)
+        # Step-time telemetry rides the tuning syncs for free; after
+        # convergence the untimed path keeps full dispatch overlap, so the
+        # gauge freezes at the last tuned-step value.
+        _metrics.gauge("optimizer_step_seconds").set(dt)
+        _metrics.histogram("optimizer_step_latency_seconds").observe(dt)
         if (getattr(self._tuner, "pending_sync", False)
                 or self._tuner.converged
                 or self._tuner.current_threshold() != before):
             self._agree_and_rebuild()
         return out
+
+
+def _set_grad_norm(v) -> None:
+    _metrics.gauge("optimizer_grad_norm").set(float(v))
+
+
+_GRAD_NORM_WARNED = False
+
+
+def _maybe_record_grad_norm(grads) -> None:
+    """Gradient-norm gauge (``HOROVOD_METRICS_GRAD_NORM=1``, off by
+    default): global L2 norm of the float leaves. Under tracing the value
+    reaches the host through ``jax.debug.callback`` — one tiny host
+    callback per step, which is why it is opt-in."""
+    from horovod_tpu.config import get_config
+    if not get_config().metrics_grad_norm:
+        return
+    try:
+        leaves = [g for g in jax.tree_util.tree_leaves(grads)
+                  if hasattr(g, "dtype")
+                  and jnp.issubdtype(g.dtype, jnp.floating)]
+        if not leaves:
+            return
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in leaves))
+        if C._is_traced(norm):
+            jax.debug.callback(_set_grad_norm, norm)
+        else:
+            _set_grad_norm(norm)
+    except Exception:
+        # Observability must never break the training step — but an
+        # opted-in gauge that silently never records is a debugging trap;
+        # say why, once.
+        global _GRAD_NORM_WARNED
+        if not _GRAD_NORM_WARNED:
+            _GRAD_NORM_WARNED = True
+            import logging
+            logging.getLogger("horovod_tpu").warning(
+                "HOROVOD_METRICS_GRAD_NORM is set but recording failed; "
+                "optimizer_grad_norm will be absent", exc_info=True)
 
 
 def allreduce_gradients(grads: Any, op: int = C.Average,
@@ -162,6 +209,7 @@ def allreduce_gradients(grads: Any, op: int = C.Average,
     """
     if not core.in_spmd_context():
         # jit auto-sharding mode: XLA already reduced the grads.
+        _maybe_record_grad_norm(grads)
         return grads
     if alive is not None:
         if op not in (C.Average, C.Sum):
@@ -179,12 +227,15 @@ def allreduce_gradients(grads: Any, op: int = C.Average,
         if op == C.Average:
             summed = jax.tree_util.tree_map(
                 lambda g: g / n_alive.astype(g.dtype), summed)
+        _maybe_record_grad_norm(summed)
         return summed
-    return C.allreduce(grads, op=op, process_set=process_set,
-                       compression=compression,
-                       prescale_factor=prescale_factor,
-                       postscale_factor=postscale_factor,
-                       fusion_threshold_bytes=fusion_threshold_bytes)
+    out = C.allreduce(grads, op=op, process_set=process_set,
+                      compression=compression,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      fusion_threshold_bytes=fusion_threshold_bytes)
+    _maybe_record_grad_norm(out)
+    return out
 
 
 def DistributedOptimizer(optimizer: optax.GradientTransformation,

@@ -34,21 +34,34 @@ class Timeline:
     Events stream through the native C appender (``cpp/hvdtpu_core.cpp``,
     the analogue of the reference's C++ timeline writer) when the library is
     built; otherwise they buffer in Python and ``flush`` serializes them.
+
+    A Python-side mirror of recent events is kept even while the native
+    appender streams, so a native failure (mid-stream or at ``close``) can
+    never lose the whole trace. The mirror is BOUNDED (``MIRROR_CAP``
+    newest events) — the native path must not grow host memory without
+    limit on long runs; without the native appender the buffer is the only
+    store and is unbounded, as before.
     """
+
+    #: python-mirror bound while the native appender is active
+    MIRROR_CAP = 100_000
 
     def __init__(self, path: str):
         self.path = path
-        self._events = []
-        self._t0 = time.perf_counter()
-        self._pid = os.getpid()
-        self._lock = threading.Lock()
-        self._closed = False
+        from collections import deque
         from horovod_tpu import native
         try:
             self._nt = native.NativeTimeline(path) \
                 if native.native_available() else None
         except (OSError, RuntimeError):
             self._nt = None
+        self._events = deque(maxlen=self.MIRROR_CAP) \
+            if self._nt is not None else deque()
+        self._dropped = 0
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+        self._closed = False
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -58,16 +71,32 @@ class Timeline:
             if self._closed:
                 return
             if self._nt is not None:
-                self._nt.event(name, cat, ts, dur, pid=self._pid, tid=tid,
-                               ph=ph, args_json=json.dumps(args) if args else "")
-            else:
-                ev = {"name": name, "cat": cat, "ph": ph, "ts": ts,
-                      "pid": self._pid, "tid": tid, "args": args}
-                if ph == "X":
-                    ev["dur"] = dur
-                if ph == "i":
-                    ev["s"] = "g"
-                self._events.append(ev)
+                # Serialize OUTSIDE the appender guard (default=str: a
+                # numpy/jax scalar in args must not masquerade as an
+                # appender death and silently disable native streaming).
+                args_json = json.dumps(args, default=str) if args else ""
+                try:
+                    self._nt.event(name, cat, ts, dur, pid=self._pid,
+                                   tid=tid, ph=ph, args_json=args_json)
+                except Exception:
+                    # Appender died mid-stream: its file is unfinishable,
+                    # but the Python mirror below still has every event —
+                    # flush() will serialize from it instead.
+                    self._nt = None
+            # Python mirror (bounded while native streams; see class
+            # docstring): if close() (or a later event) fails, flush() can
+            # still leave a valid JSON file instead of silently dropping
+            # the trace.
+            if self._events.maxlen is not None \
+                    and len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            ev = {"name": name, "cat": cat, "ph": ph, "ts": ts,
+                  "pid": self._pid, "tid": tid, "args": args}
+            if ph == "X":
+                ev["dur"] = dur
+            if ph == "i":
+                ev["s"] = "g"
+            self._events.append(ev)
 
     def marker(self, name: str, category: str = "marker", **args) -> None:
         self._emit(name, category, "i", self._now_us(), 0.0, 0, args)
@@ -83,17 +112,33 @@ class Timeline:
                        threading.get_ident() % 1_000_000, args)
 
     def flush(self) -> None:
-        """Finalize the trace file (the timeline is closed afterwards)."""
+        """Finalize the trace file (the timeline is closed afterwards).
+
+        Always leaves a valid JSON file: if the native appender was
+        constructed but ``close()`` raises, the Python-mirrored events are
+        serialized instead of being silently dropped."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             if self._nt is not None:
-                self._nt.close()
-            else:
-                with open(self.path, "w") as f:
-                    json.dump({"traceEvents": self._events,
-                               "displayTimeUnit": "ms"}, f)
+                try:
+                    self._nt.close()
+                    return
+                except Exception:
+                    pass   # fall through: rewrite from the Python mirror
+            events = list(self._events)
+            if self._dropped:
+                events.insert(0, {
+                    "name": f"timeline_mirror_dropped_{self._dropped}_events",
+                    "cat": "metrics", "ph": "i", "ts": 0.0,
+                    "pid": self._pid, "tid": 0, "s": "g", "args": {}})
+            # default=str: an unserializable marker arg degrades to its
+            # repr instead of raising after the file is already truncated
+            # — flush must ALWAYS leave valid JSON.
+            with open(self.path, "w") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f, default=str)
 
 
 _ATEXIT_REGISTERED = False
@@ -112,6 +157,10 @@ def init_timeline(path: Optional[str] = None) -> Timeline:
         if not path:
             raise ValueError(
                 "pass a path or set HOROVOD_TIMELINE=/path/timeline.json")
+        if _TIMELINE is not None:
+            # Re-init must not leak the previous instance unflushed — its
+            # file would stay invalid (or absent) forever.
+            _TIMELINE.flush()
         _TIMELINE = Timeline(path)
         if not _ATEXIT_REGISTERED:
             import atexit

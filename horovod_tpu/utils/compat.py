@@ -1,0 +1,29 @@
+"""Version-bridging shims for the jax surface the framework depends on.
+
+The framework targets the current jax API (``jax.shard_map`` with
+``check_vma``); older runtimes (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` with the same semantics under the
+``check_rep`` spelling. Every internal ``shard_map`` call routes through
+here so a single site owns the bridge.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` where available, else the experimental module's
+    implementation (``check_vma`` maps onto its ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
