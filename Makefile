@@ -1,0 +1,19 @@
+# Developer entry points. The native library has its own Makefile (cpp/).
+
+PY ?= python
+
+.PHONY: trace-smoke test native
+
+# Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
+# merged via hvd.merge_timelines; exits nonzero if the merged trace is
+# invalid JSON, the straggler report is empty, or the NEGOTIATE/QUEUE/EXEC
+# phases of a collective don't share one op-id across ranks. Also runs in
+# tier-1 as tests/test_trace_merge.py::TestTwoProcessSmoke.
+trace-smoke:
+	$(PY) tools/trace_smoke.py
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C cpp

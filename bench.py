@@ -369,8 +369,10 @@ def bench_allreduce(on_tpu):
         x = jax.make_array_from_callback((n, per_dev), sharding,
                                          lambda idx: one_row)
 
+        from horovod_tpu.utils.compat import shard_map as _compat_shard_map
+
         @jax.jit
-        @_partial(jax.shard_map, mesh=mesh, in_specs=P("x"),
+        @_partial(_compat_shard_map, mesh=mesh, in_specs=P("x"),
                   out_specs=P("x"))
         def psum_fn(v):
             return jax.lax.psum(v, "x")

@@ -32,6 +32,7 @@ import horovod_tpu as hvd
 from horovod_tpu.models.gpt2 import GPT2, GPT2Config
 from horovod_tpu.models.gpt2_pipeline import (gpt2_pp_loss_and_grad,
                                               stack_block_params)
+from horovod_tpu.utils.compat import shard_map as _compat_shard_map
 
 
 def main():
@@ -129,7 +130,7 @@ def main():
         return loss, blocks, rest
 
     if TP > 1:
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_compat_shard_map(
             train_step, mesh=mesh, in_specs=(specs, P(), P()),
             out_specs=(P(), specs, P()), check_vma=False))
     else:

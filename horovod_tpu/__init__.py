@@ -42,6 +42,9 @@ from horovod_tpu.process_set import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,
 )
 from horovod_tpu.spmd import spmd, spmd_data_sharding  # noqa: F401
+from horovod_tpu.timeline import (  # noqa: F401
+    start_timeline, stop_timeline, merge_timelines,
+)
 
 __version__ = "0.1.0"
 

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import functools
 import time as _time_mod
+from collections import deque
+from contextlib import nullcontext
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -41,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu import core
 from horovod_tpu import fusion as _fusion
 from horovod_tpu import metrics as _metrics
+from horovod_tpu import tracing as _tracing
 from horovod_tpu.adasum import adasum_allreduce, hierarchical_adasum_allreduce
 from horovod_tpu.compression import Compression
 from horovod_tpu.process_set import ProcessSet, global_process_set
@@ -460,6 +463,14 @@ _NEG_CACHE: set = set()    # python fallback response cache
 # negotiation_stats() answers "this communicator epoch", the registry
 # answers "this process" (what Prometheus scrapes expect).
 _NEG_STATS = {"full": 0, "fast": 0}
+# Cross-rank arrival attribution: each negotiation round piggybacks this
+# process's wait inside the PREVIOUS round's host allgather ([wait_ms,
+# op_seq]); after the allgather every rank knows every rank's wait for
+# round k-1. The rank that waited LEAST arrived LAST — it is the straggler
+# everyone else sat waiting for. Recent rounds in _ARRIVALS (what the
+# stall watchdog names late ranks from).
+_PREV_WAIT = [0, 0]
+_ARRIVALS: deque = deque(maxlen=64)
 
 
 def _reset_negotiation() -> None:
@@ -473,6 +484,11 @@ def _reset_negotiation() -> None:
     _NEG_CACHE.clear()
     _NEG_STATS["full"] = _NEG_STATS["fast"] = 0
     _SUBSET_BARRIER_SEQ.clear()
+    _PREV_WAIT[0] = _PREV_WAIT[1] = 0
+    _ARRIVALS.clear()
+    # Span op-ids count the same submission sequence as negotiation;
+    # restart them together so post-re-mesh op #1 is op #1 on every rank.
+    _tracing.reset_spans()
 
 
 def _neg_coordinator():
@@ -526,8 +542,60 @@ def negotiation_stall_report(timeout_s: float = 60.0):
     return coord.stall_check(timeout_s) if coord is not None else []
 
 
+def negotiation_arrival_stats(last_n: int = 16) -> list:
+    """Recent cross-process arrival records, newest last: ``{"op_seq",
+    "spread_s", "wait_s_by_process", "late_processes", "ts"}`` per
+    negotiation round. All indices here are **jax process indices**
+    (one entry per host process, the negotiation participant) — NOT
+    device ranks; on one-device-per-process topologies the two coincide.
+
+    Every round's host allgather piggybacks each process's wait time from
+    the PREVIOUS round, so after one extra round every process knows how
+    long every process sat at the rendezvous: the one that waited least
+    arrived last — the straggler the others waited for. This is what lets
+    the stall watchdog name the *late* processes, not just the waiting
+    ranks, and it feeds the ``collective_arrival_spread_seconds``
+    histogram live (the merged timeline computes the same spread offline
+    from span phase events)."""
+    out = list(_ARRIVALS)
+    return out[-int(last_n):] if last_n else out
+
+
+def _harvest_arrivals(rows: np.ndarray) -> None:
+    """Record the previous round's cross-rank waits from the piggyback
+    columns (6 = wait_ms, 7 = that wait's op sequence number)."""
+    active = rows[:, 5] == 0
+    idx = np.nonzero(active)[0]
+    if len(idx) < 2:
+        return
+    seqs = rows[idx, 7]
+    # Only a coherent set is attributable: every active rank reporting the
+    # SAME previous op (first rounds and join-restarts report seq 0).
+    if (seqs <= 0).any() or len(set(seqs.tolist())) != 1:
+        return
+    waits_s = rows[idx, 6].astype(np.float64) / 1e3
+    spread = float(waits_s.max() - waits_s.min())
+    # Late = arrived within tolerance of the last arriver (who waited
+    # least). Sub-resolution spreads are noise, not attribution.
+    late = [] if spread < 0.002 else [
+        int(r) for r, w in zip(idx, waits_s)
+        if w <= waits_s.min() + max(0.002, spread * 0.1)]
+    _ARRIVALS.append({
+        "op_seq": int(seqs[0]), "spread_s": spread,
+        "wait_s_by_process": {int(r): float(w)
+                              for r, w in zip(idx, waits_s)},
+        "late_processes": late,
+        # Monotonic stamp so consumers (stall watchdog) can tell a live
+        # pattern from a record that predates the current stall.
+        "ts": _time_mod.monotonic(),
+    })
+    _metrics.histogram("collective_arrival_spread_seconds",
+                       source="negotiation").observe(spread)
+
+
 def _negotiate(kind: str, sig_key: tuple,
-               service_desc: Optional[tuple] = None) -> tuple:
+               service_desc: Optional[tuple] = None,
+               span: Optional[_tracing.Span] = None) -> tuple:
     """Multi-process eager negotiation (upstream ``controller.cc`` +
     ``response_cache.cc``, rebuilt host-side).
 
@@ -541,10 +609,13 @@ def _negotiate(kind: str, sig_key: tuple,
 
     1. Fold ``(sequence_number, op, shapes, params)`` into a rolling
        128-bit signature hash; allgather ``[hash_0..hash_3, need_full,
-       joined]`` (6 int32 — ONE host round). The rolling hash covers the
-       entire op history, so any reorder/skip/divergence makes hashes
-       differ at the next call and every process raises *before* touching
-       the device. Joined rows are excluded from the comparison.
+       joined, prev_wait_ms, prev_wait_seq]`` (8 int32 — ONE host round;
+       columns 6-7 piggyback this process's wait at the PREVIOUS round's
+       rendezvous, see :func:`negotiation_arrival_stats`). The rolling
+       hash covers the entire op history, so any reorder/skip/divergence
+       makes hashes differ at the next call and every process raises
+       *before* touching the device. Joined rows are excluded from the
+       comparison.
     2. If any process flags ``need_full`` (signature not in its response
        cache) — joined processes always do — everyone runs the full
        object allgather, actives verify signature equality, and joined
@@ -568,6 +639,11 @@ def _negotiate(kind: str, sig_key: tuple,
     t = _tl.get_timeline()
     t0 = _time_mod.perf_counter()
     try:
+        if span is not None:
+            # Span-contexted NEGOTIATE phase (upstream timeline.cc's
+            # NEGOTIATE_* rows): same op_id on every rank's shard.
+            with _tracing.phase(span, "NEGOTIATE"):
+                return _negotiate_inner(kind, sig_key, service_desc)
         if t is not None:
             with t.activity(f"negotiate:{kind}", category="negotiation"):
                 return _negotiate_inner(kind, sig_key, service_desc)
@@ -593,8 +669,19 @@ def _negotiate_inner(kind: str, sig_key: tuple,
         coord.submit(me, sig)  # pending until negotiation completes
 
     need_full = 0 if _cache_seen(cache_key) else 1
+    # Row layout (8 x int32, fixed-shape on every path): [hash x4,
+    # need_full, joined, prev_wait_ms, prev_wait_seq]. Columns 6-7
+    # piggyback the wait this process measured at the PREVIOUS round's
+    # rendezvous, giving every rank a one-round-delayed view of who
+    # arrived late (see negotiation_arrival_stats).
+    t_arrive = _time_mod.perf_counter()
     rows = _host_allgather_i32(
-        np.concatenate([h, [need_full, 0]]).astype(np.int32))
+        np.concatenate([h, [need_full, 0, _PREV_WAIT[0],
+                            _PREV_WAIT[1]]]).astype(np.int32))
+    _PREV_WAIT[0] = min(
+        int((_time_mod.perf_counter() - t_arrive) * 1e3), 2**31 - 1)
+    _PREV_WAIT[1] = _OP_SEQ
+    _harvest_arrivals(rows)
     joined = tuple(int(i) for i in np.nonzero(rows[:, 5])[0])
     active = [i for i in range(rows.shape[0]) if rows[i, 5] == 0]
 
@@ -634,6 +721,31 @@ def _negotiate_inner(kind: str, sig_key: tuple,
     return joined
 
 
+def _maybe_profiler_annotation(kind: str, span):
+    """``HOROVOD_TRACE_JAX_PROFILER=1``: wrap the dispatched program in a
+    ``jax.profiler.TraceAnnotation`` named with the same op-id the host
+    timeline logs, so XLA device traces (``timeline.start_profiler``)
+    correlate with merged host shards. No-op (and never raises) when the
+    knob is off or the profiler is unavailable."""
+    try:
+        from horovod_tpu.config import get_config
+        if not get_config().trace_jax_profiler:
+            return nullcontext()
+        op = span.op_id if span is not None else 0
+        return jax.profiler.TraceAnnotation(f"hvd:{kind}#{op}")
+    except Exception:
+        return nullcontext()
+
+
+def _traced_span(kind: str, name: Optional[str], ps: ProcessSet):
+    """Span for an in-jit lowering (negative op-id: trace-time ids are
+    per-process — compile caches differ across ranks — so they must never
+    collide with the negotiation-ordered eager sequence trace_merge
+    correlates)."""
+    return _tracing.active_span(_tracing.mint_span(
+        kind, tensor=name, process_set=ps.process_set_id, traced=True))
+
+
 def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
                negotiate_key: tuple = (), _skip_negotiate: bool = False,
                op_name: Optional[str] = None):
@@ -660,21 +772,31 @@ def _eager_run(kind: str, tree: Any, params: tuple, param_key: tuple,
     shapes = tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
     nbytes = sum(x.size * x.dtype.itemsize for x in leaves)
     ps_arg = next((p for p in params if isinstance(p, ProcessSet)), None)
+    # Span context, minted at enqueue (upstream controller's tensor-request
+    # id): negotiation keeps every process's submission order identical, so
+    # this locally-minted monotone id names the SAME collective on every
+    # rank — the key trace_merge correlates shards by.
+    span = _tracing.mint_span(
+        kind, tensor=op_name,
+        process_set=0 if ps_arg is None else ps_arg.process_set_id)
     pend = _metrics.collective_begin(
         kind, name=op_name, nbytes=int(nbytes),
-        ranks=None if ps_arg is None else ps_arg.ranks)
+        ranks=None if ps_arg is None else ps_arg.ranks,
+        op_id=span.op_id)
     t_begin = _time_mod.perf_counter()
     try:
-        return _eager_run_inner(kind, tree, params, param_key, negotiate_key,
-                                _skip_negotiate, m, axis, n, leaves, treedef,
-                                shapes, int(nbytes), t_begin)
+        with _tracing.active_span(span):
+            return _eager_run_inner(kind, tree, params, param_key,
+                                    negotiate_key, _skip_negotiate, m, axis,
+                                    n, leaves, treedef, shapes, int(nbytes),
+                                    t_begin, span)
     finally:
         _metrics.collective_end(pend)
 
 
 def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
                      _skip_negotiate, m, axis, n, leaves, treedef, shapes,
-                     nbytes, t_begin):
+                     nbytes, t_begin, span=None):
     joined: tuple = ()
     if not _skip_negotiate:
         desc = None
@@ -684,7 +806,7 @@ def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
             op_, _ps_, pre_, post_, comp_, fus_ = params
             desc = ("allreduce", shapes, op_, pre_, post_, comp_, fus_)
         joined = _negotiate(kind, (shapes, param_key, negotiate_key),
-                            service_desc=desc)
+                            service_desc=desc, span=span)
         if joined:
             if kind != "allreduce":
                 raise RuntimeError(
@@ -737,13 +859,24 @@ def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
 
     from horovod_tpu import timeline as _tl
     t = _tl.get_timeline()
+    sp_args = {} if span is None else {"op_id": span.op_id,
+                                       "tensor": span.tensor}
     if t is not None:
-        with t.activity(kind, tensors=len(leaves), bytes=nbytes):
-            placed = [place(x) for x in leaves]
-            out_leaves = fn(*placed)
+        with t.activity(kind, tensors=len(leaves), bytes=nbytes, **sp_args):
+            # Upstream timeline.cc phase rows, span-keyed so trace_merge
+            # can line them up across rank shards: QUEUE = host staging
+            # (device placement of per-rank rows), EXEC = program dispatch
+            # (jax dispatch is async: host-side launch, not device time).
+            with _tracing.phase(span, "QUEUE", bytes=nbytes,
+                                epoch=core.init_epoch()):
+                placed = [place(x) for x in leaves]
+            with _tracing.phase(span, "EXEC", epoch=core.init_epoch()):
+                with _maybe_profiler_annotation(kind, span):
+                    out_leaves = fn(*placed)
     else:
         placed = [place(x) for x in leaves]
-        out_leaves = fn(*placed)
+        with _maybe_profiler_annotation(kind, span):
+            out_leaves = fn(*placed)
     # Dispatch latency: negotiation + placement + program launch (jax
     # dispatch is async, so this is host-side cost, not device runtime —
     # exactly the layer the host controls and the timeline records).
@@ -807,7 +940,10 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         # in-jit analogue of collective_calls_total; steps re-USE the
         # compiled program, so this counts programs, not steps).
         _metrics.counter("collective_traced_total", kind="allreduce").inc()
-        return _allreduce_tree(tensor, *args)
+        # Trace-time span: fusion reads it to stamp its flush events with
+        # the op that owns the buckets.
+        with _traced_span("allreduce", name, ps):
+            return _allreduce_tree(tensor, *args)
     pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
           compression.__name__, int(fusion_threshold_bytes))
     if op == ReduceOp.Adasum:
@@ -862,7 +998,8 @@ def broadcast(tensor, root_rank: int, process_set: Optional[ProcessSet] = None,
         raise ValueError(f"root rank {root_rank} not in process set {ps.ranks}")
     if _is_traced(tensor):
         _metrics.counter("collective_traced_total", kind="broadcast").inc()
-        return _INTRACE["broadcast"](tensor, root_rank, ps)
+        with _traced_span("broadcast", name, ps):
+            return _INTRACE["broadcast"](tensor, root_rank, ps)
     return _eager_run("broadcast", tensor, (int(root_rank), ps),
                       (int(root_rank), _ps_key(ps)), op_name=name)
 
@@ -880,7 +1017,8 @@ def allgather(tensor, process_set: Optional[ProcessSet] = None,
     ps = _resolve_ps(process_set)
     if _is_traced(tensor):
         _metrics.counter("collective_traced_total", kind="allgather").inc()
-        return _INTRACE["allgather"](tensor, ps)
+        with _traced_span("allgather", name, ps):
+            return _INTRACE["allgather"](tensor, ps)
     return _eager_run("allgather", tensor, (ps,), (_ps_key(ps),),
                       op_name=name)
 
@@ -949,7 +1087,8 @@ def alltoall(tensor, splits=None, process_set: Optional[ProcessSet] = None,
         if _is_traced(tensor):
             _metrics.counter("collective_traced_total",
                              kind="alltoall").inc()
-            return _INTRACE["alltoall"](tensor, ps)
+            with _traced_span("alltoall", name, ps):
+                return _INTRACE["alltoall"](tensor, ps)
         return _eager_run("alltoall", tensor, (ps,), (_ps_key(ps),),
                           op_name=name)
     if _is_traced(tensor) or _is_traced(splits):
@@ -1063,7 +1202,8 @@ def reducescatter(tensor, op: int = Average,
     if _is_traced(tensor):
         _metrics.counter("collective_traced_total",
                          kind="reducescatter").inc()
-        return _INTRACE["reducescatter"](tensor, op, ps)
+        with _traced_span("reducescatter", name, ps):
+            return _INTRACE["reducescatter"](tensor, op, ps)
     return _eager_run("reducescatter", tensor, (op, ps),
                       (op, _ps_key(ps)), op_name=name)
 
@@ -1189,6 +1329,25 @@ def _subset_barrier_wait(ps: ProcessSet, member_procs, timeout_s: float
     _SUBSET_BARRIER_SEQ[ps.process_set_id] = e   # advance ONLY on success
 
 
+def _barrier_wait(ps: ProcessSet) -> None:
+    """The multi-process barrier wait itself (subset sets ride the
+    store-backed member rendezvous, the global set a device sync)."""
+    if ps.ranks is not None:
+        devs = list(core.mesh().devices.ravel())
+        member_procs = sorted({devs[r].process_index for r in ps.ranks})
+        me = jax.process_index()
+        if me not in member_procs:
+            return
+        if len(member_procs) == 1:
+            return
+        from horovod_tpu.config import get_config
+        timeout_s = get_config().barrier_timeout_seconds
+        _subset_barrier_wait(ps, member_procs, timeout_s)
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("horovod_tpu_barrier")
+
+
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """Block until all members reach the barrier (``hvd.barrier``).
 
@@ -1204,25 +1363,16 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     if jax.process_count() > 1:
         # Host-side barriers never route through _eager_run, so register
         # them in the pending table directly — a peer that never arrives
-        # is exactly what the stall watchdog exists to name.
+        # is exactly what the stall watchdog exists to name. Every process
+        # calls barrier() (non-members return immediately), so the span
+        # sequence stays aligned across ranks.
+        span = _tracing.mint_span("barrier", tensor="barrier",
+                                  process_set=ps.process_set_id)
         pend = _metrics.collective_begin("barrier", name="barrier",
-                                         ranks=ps.ranks)
+                                         ranks=ps.ranks, op_id=span.op_id)
         try:
-            if ps.ranks is not None:
-                devs = list(core.mesh().devices.ravel())
-                member_procs = sorted({devs[r].process_index
-                                       for r in ps.ranks})
-                me = jax.process_index()
-                if me not in member_procs:
-                    return
-                if len(member_procs) == 1:
-                    return
-                from horovod_tpu.config import get_config
-                timeout_s = get_config().barrier_timeout_seconds
-                _subset_barrier_wait(ps, member_procs, timeout_s)
-                return
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("horovod_tpu_barrier")
+            with _tracing.phase(span, "EXEC", epoch=core.init_epoch()):
+                _barrier_wait(ps)
             return
         finally:
             _metrics.collective_end(pend)
@@ -1278,9 +1428,13 @@ def join() -> int:
         # Joined ranks serviced peers' ops without folding them into
         # their rolling hash; restart the history symmetrically (every
         # process is here) so post-join collectives negotiate cleanly.
+        # Span ids and the piggybacked arrival wait restart with it —
+        # they count the same submission sequence.
         global _OP_SEQ, _NEG_HASH
         _OP_SEQ = 0
         _NEG_HASH = b"\x00" * 16
+        _PREV_WAIT[0] = _PREV_WAIT[1] = 0
+        _tracing.reset_spans()
         return -min(table)[1]
     barrier()
     return core.size() - 1
@@ -1291,7 +1445,8 @@ def _join_service_round() -> bool:
     process has joined (returns True) or an active peer submitted an op —
     replay it with neutral contributions and return False to keep
     servicing."""
-    rows = _host_allgather_i32(np.array([0, 0, 0, 0, 1, 1], np.int32))
+    rows = _host_allgather_i32(
+        np.array([0, 0, 0, 0, 1, 1, 0, 0], np.int32))
     if rows[:, 5].all():
         return True
     objs = allgather_object(("joined",))
@@ -1404,7 +1559,10 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
         if source:
             buf[:] = payload
         out = mhu.broadcast_one_to_all(buf, is_source=source)
-        return pickle.loads(np.asarray(out).tobytes())
+        # jax 0.4.x broadcast_one_to_all returns sub-32-bit payloads
+        # UPCAST (uint8 -> uint32, values preserved); cast back before
+        # reading raw bytes or every 4th byte of the pickle is real.
+        return pickle.loads(np.asarray(out).astype(np.uint8).tobytes())
     return obj
 
 

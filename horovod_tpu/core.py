@@ -40,9 +40,21 @@ __all__ = [
     "mesh",
     "axis_name",
     "build_info",
+    "init_epoch",
 ]
 
 AXIS_NAME = "hvd"
+
+# Monotone count of init() calls this process (elastic re-meshes bump it).
+# Trace span phases carry it so a merged timeline can attribute collectives
+# to communicator epochs; elastic membership changes appear as epoch
+# boundaries in every rank's shard.
+_INIT_EPOCH = 0
+
+
+def init_epoch() -> int:
+    """Communicator epoch: how many times ``init()`` has run (0 = never)."""
+    return _INIT_EPOCH
 
 
 @dataclasses.dataclass
@@ -105,6 +117,11 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
             # init() must stay reentrant (elastic re-init, shutdown/init
             # cycles); jax.distributed may only be initialized once.
             if not _distributed_initialized():
+                # Multi-process CPU (tests, local launchers): cross-process
+                # computations need the gloo collectives backend selected
+                # before the CPU client exists (no-op elsewhere).
+                from horovod_tpu.utils.compat import enable_cpu_collectives
+                enable_cpu_collectives()
                 jax.distributed.initialize(
                     coordinator_address=coordinator_address,
                     num_processes=num_processes,
@@ -124,11 +141,39 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         # same contract here (config.py documents the TPU-inert ones).
         from horovod_tpu import config as _config
         cfg = _config.refresh()
+        global _INIT_EPOCH
+        _INIT_EPOCH += 1
         if cfg.timeline_path:
             from horovod_tpu import timeline as _tl
             if _tl.get_timeline() is None:
                 _tl.start_timeline(cfg.timeline_path,
                                    mark_cycles=cfg.timeline_mark_cycles)
+        # Clock-anchor for cross-rank trace alignment: every process leaves
+        # this barrier at (nearly) the same instant and stamps the moment
+        # into its own shard; merge_timelines aligns shards by making the
+        # anchors coincide. The barrier is UNCONDITIONAL in multi-process
+        # mode — gating it on this process's timeline config would deadlock
+        # init when HOROVOD_TIMELINE is set on only some ranks (init is
+        # already collective; one extra sync is noise). Re-inits (elastic
+        # re-mesh) stamp a new epoch marker into every shard.
+        from horovod_tpu import timeline as _tl
+        if jax.process_count() > 1 and _distributed_initialized():
+            t = _tl.get_timeline()
+            if t is not None and t.rank is None:
+                # Timeline was started before the distributed runtime came
+                # up (start_timeline pre-init), so the path never fanned
+                # out per rank — every process would stream into the SAME
+                # file. Re-init onto this rank's shard (the pre-init
+                # events flush to the base path).
+                _tl.init_timeline(t.path)
+            from jax.experimental import multihost_utils as _mhu
+            _mhu.sync_global_devices("hvdtpu_timeline_anchor")
+        if _tl.get_timeline() is not None:
+            _tl.emit_clock_anchor(epoch=_INIT_EPOCH)
+            if _INIT_EPOCH > 1:
+                _tl.get_timeline().marker("elastic_epoch", category="trace",
+                                          epoch=_INIT_EPOCH,
+                                          world=len(devs))
         # Metrics subsystem: init span + world gauges, the snapshot
         # flusher (HOROVOD_METRICS_FILE), and the stall watchdog (unless
         # HOROVOD_STALL_CHECK_DISABLE).

@@ -146,6 +146,12 @@ def _reinitialize(min_size: int, discovery: Optional[DeviceDiscovery],
         if len(devs) >= min_size:
             hvd.init(devices=devs)
             _metrics.gauge("elastic_devices").set(len(devs))
+            # Epoch boundary in this process's timeline shard (init() also
+            # stamps elastic_epoch + a fresh clock_anchor on re-init):
+            # merged traces split their critical-path rollup at these.
+            from horovod_tpu import core as _core
+            _metrics.event("elastic_epoch", epoch=_core.init_epoch(),
+                           devices=len(devs))
             return
         if time.monotonic() > deadline:
             raise RuntimeError(

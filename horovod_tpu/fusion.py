@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from horovod_tpu import metrics as _metrics
+from horovod_tpu import tracing as _tracing
 
 __all__ = ["DEFAULT_FUSION_THRESHOLD_BYTES", "fuse", "unfuse", "fused_apply"]
 
@@ -102,7 +103,12 @@ def fuse(leaves: Sequence[Any],
     # a single leaf exceeded the cap and rode its own bucket.
     _metrics.counter("fusion_tensors_total").inc(len(leaves))
     _metrics.counter("fusion_buckets_total").inc(len(plan))
-    for idxs, cause in zip(plan, causes):
+    # Span context of the collective whose tree is being fused (set by
+    # collective.py around eager dispatch and traced lowerings): flush
+    # events carry the owning op-id so a merged trace can tie each fusion
+    # bucket back to the collective it fed.
+    span = _tracing.current_span()
+    for bucket_i, (idxs, cause) in enumerate(zip(plan, causes)):
         b_bytes = sum(_nbytes(leaves[i]) for i in idxs)
         _metrics.counter("fusion_flush_total", cause=cause).inc()
         _metrics.histogram("fusion_fill_ratio",
@@ -110,6 +116,11 @@ def fuse(leaves: Sequence[Any],
             b_bytes / max(threshold_bytes, 1))
         _metrics.histogram("fusion_bucket_bytes",
                            buckets=_metrics.SIZE_BUCKETS).observe(b_bytes)
+        if span is not None:
+            _metrics._timeline_marker(
+                "fusion_flush", category="fusion", op_id=span.op_id,
+                tensor=span.tensor, bucket=bucket_i,
+                member_leaves=list(idxs), bytes=b_bytes, cause=cause)
 
     buckets = [
         leaves[idxs[0]].ravel() if len(idxs) == 1

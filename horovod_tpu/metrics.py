@@ -480,15 +480,18 @@ _PENDING_SEQ = itertools.count(1)
 
 
 def collective_begin(kind: str, name: Optional[str] = None, nbytes: int = 0,
-                     ranks: Optional[tuple] = None) -> int:
+                     ranks: Optional[tuple] = None,
+                     op_id: Optional[int] = None) -> int:
     """Register an in-flight collective (negotiation + dispatch window);
     returns a token for :func:`collective_end`. The stall watchdog reads
-    this table."""
+    this table. ``op_id`` is the span context minted at enqueue — the same
+    id the timeline phases and merged trace carry."""
     tok = next(_PENDING_SEQ)
     entry = {"token": tok, "kind": kind,
              "tensor": name if name else f"{kind}#{tok}",
              "bytes": int(nbytes),
              "ranks": None if ranks is None else tuple(ranks),
+             "op_id": op_id,
              "start": time.monotonic(), "fired": False}
     with _PENDING_LOCK:
         _PENDING[tok] = entry
@@ -513,7 +516,8 @@ def pending_collectives(older_than_s: float = 0.0) -> List[Dict[str, Any]]:
             out.append({"tensor": e["tensor"], "kind": e["kind"],
                         "process_set": ("global" if e["ranks"] is None
                                         else list(e["ranks"])),
-                        "pending_s": age, "bytes": e["bytes"]})
+                        "pending_s": age, "bytes": e["bytes"],
+                        "op_id": e.get("op_id")})
     return out
 
 
@@ -570,12 +574,15 @@ class StallWatchdog:
                        if not e["fired"] and now - e["start"] > self.timeout_s]
             for e in entries:
                 e["fired"] = True
+        late = self._likely_late_processes()
         for e in entries:
             report = {
                 "tensor": e["tensor"], "kind": e["kind"],
+                "op_id": e.get("op_id"),
                 "process_set": ("global" if e["ranks"] is None
                                 else list(e["ranks"])),
                 "waiting_ranks": self._waiting_ranks(e["ranks"]),
+                "likely_late_processes": late,
                 "pending_s": now - e["start"], "bytes": e["bytes"],
             }
             fired.append(report)
@@ -591,12 +598,34 @@ class StallWatchdog:
                 report = {"tensor": str(sig), "kind": "negotiation",
                           "process_set": "global",
                           "waiting_ranks": f"{missing} peer(s) missing",
+                          "likely_late_processes": late,
                           "pending_s": self.timeout_s, "bytes": 0}
                 fired.append(report)
                 self._fire(report)
         except Exception:
             pass
         return fired
+
+    def _likely_late_processes(self):
+        """Which PROCESSES (jax process indices, the negotiation
+        participants — not device ranks) have been arriving late recently, from the arrival
+        waits negotiation rounds piggyback — the attribution half of a
+        stall report: the waiting ranks say who is stuck, the late
+        processes say which host to look at. Only a RECENT record is trusted: the piggyback
+        covers completed rounds, so during a long stall the newest record
+        predates the stuck op and naming its late ranks would misdirect."""
+        try:
+            from horovod_tpu.collective import negotiation_arrival_stats
+            stats = negotiation_arrival_stats(1)
+            if not stats:
+                return None
+            rec = stats[-1]
+            age = time.monotonic() - rec.get("ts", 0.0)
+            if age > max(60.0, 2 * self.timeout_s):
+                return None
+            return rec["late_processes"]
+        except Exception:
+            return None
 
     @staticmethod
     def _waiting_ranks(ranks: Optional[tuple]):
@@ -616,9 +645,11 @@ class StallWatchdog:
         registry.counter("stall_events_total").inc()
         logger.warning(
             "horovod_tpu: collective stalled: %s %r pending %.1fs on "
-            "process set %s (waiting ranks: %s, %d bytes)",
+            "process set %s (waiting ranks: %s, likely late processes: %s, "
+            "%d bytes)",
             report["kind"], report["tensor"], report["pending_s"],
-            report["process_set"], report["waiting_ranks"], report["bytes"])
+            report["process_set"], report["waiting_ranks"],
+            report.get("likely_late_processes"), report["bytes"])
         _timeline_marker("collective_stall", **{
             k: v for k, v in report.items() if k != "pending_s"},
             pending_s=round(report["pending_s"], 3))

@@ -117,10 +117,18 @@ def _ring_fwd_impl(q, k, v, bias, seg, axis_name, causal, scale, bq, bk,
 
     def step(carry, i):
         o_acc, lse_acc, k, v, bias, seg_k = carry
-        src = (rank - i) % n
-        mode = _mode_of(striped, causal, src, rank)
-        o_b, lse_b = lax.switch(mode, [full_b, causal_b, skip_b, strict_b],
-                                q, k, v, bias, seg_k)
+        if not causal:
+            # Every hop is a full block: no mode switch, and no
+            # axis_index feeding a dead branch selector (whose constant-
+            # folded remnant old XLA SPMD pipelines reject as a bare
+            # PartitionId).
+            o_b, lse_b = full_b(q, k, v, bias, seg_k)
+        else:
+            src = (rank - i) % n
+            mode = _mode_of(striped, causal, src, rank)
+            o_b, lse_b = lax.switch(mode,
+                                    [full_b, causal_b, skip_b, strict_b],
+                                    q, k, v, bias, seg_k)
         o_acc, lse_acc = _safe_merge(o_acc, lse_acc, o_b, lse_b)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
@@ -191,11 +199,16 @@ def _ring_bwd(axis_name, causal, scale, bq, bk, striped, h, want_dbias,
 
     def step(carry, i):
         dq_acc, k, v, bias, seg_k, dk_acc, dv_acc, db_acc = carry
-        src = (rank - i) % n
-        mode = _mode_of(striped, causal, src, rank)
-        dq_b, dk_b, dv_b, db_b = lax.switch(
-            mode, [full_b, causal_b, skip_b, strict_b], q, k, v, bias,
-            seg_k)
+        if not causal:
+            # Mirror of the forward's non-causal fast path (see
+            # _ring_fwd_impl.step).
+            dq_b, dk_b, dv_b, db_b = full_b(q, k, v, bias, seg_k)
+        else:
+            src = (rank - i) % n
+            mode = _mode_of(striped, causal, src, rank)
+            dq_b, dk_b, dv_b, db_b = lax.switch(
+                mode, [full_b, causal_b, skip_b, strict_b], q, k, v, bias,
+                seg_k)
         dq_acc = dq_acc + dq_b
         dk_acc = dk_acc + dk_b
         dv_acc = dv_acc + dv_b

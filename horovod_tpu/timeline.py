@@ -22,7 +22,8 @@ from contextlib import contextmanager
 from typing import Optional
 
 __all__ = ["Timeline", "init_timeline", "get_timeline", "shutdown_timeline",
-           "start_timeline", "stop_timeline"]
+           "start_timeline", "stop_timeline", "shard_path",
+           "emit_clock_anchor", "merge_timelines"]
 
 _LOCK = threading.Lock()
 _TIMELINE: Optional["Timeline"] = None
@@ -46,8 +47,10 @@ class Timeline:
     #: python-mirror bound while the native appender is active
     MIRROR_CAP = 100_000
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rank: Optional[int] = None,
+                 world: Optional[int] = None):
         self.path = path
+        self.rank = rank
         from collections import deque
         from horovod_tpu import native
         try:
@@ -62,6 +65,14 @@ class Timeline:
         self._pid = os.getpid()
         self._lock = threading.Lock()
         self._closed = False
+        if rank is not None:
+            # Shard identity rides IN the event stream (not a top-level
+            # key) so the native appender path carries it too; trace_merge
+            # reads it back to label per-rank tracks.
+            self._emit("process_name", "__metadata", "M", 0.0, 0.0, 0,
+                       {"name": f"rank {rank}"})
+            self.marker("shard_meta", category="trace", rank=rank,
+                        world=world)
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -144,8 +155,21 @@ class Timeline:
 _ATEXIT_REGISTERED = False
 
 
+def shard_path(path: str, rank: int) -> str:
+    """Per-rank shard name for a multi-process run: ``/p/trace.json`` →
+    ``/p/trace.rank3.json`` (what :func:`merge_timelines` re-discovers)."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{rank}{ext or '.json'}"
+
+
 def init_timeline(path: Optional[str] = None) -> Timeline:
     """Enable the timeline (``HOROVOD_TIMELINE`` env var or explicit path).
+
+    Under multi-process the path fans out to one SHARD per process
+    (``trace.json`` → ``trace.rank{N}.json``) — every rank writing the same
+    file would corrupt it, and the per-rank shards are exactly what
+    ``hvd.merge_timelines`` / ``tools/trace_merge.py`` consume to build the
+    cross-rank view.
 
     Registers an ``atexit`` flush the first time: the Chrome trace is only
     valid once finalized, and scripts that never call ``stop_timeline`` /
@@ -157,16 +181,37 @@ def init_timeline(path: Optional[str] = None) -> Timeline:
         if not path:
             raise ValueError(
                 "pass a path or set HOROVOD_TIMELINE=/path/timeline.json")
+        rank = world = None
+        try:
+            import jax
+            if jax.process_count() > 1:
+                rank = jax.process_index()
+                world = jax.process_count()
+                path = shard_path(path, rank)
+        except Exception:
+            pass
         if _TIMELINE is not None:
             # Re-init must not leak the previous instance unflushed — its
             # file would stay invalid (or absent) forever.
             _TIMELINE.flush()
-        _TIMELINE = Timeline(path)
+        _TIMELINE = Timeline(path, rank=rank, world=world)
         if not _ATEXIT_REGISTERED:
             import atexit
             atexit.register(shutdown_timeline)
             _ATEXIT_REGISTERED = True
         return _TIMELINE
+
+
+def emit_clock_anchor(epoch: int = 0) -> None:
+    """Record the init-barrier instant this process just left
+    (``clock_anchor``): every rank emits it at the same real moment, so
+    ``merge_timelines`` can align per-process clocks by making the anchors
+    coincide. ``wall_time`` is attached for skew *reporting* only — wall
+    clocks never decide alignment."""
+    t = get_timeline()
+    if t is not None:
+        t.marker("clock_anchor", category="trace", epoch=epoch,
+                 wall_time=time.time())
 
 
 def get_timeline() -> Optional[Timeline]:
@@ -190,6 +235,14 @@ def start_timeline(path: str, mark_cycles: bool = False) -> None:
 def stop_timeline() -> None:
     """``hvd.stop_timeline`` parity."""
     shutdown_timeline()
+
+
+def merge_timelines(inputs, output: Optional[str] = None, **kwargs):
+    """Merge per-rank timeline shards into one Chrome trace with per-rank
+    tracks and a straggler report (``hvd.merge_timelines``); see
+    :func:`horovod_tpu.trace_merge.merge_timelines`."""
+    from horovod_tpu.trace_merge import merge_timelines as _merge
+    return _merge(inputs, output, **kwargs)
 
 
 # jax.profiler passthroughs: device-side tracing, the XLA-native analogue of

@@ -1,0 +1,431 @@
+"""Cross-rank trace aggregation: merge per-rank timeline shards into one
+Chrome trace with per-rank tracks, clock alignment, and a straggler report.
+
+Upstream Horovod writes ONE timeline because its controller sees every
+rank's negotiation. The TPU rebuild's multi-process mode gives each process
+its own timeline shard (``HOROVOD_TIMELINE=/path/trace.json`` →
+``trace.rank{N}.json``); this module is the controller-eye view
+reconstructed after the fact:
+
+* **Per-rank tracks** — every shard's events are remapped to ``pid = rank``
+  with ``process_name`` metadata, so Perfetto/chrome://tracing shows one
+  swim-lane per rank.
+* **Clock alignment** — each shard records a ``clock_anchor`` instant at the
+  init barrier (``core.init`` emits it right after
+  ``sync_global_devices``); all ranks left that barrier at (nearly) the
+  same real instant, so shifting every shard to make the anchors coincide
+  cancels per-process monotonic-clock origins AND wall-clock skew. The
+  residual per-rank wall-clock offset is reported, not trusted.
+* **Straggler report** — phase events (``NEGOTIATE``/``QUEUE``/``EXEC``)
+  carry the span context minted in ``collective.py`` (monotone ``op_id``,
+  identical across ranks by negotiation order), so arrival spread per
+  collective — first-rank vs last-rank enqueue — and a per-rank "time
+  blamed" rollup fall out of a groupby. Allreduce-time *skew*, not mean
+  latency, is what determines step time on mesh/ring topologies (see
+  PAPERS: arxiv 2011.03605, 2401.09356); this report measures it.
+
+A truncated or corrupt shard degrades to a warning (its parseable prefix is
+salvaged when possible); the merge never crashes on one bad rank.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = ["merge_timelines", "discover_shards", "load_shard",
+           "straggler_report"]
+
+#: phase-event names (tracing.phase) that mark a collective's host phases
+PHASE_NAMES = ("NEGOTIATE", "QUEUE", "EXEC")
+
+_RANK_RE = re.compile(r"\.rank(\d+)\.json$")
+
+
+# ---------------------------------------------------------------------------
+# shard loading
+# ---------------------------------------------------------------------------
+
+def discover_shards(inputs: Union[str, Sequence[str]]) -> List[str]:
+    """Resolve ``inputs`` to a sorted list of shard paths.
+
+    Accepts a list of explicit paths, a glob pattern, a directory, or the
+    base path that was passed as ``HOROVOD_TIMELINE`` (``trace.json`` →
+    every ``trace.rank*.json`` next to it, plus ``trace.json`` itself if a
+    single-process run wrote it).
+    """
+    if not isinstance(inputs, str):
+        paths: List[str] = []
+        for p in inputs:
+            paths.extend(discover_shards(p))
+        # de-dup, keep order
+        return list(dict.fromkeys(paths))
+    if os.path.isdir(inputs):
+        # Never re-ingest a previous merge output as a "shard".
+        return sorted(p for p in glob.glob(os.path.join(inputs, "*.json"))
+                      if not p.endswith(".merged.json"))
+    if "*" in inputs or "?" in inputs:
+        return sorted(p for p in glob.glob(inputs)
+                      if not p.endswith(".merged.json"))
+    root, ext = os.path.splitext(inputs)
+    sharded = sorted(glob.glob(f"{root}.rank*{ext or '.json'}"),
+                     key=lambda p: _shard_rank_from_name(p, 1 << 30))
+    if sharded:
+        return sharded
+    return [inputs] if os.path.exists(inputs) else []
+
+
+def _shard_rank_from_name(path: str, default: int) -> int:
+    m = _RANK_RE.search(path)
+    return int(m.group(1)) if m else default
+
+
+def _salvage_events(text: str) -> Optional[List[dict]]:
+    """Best-effort recovery of the parseable event prefix of a truncated
+    shard: trim back to the last complete ``}`` and close the arrays."""
+    start = text.find("[")
+    if start < 0:
+        return None
+    body = text[start + 1:]
+    cut = body.rfind("}")
+    while cut >= 0:
+        try:
+            evs = json.loads("[" + body[:cut + 1] + "]")
+            return [e for e in evs if isinstance(e, dict)]
+        except ValueError:
+            cut = body.rfind("}", 0, cut)
+    return None
+
+
+def load_shard(path: str) -> Tuple[List[dict], List[str]]:
+    """Load one shard's events; returns ``(events, warnings)``. A corrupt
+    or truncated shard yields its salvageable prefix (possibly empty) and a
+    warning instead of raising."""
+    warnings: List[str] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [], [f"{path}: unreadable ({e})"]
+    try:
+        doc = json.loads(text)
+        events = doc.get("traceEvents", []) if isinstance(doc, dict) \
+            else doc
+        if not isinstance(events, list):
+            return [], [f"{path}: no traceEvents array"]
+        return [e for e in events if isinstance(e, dict)], warnings
+    except ValueError:
+        evs = _salvage_events(text)
+        if evs is None:
+            return [], [f"{path}: corrupt shard, no events salvageable "
+                        "(skipped)"]
+        return evs, [f"{path}: truncated/corrupt shard — salvaged "
+                     f"{len(evs)} events"]
+
+
+def _shard_rank(path: str, events: List[dict], ordinal: int) -> int:
+    """Rank of a shard: its ``shard_meta`` event, else the ``.rank{N}.``
+    filename convention, else file ordinal."""
+    for e in events:
+        if e.get("name") == "shard_meta":
+            try:
+                return int(e.get("args", {})["rank"])
+            except (KeyError, TypeError, ValueError):
+                break
+    return _shard_rank_from_name(path, ordinal)
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+
+def _find_anchors(events: List[dict]) -> Dict[int, dict]:
+    """Init-barrier ``clock_anchor`` instants by epoch (elastic re-inits
+    emit one per epoch; per epoch the earliest wins)."""
+    out: Dict[int, dict] = {}
+    for e in events:
+        if e.get("name") != "clock_anchor":
+            continue
+        try:
+            ep = int((e.get("args") or {}).get("epoch", 0))
+        except (TypeError, ValueError):
+            ep = 0
+        cur = out.get(ep)
+        if cur is None or e.get("ts", 0.0) < cur.get("ts", 0.0):
+            out[ep] = e
+    return out
+
+
+def _select_anchor_epoch(shards: List[Dict[str, Any]]
+                         ) -> Tuple[Dict[int, dict], List[str]]:
+    """Pick ONE barrier every anchored shard attended: the highest epoch
+    present in all of them. A shard's earliest anchor is NOT necessarily a
+    common barrier (an elastic-relaunched worker's first anchor is a
+    survivor's Nth), so aligning on it would shift whole shards by an
+    epoch; the max common epoch is a barrier everyone demonstrably left
+    together. Returns ``(anchor_by_rank, warnings)``."""
+    warnings: List[str] = []
+    anchored = [s for s in shards if s["anchors"]]
+    if not anchored:
+        return {}, warnings
+    common = set.intersection(*(set(s["anchors"]) for s in anchored))
+    out: Dict[int, dict] = {}
+    if common:
+        ep = max(common)
+        for s in anchored:
+            out[s["rank"]] = s["anchors"][ep]
+    else:
+        # No shared epoch number (mixed restarts): best effort — each
+        # shard's earliest anchor, loudly caveated.
+        for s in anchored:
+            out[s["rank"]] = s["anchors"][min(s["anchors"])]
+        warnings.append(
+            "no clock_anchor epoch is common to all shards — aligned on "
+            "each shard's earliest anchor; spreads across elastic "
+            "restarts may be wrong")
+    return out, warnings
+
+
+def _align_offsets(shards: List[Dict[str, Any]]
+                   ) -> Tuple[Dict[int, float], Dict[int, float], List[str]]:
+    """Per-rank ts offsets so every shard's anchor lands on the same merged
+    timestamp. Returns ``(offset_us_by_rank, wall_skew_s_by_rank,
+    warnings)``; shards without an anchor keep their raw timestamps (offset
+    such that alignment is identity) with a warning."""
+    anchored, warnings = _select_anchor_epoch(shards)
+    offsets: Dict[int, float] = {}
+    skew: Dict[int, float] = {}
+    if anchored:
+        # Align every anchor to the LATEST anchor ts: offsets are then
+        # non-negative, so no event moves before t=0.
+        base = max(a.get("ts", 0.0) for a in anchored.values())
+        walls = {r: a.get("args", {}).get("wall_time")
+                 for r, a in anchored.items()}
+        ref_wall = next((w for w in walls.values() if w is not None), None)
+        for s in shards:
+            r = s["rank"]
+            a = anchored.get(r)
+            if a is None:
+                offsets[r] = 0.0
+                warnings.append(
+                    f"rank {r}: no clock_anchor event — timestamps kept "
+                    "unaligned")
+                continue
+            offsets[r] = base - a.get("ts", 0.0)
+            w = walls.get(r)
+            skew[r] = (w - ref_wall) if (w is not None
+                                         and ref_wall is not None) else 0.0
+    else:
+        for s in shards:
+            offsets[s["rank"]] = 0.0
+        if len(shards) > 1:
+            warnings.append(
+                "no clock_anchor events in any shard — per-rank clocks "
+                "not aligned; arrival spreads include clock skew")
+    return offsets, skew, warnings
+
+
+# ---------------------------------------------------------------------------
+# straggler analysis
+# ---------------------------------------------------------------------------
+
+#: below this arrival spread, ranks are "simultaneous": anchor alignment
+#: is only barrier-exit accurate, so attributing blame from a smaller
+#: delta would report clock jitter as stragglers (the live negotiation
+#: path applies the same idea at its coarser ms resolution).
+MIN_ATTRIBUTABLE_SPREAD_S = 1e-4
+
+
+def straggler_report(shards: List[Dict[str, Any]],
+                     offsets: Dict[int, float],
+                     skew: Dict[int, float],
+                     min_spread_s: float = MIN_ATTRIBUTABLE_SPREAD_S
+                     ) -> Dict[str, Any]:
+    """Cross-rank arrival analysis over span-contexted phase events.
+
+    For every collective ``op_id`` seen on 2+ ranks: the **arrival** of a
+    rank is the earliest aligned phase timestamp it logged for that op
+    (NEGOTIATE start when present, else QUEUE/EXEC); the **spread** is
+    last-rank minus first-rank arrival; **blame** charges the spread to the
+    last-arriving rank (its lateness is what every other rank waited out);
+    the **critical path** estimate sums, per elastic epoch, each op's
+    spread plus the last rank's EXEC duration. Spreads below
+    ``min_spread_s`` still report but neither name late ranks nor accrue
+    blame — that's alignment jitter, not a straggler.
+    """
+    # op_id -> rank -> {"arrival": us, "exec_dur": us, meta...}
+    ops: Dict[int, Dict[int, Dict[str, Any]]] = {}
+    meta: Dict[int, Dict[str, Any]] = {}
+    for s in shards:
+        r = s["rank"]
+        off = offsets.get(r, 0.0)
+        for e in s["events"]:
+            name = e.get("name")
+            if name not in PHASE_NAMES:
+                continue
+            args = e.get("args") or {}
+            op_id = args.get("op_id")
+            if op_id is None:
+                continue
+            try:
+                op_id = int(op_id)
+            except (TypeError, ValueError):
+                continue
+            if op_id <= 0:
+                # Negative ids are trace-time lowerings: per-process
+                # compile order, not cross-rank comparable.
+                continue
+            ts = float(e.get("ts", 0.0)) + off
+            entry = ops.setdefault(op_id, {}).setdefault(
+                r, {"arrival": ts, "exec_dur": 0.0})
+            entry["arrival"] = min(entry["arrival"], ts)
+            if name == "EXEC":
+                entry["exec_dur"] = max(entry["exec_dur"],
+                                        float(e.get("dur", 0.0)))
+            m = meta.setdefault(op_id, {})
+            for k in ("tensor", "kind", "process_set", "epoch"):
+                if k in args and k not in m:
+                    m[k] = args[k]
+
+    collectives: List[Dict[str, Any]] = []
+    blame: Dict[int, float] = {s["rank"]: 0.0 for s in shards}
+    epochs: Dict[str, float] = {}
+    for op_id in sorted(ops):
+        per_rank = ops[op_id]
+        if len(per_rank) < 2:
+            continue
+        arrivals = {r: v["arrival"] for r, v in per_rank.items()}
+        first_rank = min(arrivals, key=arrivals.get)
+        last_rank = max(arrivals, key=arrivals.get)
+        spread_us = arrivals[last_rank] - arrivals[first_rank]
+        spread_s = spread_us / 1e6
+        attributable = spread_s >= min_spread_s
+        late = [r for r, a in arrivals.items()
+                if a - arrivals[first_rank] > spread_us * 0.5] \
+            if attributable else []
+        if attributable:
+            blame[last_rank] = blame.get(last_rank, 0.0) + spread_s
+        exec_s = per_rank[last_rank]["exec_dur"] / 1e6
+        epoch = str(meta.get(op_id, {}).get("epoch", 0))
+        epochs[epoch] = epochs.get(epoch, 0.0) + spread_s + exec_s
+        collectives.append({
+            "op_id": op_id,
+            "tensor": meta.get(op_id, {}).get("tensor"),
+            "kind": meta.get(op_id, {}).get("kind"),
+            "process_set": meta.get(op_id, {}).get("process_set", 0),
+            "arrival_us": {str(r): round(a, 3)
+                           for r, a in sorted(arrivals.items())},
+            "spread_seconds": spread_s,
+            "first_rank": first_rank,
+            "last_rank": last_rank,
+            "late_ranks": sorted(late),
+            "exec_seconds_last_rank": exec_s,
+        })
+    return {
+        "ranks": sorted(s["rank"] for s in shards),
+        "collectives": collectives,
+        "blame_seconds_by_rank": {str(r): v for r, v in sorted(blame.items())},
+        "critical_path_seconds_by_epoch": epochs,
+        "critical_path_seconds": sum(epochs.values()),
+        "clock_skew_seconds_by_rank": {str(r): v
+                                       for r, v in sorted(skew.items())},
+    }
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def merge_timelines(inputs: Union[str, Sequence[str]],
+                    output: Optional[str] = None, *,
+                    feed_metrics: bool = True) -> Dict[str, Any]:
+    """Merge per-rank timeline shards into one Chrome trace
+    (``hvd.merge_timelines``).
+
+    ``inputs``: the base path given as ``HOROVOD_TIMELINE`` (shards are
+    discovered next to it), a glob, a directory, or an explicit list of
+    shard paths. Returns the merged trace dict — ``traceEvents`` with
+    per-rank ``pid`` tracks plus a ``stragglerReport`` key (ignored by
+    trace viewers) — and writes it to ``output`` when given.
+
+    When ``feed_metrics`` (default), each collective's arrival spread is
+    observed into the process-local metrics registry as
+    ``collective_arrival_spread_seconds{source="merge"}`` so a post-run
+    merge surfaces skew through the same exporters as live metrics.
+    """
+    paths = discover_shards(inputs)
+    if not paths:
+        raise FileNotFoundError(f"no timeline shards found for {inputs!r}")
+    warnings: List[str] = []
+    shards: List[Dict[str, Any]] = []
+    for i, path in enumerate(paths):
+        events, w = load_shard(path)
+        warnings.extend(w)
+        for msg in w:
+            logger.warning("trace_merge: %s", msg)
+        if not events:
+            continue
+        rank = _shard_rank(path, events, i)
+        if any(s["rank"] == rank for s in shards):
+            warnings.append(f"{path}: duplicate rank {rank} — skipped "
+                            "(is a previous merge output in the input set?)")
+            logger.warning("trace_merge: %s", warnings[-1])
+            continue
+        shards.append({"path": path, "events": events, "rank": rank,
+                       "anchors": _find_anchors(events)})
+    if not shards:
+        raise ValueError(
+            f"no events salvageable from any shard of {inputs!r}: "
+            + "; ".join(warnings))
+
+    offsets, skew, w = _align_offsets(shards)
+    warnings.extend(w)
+    for msg in w:
+        logger.warning("trace_merge: %s", msg)
+
+    merged: List[dict] = []
+    for s in shards:
+        r = s["rank"]
+        off = offsets.get(r, 0.0)
+        merged.append({"name": "process_name", "ph": "M", "pid": r,
+                       "args": {"name": f"rank {r}"}})
+        merged.append({"name": "process_sort_index", "ph": "M", "pid": r,
+                       "args": {"sort_index": r}})
+        for e in s["events"]:
+            if e.get("ph") == "M":
+                continue        # per-shard metadata is re-synthesized above
+            out = dict(e)
+            out["pid"] = r
+            if "ts" in out:
+                out["ts"] = float(out["ts"]) + off
+            merged.append(out)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+
+    report = straggler_report(shards, offsets, skew)
+    if warnings:
+        report["warnings"] = warnings
+
+    if feed_metrics:
+        try:
+            from horovod_tpu import metrics as _metrics
+            for c in report["collectives"]:
+                _metrics.histogram("collective_arrival_spread_seconds",
+                                   source="merge").observe(
+                    c["spread_seconds"])
+        except Exception:
+            logger.exception("trace_merge: feeding metrics failed")
+
+    doc = {"traceEvents": merged, "displayTimeUnit": "ms",
+           "stragglerReport": report}
+    if output:
+        tmp = f"{output}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, output)
+    return doc

@@ -1,17 +1,23 @@
 """Version-bridging shims for the jax surface the framework depends on.
 
-The framework targets the current jax API (``jax.shard_map`` with
-``check_vma``); older runtimes (< 0.5) only ship
-``jax.experimental.shard_map.shard_map`` with the same semantics under the
-``check_rep`` spelling. Every internal ``shard_map`` call routes through
-here so a single site owns the bridge.
+The framework targets the current jax API; older runtimes spell parts of it
+differently. Every internal call site routes through here so a single site
+owns each bridge:
+
+* ``shard_map`` — ``jax.shard_map`` (``check_vma``) vs the pre-0.5
+  ``jax.experimental.shard_map.shard_map`` (``check_rep``).
+* ``remat_policy`` — ``jax.checkpoint_policies`` vs the older
+  ``jax.ad_checkpoint.checkpoint_policies`` spelling.
+* ``enable_cpu_collectives`` — multi-process CPU runs need the gloo
+  cross-process collectives backend; jax >= 0.5 selects it automatically,
+  0.4.x needs the config knob set before the backend initializes.
 """
 
 from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "remat_policy", "enable_cpu_collectives"]
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kwargs):
@@ -27,3 +33,29 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kwargs):
         kwargs["check_rep"] = check_vma
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kwargs)
+
+
+def remat_policy(name: str):
+    """Rematerialization policy by name, across the ``jax
+    .checkpoint_policies`` / ``jax.ad_checkpoint.checkpoint_policies``
+    spellings (e.g. ``"dots_with_no_batch_dims_saveable"``)."""
+    holder = getattr(jax, "checkpoint_policies", None)
+    if holder is None or not hasattr(holder, name):
+        from jax import ad_checkpoint
+        holder = ad_checkpoint.checkpoint_policies
+    return getattr(holder, name)
+
+
+def enable_cpu_collectives() -> None:
+    """Make multi-process *CPU* runs able to execute cross-process
+    computations (``process_allgather``, eager device collectives over a
+    multi-host CPU mesh).
+
+    jax 0.4.x raises ``Multiprocess computations aren't implemented on the
+    CPU backend`` unless the gloo collectives implementation is selected
+    before the CPU client initializes; newer jax selects it automatically
+    (where the config knob may no longer exist — hence best-effort)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass

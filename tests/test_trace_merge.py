@@ -1,0 +1,379 @@
+"""Cross-rank trace aggregation (ISSUE 2 tentpole): shard merge, clock
+alignment, straggler math, corrupt-shard degradation, and the live
+2-process smoke."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import trace_merge as tm
+
+
+def _shard(path, rank, anchor_ts, wall, events):
+    """Write a synthetic rank shard: shard_meta + clock_anchor + events."""
+    evs = [
+        {"name": "shard_meta", "cat": "trace", "ph": "i", "ts": 0.0,
+         "pid": 12345 + rank, "tid": 0, "args": {"rank": rank, "world": 2}},
+        {"name": "clock_anchor", "cat": "trace", "ph": "i",
+         "ts": anchor_ts, "pid": 12345 + rank, "tid": 0,
+         "args": {"epoch": 1, "wall_time": wall}},
+    ] + events
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def _phase(name, op_id, ts, dur=50.0, pid=0, **extra):
+    args = {"op_id": op_id, "kind": "allreduce",
+            "tensor": f"t{op_id}", "process_set": 0}
+    args.update(extra)
+    return {"name": name, "cat": "phase", "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": 7, "args": args}
+
+
+class TestMergeSynthetic:
+    def _two_shards(self, tmp_path):
+        # Rank 0: clock origin such that the anchor sits at ts=1000;
+        # rank 1's monotonic clock started elsewhere: anchor at ts=5000.
+        # Relative to its anchor, rank 0 enqueues op 1 at +1000us and
+        # op 2 at +3000us; rank 1 at +1300us and +3000us -> op 1 spread
+        # 300us blamed on rank 1, op 2 spread 0.
+        s0 = _shard(
+            str(tmp_path / "trace.rank0.json"), 0, 1000.0, 100.0,
+            [_phase("NEGOTIATE", 1, 2000.0), _phase("QUEUE", 1, 2050.0),
+             _phase("EXEC", 1, 2100.0, dur=400.0),
+             _phase("QUEUE", 2, 4000.0), _phase("EXEC", 2, 4050.0)])
+        s1 = _shard(
+            str(tmp_path / "trace.rank1.json"), 1, 5000.0, 100.002,
+            [_phase("NEGOTIATE", 1, 6300.0), _phase("QUEUE", 1, 6350.0),
+             _phase("EXEC", 1, 6400.0, dur=200.0),
+             _phase("QUEUE", 2, 8000.0), _phase("EXEC", 2, 8050.0)])
+        return s0, s1
+
+    def test_merge_tracks_alignment_and_straggler_math(self, tmp_path):
+        self._two_shards(tmp_path)
+        out = str(tmp_path / "merged.json")
+        # Discovery from the HOROVOD_TIMELINE base path, not the shards.
+        doc = hvd.merge_timelines(str(tmp_path / "trace.json"), out,
+                                  feed_metrics=False)
+
+        # Valid Chrome trace on disk, one pid track per rank + metadata.
+        disk = json.loads(open(out).read())
+        pids = {e["pid"] for e in disk["traceEvents"] if e.get("ph") != "M"}
+        assert pids == {0, 1}
+        names = {(e["name"], e["pid"]) for e in disk["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert ("process_name", 0) in names and ("process_name", 1) in names
+
+        # Clock alignment: anchors coincide after the per-shard offsets,
+        # so op 1's aligned arrival delta is 1300-1000=300us even though
+        # the raw shard timestamps differ by 4300us.
+        rep = doc["stragglerReport"]
+        assert rep["ranks"] == [0, 1]
+        ops = {c["op_id"]: c for c in rep["collectives"]}
+        assert set(ops) == {1, 2}
+        assert ops[1]["spread_seconds"] == pytest.approx(300e-6)
+        assert ops[1]["first_rank"] == 0
+        assert ops[1]["last_rank"] == 1
+        assert ops[1]["late_ranks"] == [1]
+        assert ops[2]["spread_seconds"] == pytest.approx(0.0)
+
+        # Blame rollup: the full spread of op 1 charges rank 1.
+        blame = rep["blame_seconds_by_rank"]
+        assert blame["1"] == pytest.approx(300e-6)
+        assert blame["0"] == pytest.approx(0.0)
+
+        # Critical path: per-op spread + last rank's EXEC duration.
+        # op1: 300us + 200us (rank 1 exec), op2: 0 + 50us.
+        assert doc["stragglerReport"]["critical_path_seconds"] == \
+            pytest.approx((300 + 200 + 0 + 50) * 1e-6)
+
+        # Wall-clock skew is reported relative to rank 0 (2ms), but never
+        # used for alignment.
+        assert rep["clock_skew_seconds_by_rank"]["1"] == \
+            pytest.approx(0.002, rel=1e-3)
+
+    def test_merge_feeds_arrival_spread_histogram(self, tmp_path):
+        self._two_shards(tmp_path)
+        hvd.reset_metrics()
+        hvd.merge_timelines(str(tmp_path / "trace.json"))
+        snap = hvd.metrics()
+        series = snap["histograms"]["collective_arrival_spread_seconds"]
+        merged = [s for s in series if s["labels"].get("source") == "merge"]
+        assert merged and merged[0]["count"] == 2
+
+    def test_truncated_shard_degrades_to_warning(self, tmp_path, caplog):
+        import logging
+        self._two_shards(tmp_path)
+        # Truncate rank 1 mid-event, as a crash mid-stream would.
+        p1 = tmp_path / "trace.rank1.json"
+        text = p1.read_text()
+        p1.write_text(text[: int(len(text) * 0.6)])
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            doc = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                     feed_metrics=False)
+        assert any("truncated/corrupt" in r.getMessage()
+                   for r in caplog.records)
+        assert doc["stragglerReport"].get("warnings")
+        # Rank 0 plus rank 1's salvaged prefix still merge.
+        assert 0 in {e.get("pid") for e in doc["traceEvents"]}
+
+    def test_wholly_corrupt_shard_skipped(self, tmp_path, caplog):
+        import logging
+        self._two_shards(tmp_path)
+        (tmp_path / "trace.rank1.json").write_text("not json at all")
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            doc = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                     feed_metrics=False)
+        # One healthy shard left: merge succeeds, no cross-rank report.
+        assert doc["stragglerReport"]["ranks"] == [0]
+        assert doc["stragglerReport"]["collectives"] == []
+
+    def test_no_shards_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            tm.merge_timelines(str(tmp_path / "nothing.json"))
+
+    def test_shard_without_anchor_warns_not_crashes(self, tmp_path):
+        _shard(str(tmp_path / "trace.rank0.json"), 0, 100.0, 1.0,
+               [_phase("QUEUE", 1, 200.0)])
+        p1 = tmp_path / "trace.rank1.json"
+        with open(p1, "w") as f:
+            json.dump({"traceEvents": [_phase("QUEUE", 1, 9000.0, pid=77)],
+                       "displayTimeUnit": "ms"}, f)
+        doc = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                 feed_metrics=False)
+        assert any("no clock_anchor" in w
+                   for w in doc["stragglerReport"]["warnings"])
+
+    def test_alignment_uses_max_common_anchor_epoch(self, tmp_path):
+        """Elastic: rank 0's shard spans epochs 1-2, rank 1 relaunched
+        with only epoch 2 — alignment must use the epoch-2 barrier, not
+        rank 0's earliest anchor (an epoch rank 1 never attended)."""
+        evs0 = [
+            {"name": "clock_anchor", "cat": "trace", "ph": "i",
+             "ts": 60000.0, "pid": 1, "tid": 0,
+             "args": {"epoch": 2, "wall_time": 60.0}},
+            _phase("QUEUE", 9, 61000.0), _phase("EXEC", 9, 61050.0),
+        ]
+        # epoch-1 anchor sits EARLIER in rank 0's shard
+        s0 = _shard(str(tmp_path / "trace.rank0.json"), 0, 100.0, 0.0,
+                    evs0)
+        s1 = _shard(str(tmp_path / "trace.rank1.json"), 1, 500.0, 60.0,
+                    [_phase("QUEUE", 9, 1400.0), _phase("EXEC", 9, 1450.0)])
+        # rank 1's only anchor is epoch... _shard writes epoch 1; rewrite
+        # it as epoch 2 so epochs {1,2} vs {2} intersect at 2.
+        doc = json.loads(open(s1).read())
+        for e in doc["traceEvents"]:
+            if e["name"] == "clock_anchor":
+                e["args"]["epoch"] = 2
+        json.dump(doc, open(s1, "w"))
+        rep = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                 feed_metrics=False)["stragglerReport"]
+        ops = {c["op_id"]: c for c in rep["collectives"]}
+        # epoch-2 alignment: rank 0 arrives +1000us after its anchor,
+        # rank 1 +900us -> spread 100us. Earliest-anchor alignment would
+        # have produced a bogus ~60s spread.
+        assert ops[9]["spread_seconds"] == pytest.approx(100e-6)
+        assert ops[9]["last_rank"] == 0
+
+    def test_duplicate_rank_and_merged_output_skipped(self, tmp_path):
+        self._two_shards(tmp_path)
+        out = str(tmp_path / "trace.merged.json")
+        tm.merge_timelines(str(tmp_path / "trace.json"), out,
+                           feed_metrics=False)
+        # Re-merging the DIRECTORY must not ingest the merge output, and
+        # must not double-count any rank.
+        rep = tm.merge_timelines(str(tmp_path),
+                                 feed_metrics=False)["stragglerReport"]
+        assert rep["ranks"] == [0, 1]
+        assert {c["op_id"] for c in rep["collectives"]} == {1, 2}
+
+    def test_sub_floor_spread_reports_but_does_not_blame(self, tmp_path):
+        # 30us spread: reported, but below MIN_ATTRIBUTABLE_SPREAD_S —
+        # no late ranks, no blame (alignment jitter, not a straggler).
+        _shard(str(tmp_path / "trace.rank0.json"), 0, 0.0, 1.0,
+               [_phase("QUEUE", 1, 1000.0)])
+        _shard(str(tmp_path / "trace.rank1.json"), 1, 0.0, 1.0,
+               [_phase("QUEUE", 1, 1030.0)])
+        rep = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                 feed_metrics=False)["stragglerReport"]
+        (c,) = rep["collectives"]
+        assert c["spread_seconds"] == pytest.approx(30e-6)
+        assert c["late_ranks"] == []
+        assert rep["blame_seconds_by_rank"] == {"0": 0.0, "1": 0.0}
+
+    def test_traced_negative_op_ids_excluded(self, tmp_path):
+        # Trace-time lowerings (negative ids, per-process compile order)
+        # must never be correlated cross-rank.
+        _shard(str(tmp_path / "trace.rank0.json"), 0, 0.0, 1.0,
+               [_phase("EXEC", -1, 100.0)])
+        _shard(str(tmp_path / "trace.rank1.json"), 1, 0.0, 1.0,
+               [_phase("EXEC", -1, 900.0)])
+        doc = tm.merge_timelines(str(tmp_path / "trace.json"),
+                                 feed_metrics=False)
+        assert doc["stragglerReport"]["collectives"] == []
+
+
+class TestShardDiscovery:
+    def test_base_path_glob_dir_and_list(self, tmp_path):
+        s0 = _shard(str(tmp_path / "trace.rank0.json"), 0, 0.0, 1.0, [])
+        s1 = _shard(str(tmp_path / "trace.rank1.json"), 1, 0.0, 1.0, [])
+        base = str(tmp_path / "trace.json")
+        assert tm.discover_shards(base) == [s0, s1]
+        assert tm.discover_shards(str(tmp_path)) == sorted([s0, s1])
+        assert tm.discover_shards(str(tmp_path / "*.json")) == \
+            sorted([s0, s1])
+        assert tm.discover_shards([s1, s0]) == [s1, s0]
+
+    def test_single_file_fallback(self, tmp_path):
+        p = _shard(str(tmp_path / "solo.json"), 0, 0.0, 1.0, [])
+        assert tm.discover_shards(p) == [p]
+
+
+class TestCli:
+    def test_cli_merges_and_reports(self, tmp_path):
+        TestMergeSynthetic()._two_shards(tmp_path)
+        out = str(tmp_path / "m.json")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "trace_merge.py"),
+             str(tmp_path / "trace.json"), "-o", out, "--report",
+             "--no-metrics"],
+            capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr
+        rep = json.loads(r.stdout)
+        assert {c["op_id"] for c in rep["collectives"]} == {1, 2}
+        assert json.loads(open(out).read())["traceEvents"]
+
+    def test_cli_no_shards_nonzero_exit(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "trace_merge.py"),
+             str(tmp_path / "none.json")],
+            capture_output=True, text=True, timeout=240)
+        assert r.returncode == 1
+
+
+class TestSpanContexts:
+    def test_eager_collective_emits_all_phases_one_op_id(self, tmp_path):
+        """Single-process: QUEUE/EXEC phases + umbrella span share the
+        op-id minted at enqueue (NEGOTIATE needs >1 process)."""
+        import numpy as np
+        from horovod_tpu import timeline as tl
+        path = tmp_path / "t.json"
+        tl.start_timeline(str(path))
+        try:
+            hvd.allreduce(np.ones((hvd.size(), 3), np.float32),
+                          name="span/probe")
+        finally:
+            tl.stop_timeline()
+        evs = json.loads(path.read_text())["traceEvents"]
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        ops = {e["args"]["op_id"] for e in by_name["QUEUE"] +
+               by_name["EXEC"] if e["args"]["tensor"] == "span/probe"}
+        assert len(ops) == 1
+        umbrella = [e for e in by_name["allreduce"]
+                    if e["args"].get("tensor") == "span/probe"]
+        assert umbrella and umbrella[0]["args"]["op_id"] in ops
+
+    def test_fusion_flush_records_member_op_id(self, tmp_path):
+        import numpy as np
+        from horovod_tpu import timeline as tl
+        path = tmp_path / "t.json"
+        tl.start_timeline(str(path))
+        try:
+            n = hvd.size()
+            hvd.allreduce({"a": np.ones((n, 2), np.float32),
+                           "b": np.ones((n, 4), np.float32)},
+                          name="fused/pair")
+        finally:
+            tl.stop_timeline()
+        evs = json.loads(path.read_text())["traceEvents"]
+        flushes = [e for e in evs if e["name"] == "fusion_flush"
+                   and e["args"].get("tensor") == "fused/pair"]
+        assert flushes
+        execs = [e for e in evs if e["name"] == "EXEC"
+                 and e["args"].get("tensor") == "fused/pair"]
+        assert execs
+        assert flushes[0]["args"]["op_id"] == execs[0]["args"]["op_id"]
+
+
+class TestArrivalAttribution:
+    def test_harvest_names_late_ranks_and_feeds_histogram(self):
+        """The negotiation piggyback: rank 2 waited least -> it arrived
+        last -> it is the straggler; spread feeds the live histogram."""
+        import numpy as np
+        from horovod_tpu import collective as C
+        hvd.reset_metrics()
+        C._ARRIVALS.clear()
+        # 3 active processes, coherent prev-op seq 7: waits 120ms / 100ms
+        # / 1ms. Columns: [hash x4, need_full, joined, wait_ms, seq].
+        rows = np.asarray([[0, 0, 0, 0, 0, 0, 120, 7],
+                           [0, 0, 0, 0, 0, 0, 100, 7],
+                           [0, 0, 0, 0, 0, 0, 1, 7]], np.int32)
+        C._harvest_arrivals(rows)
+        stats = C.negotiation_arrival_stats()
+        assert len(stats) == 1
+        assert stats[0]["op_seq"] == 7
+        assert stats[0]["late_processes"] == [2]
+        assert stats[0]["spread_s"] == pytest.approx(0.119)
+        snap = hvd.metrics()
+        series = snap["histograms"]["collective_arrival_spread_seconds"]
+        live = [s for s in series
+                if s["labels"].get("source") == "negotiation"]
+        assert live and live[0]["count"] == 1
+
+    def test_harvest_skips_incoherent_and_joined_rows(self):
+        import numpy as np
+        from horovod_tpu import collective as C
+        C._ARRIVALS.clear()
+        # Mixed prev-op seqs (ranks mid-restart): not attributable.
+        C._harvest_arrivals(np.asarray(
+            [[0, 0, 0, 0, 0, 0, 50, 3], [0, 0, 0, 0, 0, 0, 50, 4]],
+            np.int32))
+        # A joined row is excluded; only one active rank left -> skip.
+        C._harvest_arrivals(np.asarray(
+            [[0, 0, 0, 0, 0, 0, 50, 3], [0, 0, 0, 0, 1, 1, 0, 0]],
+            np.int32))
+        assert C.negotiation_arrival_stats() == []
+
+    def test_watchdog_report_carries_late_ranks(self):
+        import numpy as np
+        from horovod_tpu import collective as C
+        from horovod_tpu import metrics as M
+        C._ARRIVALS.clear()
+        C._harvest_arrivals(np.asarray(
+            [[0, 0, 0, 0, 0, 0, 90, 5], [0, 0, 0, 0, 0, 0, 2, 5]],
+            np.int32))
+        wd = M.StallWatchdog(timeout_s=0.0, poll_s=60)
+        tok = M.collective_begin("allreduce", name="stuck/grads",
+                                 op_id=41)
+        try:
+            import time
+            time.sleep(0.01)
+            reports = wd.check_once()
+        finally:
+            M.collective_end(tok)
+        mine = [r for r in reports if r["tensor"] == "stuck/grads"]
+        assert mine, reports
+        assert mine[0]["likely_late_processes"] == [1]
+        assert mine[0]["op_id"] == 41
+
+
+class TestTwoProcessSmoke:
+    def test_trace_smoke_two_process(self, tmp_path):
+        """Acceptance drive: 2 real processes, shards, merge, straggler
+        report, and the same op-id in NEGOTIATE/QUEUE/EXEC across ranks
+        (tools/trace_smoke.py, also `make trace-smoke`)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "trace_smoke.py")],
+            capture_output=True, text=True, timeout=500)
+        assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+        assert "trace-smoke OK" in r.stdout
