@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: trace-smoke test native
+.PHONY: trace-smoke overlap-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -11,6 +11,13 @@ PY ?= python
 # tier-1 as tests/test_trace_merge.py::TestTwoProcessSmoke.
 trace-smoke:
 	$(PY) tools/trace_smoke.py
+
+# Overlapped gradient-sync smoke: 2 CPU processes run the same tiny train
+# loop with the monolithic psum and the chunked RS+AG pipeline and must
+# land on identical parameters on every rank. Also runs in tier-1 as
+# tests/test_overlap.py::TestTwoProcessSmoke.
+overlap-smoke:
+	$(PY) tools/overlap_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -106,14 +106,18 @@ def _lm_model_flops(n_matmul_params, n_layers, seq_len, d_attn, n_tokens):
 
 def _collective_counters():
     """Collective-level observability embedded in every BENCH_*.json line:
-    negotiation round counts (full vs cached fast path) plus per-kind
-    eager call/byte counters from the metrics registry. Cumulative over
-    the process — diff consecutive lines of an `--model all` run to
-    attribute counts to one config."""
+    the active allreduce algorithm knob, negotiation round counts (full
+    vs cached fast path) plus per-kind eager call/byte counters from the
+    metrics registry. Cumulative over the process — diff consecutive
+    lines of an `--model all` run to attribute counts to one config."""
     try:
         from horovod_tpu.collective import negotiation_stats
+        from horovod_tpu.config import get_config
         from horovod_tpu.metrics import collective_summary
-        return {"negotiation": negotiation_stats(),
+        cfg = get_config()
+        return {"allreduce_alg": cfg.allreduce_algorithm,
+                "overlap_chunks": cfg.overlap_chunks,
+                "negotiation": negotiation_stats(),
                 "collectives": collective_summary()}
     except Exception:
         return {}
@@ -354,6 +358,10 @@ def bench_allreduce(on_tpu):
 
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from horovod_tpu.config import get_config
+    cfg = get_config()
+    alg = cfg.allreduce_algorithm
+
     devs = jax.devices()
     counts = [n for n in (2, 4, 8, 16, 32, 64, 128, 256)
               if n <= len(devs)]
@@ -374,8 +382,15 @@ def bench_allreduce(on_tpu):
         @jax.jit
         @_partial(_compat_shard_map, mesh=mesh, in_specs=P("x"),
                   out_specs=P("x"))
-        def psum_fn(v):
-            return jax.lax.psum(v, "x")
+        def psum_fn(v, n=n):
+            # Honors HOROVOD_ALLREDUCE_ALGORITHM / --allreduce-alg, so
+            # --sweep-comm measures the real per-algorithm lowering here.
+            if alg in ("psum", "auto"):
+                return jax.lax.psum(v, "x")
+            from horovod_tpu import overlap as _overlap
+            chunks = cfg.overlap_chunks if alg == "chunked_rs_ag" else 1
+            return _overlap.chunked_rs_ag_psum(
+                v.ravel(), "x", n, chunks=chunks).reshape(v.shape)
 
         _sync(psum_fn(x))                       # compile + warm
         t0 = time.perf_counter()
@@ -614,11 +629,27 @@ _BENCHES = {"resnet50": bench_resnet50, "gpt2": bench_gpt2,
             "allreduce": bench_allreduce}
 
 
+def _apply_comm_flags(args):
+    """Resolve --allreduce-alg/--overlap-chunks into the HOROVOD_* env
+    (read by config.refresh() inside hvd.init()), so the bench exercises
+    exactly the knob surface users set."""
+    if getattr(args, "allreduce_alg", None):
+        os.environ["HOROVOD_ALLREDUCE_ALGORITHM"] = args.allreduce_alg
+    if getattr(args, "overlap_chunks", None):
+        os.environ["HOROVOD_OVERLAP_CHUNKS"] = str(args.overlap_chunks)
+
+
+#: --sweep-comm measures one line per algorithm (auto is skipped: it
+#: resolves to one of the explicit three per bucket size).
+SWEEP_ALGS = ("psum", "rs_ag", "chunked_rs_ag")
+
+
 def _inner_main(args):
     if os.environ.get("JAX_PLATFORMS"):
         # The image's sitecustomize imports jax before env vars can apply;
         # honor an explicit platform request (e.g. the virtual CPU mesh).
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _apply_comm_flags(args)
     hvd.init()
     on_tpu = jax.default_backend() != "cpu"
     if not on_tpu and not os.environ.get(
@@ -635,6 +666,16 @@ def _inner_main(args):
                      "mid-window); refusing to record CPU numbers under "
                      "TPU metric names"}), flush=True)
         return _RC_CPU_FALLBACK
+    if getattr(args, "sweep_comm", False):
+        # One JSON line per allreduce algorithm for the selected model
+        # (headline model when "all" was asked): hvd.init() re-reads the
+        # env knob, so each pass compiles and measures the real lowering.
+        model = "resnet50" if args.model == "all" else args.model
+        for alg in SWEEP_ALGS:
+            os.environ["HOROVOD_ALLREDUCE_ALGORITHM"] = alg
+            hvd.init()
+            _BENCHES[model](on_tpu)
+        return
     if args.model == "all":
         # headline (resnet50) last so single-line parsers read it.
         for name in ("allreduce", "mnist", "vit", "bert", "gpt2",
@@ -739,6 +780,12 @@ def _supervise(args) -> int:
     # relay wedges mid-run.
     cmd = [sys.executable, os.path.abspath(__file__),
            "--model", args.model, "--inner"]
+    if getattr(args, "allreduce_alg", None):
+        cmd += ["--allreduce-alg", args.allreduce_alg]
+    if getattr(args, "overlap_chunks", None):
+        cmd += ["--overlap-chunks", str(args.overlap_chunks)]
+    if getattr(args, "sweep_comm", False):
+        cmd += ["--sweep-comm"]
     try:
         r = subprocess.run(cmd, timeout=run_timeout)
     except subprocess.TimeoutExpired:
@@ -760,13 +807,29 @@ def _supervise(args) -> int:
     return 0
 
 
-def main():
+def _build_parser():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
                    choices=list(_BENCHES) + ["all"])
     p.add_argument("--inner", action="store_true",
                    help="run directly in-process (no probe/supervision)")
-    args = p.parse_args()
+    p.add_argument("--allreduce-alg", dest="allreduce_alg", default=None,
+                   choices=["auto", "psum", "rs_ag", "chunked_rs_ag"],
+                   help="gradient-sync algorithm for this run "
+                        "(HOROVOD_ALLREDUCE_ALGORITHM)")
+    p.add_argument("--overlap-chunks", dest="overlap_chunks", type=int,
+                   default=None,
+                   help="chunked_rs_ag pipeline depth "
+                        "(HOROVOD_OVERLAP_CHUNKS)")
+    p.add_argument("--sweep-comm", dest="sweep_comm", action="store_true",
+                   help="one JSON line per allreduce algorithm "
+                        f"({', '.join(SWEEP_ALGS)}) for the selected "
+                        "model")
+    return p
+
+
+def main():
+    args = _build_parser().parse_args()
     if args.inner or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # Explicit CPU runs (tests, virtual mesh) never touch the relay.
         return _inner_main(args)

@@ -28,6 +28,10 @@ from horovod_tpu.compression import Compression  # noqa: F401
 # ``hvd.metrics.to_prometheus()``, ``hvd.metrics.start_stall_watchdog()``,
 # ``hvd.metrics.start_metrics_flusher()``, ...
 from horovod_tpu import metrics  # noqa: F401
+# Overlapped gradient sync: algorithm selection (auto|psum|rs_ag|
+# chunked_rs_ag), chunked RS+AG pipelines, backward taps, latency-hiding
+# scheduler wiring (docs/PERFORMANCE.md).
+from horovod_tpu import overlap  # noqa: F401
 from horovod_tpu.metrics import reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,

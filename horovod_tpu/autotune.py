@@ -311,23 +311,33 @@ class BayesianAutotuner:
 
     #: categorical compression levels, in one-hot embedding order
     COMPRESSION_CHOICES = ("none", "fp16")
+    #: allreduce algorithm axis (overlap.py), in embedding order — "auto"
+    #: is excluded: the tuner's whole job is to beat the heuristic.
+    ALGORITHM_CHOICES = ("psum", "rs_ag", "chunked_rs_ag")
+    #: chunk-count rungs for chunked_rs_ag (log2-embedded)
+    CHUNK_CHOICES = (1, 2, 4, 8)
 
     def __init__(self, lo_bytes: int = _MB, hi_bytes: int = 256 * _MB,
                  probes: int = 6, samples_per_probe: int = 10,
-                 tune_compression: bool = False):
+                 tune_compression: bool = False,
+                 tune_algorithm: bool = False):
         import math
         self._lo = math.log2(lo_bytes)
         self._hi = math.log2(hi_bytes)
         self._probes = probes
         self._samples = samples_per_probe
         self._tune_comp = tune_compression
-        # (normalized threshold coord, compression index) per probe
+        self._tune_alg = tune_algorithm
+        # (normalized threshold coord, compression index, algorithm
+        # index, chunk index) per probe
         self._xs: List[tuple] = []
         self._ys: List[float] = []   # median step seconds per probe
         self._pending: List[float] = []
         self._cur = self._next_point()
         self._best: Optional[int] = None
         self._best_compression: Optional[str] = None
+        self._best_algorithm: Optional[str] = None
+        self._best_chunks: Optional[int] = None
         #: True whenever a fresh GP proposal is live and has not yet been
         #: agreed across processes (see class docstring). The first point
         #: is fixed, so no sync is needed until a probe completes.
@@ -349,6 +359,25 @@ class BayesianAutotuner:
             return self._best_compression
         return self.COMPRESSION_CHOICES[self._cur[1]]
 
+    def current_algorithm(self) -> str:
+        """Current allreduce-algorithm pick ("auto" — i.e. the size
+        heuristic — unless ``tune_algorithm``)."""
+        if not self._tune_alg:
+            return "auto"
+        if self._best_algorithm is not None:
+            return self._best_algorithm
+        return self.ALGORITHM_CHOICES[self._cur[2]]
+
+    def current_chunks(self) -> int:
+        """Current chunked_rs_ag pipeline depth (the config default when
+        algorithm tuning is off)."""
+        if not self._tune_alg:
+            from horovod_tpu.config import get_config
+            return get_config().overlap_chunks
+        if self._best_chunks is not None:
+            return self._best_chunks
+        return self.CHUNK_CHOICES[self._cur[3]]
+
     def record(self, step_seconds: float) -> None:
         if self._best is not None:
             return
@@ -365,10 +394,15 @@ class BayesianAutotuner:
             i = min(range(len(self._ys)), key=self._ys.__getitem__)
             self._best = self._denorm(self._xs[i][0])
             self._best_compression = self.COMPRESSION_CHOICES[self._xs[i][1]]
+            if self._tune_alg:
+                self._best_algorithm = self.ALGORITHM_CHOICES[self._xs[i][2]]
+                self._best_chunks = self.CHUNK_CHOICES[self._xs[i][3]]
             gauge("autotune_threshold_bytes").set(self._best)
             event("autotune_converged", mode="bayes",
                   threshold_bytes=self._best,
-                  compression=self._best_compression)
+                  compression=self._best_compression,
+                  algorithm=self.current_algorithm(),
+                  chunks=self.current_chunks() if self._tune_alg else None)
         else:
             self._cur = self._next_point()
             # points 2-3 of the initial design are timing-independent and
@@ -377,6 +411,8 @@ class BayesianAutotuner:
             event("autotune_probe", mode="bayes",
                   threshold_bytes=self._denorm(self._cur[0]),
                   compression=self.COMPRESSION_CHOICES[self._cur[1]],
+                  algorithm=(self.ALGORITHM_CHOICES[self._cur[2]]
+                             if self._tune_alg else "auto"),
                   median_step_s=round(med, 6))
 
     def current_point(self) -> tuple:
@@ -385,42 +421,65 @@ class BayesianAutotuner:
         return self._cur
 
     def set_current_point(self, point) -> None:
-        x01, comp = point
-        self._cur = (float(x01), int(comp))
+        point = tuple(point)
+        if len(point) == 2:            # legacy (threshold, compression)
+            point = point + self._cur[2:]
+        x01, comp, alg, chunk = point
+        self._cur = (float(x01), int(comp), int(alg), int(chunk))
         self.pending_sync = False
 
     def summary(self) -> str:
         lines = [f"bayesian autotune: {len(self._xs)} probes"]
-        for (x, c), y in zip(self._xs, self._ys):
+        for (x, c, a, ch), y in zip(self._xs, self._ys):
+            alg = (f" {self.ALGORITHM_CHOICES[a]}x{self.CHUNK_CHOICES[ch]}"
+                   if self._tune_alg else "")
             lines.append(f"  {self._denorm(x) / _MB:8.1f} MB "
-                         f"{self.COMPRESSION_CHOICES[c]:5s} -> "
+                         f"{self.COMPRESSION_CHOICES[c]:5s}{alg} -> "
                          f"{y * 1e3:8.2f} ms/step")
         if self._best is not None:
+            alg = (f" {self._best_algorithm}x{self._best_chunks}"
+                   if self._tune_alg else "")
             lines.append(f"best: {self._best / _MB:.1f} MB "
-                         f"{self._best_compression}")
+                         f"{self._best_compression}{alg}")
         return "\n".join(lines)
 
     # -- GP machinery -----------------------------------------------------
     def _denorm(self, x01: float) -> int:
         return int(round(2 ** (self._lo + x01 * (self._hi - self._lo))))
 
-    def _embed(self, x01: float, comp: int):
+    def _embed(self, x01: float, comp: int, alg: int = 0, chunk: int = 0):
+        import math
+
         import numpy as np
-        onehot = [0.0] * len(self.COMPRESSION_CHOICES)
-        onehot[comp] = 1.0
-        return np.array([x01] + (onehot if self._tune_comp else []))
+        coords = [x01]
+        if self._tune_comp:
+            onehot = [0.0] * len(self.COMPRESSION_CHOICES)
+            onehot[comp] = 1.0
+            coords += onehot
+        if self._tune_alg:
+            onehot = [0.0] * len(self.ALGORITHM_CHOICES)
+            onehot[alg] = 1.0
+            coords += onehot
+            # chunk count embeds as a normalized log2 scalar (it is
+            # ordinal, unlike the algorithm category)
+            span = math.log2(max(self.CHUNK_CHOICES))
+            coords.append(math.log2(self.CHUNK_CHOICES[chunk])
+                          / max(span, 1.0))
+        return np.array(coords)
 
     def _next_point(self) -> tuple:
         """Initial quasi-random design for 3 probes, then GP + expected
         improvement over a dense candidate grid."""
         import numpy as np
         n_comp = len(self.COMPRESSION_CHOICES) if self._tune_comp else 1
+        n_alg = len(self.ALGORITHM_CHOICES) if self._tune_alg else 1
+        n_chunk = len(self.CHUNK_CHOICES) if self._tune_alg else 1
         n = len(self._xs)
         if n < 3:
             # fixed space-filling start: ends + middle of the log range,
-            # cycling compression choices so every category gets data
-            return ((0.0, 0.5, 1.0)[n], n % n_comp)
-        X = np.stack([self._embed(x, c) for x, c in self._xs])
+            # cycling the categorical choices so every axis gets data
+            return ((0.0, 0.5, 1.0)[n], n % n_comp, n % n_alg, n % n_chunk)
+        X = np.stack([self._embed(*p) for p in self._xs])
         y = np.asarray(self._ys)
         y_mu, y_sd = y.mean(), max(y.std(), 1e-12)
         yn = (y - y_mu) / y_sd
@@ -431,10 +490,12 @@ class BayesianAutotuner:
             return sf2 * np.exp(-d2 / (2 * ell * ell))
 
         K = kern(X, X) + sn2 * np.eye(n)
-        # candidates: dense threshold grid x every category
+        # candidates: dense threshold grid x every category combination
         grid = np.linspace(0.0, 1.0, 65)
-        cands = [(g, c) for c in range(n_comp) for g in grid]
-        Xc = np.stack([self._embed(g, c) for g, c in cands])
+        cands = [(g, c, a, ch)
+                 for ch in range(n_chunk) for a in range(n_alg)
+                 for c in range(n_comp) for g in grid]
+        Xc = np.stack([self._embed(*p) for p in cands])
         Ks = kern(Xc, X)
         sol = np.linalg.solve(K, np.eye(n))
         mu = Ks @ sol @ yn

@@ -194,6 +194,32 @@ def _identity_for(op: int, x: jnp.ndarray) -> jnp.ndarray:
 # in-trace (SPMD) implementations
 # ---------------------------------------------------------------------------
 
+def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks):
+    """Bandwidth-optimal lowering of a Sum/Average fusion bucket:
+    reduce-scatter + all-gather over the full axis (``overlap.py``),
+    optionally as ``chunks`` pipelined pieces. Same masked-subset
+    contract as :func:`_allreduce_leaf` — members contribute their
+    value, non-members zeros, and non-members get their input back."""
+    from horovod_tpu import overlap as _overlap
+    if op not in (ReduceOp.Sum, ReduceOp.Average):
+        raise ValueError("rs_ag decomposition applies to Sum/Average only")
+    k = ps.size()
+    member, _ = _member_and_setrank(ps)
+    is_subset = ps.ranks is not None
+    x_in = x
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, x.dtype)
+    masked = jnp.where(member, x, jnp.zeros_like(x)) if is_subset else x
+    out = _overlap.chunked_rs_ag_psum(masked, ps.axis, core.size(),
+                                      chunks=chunks)
+    if op == ReduceOp.Average:
+        out = out / jnp.asarray(k, out.dtype) if jnp.issubdtype(
+            out.dtype, jnp.floating) else out // k
+    if postscale != 1.0:
+        out = out * jnp.asarray(postscale, out.dtype)
+    return jnp.where(member, out, x_in) if is_subset else out
+
+
 def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
     """Masked full-axis reduction: members contribute their value, non-members
     the op's neutral element, and non-members get their input back. One XLA
@@ -237,9 +263,13 @@ def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
 
 
 def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
-                    fusion_threshold):
+                    fusion_threshold, algorithm="auto",
+                    overlap_chunks=None, reverse=False):
     if op not in _SCALING_OPS and (prescale != 1.0 or postscale != 1.0):
         raise ValueError("prescale/postscale only apply to Sum/Average/Adasum")
+    from horovod_tpu import overlap as _overlap
+    if overlap_chunks is None:
+        overlap_chunks = _overlap.DEFAULT_CHUNKS
 
     wire = getattr(compression, "wire", None)
     if wire is not None:
@@ -300,10 +330,30 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
 
     def reduce_buffer(buf):
         c, ctx = compression.compress(buf)
-        r = _allreduce_leaf(c, op, ps, prescale, postscale)
+        nbytes = int(c.size) * jnp.dtype(c.dtype).itemsize
+        alg = _overlap.resolve_algorithm(
+            algorithm, nbytes, op, core.size(),
+            reducible=op in (ReduceOp.Sum, ReduceOp.Average))
+        # Per-bucket algorithm telemetry (trace-time: one count per
+        # compiled bucket, like the fusion counters).
+        _metrics.counter("allreduce_algorithm_total", algorithm=alg).inc()
+        span = _tracing.current_span()
+        if span is not None:
+            _metrics._timeline_marker(
+                "allreduce_algorithm", category="overlap",
+                op_id=span.op_id, tensor=span.tensor, algorithm=alg,
+                bytes=nbytes,
+                chunks=overlap_chunks if alg == "chunked_rs_ag" else 1)
+        if alg == "psum":
+            r = _allreduce_leaf(c, op, ps, prescale, postscale)
+        else:
+            r = _rs_ag_leaf(c, op, ps, prescale, postscale,
+                            chunks=overlap_chunks
+                            if alg == "chunked_rs_ag" else 1)
         return compression.decompress(r, ctx)
 
-    return _fusion.fused_apply(reduce_buffer, tree, fusion_threshold)
+    return _fusion.fused_apply(reduce_buffer, tree, fusion_threshold,
+                               reverse=reverse, pin_order=reverse)
 
 
 def _broadcast_leaf(x, root_rank, ps: ProcessSet):
@@ -803,8 +853,9 @@ def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
         if kind == "allreduce" and params[1].ranks is None:
             # Everything a joined peer needs to replay this collective
             # with neutral contributions (all picklable by reference).
-            op_, _ps_, pre_, post_, comp_, fus_ = params
-            desc = ("allreduce", shapes, op_, pre_, post_, comp_, fus_)
+            op_, _ps_, pre_, post_, comp_, fus_, alg_, chk_, rev_ = params
+            desc = ("allreduce", shapes, op_, pre_, post_, comp_, fus_,
+                    alg_, chk_, rev_)
         joined = _negotiate(kind, (shapes, param_key, negotiate_key),
                             service_desc=desc, span=span)
         if joined:
@@ -919,7 +970,10 @@ def _ps_key(ps: ProcessSet):
 def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=Compression.none, name: Optional[str] = None,
-              fusion_threshold_bytes: Optional[int] = None):
+              fusion_threshold_bytes: Optional[int] = None,
+              algorithm: Optional[str] = None,
+              overlap_chunks: Optional[int] = None,
+              _reverse_issue: bool = False):
     """Allreduce a tensor or pytree across the communicator (``hvd.allreduce``).
 
     Inside jit/shard_map: lowers to XLA psum/pmin/pmax/ppermute over the mesh
@@ -928,13 +982,45 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
 
     ``fusion_threshold_bytes`` defaults to ``HOROVOD_FUSION_THRESHOLD``
     (64 MB when unset), read at init like upstream.
+
+    ``algorithm`` picks the per-bucket lowering for Sum/Average (other ops
+    pass through to their existing lowerings):
+
+    * ``"psum"`` — one fused XLA psum per bucket (latency-optimal);
+    * ``"rs_ag"`` — ``lax.psum_scatter`` + ``lax.all_gather``
+      (bandwidth-optimal ring decomposition);
+    * ``"chunked_rs_ag"`` — the bucket split into ``overlap_chunks``
+      pipelined RS+AG pairs so XLA can overlap chunk i's all-gather with
+      chunk i+1's reduce-scatter (see ``overlap.py``);
+    * ``"auto"`` (default via ``HOROVOD_ALLREDUCE_ALGORITHM``) — per
+      bucket by size: small buckets psum, large rs_ag, largest chunked.
+
+    Quantized wire compression (``Compression.grouped_*``) restructures
+    the reduction itself and ignores ``algorithm``. ``_reverse_issue`` is
+    internal (gradient overlap): buckets issue in reverse order with
+    pinned scheduling.
     """
+    from horovod_tpu.config import get_config
+    cfg = get_config()
     if fusion_threshold_bytes is None:
-        from horovod_tpu.config import get_config
-        fusion_threshold_bytes = get_config().fusion_threshold_bytes
+        fusion_threshold_bytes = cfg.fusion_threshold_bytes
+    if algorithm is None:
+        algorithm = cfg.allreduce_algorithm
+    if overlap_chunks is None:
+        overlap_chunks = cfg.overlap_chunks
+    from horovod_tpu import overlap as _overlap
+    if algorithm not in _overlap.ALGORITHMS:
+        raise ValueError(
+            f"unknown allreduce algorithm {algorithm!r}; expected one of "
+            f"{_overlap.ALGORITHMS}")
+    overlap_chunks = int(overlap_chunks)
+    if overlap_chunks < 1:
+        raise ValueError(
+            f"overlap_chunks must be >= 1, got {overlap_chunks}")
     ps = _resolve_ps(process_set)
     args = (op, ps, float(prescale_factor), float(postscale_factor),
-            compression, int(fusion_threshold_bytes))
+            compression, int(fusion_threshold_bytes), algorithm,
+            overlap_chunks, bool(_reverse_issue))
     if _is_traced(tensor):
         # Trace-time telemetry: one count per compiled lowering (the
         # in-jit analogue of collective_calls_total; steps re-USE the
@@ -945,7 +1031,8 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         with _traced_span("allreduce", name, ps):
             return _allreduce_tree(tensor, *args)
     pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
-          compression.__name__, int(fusion_threshold_bytes))
+          compression.__name__, int(fusion_threshold_bytes), algorithm,
+          overlap_chunks, bool(_reverse_issue))
     if op == ReduceOp.Adasum:
         # Hierarchical mode changes the compiled program; key it.
         groups = _hierarchical_adasum_groups(ps)
@@ -1380,7 +1467,8 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     jax.block_until_ready(_eager_run("allreduce", token,
                                      (ReduceOp.Sum, ps, 1.0, 1.0,
                                       Compression.none,
-                                      _fusion.DEFAULT_FUSION_THRESHOLD_BYTES),
+                                      _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
+                                      "psum", 1, False),
                                      ("barrier", _ps_key(ps)),
                                      op_name="barrier"))
 
@@ -1468,7 +1556,8 @@ def _join_service_round() -> bool:
         raise RuntimeError(
             "joined process cannot service this eager collective (no "
             "descriptor — only global-set allreduce is join-serviceable)")
-    kind, shapes, op, prescale, postscale, compression, fusion = desc
+    (kind, shapes, op, prescale, postscale, compression, fusion,
+     algorithm, chunks, reverse) = desc
     _check_join_avg_dtypes(op, shapes)
     # broadcast_to: O(1) host memory for the full (n, ...) stacked view —
     # place() only reads this process's rows anyway.
@@ -1487,13 +1576,14 @@ def _join_service_round() -> bool:
     # parked inside the device collective.
     ps = _resolve_ps(None)
     pk = (op, _ps_key(ps), prescale, postscale, compression.__name__,
-          fusion)
+          fusion, algorithm, chunks, reverse)
     if op == ReduceOp.Adasum:
         groups = _hierarchical_adasum_groups(ps)
         pk = pk + (None if groups is None
                    else tuple(tuple(g) for g in groups),)
     _eager_run(kind, tree,
-               (op, ps, prescale, postscale, compression, fusion),
+               (op, ps, prescale, postscale, compression, fusion,
+                algorithm, chunks, reverse),
                pk, _skip_negotiate=True)
     return False
 

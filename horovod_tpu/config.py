@@ -44,6 +44,16 @@ def _env_bool(name: str, default: bool = False) -> bool:
 class Config:
     # Fusion (fusion_buffer_manager.cc): HOROVOD_FUSION_THRESHOLD bytes.
     fusion_threshold_bytes: int = 64 * _MB
+    # Gradient-sync algorithm axis (overlap.py):
+    # HOROVOD_ALLREDUCE_ALGORITHM in {auto, psum, rs_ag, chunked_rs_ag}
+    # picks the per-bucket allreduce lowering; HOROVOD_OVERLAP_CHUNKS is
+    # the pipeline depth of chunked_rs_ag; HOROVOD_XLA_LATENCY_HIDING=1
+    # wires the XLA latency-hiding-scheduler flags at init so async
+    # collectives overlap compute (TPU only; must be set before the
+    # backend initializes).
+    allreduce_algorithm: str = "auto"
+    overlap_chunks: int = 4
+    xla_latency_hiding: bool = False
     # Timeline (timeline.cc): HOROVOD_TIMELINE=<path> starts the Chrome
     # trace at init; HOROVOD_TIMELINE_MARK_CYCLES adds cycle markers.
     timeline_path: Optional[str] = None
@@ -106,12 +116,42 @@ _INERT_VARS = {
 }
 
 
+def _env_algorithm() -> str:
+    from horovod_tpu.overlap import ALGORITHMS
+    v = (os.environ.get("HOROVOD_ALLREDUCE_ALGORITHM", "auto")
+         .strip().lower() or "auto")
+    if v not in ALGORITHMS:
+        raise ValueError(
+            f"HOROVOD_ALLREDUCE_ALGORITHM={v!r}: expected one of "
+            f"{ALGORITHMS}")
+    return v
+
+
+def _env_chunks() -> int:
+    v = os.environ.get("HOROVOD_OVERLAP_CHUNKS")
+    if not v:
+        from horovod_tpu.overlap import DEFAULT_CHUNKS
+        return DEFAULT_CHUNKS
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"HOROVOD_OVERLAP_CHUNKS={v!r}: expected a positive integer")
+    if n < 1:
+        raise ValueError(
+            f"HOROVOD_OVERLAP_CHUNKS={n}: chunk count must be >= 1")
+    return n
+
+
 def refresh() -> Config:
     """Re-read ``HOROVOD_*`` from the environment (called by ``init()``)."""
     global _CONFIG
     cfg = Config(
         fusion_threshold_bytes=_env_bytes("HOROVOD_FUSION_THRESHOLD",
                                           64 * _MB),
+        allreduce_algorithm=_env_algorithm(),
+        overlap_chunks=_env_chunks(),
+        xla_latency_hiding=_env_bool("HOROVOD_XLA_LATENCY_HIDING"),
         timeline_path=os.environ.get("HOROVOD_TIMELINE") or None,
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
         trace_jax_profiler=_env_bool("HOROVOD_TRACE_JAX_PROFILER"),

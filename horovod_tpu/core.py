@@ -112,6 +112,17 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         num_processes = int(os.environ["HVD_TPU_NUM_PROCESSES"])
         process_id = int(os.environ["HVD_TPU_PROCESS_ID"])
     with _LOCK:
+        # Upstream reads its HOROVOD_* knob surface once at horovod_init;
+        # same contract here (config.py documents the TPU-inert ones).
+        # Read BEFORE anything touches a jax backend: the latency-hiding
+        # scheduler rides XLA_FLAGS, which are consumed at backend
+        # creation — after jax.devices() below it would be too late.
+        from horovod_tpu import config as _config
+        cfg = _config.refresh()
+        lhs_applied = False
+        if cfg.xla_latency_hiding:
+            from horovod_tpu import overlap as _overlap
+            lhs_applied = _overlap.enable_latency_hiding()
         if coordinator_address is not None or (
                 num_processes is not None and num_processes > 1):
             # init() must stay reentrant (elastic re-init, shutdown/init
@@ -137,10 +148,6 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         _coll._EAGER_CACHE.clear()
         _coll._reset_negotiation()
         _ps._reset_for_init(m, axis_name)
-        # Upstream reads its HOROVOD_* knob surface once at horovod_init;
-        # same contract here (config.py documents the TPU-inert ones).
-        from horovod_tpu import config as _config
-        cfg = _config.refresh()
         global _INIT_EPOCH
         _INIT_EPOCH += 1
         if cfg.timeline_path:
@@ -180,6 +187,20 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         from horovod_tpu import metrics as _metrics
         _metrics.on_init(cfg, init_seconds=_time.perf_counter() - t0,
                          world=len(devs))
+        # Resolved comm-knob gauges (hvd.metrics()-visible): the algorithm
+        # as an info-style labeled gauge, chunk depth and whether the
+        # latency-hiding flags actually applied (False on CPU runs or
+        # when the backend beat init() to initialization). Inactive
+        # algorithm labels are zeroed so a re-init with a different knob
+        # (bench --sweep-comm) leaves exactly one label at 1.
+        from horovod_tpu.overlap import ALGORITHMS as _algs
+        for _a in _algs:
+            _metrics.gauge("config_allreduce_algorithm",
+                           algorithm=_a).set(
+                1 if _a == cfg.allreduce_algorithm else 0)
+        _metrics.gauge("config_overlap_chunks").set(cfg.overlap_chunks)
+        _metrics.gauge("config_xla_latency_hiding").set(
+            1 if lhs_applied else 0)
 
 
 def shutdown() -> None:
@@ -294,6 +315,9 @@ def build_info() -> dict:
         # plus any accepted-but-inert variables with the reason they have
         # no TPU mechanism.
         "fusion_threshold_bytes": cfg.fusion_threshold_bytes,
+        "allreduce_algorithm": cfg.allreduce_algorithm,
+        "overlap_chunks": cfg.overlap_chunks,
+        "xla_latency_hiding": cfg.xla_latency_hiding,
         "autotune": cfg.autotune,
         "autotune_mode": cfg.autotune_mode,
         "inert_env": dict(cfg.inert),

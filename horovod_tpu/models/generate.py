@@ -16,6 +16,14 @@ The cache is a plain pytree of ``(B, T_total, H, hd)`` arrays (one K and
 one V per layer), donated through the scan carry. Sampling: greedy at
 ``temperature=0`` (the default), otherwise temperature softmax with
 optional top-k truncation; an ``eos_id`` freezes finished rows.
+
+Decode steps compute in the model's ``cfg.dtype`` with the SAME fp32
+islands as the training forward (fp32 norms and softmax, fp32 logits
+head): the per-layer cast back to bf16 re-synchronizes the two lowerings
+at every boundary, which is what makes greedy decode-vs-forward parity
+hold bit-for-bit instead of drifting by reduction-order noise. The
+residual near-ties are closed by :func:`greedy_token`'s deterministic
+tolerance tie-break.
 """
 
 from __future__ import annotations
@@ -25,20 +33,27 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "t5_generate"]
+__all__ = ["generate", "t5_generate", "greedy_token"]
 
 
 def _layernorm(x, p, eps):
+    """Mirrors ``flax.linen.LayerNorm(dtype=float32)`` bit for bit: fp32
+    fast-variance stats (``E[x^2] - E[x]^2``) and the scale folded into
+    the rsqrt multiplier BEFORE it touches x — the association the
+    training forward compiled. Returns fp32."""
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
-    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-    return (xf - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    var = (xf * xf).mean(-1, keepdims=True) - mu * mu
+    mul = jax.lax.rsqrt(var + eps) * p["scale"]
+    return (xf - mu) * mul + p["bias"]
 
 
 def _rmsnorm(x, p, eps):
+    """Training ``RMSNorm`` (llama.py, shared by t5): fp32 inside, cast
+    back to the residual dtype — the cast is load-bearing for parity."""
     xf = x.astype(jnp.float32)
     y = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
-    return y * p["scale"]
+    return (y * p["scale"]).astype(x.dtype)
 
 
 def _attend_cached(q, ck, cv, idx, scale):
@@ -47,26 +62,31 @@ def _attend_cached(q, ck, cv, idx, scale):
     GQA stays grouped end-to-end: the cache is stored at Hkv width (the
     whole point of grouped heads — H/Hkv times less KV memory) and the
     query heads fold into (Hkv, H/Hkv) groups for the score einsums
-    instead of repeat-expanding K/V."""
+    instead of repeat-expanding K/V. Dtype flow mirrors the training
+    dense path (``ops/attention.multihead_attention``): scores in the
+    compute dtype then cast fp32, softmax fp32, probabilities cast back
+    before the value einsum."""
     b, h, hd = q.shape
     hkv = ck.shape[2]
-    qg = q.reshape(b, hkv, h // hkv, hd).astype(jnp.float32)
-    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck.astype(jnp.float32)) * scale
+    qg = q.reshape(b, hkv, h // hkv, hd)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck).astype(jnp.float32) * scale
     t = ck.shape[1]
     s = jnp.where(jnp.arange(t)[None, None, None, :] <= idx, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkgt,btkd->bkgd", p, cv.astype(jnp.float32))
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgt,btkd->bkgd", p, cv)
     return o.reshape(b, h, hd)
 
 
 def _gpt2_step(cfg, params, cache, tok, idx):
     """tok (B,) at position idx -> (new_cache, logits (B, V))."""
+    dt = cfg.dtype
     H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
-    x = params["wte"][tok] + params["wpe"][idx]          # (B, D) fp32
+    x = params["wte"][tok].astype(dt) + params["wpe"][idx].astype(dt)
     for i in range(cfg.num_layers):
         p = params[f"h{i}"]
-        h = _layernorm(x, p["ln1"], cfg.ln_eps)
-        qkv = h @ p["attn"]["qkv"]["kernel"] + p["attn"]["qkv"]["bias"]
+        h = _layernorm(x, p["ln1"], cfg.ln_eps).astype(dt)
+        qkv = h @ p["attn"]["qkv"]["kernel"].astype(dt) \
+            + p["attn"]["qkv"]["bias"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
             cache[i]["k"], k.reshape(-1, H, hd), idx, axis=1)
@@ -74,13 +94,14 @@ def _gpt2_step(cfg, params, cache, tok, idx):
             cache[i]["v"], v.reshape(-1, H, hd), idx, axis=1)
         o = _attend_cached(q.reshape(-1, H, hd), ck, cv, idx, hd ** -0.5)
         x = x + (o.reshape(-1, H * hd) @ p["attn"]["out"]["kernel"]
-                 + p["attn"]["out"]["bias"])
-        h = _layernorm(x, p["ln2"], cfg.ln_eps)
-        h = jax.nn.gelu(h @ p["mlp"]["fc"]["kernel"]
-                        + p["mlp"]["fc"]["bias"])
-        x = x + (h @ p["mlp"]["proj"]["kernel"] + p["mlp"]["proj"]["bias"])
-    x = _layernorm(x, params["ln_f"], cfg.ln_eps)
-    return cache, x @ params["wte"].T                    # tied head
+                 .astype(dt) + p["attn"]["out"]["bias"].astype(dt))
+        h = _layernorm(x, p["ln2"], cfg.ln_eps).astype(dt)
+        h = jax.nn.gelu(h @ p["mlp"]["fc"]["kernel"].astype(dt)
+                        + p["mlp"]["fc"]["bias"].astype(dt))
+        x = x + (h @ p["mlp"]["proj"]["kernel"].astype(dt)
+                 + p["mlp"]["proj"]["bias"].astype(dt))
+    x = _layernorm(x, params["ln_f"], cfg.ln_eps)        # fp32
+    return cache, x @ params["wte"].T                    # tied head, fp32
 
 
 def _rope_one(x, pos, theta):
@@ -92,15 +113,16 @@ def _rope_one(x, pos, theta):
 
 
 def _llama_step(cfg, params, cache, tok, idx):
+    dt = cfg.dtype
     H, Hkv = cfg.num_heads, cfg.num_kv_heads
     hd = cfg.d_model // H
-    x = params["wte"][tok].astype(jnp.float32)           # (B, D)
+    x = params["wte"][tok].astype(dt)                    # (B, D)
     for i in range(cfg.num_layers):
         p = params[f"h{i}"]
         h = _rmsnorm(x, p["norm_attn"], cfg.rms_eps)
-        q = (h @ p["attn"]["wq"]["kernel"]).reshape(-1, H, hd)
-        k = (h @ p["attn"]["wk"]["kernel"]).reshape(-1, Hkv, hd)
-        v = (h @ p["attn"]["wv"]["kernel"]).reshape(-1, Hkv, hd)
+        q = (h @ p["attn"]["wq"]["kernel"].astype(dt)).reshape(-1, H, hd)
+        k = (h @ p["attn"]["wk"]["kernel"].astype(dt)).reshape(-1, Hkv, hd)
+        v = (h @ p["attn"]["wv"]["kernel"].astype(dt)).reshape(-1, Hkv, hd)
         q = _rope_one(q, idx, cfg.rope_theta)
         k = _rope_one(k, idx, cfg.rope_theta)
         ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
@@ -108,29 +130,31 @@ def _llama_step(cfg, params, cache, tok, idx):
         cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
             cache[i]["v"], v, idx, axis=1)
         o = _attend_cached(q, ck, cv, idx, hd ** -0.5)
-        x = x + o.reshape(-1, H * hd) @ p["attn"]["wo"]["kernel"]
+        x = x + o.reshape(-1, H * hd) @ p["attn"]["wo"]["kernel"].astype(dt)
         h = _rmsnorm(x, p["norm_mlp"], cfg.rms_eps)
-        g = jax.nn.silu(h @ p["mlp"]["gate"]["kernel"])
-        u = h @ p["mlp"]["up"]["kernel"]
-        x = x + (g * u) @ p["mlp"]["down"]["kernel"]
+        g = jax.nn.silu(h @ p["mlp"]["gate"]["kernel"].astype(dt))
+        u = h @ p["mlp"]["up"]["kernel"].astype(dt)
+        x = x + (g * u) @ p["mlp"]["down"]["kernel"].astype(dt)
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
-    return cache, x @ params["lm_head"].T                # untied head
+    return cache, x.astype(jnp.float32) @ params["lm_head"].T  # untied head
 
 
 def _t5_encode(model, cfg, params, src, src_mask):
     """Encoder states (THE training encoder — ``T5.__call__`` with
     ``dec_tokens=None``, shared attention dispatch and all) + per-layer
-    cross-attention K/V, computed ONCE per generation."""
+    cross-attention K/V, computed ONCE per generation. Stays in
+    ``cfg.dtype`` end to end, exactly like the training decoder's view
+    of the encoder output."""
     H, hd = cfg.num_heads, cfg.head_dim
     T = src.shape[1]
-    enc = model.apply({"params": params}, src, None,
-                      enc_mask=src_mask).astype(jnp.float32)
+    dt = cfg.dtype
+    enc = model.apply({"params": params}, src, None, enc_mask=src_mask)
     cross = []
     for i in range(cfg.num_decoder_layers):
         p = params[f"dec{i}"]["cross_attn"]
         cross.append({
-            "k": (enc @ p["k"]["kernel"]).reshape(-1, T, H, hd),
-            "v": (enc @ p["v"]["kernel"]).reshape(-1, T, H, hd)})
+            "k": (enc @ p["k"]["kernel"].astype(dt)).reshape(-1, T, H, hd),
+            "v": (enc @ p["v"]["kernel"].astype(dt)).reshape(-1, T, H, hd)})
     return cross
 
 
@@ -140,13 +164,17 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
     ``dec_bias_tbl`` is the (T_dec, H, T_dec) causal rel-bias tensor
     precomputed outside the scan; row ``idx`` biases this query."""
     H, hd = cfg.num_heads, cfg.head_dim
-    x = params["embedding"][tok]                          # (B, D)
+    dt = cfg.dtype
+    x = params["embedding"][tok].astype(dt)               # (B, D)
     for i in range(cfg.num_decoder_layers):
         p = params[f"dec{i}"]
         h = _rmsnorm(x, p["ln1"], 1e-6)
-        q = (h @ p["self_attn"]["q"]["kernel"]).reshape(-1, H, hd)
-        k = (h @ p["self_attn"]["k"]["kernel"]).reshape(-1, H, hd)
-        v = (h @ p["self_attn"]["v"]["kernel"]).reshape(-1, H, hd)
+        q = (h @ p["self_attn"]["q"]["kernel"].astype(dt)) \
+            .reshape(-1, H, hd)
+        k = (h @ p["self_attn"]["k"]["kernel"].astype(dt)) \
+            .reshape(-1, H, hd)
+        v = (h @ p["self_attn"]["v"]["kernel"].astype(dt)) \
+            .reshape(-1, H, hd)
         ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
             cache[i]["k"], k, idx, axis=1)
         cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
@@ -154,32 +182,35 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
         # T5: no 1/sqrt scaling; additive causal rel bias for this row.
         b = jax.lax.dynamic_index_in_dim(dec_bias_tbl, idx, axis=0,
                                          keepdims=False)   # (H, T_dec)
-        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
-                       ck.astype(jnp.float32)) + b[None]
+        s = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32) \
+            + b[None]
         t = ck.shape[1]
         s = jnp.where(jnp.arange(t)[None, None, :] <= idx, s, -1e30)
-        o = jnp.einsum("bht,bthd->bhd", jax.nn.softmax(s, -1),
-                       cv.astype(jnp.float32))
-        x = x + o.reshape(-1, H * hd) @ p["self_attn"]["o"]["kernel"]
+        a = jax.nn.softmax(s, -1).astype(dt)
+        o = jnp.einsum("bht,bthd->bhd", a, cv)
+        x = x + o.reshape(-1, H * hd) \
+            @ p["self_attn"]["o"]["kernel"].astype(dt)
         # Cross-attention over the fixed encoder K/V; no bias, masked.
         h = _rmsnorm(x, p["ln2"], 1e-6)
-        q = (h @ p["cross_attn"]["q"]["kernel"]).reshape(-1, H, hd)
-        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
-                       cross[i]["k"].astype(jnp.float32))
+        q = (h @ p["cross_attn"]["q"]["kernel"].astype(dt)) \
+            .reshape(-1, H, hd)
+        s = jnp.einsum("bhd,bthd->bht", q, cross[i]["k"]) \
+            .astype(jnp.float32)
         s = jnp.where(src_mask[:, None, :], s, -1e30)
-        a = jax.nn.softmax(s, -1)
+        a = jax.nn.softmax(s, -1).astype(dt)
         # Fully-padded source rows: zero the attention instead of a
         # uniform softmax over -inf (the shared dense path's contract).
-        a = a * src_mask.any(-1)[:, None, None]
-        o = jnp.einsum("bht,bthd->bhd", a,
-                       cross[i]["v"].astype(jnp.float32))
-        x = x + o.reshape(-1, H * hd) @ p["cross_attn"]["o"]["kernel"]
+        a = jnp.where(src_mask.any(-1)[:, None, None], a,
+                      jnp.zeros_like(a))
+        o = jnp.einsum("bht,bthd->bhd", a, cross[i]["v"])
+        x = x + o.reshape(-1, H * hd) \
+            @ p["cross_attn"]["o"]["kernel"].astype(dt)
         h = _rmsnorm(x, p["ln3"], 1e-6)
-        g = jax.nn.gelu(h @ p["mlp"]["wi_0"]["kernel"])
-        u = h @ p["mlp"]["wi_1"]["kernel"]
-        x = x + (g * u) @ p["mlp"]["wo"]["kernel"]
+        g = jax.nn.gelu(h @ p["mlp"]["wi_0"]["kernel"].astype(dt))
+        u = h @ p["mlp"]["wi_1"]["kernel"].astype(dt)
+        x = x + (g * u) @ p["mlp"]["wo"]["kernel"].astype(dt)
     x = _rmsnorm(x, params["dec_norm"], 1e-6)
-    return cache, x @ params["lm_head"].T
+    return cache, x.astype(jnp.float32) @ params["lm_head"].T
 
 
 def t5_generate(model: Any, params: Any, src: jnp.ndarray,
@@ -225,9 +256,9 @@ def t5_generate(model: Any, params: Any, src: jnp.ndarray,
     dec_bias = dec_bias.transpose(0, 2, 1)                # (Tq, H, Tk)
 
     cache = {i: {"k": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
-                                jnp.float32),
+                                cfg.dtype),
                  "v": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
-                                jnp.float32)}
+                                cfg.dtype)}
              for i in range(cfg.num_decoder_layers)}
     keys = (jax.random.split(rng, T_dec) if rng is not None
             else jnp.zeros((T_dec, 2), jnp.uint32))
@@ -265,9 +296,27 @@ def _step_fn(model):
                     f"{type(model).__name__}")
 
 
+def greedy_token(logits, rel_tol: float = 1e-5):
+    """Deterministic greedy pick with a tolerance tie-break.
+
+    Plain ``argmax`` is bit-fragile: two lowerings of the same model
+    (cached decode vs full forward, fused vs unfused) accumulate fp32
+    sums in different orders, and a near-tie then flips the picked token.
+    This selects the LOWEST token id whose logit is within
+    ``rel_tol * max(1, |top|)`` of the maximum — any two lowerings whose
+    logits agree to well under the tolerance pick the same token, and
+    ties break identically everywhere. The parity oracles in
+    ``tests/test_generate.py`` use the same rule.
+    """
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    eps = rel_tol * jnp.maximum(jnp.abs(m), 1.0)
+    # argmax of bool returns the FIRST True: lowest index within band.
+    return jnp.argmax(logits >= m - eps, axis=-1)
+
+
 def _sample(logits, temperature, top_k, key):
     if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+        return greedy_token(logits)
     logits = logits / temperature
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
@@ -310,9 +359,10 @@ def generate(model: Any, params: Any, prompt: jnp.ndarray,
                          f"{cfg.vocab_size}], got {top_k}")
     hd = cfg.d_model // cfg.num_heads
     # GQA caches stay at kv width — the memory saving grouped heads
-    # exist for (kv_heads == num_heads for GPT-2/MHA).
-    cache = {i: {"k": jnp.zeros((B, total, kv_heads, hd), jnp.float32),
-                 "v": jnp.zeros((B, total, kv_heads, hd), jnp.float32)}
+    # exist for (kv_heads == num_heads for GPT-2/MHA) — and in the
+    # model's compute dtype, like the training K/V they mirror.
+    cache = {i: {"k": jnp.zeros((B, total, kv_heads, hd), cfg.dtype),
+                 "v": jnp.zeros((B, total, kv_heads, hd), cfg.dtype)}
              for i in range(cfg.num_layers)}
     prompt = prompt.astype(jnp.int32)
     keys = (jax.random.split(rng, total) if rng is not None

@@ -80,14 +80,31 @@ class AutotunedStep:
     """
 
     def __init__(self, make_step, tuner=None):
+        import inspect
+
         from horovod_tpu.autotune import BayesianAutotuner
         from horovod_tpu.config import get_config
         cfg = get_config()
         self._make = make_step
+        # make_step(threshold) is the classic surface; a 3-arg
+        # make_step(threshold, algorithm, chunks) additionally receives
+        # the tuner's comm-algorithm picks (BayesianAutotuner(
+        # tune_algorithm=True)) to thread into DistributedOptimizer.
+        # Only REQUIRED positional params count — a 1-arg builder with
+        # defaulted extras (make_step(thr, jit=True)) must not have an
+        # algorithm string rammed into its keyword slots.
+        try:
+            sig = inspect.signature(make_step)
+            self._make_arity = sum(
+                1 for p in sig.parameters.values()
+                if p.default is p.empty and p.kind in (
+                    p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+        except (TypeError, ValueError):
+            self._make_arity = 1
         self._tuner = tuner if tuner is not None else BayesianAutotuner(
             probes=cfg.autotune_probes,
             samples_per_probe=cfg.autotune_samples)
-        self._fn = make_step(self._tuner.current_threshold())
+        self._fn = self._build(self._tuner.current_threshold())
         self._done = False
         # The first call after any (re)build pays jit trace + XLA compile
         # — recording it would hand the GP a compile-dominated outlier
@@ -101,6 +118,14 @@ class AutotunedStep:
 
     def current_threshold(self) -> int:
         return self._tuner.current_threshold()
+
+    def _build(self, threshold: int):
+        if self._make_arity >= 3:
+            t = self._tuner
+            alg = getattr(t, "current_algorithm", lambda: "auto")()
+            chunks = getattr(t, "current_chunks", lambda: None)()
+            return self._make(threshold, alg, chunks)
+        return self._make(threshold)
 
     def _agree_and_rebuild(self) -> None:
         t = self._tuner
@@ -119,12 +144,18 @@ class AutotunedStep:
                 # program must use one agreed value — and the tuner must
                 # REPORT that value (current_threshold() after
                 # convergence is what users persist), so write it back.
+                # The algorithm picks feed traced collective signatures
+                # the same way; agree on rank 0's.
                 best = int(C.broadcast_object(best, 0))
                 t._best = best
-            self._fn = self._make(best)
+                if getattr(t, "_tune_alg", False):
+                    alg, chunks = C.broadcast_object(
+                        (t.current_algorithm(), t.current_chunks()), 0)
+                    t._best_algorithm, t._best_chunks = alg, int(chunks)
+            self._fn = self._build(best)
             self._done = True
         else:
-            self._fn = self._make(t.current_threshold())
+            self._fn = self._build(t.current_threshold())
         self._skip_next = True
 
     def __call__(self, *args, **kwargs):
@@ -200,17 +231,34 @@ def allreduce_gradients(grads: Any, op: int = C.Average,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0,
                         fusion_threshold_bytes: Optional[int] = None,
-                        alive: Optional[jnp.ndarray] = None) -> Any:
+                        alive: Optional[jnp.ndarray] = None,
+                        algorithm: Optional[str] = None,
+                        overlap_chunks: Optional[int] = None,
+                        overlap: bool = False) -> Any:
     """Fused allreduce of a gradient pytree (in-trace).
 
     ``alive`` implements the Join op for uneven data (upstream
     ``horovod/common/ops/../join``): pass a 0/1 scalar per device; dead
     devices contribute zeros and the mean divides by the live count.
+
+    ``algorithm`` / ``overlap_chunks`` select the per-bucket lowering
+    (see :func:`horovod_tpu.collective.allreduce`). ``overlap=True``
+    issues the per-bucket collectives in reverse bucket order with
+    pinned scheduling (``lax.optimization_barrier``) — the last-produced
+    gradients' bucket goes first, so the latency-hiding scheduler can
+    start it while earlier layers are still in their backward — instead
+    of one ordering-free batch at the end of backward. For collectives
+    issued *inside* the backward itself use ``hvd.grad(overlap=True)``
+    (custom_vjp taps).
     """
     if not core.in_spmd_context():
         # jit auto-sharding mode: XLA already reduced the grads.
         _maybe_record_grad_norm(grads)
         return grads
+    comm_kw = dict(compression=compression,
+                   fusion_threshold_bytes=fusion_threshold_bytes,
+                   algorithm=algorithm, overlap_chunks=overlap_chunks,
+                   _reverse_issue=overlap)
     if alive is not None:
         if op not in (C.Average, C.Sum):
             raise ValueError("join-style allreduce supports Sum/Average only")
@@ -220,20 +268,16 @@ def allreduce_gradients(grads: Any, op: int = C.Average,
         grads = jax.tree_util.tree_map(
             lambda g: g * alivef.astype(g.dtype), grads)
         summed = C.allreduce(grads, op=C.Sum, process_set=process_set,
-                             compression=compression,
                              prescale_factor=prescale_factor,
-                             postscale_factor=postscale_factor,
-                             fusion_threshold_bytes=fusion_threshold_bytes)
+                             postscale_factor=postscale_factor, **comm_kw)
         if op == C.Average:
             summed = jax.tree_util.tree_map(
                 lambda g: g / n_alive.astype(g.dtype), summed)
         _maybe_record_grad_norm(summed)
         return summed
     out = C.allreduce(grads, op=op, process_set=process_set,
-                      compression=compression,
                       prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor,
-                      fusion_threshold_bytes=fusion_threshold_bytes)
+                      postscale_factor=postscale_factor, **comm_kw)
     _maybe_record_grad_norm(out)
     return out
 
@@ -246,6 +290,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          postscale_factor: float = 1.0,
                          fusion_threshold_bytes: Optional[int] = None,
                          backward_passes_per_step: int = 1,
+                         algorithm: Optional[str] = None,
+                         overlap_chunks: Optional[int] = None,
+                         overlap: bool = False,
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are synchronized before the update
     (``hvd.DistributedOptimizer``).
@@ -263,6 +310,13 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     synced update on every k-th; everything stays jit-compatible (counter +
     accumulator live in the optimizer state; probe the k-boundary with
     ``accumulation_has_updated(opt_state)``).
+
+    ``algorithm`` / ``overlap_chunks`` select the per-bucket allreduce
+    lowering (``psum`` / ``rs_ag`` / ``chunked_rs_ag`` / ``auto``; see
+    :func:`horovod_tpu.collective.allreduce`); ``overlap=True`` issues
+    per-bucket collectives in reverse production order with pinned
+    scheduling instead of one end-of-backward batch (see
+    :func:`allreduce_gradients`).
     """
 
     def init(params):
@@ -273,7 +327,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             grads, op=op, process_set=process_set, compression=compression,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             fusion_threshold_bytes=fusion_threshold_bytes,
-            alive=extra.pop("alive", None))
+            alive=extra.pop("alive", None),
+            algorithm=algorithm, overlap_chunks=overlap_chunks,
+            overlap=overlap)
         return optimizer.update(grads, state, params, **extra)
 
     tx = optax.GradientTransformation(init, update)
@@ -300,15 +356,51 @@ def accumulation_has_updated(opt_state) -> "jnp.ndarray":
 
 def grad(fun: Callable, argnums=0, op: int = C.Average,
          process_set: Optional[ProcessSet] = None,
-         compression=Compression.none, **gradkw) -> Callable:
+         compression=Compression.none, overlap: bool = False,
+         algorithm: Optional[str] = None,
+         overlap_chunks: Optional[int] = None, **gradkw) -> Callable:
     """Distributed ``jax.grad``: gradients are allreduced across the
-    communicator (the JAX-native ``hvd.DistributedGradientTape``)."""
+    communicator (the JAX-native ``hvd.DistributedGradientTape``).
+
+    ``overlap=True`` swaps the end-of-backward allreduce for custom_vjp
+    identity taps on each top-level parameter group
+    (:func:`horovod_tpu.overlap.tap_params`): every group's gradient is
+    synchronized *inside* the backward, the moment it is produced —
+    reverse production order for free — so XLA (especially with
+    ``HOROVOD_XLA_LATENCY_HIDING=1``) overlaps the collectives with the
+    rest of the backward instead of serializing them after it.
+    """
+    if overlap:
+        from horovod_tpu import overlap as _overlap
+        sync_kw = dict(op=op, process_set=process_set,
+                       compression=compression, algorithm=algorithm,
+                       overlap_chunks=overlap_chunks)
+        idxs = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+
+        def tapped_fun(*args, **kwargs):
+            args = list(args)
+            for i in idxs:
+                args[i] = _overlap.tap_params(args[i], **sync_kw)
+            return fun(*args, **kwargs)
+
+        gfun = jax.grad(tapped_fun, argnums=argnums, **gradkw)
+
+        def wrapped(*args, **kwargs):
+            g = gfun(*args, **kwargs)
+            # The taps already synchronized every group; only telemetry
+            # remains.
+            _maybe_record_grad_norm(g)
+            return g
+        return wrapped
+
     gfun = jax.grad(fun, argnums=argnums, **gradkw)
 
     def wrapped(*args, **kwargs):
         g = gfun(*args, **kwargs)
         return allreduce_gradients(g, op=op, process_set=process_set,
-                                   compression=compression)
+                                   compression=compression,
+                                   algorithm=algorithm,
+                                   overlap_chunks=overlap_chunks)
     return wrapped
 
 

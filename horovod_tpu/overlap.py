@@ -1,0 +1,305 @@
+"""Overlapped gradient synchronization: algorithm selection, chunked
+reduce-scatter + all-gather pipelines, and latency-hiding scheduling.
+
+The reference Horovod's whole reason to exist is hiding communication
+behind backward compute (``controller.cc`` cycle-time batching). This
+module is that layer for the TPU rebuild, in the three places XLA gives
+us leverage:
+
+* **Algorithm selection** (:func:`resolve_algorithm`): every allreduce
+  bucket can lower to the latency-optimal single ``psum`` or to a
+  bandwidth-optimal reduce-scatter + all-gather decomposition
+  (``lax.psum_scatter`` + ``lax.all_gather`` — the classic
+  2(n-1)/n-traffic ring split; PAPERS.md "Swing", and the RS+AG shape
+  ``optimizer_sharded.py`` already proves out for the weight update).
+  ``auto`` picks per bucket by size: small buckets keep the one-op psum,
+  large buckets take RS+AG, the largest take the **chunked** pipeline.
+* **Chunked pipelining** (:func:`chunked_rs_ag_psum`): a big bucket is
+  split into K chunks whose reduce-scatters are issue-ordered with
+  ``lax.optimization_barrier`` so XLA can run chunk i's all-gather
+  concurrently with chunk i+1's reduce-scatter (and with surrounding
+  compute once the latency-hiding scheduler is on).
+* **Backward taps** (:func:`make_grad_sync_tap` / :func:`tap_params`):
+  ``custom_vjp`` identities on parameter groups whose backward rule
+  allreduces the incoming cotangent — collectives are issued *inside*
+  the backward in reverse-production order (last layer's grads first)
+  instead of after one barrier at the end, which is exactly the overlap
+  the reference's ready-ordering machinery bought on GPUs.
+
+:func:`enable_latency_hiding` wires the XLA flags
+(``--xla_tpu_enable_latency_hiding_scheduler`` + async collectives) that
+let the compiler actually interleave those collectives with compute;
+``core.init`` calls it under ``HOROVOD_XLA_LATENCY_HIDING``.
+
+Everything here is trace-time: sizes are static python ints, so
+selection/chunking never fragments the compile cache beyond the knobs
+the user actually turned.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu import metrics as _metrics
+
+__all__ = [
+    "ALGORITHMS", "resolve_algorithm", "rs_ag_psum", "chunked_rs_ag_psum",
+    "make_grad_sync_tap", "tap_params", "enable_latency_hiding",
+    "RS_AG_MIN_BYTES", "CHUNKED_MIN_BYTES",
+]
+
+log = logging.getLogger("horovod_tpu")
+
+#: the ``algorithm=`` axis of ``hvd.allreduce``
+ALGORITHMS = ("auto", "psum", "rs_ag", "chunked_rs_ag")
+
+# auto-selection size cutoffs, per fusion bucket. Below RS_AG_MIN the
+# single psum's one-collective latency wins; above it the ring
+# decomposition's 2(n-1)/n bandwidth optimality takes over; above
+# CHUNKED_MIN the bucket is big enough that splitting it into pipelined
+# chunks buys overlap worth the extra per-chunk latency. Both are
+# deliberately far above anything the CPU test meshes reduce, so `auto`
+# keeps bit-identical psum lowerings there.
+RS_AG_MIN_BYTES = 4 * 1024 * 1024
+CHUNKED_MIN_BYTES = 32 * 1024 * 1024
+
+#: default chunk count for ``chunked_rs_ag`` (HOROVOD_OVERLAP_CHUNKS)
+DEFAULT_CHUNKS = 4
+
+
+def resolve_algorithm(requested: str, nbytes: int, op: int, world: int,
+                      reducible: bool) -> str:
+    """Resolve the per-bucket algorithm.
+
+    ``requested`` is the user/config choice (one of :data:`ALGORITHMS`);
+    ``nbytes`` the static bucket size; ``reducible`` whether the op has
+    an RS+AG decomposition at all (Sum/Average do; Min/Max/Product/
+    Adasum pass through to their existing lowerings — requesting
+    ``rs_ag`` for an Adasum allreduce is a no-op by design, so one
+    training script can set a global algorithm without branching on op).
+    """
+    if requested not in ALGORITHMS:
+        raise ValueError(
+            f"unknown allreduce algorithm {requested!r}; expected one of "
+            f"{ALGORITHMS} (HOROVOD_ALLREDUCE_ALGORITHM)")
+    if not reducible or world <= 1:
+        return "psum"
+    if requested != "auto":
+        return requested
+    if nbytes >= CHUNKED_MIN_BYTES:
+        return "chunked_rs_ag"
+    if nbytes >= RS_AG_MIN_BYTES:
+        return "rs_ag"
+    return "psum"
+
+
+def _split_sizes(m: int, n: int, chunks: int) -> Tuple[int, int]:
+    """(per_chunk, n_chunks) for an m-element buffer reduced over n
+    devices: every chunk must be a multiple of n (psum_scatter tiles
+    dim 0 across the axis) and empty all-padding chunks are clamped
+    away."""
+    chunks = max(1, int(chunks))
+    chunks = min(chunks, max(1, -(-m // n)))      # no all-padding chunks
+    per = -(-m // chunks)                         # ceil split
+    per = -(-per // n) * n                        # round up to n-multiple
+    # per * chunks >= m by construction
+    return per, chunks
+
+
+def rs_ag_psum(x: jnp.ndarray, axis: str, world: int) -> jnp.ndarray:
+    """Bandwidth-optimal sum-allreduce of a 1-D buffer: reduce-scatter
+    then all-gather over ``axis`` (2(n-1)/n bytes per device on a ring
+    vs the fused psum's scheduler choice). Shape-preserving; padding is
+    internal."""
+    return chunked_rs_ag_psum(x, axis, world, chunks=1)
+
+
+def chunked_rs_ag_psum(x: jnp.ndarray, axis: str, world: int,
+                       chunks: int = DEFAULT_CHUNKS) -> jnp.ndarray:
+    """Sum-allreduce a 1-D buffer as ``chunks`` pipelined RS+AG pairs.
+
+    The chunk reduce-scatters are chained with
+    ``lax.optimization_barrier`` so their issue order is pinned
+    (chunk i+1's RS cannot be hoisted before chunk i's): XLA is then
+    free to overlap chunk i's all-gather — and, under the latency-hiding
+    scheduler, surrounding compute — with chunk i+1's reduce-scatter.
+    Numerically this is the same per-element sum of ``world``
+    contributions as one psum (each element is reduced exactly once, by
+    one scatter shard).
+    """
+    if x.ndim != 1:
+        raise ValueError(f"rs+ag operates on 1-D fusion buffers, got "
+                         f"shape {x.shape}")
+    m = x.shape[0]
+    if m == 0 or world <= 1:
+        return x
+    per, chunks = _split_sizes(m, world, chunks)
+    total = per * chunks
+    if total != m:
+        x = jnp.concatenate(
+            [x, jnp.zeros((total - m,), x.dtype)])
+    elem = jnp.dtype(x.dtype).itemsize
+    for i in range(chunks):
+        _metrics.histogram("allreduce_chunk_bytes",
+                           buckets=_metrics.SIZE_BUCKETS).observe(per * elem)
+    scattered = []
+    prev = None
+    for i in range(chunks):
+        piece = lax.slice(x, (i * per,), ((i + 1) * per,))
+        if prev is not None:
+            # Pin issue order: chunk i's RS result gates chunk i+1's RS
+            # input. The barrier is ordering-only — values pass through
+            # untouched — but it stops XLA from fusing every chunk into
+            # one monolithic collective, which is the whole pipeline.
+            piece, prev = lax.optimization_barrier((piece, prev))
+        s = lax.psum_scatter(piece, axis, scatter_dimension=0, tiled=True)
+        scattered.append(s)
+        prev = s
+    gathered = [lax.all_gather(s, axis, tiled=True) for s in scattered]
+    out = gathered[0] if chunks == 1 else jnp.concatenate(gathered)
+    return out if total == m else lax.slice(out, (0,), (m,))
+
+
+# ---------------------------------------------------------------------------
+# backward taps: issue collectives inside the backward pass
+# ---------------------------------------------------------------------------
+
+def make_grad_sync_tap(**allreduce_kwargs) -> Callable[[Any], Any]:
+    """Build a ``custom_vjp`` identity whose backward rule allreduces the
+    incoming cotangent (``hvd.allreduce(**allreduce_kwargs)``).
+
+    Apply it to a parameter (sub)tree *before* the forward uses it: the
+    forward is untouched, and during backward the group's gradient is
+    synchronized the moment it is produced — for the last-used group
+    that is long before the first layers finish their backward, which is
+    the latency-hiding window the reference chased with ready-ordering.
+    Outside an SPMD context the tap is a full identity (mirrors
+    ``allreduce_gradients``'s jit-auto-sharding behaviour).
+    """
+
+    @jax.custom_vjp
+    def tap(tree):
+        return tree
+
+    def fwd(tree):
+        return tree, None
+
+    def bwd(_, ct):
+        from horovod_tpu import collective as C
+        from horovod_tpu import core
+        if not core.in_spmd_context():
+            return (ct,)
+        return (C.allreduce(ct, **allreduce_kwargs),)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+def tap_params(params: Any, **allreduce_kwargs) -> Any:
+    """Tap every top-level group of ``params`` with its own gradient-sync
+    identity (:func:`make_grad_sync_tap`).
+
+    One tap per top-level child (one for a leaf/opaque tree) means one
+    independent backward collective per group, issued in reverse
+    production order by the backward pass itself — no end-of-backward
+    barrier. Used by ``hvd.grad(..., overlap=True)``.
+    """
+    if isinstance(params, dict):
+        return {k: make_grad_sync_tap(**allreduce_kwargs)(v)
+                for k, v in params.items()}
+    if isinstance(params, (list, tuple)):
+        out = [make_grad_sync_tap(**allreduce_kwargs)(v) for v in params]
+        return type(params)(out)
+    return make_grad_sync_tap(**allreduce_kwargs)(params)
+
+
+# ---------------------------------------------------------------------------
+# XLA latency-hiding scheduler wiring
+# ---------------------------------------------------------------------------
+
+#: flags that let XLA overlap async collectives with compute on TPU.
+XLA_LATENCY_HIDING_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+)
+
+
+def _backend_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _tpu_plausible() -> bool:
+    """Is this process plausibly going to create a TPU backend? The
+    ``xla_tpu_*`` flags are UNKNOWN to the CPU/GPU compilers (backend
+    creation aborts on them), so they may only be appended when a TPU
+    runtime is actually in play."""
+    plat = os.environ.get("JAX_PLATFORMS", "").lower()
+    if plat:
+        return any(p.strip() in ("tpu", "axon")
+                   for p in plat.split(","))
+    import importlib.util
+    return any(importlib.util.find_spec(m) is not None
+               for m in ("libtpu", "jax_plugins.axon"))
+
+
+def enable_latency_hiding() -> bool:
+    """Append the latency-hiding scheduler flags to ``XLA_FLAGS``.
+
+    Returns True when every flag is in place (at its enabling value) in
+    time to matter. The flags are read once, at backend creation, so
+    this must run before the first jax computation — ``core.init`` calls
+    it under ``HOROVOD_XLA_LATENCY_HIDING=1``. Refusals:
+
+    * backend already initialized: too late, warn and return False
+      (restart the process with the knob set, or put the flags in
+      ``XLA_FLAGS`` yourself);
+    * no TPU runtime in sight (``JAX_PLATFORMS`` names a non-TPU
+      backend, or is unset with no TPU plugin importable): the
+      ``xla_tpu_*`` flags are unknown to other compilers and would
+      abort backend creation, so they are skipped;
+    * a flag already set in ``XLA_FLAGS`` is respected, never
+      overridden — an explicit ``...=false`` means the user turned that
+      piece off, and the function reports False so the
+      ``config_xla_latency_hiding`` gauge stays truthful.
+    """
+    if not _tpu_plausible():
+        log.info("HOROVOD_XLA_LATENCY_HIDING set on a non-TPU run; the "
+                 "TPU scheduler flags do not apply — skipped")
+        return False
+    if _backend_initialized():
+        log.warning(
+            "HOROVOD_XLA_LATENCY_HIDING set but the XLA backend is already "
+            "initialized; flags cannot apply this process. Set XLA_FLAGS "
+            "before importing jax, or init() earlier.")
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    present = {t.split("=")[0] for t in flags.split()
+               if t.startswith("--xla")}
+    missing = [f for f in XLA_LATENCY_HIDING_FLAGS
+               if f.split("=")[0] not in present]
+    if missing:
+        os.environ["XLA_FLAGS"] = (flags + " " + " ".join(missing)).strip()
+    final = {t.split("=")[0]: (t.split("=", 1)[1] if "=" in t else "true")
+             for t in os.environ.get("XLA_FLAGS", "").split()
+             if t.startswith("--xla")}
+    applied = all(final.get(f.split("=")[0]) == f.split("=", 1)[1]
+                  for f in XLA_LATENCY_HIDING_FLAGS)
+    if not applied:
+        log.warning(
+            "HOROVOD_XLA_LATENCY_HIDING set but XLA_FLAGS already pins "
+            "part of the latency-hiding flag set to a different value; "
+            "respecting the explicit setting.")
+    return applied

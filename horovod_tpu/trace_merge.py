@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 logger = logging.getLogger("horovod_tpu")
 
 __all__ = ["merge_timelines", "discover_shards", "load_shard",
-           "straggler_report"]
+           "straggler_report", "overlap_report"]
 
 #: phase-event names (tracing.phase) that mark a collective's host phases
 PHASE_NAMES = ("NEGOTIATE", "QUEUE", "EXEC")
@@ -338,6 +338,68 @@ def straggler_report(shards: List[Dict[str, Any]],
     }
 
 
+def overlap_report(shards: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-rank collective-overlap estimate from the op-id EXEC spans.
+
+    For each rank, the EXEC phase events of positive op-ids form a set of
+    host dispatch intervals. ``sum_seconds`` is their total duration,
+    ``busy_seconds`` the duration of their union; the **overlap
+    efficiency** ``1 - busy/sum`` is the fraction of collective dispatch
+    time that ran concurrently with another collective's — 0.0 when
+    every collective was serialized (one monolithic end-of-backward
+    batch), approaching 1 - 1/k when k chunks/buckets pipeline cleanly.
+    This is a host-side *estimate* (jax dispatch is async; device
+    overlap on a real slice is read from the profiler), but it is
+    computed from the same spans on every rank, so regressions show up
+    as a drop without any TPU in the loop.
+    """
+    per_rank: Dict[str, Dict[str, float]] = {}
+    effs = []
+    for s in shards:
+        intervals = []
+        for e in s["events"]:
+            if e.get("name") != "EXEC":
+                continue
+            args = e.get("args") or {}
+            try:
+                op_id = int(args.get("op_id"))
+            except (TypeError, ValueError):
+                continue
+            if op_id <= 0:
+                continue
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+            if dur > 0:
+                intervals.append((ts, ts + dur))
+        total = sum(b - a for a, b in intervals)
+        busy = 0.0
+        intervals.sort()
+        cur_a = cur_b = None
+        for a, b in intervals:
+            if cur_b is None or a > cur_b:
+                if cur_b is not None:
+                    busy += cur_b - cur_a
+                cur_a, cur_b = a, b
+            else:
+                cur_b = max(cur_b, b)
+        if cur_b is not None:
+            busy += cur_b - cur_a
+        eff = (1.0 - busy / total) if total > 0 else 0.0
+        if len(intervals) >= 2:
+            effs.append(eff)
+        per_rank[str(s["rank"])] = {
+            "collective_exec_sum_seconds": total / 1e6,
+            "collective_exec_busy_seconds": busy / 1e6,
+            "overlap_efficiency": round(eff, 4),
+            "exec_spans": len(intervals),
+        }
+    return {
+        "by_rank": per_rank,
+        "overlap_efficiency": round(sum(effs) / len(effs), 4) if effs
+        else 0.0,
+    }
+
+
 # ---------------------------------------------------------------------------
 # merge
 # ---------------------------------------------------------------------------
@@ -408,6 +470,7 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
     merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
 
     report = straggler_report(shards, offsets, skew)
+    report["overlap"] = overlap_report(shards)
     if warnings:
         report["warnings"] = warnings
 
@@ -418,6 +481,9 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
                 _metrics.histogram("collective_arrival_spread_seconds",
                                    source="merge").observe(
                     c["spread_seconds"])
+            _metrics.gauge("overlap_efficiency_estimate",
+                           source="merge").set(
+                report["overlap"]["overlap_efficiency"])
         except Exception:
             logger.exception("trace_merge: feeding metrics failed")
 
