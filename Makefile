@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: trace-smoke overlap-smoke test native
+.PHONY: trace-smoke overlap-smoke serve-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -18,6 +18,14 @@ trace-smoke:
 # tests/test_overlap.py::TestTwoProcessSmoke.
 overlap-smoke:
 	$(PY) tools/overlap_smoke.py
+
+# Multi-replica serving smoke: 2 CPU replica processes share a request
+# spool, overlapping streaming requests land on both, one replica is
+# SIGKILLed mid-stream, and the survivor must reclaim its orphaned
+# claims (stale heartbeat) and drain the whole queue. Also runs in
+# tier-1 as tests/test_serving.py::TestTwoProcessSmoke.
+serve-smoke:
+	$(PY) tools/serve_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

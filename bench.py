@@ -644,6 +644,34 @@ def _apply_comm_flags(args):
 SWEEP_ALGS = ("psum", "rs_ag", "chunked_rs_ag")
 
 
+def _load_serve_bench():
+    """tools/serve_bench.py as a module (tools/ is not a package)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    spec = importlib.util.spec_from_file_location("hvd_serve_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def bench_serve(on_tpu):
+    """--serve: Poisson-arrival serving bench (tools/serve_bench.py) —
+    TTFT/TPOT/throughput percentiles under the continuous-batching
+    engine. Knobs via HVD_SERVE_BENCH_{REQUESTS,RATE,SLOTS} so the CPU
+    guard test stays fast without a flag zoo."""
+    sb = _load_serve_bench()
+    return sb.run_bench(
+        model_size="medium" if on_tpu else "tiny",
+        requests=int(os.environ.get(
+            "HVD_SERVE_BENCH_REQUESTS", "32" if on_tpu else "10")),
+        rate=float(os.environ.get("HVD_SERVE_BENCH_RATE", "25")),
+        slots=int(os.environ.get(
+            "HVD_SERVE_BENCH_SLOTS", "8" if on_tpu else "4")),
+        max_len=256 if on_tpu else 96,
+        metric="serve_tokens_per_sec_per_chip")
+
+
 def _inner_main(args):
     if os.environ.get("JAX_PLATFORMS"):
         # The image's sitecustomize imports jax before env vars can apply;
@@ -666,6 +694,9 @@ def _inner_main(args):
                      "mid-window); refusing to record CPU numbers under "
                      "TPU metric names"}), flush=True)
         return _RC_CPU_FALLBACK
+    if getattr(args, "serve", False):
+        bench_serve(on_tpu)
+        return
     if getattr(args, "sweep_comm", False):
         # One JSON line per allreduce algorithm for the selected model
         # (headline model when "all" was asked): hvd.init() re-reads the
@@ -786,6 +817,8 @@ def _supervise(args) -> int:
         cmd += ["--overlap-chunks", str(args.overlap_chunks)]
     if getattr(args, "sweep_comm", False):
         cmd += ["--sweep-comm"]
+    if getattr(args, "serve", False):
+        cmd += ["--serve"]
     try:
         r = subprocess.run(cmd, timeout=run_timeout)
     except subprocess.TimeoutExpired:
@@ -825,6 +858,10 @@ def _build_parser():
                    help="one JSON line per allreduce algorithm "
                         f"({', '.join(SWEEP_ALGS)}) for the selected "
                         "model")
+    p.add_argument("--serve", dest="serve", action="store_true",
+                   help="Poisson-arrival serving bench (continuous-"
+                        "batching engine): TTFT/TPOT/throughput "
+                        "percentiles as one JSON line")
     return p
 
 

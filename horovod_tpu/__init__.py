@@ -32,6 +32,9 @@ from horovod_tpu import metrics  # noqa: F401
 # chunked_rs_ag), chunked RS+AG pipelines, backward taps, latency-hiding
 # scheduler wiring (docs/PERFORMANCE.md).
 from horovod_tpu import overlap  # noqa: F401
+# Continuous-batching inference: hvd.serving.InferenceEngine (paged KV
+# cache, request scheduler, multi-replica dispatch — docs/SERVING.md).
+from horovod_tpu import serving  # noqa: F401
 from horovod_tpu.metrics import reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,

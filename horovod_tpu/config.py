@@ -88,6 +88,21 @@ class Config:
     # The same knobs gate metrics.StallWatchdog (auto-started by init()).
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
+    # Serving subsystem (serving/, docs/SERVING.md): HOROVOD_SERVE_SLOTS
+    # decode lanes per engine, HOROVOD_SERVE_MAX_LEN max prompt+output
+    # tokens, HOROVOD_SERVE_BLOCK_SIZE tokens per paged-KV block,
+    # HOROVOD_SERVE_QUEUE_LIMIT backpressure bound,
+    # HOROVOD_SERVE_PREFILL_CHUNK prompt tokens per interleaved prefill
+    # dispatch (1 = pure token-level interleaving, no second program),
+    # HOROVOD_SERVE_KV_QUANT in {"", "int8", "fp8"} for 1-byte KV blocks,
+    # HOROVOD_SERVE_HEARTBEAT replica liveness period (replica.py).
+    serve_slots: int = 8
+    serve_max_len: int = 512
+    serve_block_size: int = 16
+    serve_queue_limit: int = 128
+    serve_prefill_chunk: int = 8
+    serve_kv_quant: str = ""
+    serve_heartbeat_seconds: float = 2.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Subset-barrier wait (collective.barrier on a process set); its own
@@ -143,6 +158,29 @@ def _env_chunks() -> int:
     return n
 
 
+def _env_posint(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r}: expected a positive integer")
+    if n < 1:
+        raise ValueError(f"{name}={n}: must be >= 1")
+    return n
+
+
+def _env_kv_quant() -> str:
+    v = os.environ.get("HOROVOD_SERVE_KV_QUANT", "").strip().lower()
+    if v in ("", "none", "off", "0"):
+        return ""
+    if v not in ("int8", "fp8"):
+        raise ValueError(f"HOROVOD_SERVE_KV_QUANT={v!r}: expected "
+                         f"'int8', 'fp8', or unset")
+    return v
+
+
 def refresh() -> Config:
     """Re-read ``HOROVOD_*`` from the environment (called by ``init()``)."""
     global _CONFIG
@@ -168,6 +206,14 @@ def refresh() -> Config:
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+        serve_slots=_env_posint("HOROVOD_SERVE_SLOTS", 8),
+        serve_max_len=_env_posint("HOROVOD_SERVE_MAX_LEN", 512),
+        serve_block_size=_env_posint("HOROVOD_SERVE_BLOCK_SIZE", 16),
+        serve_queue_limit=_env_posint("HOROVOD_SERVE_QUEUE_LIMIT", 128),
+        serve_prefill_chunk=_env_posint("HOROVOD_SERVE_PREFILL_CHUNK", 8),
+        serve_kv_quant=_env_kv_quant(),
+        serve_heartbeat_seconds=max(
+            0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         barrier_timeout_seconds=max(
             1.0, _env_float("HOROVOD_BARRIER_TIMEOUT", 600.0)),

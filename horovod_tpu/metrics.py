@@ -389,6 +389,16 @@ def collective_summary() -> Dict[str, Dict[str, Any]]:
 
 _FLUSHER_LOCK = threading.Lock()
 _FLUSHER: Optional["_Flusher"] = None
+_ATEXIT_REGISTERED = False
+
+
+def _drain_flusher_at_exit() -> None:
+    """Interpreter-exit drain: short-lived processes (serving replicas,
+    one-shot bench runs) that never call ``hvd.shutdown()`` must still
+    land their FINAL snapshot — without this, a process whose lifetime
+    is shorter than ``HOROVOD_METRICS_INTERVAL`` exports nothing at
+    all. Mirrors the timeline's atexit flush (``timeline.init_timeline``)."""
+    stop_metrics_flusher(final_write=True)
 
 
 class _Flusher:
@@ -453,6 +463,7 @@ def start_metrics_flusher(path: Optional[str] = None,
             path = f"{root}.r{jax.process_index()}{ext}"
     except Exception:
         pass
+    global _ATEXIT_REGISTERED
     with _FLUSHER_LOCK:
         if _FLUSHER is not None:
             if (_FLUSHER.path == path
@@ -460,6 +471,10 @@ def start_metrics_flusher(path: Optional[str] = None,
                 return
             _FLUSHER.stop(final_write=False)
         _FLUSHER = _Flusher(path, interval_s)
+        if not _ATEXIT_REGISTERED:
+            import atexit
+            atexit.register(_drain_flusher_at_exit)
+            _ATEXIT_REGISTERED = True
 
 
 def stop_metrics_flusher(final_write: bool = True) -> None:

@@ -24,16 +24,28 @@ at every boundary, which is what makes greedy decode-vs-forward parity
 hold bit-for-bit instead of drifting by reduction-order noise. The
 residual near-ties are closed by :func:`greedy_token`'s deterministic
 tolerance tie-break.
+
+The per-family step functions and cache allocators are exposed through a
+registry (:func:`decode_step` / :func:`init_cache` / :func:`decode_family`)
+shared by :func:`generate` here and the continuous-batching serving engine
+(``horovod_tpu.serving``): one decode program, two drivers. Steps accept
+either the plain dense cache dict (scalar position — the ``generate()``
+scan) or any object implementing the small KV-cache protocol
+(``update(layer, k, v, pos) -> (cache, ck, cv)``) with per-row ``(B,)``
+positions — what the serving engine's paged cache plugs in.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "t5_generate", "greedy_token"]
+__all__ = ["generate", "t5_generate", "greedy_token",
+           "decode_step", "init_cache", "decode_family", "DecodeFamily",
+           "DenseKVCache", "t5_decoder_bias", "t5_encode"]
 
 
 def _layernorm(x, p, eps):
@@ -56,8 +68,69 @@ def _rmsnorm(x, p, eps):
     return (y * p["scale"]).astype(x.dtype)
 
 
+class DenseKVCache:
+    """The plain dense cache as a protocol object: a pytree over the
+    ``{layer: {"k","v"}}`` dict :func:`init_cache` allocates. ``update``
+    keeps the scalar-position path on ``dynamic_update_index_in_dim``
+    (what ``generate()``'s scan compiled since PR 3 — a dynamic-update-
+    slice XLA aliases in place) and uses a per-row scatter only for
+    ``(B,)`` vector positions."""
+
+    __slots__ = ("layers",)
+
+    def __init__(self, layers):
+        self.layers = layers
+
+    def update(self, layer, k, v, pos):
+        ent = self.layers[layer]
+        if jnp.ndim(pos) == 0:
+            ck = jax.lax.dynamic_update_index_in_dim(ent["k"], k, pos,
+                                                     axis=1)
+            cv = jax.lax.dynamic_update_index_in_dim(ent["v"], v, pos,
+                                                     axis=1)
+        else:
+            rows = jnp.arange(k.shape[0])
+            ck = ent["k"].at[rows, pos].set(k)
+            cv = ent["v"].at[rows, pos].set(v)
+        layers = dict(self.layers)
+        layers[layer] = {"k": ck, "v": cv}
+        return DenseKVCache(layers), ck, cv
+
+    def tree_flatten(self):
+        return (self.layers,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+
+jax.tree_util.register_pytree_node_class(DenseKVCache)
+
+
+def _as_cache(cache):
+    """Accept the raw dense dict (the public scan-carry format) or any
+    protocol object; remember which so the step returns the same kind."""
+    if isinstance(cache, dict):
+        return DenseKVCache(cache), True
+    return cache, False
+
+
+def _key_mask(t, pos, lead_dims):
+    """(..., t) bool: key position <= query position. ``pos`` scalar
+    broadcasts everywhere; ``(B,)`` positions get ``lead_dims`` singleton
+    axes between batch and keys (per-slot masks for the serving engine's
+    mixed-progress lanes)."""
+    ar = jnp.arange(t)
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        return ar <= pos
+    return ar[(None,) * (lead_dims + 1)] <= \
+        pos[(slice(None),) + (None,) * (lead_dims + 1)]
+
+
 def _attend_cached(q, ck, cv, idx, scale):
-    """One query (B, H, hd) over a cache (B, T, Hkv, hd), keys <= idx.
+    """One query (B, H, hd) over a cache (B, T, Hkv, hd), keys <= idx
+    (``idx`` scalar, or ``(B,)`` per-row positions).
 
     GQA stays grouped end-to-end: the cache is stored at Hkv width (the
     whole point of grouped heads — H/Hkv times less KV memory) and the
@@ -71,14 +144,19 @@ def _attend_cached(q, ck, cv, idx, scale):
     qg = q.reshape(b, hkv, h // hkv, hd)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, ck).astype(jnp.float32) * scale
     t = ck.shape[1]
-    s = jnp.where(jnp.arange(t)[None, None, None, :] <= idx, s, -1e30)
+    s = jnp.where(_key_mask(t, idx, 2), s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     o = jnp.einsum("bkgt,btkd->bkgd", p, cv)
     return o.reshape(b, h, hd)
 
 
 def _gpt2_step(cfg, params, cache, tok, idx):
-    """tok (B,) at position idx -> (new_cache, logits (B, V))."""
+    """tok (B,) at position idx -> (new_cache, logits (B, V)).
+
+    ``idx`` is a scalar (all rows at one position — the ``generate()``
+    scan) or ``(B,)`` per-row positions (the serving engine's lanes);
+    ``cache`` is the dense dict or any KV-cache protocol object."""
+    cache, raw = _as_cache(cache)
     dt = cfg.dtype
     H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
     x = params["wte"][tok].astype(dt) + params["wpe"][idx].astype(dt)
@@ -88,10 +166,8 @@ def _gpt2_step(cfg, params, cache, tok, idx):
         qkv = h @ p["attn"]["qkv"]["kernel"].astype(dt) \
             + p["attn"]["qkv"]["bias"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["k"], k.reshape(-1, H, hd), idx, axis=1)
-        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["v"], v.reshape(-1, H, hd), idx, axis=1)
+        cache, ck, cv = cache.update(i, k.reshape(-1, H, hd),
+                                     v.reshape(-1, H, hd), idx)
         o = _attend_cached(q.reshape(-1, H, hd), ck, cv, idx, hd ** -0.5)
         x = x + (o.reshape(-1, H * hd) @ p["attn"]["out"]["kernel"]
                  .astype(dt) + p["attn"]["out"]["bias"].astype(dt))
@@ -101,18 +177,23 @@ def _gpt2_step(cfg, params, cache, tok, idx):
         x = x + (h @ p["mlp"]["proj"]["kernel"].astype(dt)
                  + p["mlp"]["proj"]["bias"].astype(dt))
     x = _layernorm(x, params["ln_f"], cfg.ln_eps)        # fp32
-    return cache, x @ params["wte"].T                    # tied head, fp32
+    return (cache.layers if raw else cache), \
+        x @ params["wte"].T                              # tied head, fp32
 
 
 def _rope_one(x, pos, theta):
-    """RoPE for a single position: x (B, H, hd) — THE training rotation
-    (``models.llama.apply_rope``) on a length-1 sequence, so decode can
-    never drift from the training convention."""
+    """RoPE for a single position per row: x (B, H, hd) — THE training
+    rotation (``models.llama.apply_rope``) on a length-1 sequence, so
+    decode can never drift from the training convention. Scalar ``pos``
+    rotates every row alike; ``(B,)`` rotates per row (serving lanes)."""
     from horovod_tpu.models.llama import apply_rope
-    return apply_rope(x[:, None], jnp.atleast_1d(pos), theta)[:, 0]
+    pos = jnp.asarray(pos)
+    pos = pos[:, None] if pos.ndim else pos[None]
+    return apply_rope(x[:, None], pos, theta)[:, 0]
 
 
 def _llama_step(cfg, params, cache, tok, idx):
+    cache, raw = _as_cache(cache)
     dt = cfg.dtype
     H, Hkv = cfg.num_heads, cfg.num_kv_heads
     hd = cfg.d_model // H
@@ -125,10 +206,7 @@ def _llama_step(cfg, params, cache, tok, idx):
         v = (h @ p["attn"]["wv"]["kernel"].astype(dt)).reshape(-1, Hkv, hd)
         q = _rope_one(q, idx, cfg.rope_theta)
         k = _rope_one(k, idx, cfg.rope_theta)
-        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["k"], k, idx, axis=1)
-        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["v"], v, idx, axis=1)
+        cache, ck, cv = cache.update(i, k, v, idx)
         o = _attend_cached(q, ck, cv, idx, hd ** -0.5)
         x = x + o.reshape(-1, H * hd) @ p["attn"]["wo"]["kernel"].astype(dt)
         h = _rmsnorm(x, p["norm_mlp"], cfg.rms_eps)
@@ -136,7 +214,8 @@ def _llama_step(cfg, params, cache, tok, idx):
         u = h @ p["mlp"]["up"]["kernel"].astype(dt)
         x = x + (g * u) @ p["mlp"]["down"]["kernel"].astype(dt)
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
-    return cache, x.astype(jnp.float32) @ params["lm_head"].T  # untied head
+    return (cache.layers if raw else cache), \
+        x.astype(jnp.float32) @ params["lm_head"].T      # untied head
 
 
 def _t5_encode(model, cfg, params, src, src_mask):
@@ -162,7 +241,9 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
     """One decoder token against the self-attn cache + fixed cross K/V.
 
     ``dec_bias_tbl`` is the (T_dec, H, T_dec) causal rel-bias tensor
-    precomputed outside the scan; row ``idx`` biases this query."""
+    precomputed outside the scan; row ``idx`` biases this query (per-row
+    rows when ``idx`` is ``(B,)``)."""
+    cache, raw = _as_cache(cache)
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
     x = params["embedding"][tok].astype(dt)               # (B, D)
@@ -175,17 +256,17 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
             .reshape(-1, H, hd)
         v = (h @ p["self_attn"]["v"]["kernel"].astype(dt)) \
             .reshape(-1, H, hd)
-        ck = cache[i]["k"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["k"], k, idx, axis=1)
-        cv = cache[i]["v"] = jax.lax.dynamic_update_index_in_dim(
-            cache[i]["v"], v, idx, axis=1)
+        cache, ck, cv = cache.update(i, k, v, idx)
         # T5: no 1/sqrt scaling; additive causal rel bias for this row.
-        b = jax.lax.dynamic_index_in_dim(dec_bias_tbl, idx, axis=0,
-                                         keepdims=False)   # (H, T_dec)
-        s = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32) \
-            + b[None]
+        if jnp.ndim(idx) == 0:
+            b = jax.lax.dynamic_index_in_dim(
+                dec_bias_tbl, idx, axis=0, keepdims=False)[None]
+        else:                                 # (B,) rows -> (B, H, T_tbl)
+            b = dec_bias_tbl[idx]
         t = ck.shape[1]
-        s = jnp.where(jnp.arange(t)[None, None, :] <= idx, s, -1e30)
+        s = jnp.einsum("bhd,bthd->bht", q, ck).astype(jnp.float32) \
+            + b[..., :t]
+        s = jnp.where(_key_mask(t, idx, 1), s, -1e30)
         a = jax.nn.softmax(s, -1).astype(dt)
         o = jnp.einsum("bht,bthd->bhd", a, cv)
         x = x + o.reshape(-1, H * hd) \
@@ -210,7 +291,30 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
         u = h @ p["mlp"]["wi_1"]["kernel"].astype(dt)
         x = x + (g * u) @ p["mlp"]["wo"]["kernel"].astype(dt)
     x = _rmsnorm(x, params["dec_norm"], 1e-6)
-    return cache, x.astype(jnp.float32) @ params["lm_head"].T
+    return (cache.layers if raw else cache), \
+        x.astype(jnp.float32) @ params["lm_head"].T
+
+
+def t5_encode(model: Any, cfg, params, src, src_mask):
+    """Public name for the one-shot encoder + cross-attention K/V pass
+    (:func:`_t5_encode`): the serving engine runs this once per admitted
+    request and scatters the rows into its per-slot cross buffers."""
+    return _t5_encode(model, cfg, params, src, src_mask)
+
+
+def t5_decoder_bias(cfg, params, t_dec: int) -> jnp.ndarray:
+    """The (T_dec, H, T_dec) causal relative-position bias tensor the
+    decoder self-attention adds — precomputed once per generation (and
+    once per engine at its ``max_len``: the bucketing depends only on
+    relative offsets, so row ``idx`` of a larger table equals row ``idx``
+    of a smaller one wherever the key mask admits)."""
+    from horovod_tpu.models.t5 import relative_position_bucket
+    rel = jnp.arange(t_dec)[None, :] - jnp.arange(t_dec)[:, None]
+    buckets = relative_position_bucket(
+        rel, bidirectional=False, num_buckets=cfg.rel_buckets,
+        max_distance=cfg.rel_max_distance)
+    dec_bias = params["dec_rel"]["rel_bias"][buckets]     # (T, T, H)
+    return dec_bias.transpose(0, 2, 1)                    # (Tq, H, Tk)
 
 
 def t5_generate(model: Any, params: Any, src: jnp.ndarray,
@@ -225,7 +329,7 @@ def t5_generate(model: Any, params: Any, src: jnp.ndarray,
     decoder starts from T5's pad/start token and scans with a cached
     self-attention. Sampling controls as :func:`generate`.
     """
-    from horovod_tpu.models.t5 import T5, relative_position_bucket
+    from horovod_tpu.models.t5 import T5
     if not isinstance(model, T5):
         raise TypeError(f"t5_generate needs a T5 model, got "
                         f"{type(model).__name__}")
@@ -248,18 +352,9 @@ def t5_generate(model: Any, params: Any, src: jnp.ndarray,
     cross = _t5_encode(model, cfg, params, src, src_mask)
 
     T_dec = int(max_new_tokens)
-    rel = jnp.arange(T_dec)[None, :] - jnp.arange(T_dec)[:, None]
-    buckets = relative_position_bucket(
-        rel, bidirectional=False, num_buckets=cfg.rel_buckets,
-        max_distance=cfg.rel_max_distance)
-    dec_bias = params["dec_rel"]["rel_bias"][buckets]     # (T, T, H)
-    dec_bias = dec_bias.transpose(0, 2, 1)                # (Tq, H, Tk)
+    dec_bias = t5_decoder_bias(cfg, params, T_dec)
 
-    cache = {i: {"k": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
-                                cfg.dtype),
-                 "v": jnp.zeros((B, T_dec, cfg.num_heads, cfg.head_dim),
-                                cfg.dtype)}
-             for i in range(cfg.num_decoder_layers)}
+    cache = init_cache(cfg, B, T_dec)
     keys = (jax.random.split(rng, T_dec) if rng is not None
             else jnp.zeros((T_dec, 2), jnp.uint32))
 
@@ -279,21 +374,122 @@ def t5_generate(model: Any, params: Any, src: jnp.ndarray,
     return out.T
 
 
+# ---------------------------------------------------------------------------
+# decode-step registry: one decode program per family, two drivers
+# (``generate()`` here, the continuous-batching engine in
+# ``horovod_tpu.serving``) — the factoring that keeps engine output
+# token-identical to offline generation by construction.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DecodeFamily:
+    """One family's decode surface: the per-token step plus the cache
+    geometry (layers x kv-heads x head-dim) both drivers allocate from.
+
+    ``step(cfg, params, cache, tok, pos, extras=None)`` advances every
+    row one token: ``cache`` is the dense dict or a protocol object,
+    ``pos`` a scalar or ``(B,)``, ``extras`` family side-state (T5's
+    cross K/V + source mask + bias table; ``None`` for decoder-only).
+    """
+
+    name: str
+    step: Callable[..., Tuple[Any, jnp.ndarray]]
+    num_layers: Callable[[Any], int]
+    kv_heads: Callable[[Any], int]
+    head_dim: Callable[[Any], int]
+    validate: Callable[[Any], None]
+
+
+def _reject_moe(cfg) -> None:
+    if getattr(cfg, "num_experts", 0) > 0:
+        raise NotImplementedError(
+            "generate() does not decode MoE configs yet")
+
+
+def _gpt2_entry(cfg, params, cache, tok, pos, extras=None):
+    return _gpt2_step(cfg, params, cache, tok, pos)
+
+
+def _llama_entry(cfg, params, cache, tok, pos, extras=None):
+    return _llama_step(cfg, params, cache, tok, pos)
+
+
+def _t5_entry(cfg, params, cache, tok, pos, extras=None):
+    if extras is None:
+        raise ValueError("the T5 decode step needs extras= with "
+                         "{'cross', 'src_mask', 'dec_bias'}")
+    return _t5_step(cfg, params, cache, extras["cross"],
+                    extras["src_mask"], extras["dec_bias"], tok, pos)
+
+
+_FAMILIES = {
+    "GPT2Config": DecodeFamily(
+        name="gpt2", step=_gpt2_entry,
+        num_layers=lambda c: c.num_layers,
+        kv_heads=lambda c: c.num_heads,
+        head_dim=lambda c: c.d_model // c.num_heads,
+        validate=_reject_moe),
+    "LlamaConfig": DecodeFamily(
+        name="llama", step=_llama_entry,
+        num_layers=lambda c: c.num_layers,
+        kv_heads=lambda c: c.num_kv_heads,
+        head_dim=lambda c: c.d_model // c.num_heads,
+        validate=_reject_moe),
+    "T5Config": DecodeFamily(
+        name="t5", step=_t5_entry,
+        num_layers=lambda c: c.num_decoder_layers,
+        kv_heads=lambda c: c.num_heads,
+        head_dim=lambda c: c.head_dim,
+        validate=lambda c: None),
+}
+
+
+def decode_family(cfg) -> DecodeFamily:
+    """The :class:`DecodeFamily` for a model config (by config type)."""
+    fam = _FAMILIES.get(type(cfg).__name__)
+    if fam is None:
+        raise TypeError(
+            f"no decode family registered for {type(cfg).__name__}; "
+            f"known: {sorted(_FAMILIES)}")
+    return fam
+
+
+def decode_step(cfg) -> Callable[..., Tuple[Any, jnp.ndarray]]:
+    """``(params, cache, tok, pos, extras=None) -> (cache, logits)`` —
+    the family's per-token decode step bound to ``cfg``."""
+    fam = decode_family(cfg)
+    fam.validate(cfg)
+
+    def step(params, cache, tok, pos, extras=None):
+        return fam.step(cfg, params, cache, tok, pos, extras)
+
+    return step
+
+
+def init_cache(cfg, batch: int, total_len: int):
+    """The dense KV cache both drivers' shapes derive from: one K and one
+    V of ``(B, T, kv_heads, head_dim)`` per layer, in the model's compute
+    dtype (GQA caches stay at kv width — the memory saving grouped heads
+    exist for)."""
+    fam = decode_family(cfg)
+    kv, hd = fam.kv_heads(cfg), fam.head_dim(cfg)
+    return {i: {"k": jnp.zeros((batch, total_len, kv, hd), cfg.dtype),
+                "v": jnp.zeros((batch, total_len, kv, hd), cfg.dtype)}
+            for i in range(fam.num_layers(cfg))}
+
+
 def _step_fn(model):
     from horovod_tpu.models.gpt2 import GPT2
     from horovod_tpu.models.llama import Llama
     if isinstance(model, Llama):
-        if model.cfg.num_experts > 0:
-            raise NotImplementedError(
-                "generate() does not decode MoE configs yet")
-        return _llama_step, model.cfg.num_kv_heads
-    if isinstance(model, GPT2):
-        if model.cfg.num_experts > 0:
-            raise NotImplementedError(
-                "generate() does not decode MoE configs yet")
-        return _gpt2_step, model.cfg.num_heads
-    raise TypeError(f"generate() supports GPT2 and Llama models, got "
-                    f"{type(model).__name__}")
+        fam = _FAMILIES["LlamaConfig"]
+    elif isinstance(model, GPT2):
+        fam = _FAMILIES["GPT2Config"]
+    else:
+        raise TypeError(f"generate() supports GPT2 and Llama models, got "
+                        f"{type(model).__name__}")
+    fam.validate(model.cfg)
+    return fam, fam.kv_heads(model.cfg)
 
 
 def greedy_token(logits, rel_tol: float = 1e-5):
@@ -337,7 +533,8 @@ def generate(model: Any, params: Any, prompt: jnp.ndarray,
     chase). ``temperature=0`` is greedy; ``eos_id`` freezes a row once
     it samples EOS (further positions repeat ``eos_id``).
     """
-    step, kv_heads = _step_fn(model)
+    fam, _ = _step_fn(model)
+    step = fam.step
     cfg = model.cfg
     # Converted checkpoints arrive as numpy trees; decode indexes tables
     # with traced token ids, which needs device arrays.
@@ -357,13 +554,7 @@ def generate(model: Any, params: Any, prompt: jnp.ndarray,
     if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
         raise ValueError(f"top_k must be in [1, vocab_size="
                          f"{cfg.vocab_size}], got {top_k}")
-    hd = cfg.d_model // cfg.num_heads
-    # GQA caches stay at kv width — the memory saving grouped heads
-    # exist for (kv_heads == num_heads for GPT-2/MHA) — and in the
-    # model's compute dtype, like the training K/V they mirror.
-    cache = {i: {"k": jnp.zeros((B, total, kv_heads, hd), cfg.dtype),
-                 "v": jnp.zeros((B, total, kv_heads, hd), cfg.dtype)}
-             for i in range(cfg.num_layers)}
+    cache = init_cache(cfg, B, total)
     prompt = prompt.astype(jnp.int32)
     keys = (jax.random.split(rng, total) if rng is not None
             else jnp.zeros((total, 2), jnp.uint32))

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["quantized_allreduce", "BLOCK", "WIRE_FORMATS"]
+__all__ = ["quantized_allreduce", "quantize_blocks", "dequantize_blocks",
+           "BLOCK", "WIRE_FORMATS"]
 
 # Elements sharing one quantization scale. Must divide the padded chunk.
 BLOCK = 256
@@ -50,15 +51,21 @@ _F8 = jnp.float8_e4m3fn
 _F8_MAX = 448.0
 
 
-def _blockify(x: jnp.ndarray):
+def _blockify(x: jnp.ndarray, block: int = BLOCK):
     shape = x.shape
-    return x.reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK)), shape
+    return x.reshape(shape[:-1] + (shape[-1] // block, block)), shape
 
 
-def _quantize_blocks(x: jnp.ndarray, wire: str = "int8"):
-    """(..., L) with L % BLOCK == 0 -> (1-byte (..., L), scales
-    (..., L/BLOCK)) using symmetric per-block max-abs scales."""
-    blocks, shape = _blockify(x)
+def quantize_blocks(x: jnp.ndarray, wire: str = "int8",
+                    block: int = BLOCK):
+    """(..., L) with L % block == 0 -> (1-byte (..., L), scales
+    (..., L/block)) using symmetric per-block max-abs scales.
+
+    ``block`` defaults to the wire-format granularity the quantized
+    allreduce ships (one fp32 scale per 256 values); other consumers pick
+    their own natural block — the paged KV cache (``serving/cache.py``)
+    quantizes per (token, head) vector, i.e. ``block=head_dim``."""
+    blocks, shape = _blockify(x, block)
     absmax = jnp.max(jnp.abs(blocks), axis=-1)
     if wire == "int8":
         # Same derived-scale floor as fp8 below: absmax/127 must be a
@@ -85,11 +92,18 @@ def _quantize_blocks(x: jnp.ndarray, wire: str = "int8"):
     return q.reshape(shape), scale
 
 
-def _dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+def dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray,
+                      block: int = BLOCK) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blocks` (fp32 out)."""
     shape = q.shape
     blocks = q.astype(jnp.float32).reshape(
-        shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+        shape[:-1] + (shape[-1] // block, block))
     return (blocks * scale[..., None]).reshape(shape)
+
+
+# The allreduce below predates the public names; keep its call sites.
+_quantize_blocks = quantize_blocks
+_dequantize_blocks = dequantize_blocks
 
 
 def quantized_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,

@@ -1,0 +1,45 @@
+"""hvd.serving — continuous-batching inference over the decode registry.
+
+The training side of this repo ends at ``models/generate.py``: offline,
+fixed-batch, dense-cache generation. This package is the online half the
+ROADMAP's "heavy traffic from millions of users" north star needs:
+
+* :class:`~horovod_tpu.serving.engine.InferenceEngine` — a fixed-shape
+  pool of ``slots`` decode lanes stepped by ONE jitted program; finished
+  requests are evicted and queued requests admitted *between* steps
+  (static shapes, per-slot positions, masked dead lanes — no recompile),
+  with prefill chunked and interleaved against decode.
+* :class:`~horovod_tpu.serving.cache.PagedKVCache` — per-slot block
+  tables over a shared block pool, so KV memory scales with *live
+  tokens* instead of ``slots x max_len``; optional int8/fp8 block
+  quantization rides :mod:`horovod_tpu.ops.quantized` (EQuARX-style
+  per-block scales).
+* :class:`~horovod_tpu.serving.scheduler.RequestQueue` — FCFS+priority
+  admission with per-request deadlines and bounded-queue backpressure
+  (reject-with-reason, never silent drops).
+* :mod:`~horovod_tpu.serving.replica` — one engine per rank with
+  least-queue-depth dispatch and heartbeat-based failover: a lost
+  replica's claimed requests are reclaimed and drained by survivors
+  (the availability playbook of "Highly Available Data Parallel ML
+  training on Mesh Networks", PAPERS.md).
+
+Observability is wired through PRs 1–2: TTFT/TPOT/queue-wait histograms,
+slot-occupancy and queue-depth gauges, per-request timeline markers, and
+stall-watchdog coverage of stuck decode steps. See docs/SERVING.md.
+"""
+
+from horovod_tpu.serving.cache import BlockManager, PagedKVCache  # noqa: F401
+from horovod_tpu.serving.engine import InferenceEngine  # noqa: F401
+from horovod_tpu.serving.scheduler import (  # noqa: F401
+    Request, RequestQueue, RequestStatus, SlotPool,
+)
+from horovod_tpu.serving.replica import (  # noqa: F401
+    Dispatcher, ReplicaServer, submit_file_request, wait_file_result,
+)
+
+__all__ = [
+    "InferenceEngine", "PagedKVCache", "BlockManager",
+    "Request", "RequestQueue", "RequestStatus", "SlotPool",
+    "Dispatcher", "ReplicaServer", "submit_file_request",
+    "wait_file_result",
+]

@@ -1,0 +1,348 @@
+"""Block/paged KV-cache: per-slot block tables over a shared pool.
+
+The dense cache ``models/generate.py`` allocates is ``(B, T_max)`` per
+layer whether a lane holds a 2000-token conversation or a 12-token
+one-liner; under continuous batching that makes KV memory the product of
+the *worst cases*. Here the cache is a pool of fixed-size blocks
+(``block_size`` tokens each) shared by every slot, with a per-slot block
+table mapping logical position ``t`` to pool block ``table[slot, t //
+block_size]`` — the vLLM PagedAttention layout, reduced to what a
+jit-stable engine needs:
+
+* **Device half** (:class:`PagedKVCache`, a pytree): the K/V pools
+  ``(L, N, block_size, Hkv, hd)``, the block table ``(slots,
+  max_blocks)``, and an ``active`` lane mask. ``update()`` implements
+  the decode registry's cache protocol (``models/generate.py``), so the
+  SAME per-family step functions run against dense or paged storage:
+  writes scatter through the table, reads gather the table-ordered view.
+  Dead lanes are redirected to **block 0, the reserved trash block** —
+  their writes land in garbage space instead of corrupting a neighbour,
+  which is what lets the engine mask lanes without recompiling.
+* **Host half** (:class:`BlockManager`): free list, per-block refcounts,
+  slot reservations. Admission reserves a request's worst case
+  (``ceil(total_tokens / block_size)`` blocks) so a mid-flight
+  allocation can never fail; blocks are *allocated* lazily as positions
+  are actually written, so peak pool usage tracks live tokens — the
+  acceptance gauge ``serve_blocks_in_use`` stays strictly below the
+  dense ``B x T_max`` equivalent whenever requests are shorter than the
+  worst case.
+
+Table VALUES change between steps (host-side admit/evict); table SHAPE
+never does — so the jitted step never recompiles.
+
+Optional 1-byte storage: ``quant="int8"`` / ``"fp8"`` stores pools in
+the EQuARX wire formats of :mod:`horovod_tpu.ops.quantized` with one
+fp32 scale per (token, head) vector (``block=head_dim`` granularity),
+quartering KV memory against fp32 (halving against bf16) at a bounded
+per-read rounding cost.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.ops.quantized import quantize_blocks, dequantize_blocks
+
+__all__ = ["PagedKVCache", "BlockManager", "TRASH_BLOCK"]
+
+#: pool block 0 is never allocated: masked-off lanes write here.
+TRASH_BLOCK = 0
+
+_QUANT_MODES = (None, "int8", "fp8")
+
+
+class PagedKVCache:
+    """Device half of the paged cache (registered pytree).
+
+    Children: ``kp``/``vp`` pools, optional ``ks``/``vs`` scale pools,
+    ``table``, ``active``. Static aux: block size, quantization mode,
+    compute dtype — so two engines with different knobs can never share
+    a stale jit cache entry.
+    """
+
+    __slots__ = ("kp", "vp", "ks", "vs", "table", "active",
+                 "block_size", "quant", "dtype")
+
+    def __init__(self, kp, vp, ks, vs, table, active, *,
+                 block_size: int, quant: Optional[str], dtype):
+        self.kp, self.vp, self.ks, self.vs = kp, vp, ks, vs
+        self.table, self.active = table, active
+        self.block_size = int(block_size)
+        self.quant = quant
+        self.dtype = dtype
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def create(cls, layers: int, kv_heads: int, head_dim: int, *,
+               slots: int, num_blocks: int, block_size: int,
+               max_blocks_per_slot: int, dtype,
+               quant: Optional[str] = None) -> "PagedKVCache":
+        if quant not in _QUANT_MODES:
+            raise ValueError(f"quant={quant!r}: expected one of "
+                             f"{_QUANT_MODES}")
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        pool = (layers, num_blocks, block_size, kv_heads, head_dim)
+        store = jnp.int8 if quant == "int8" else (
+            jnp.float8_e4m3fn if quant == "fp8" else dtype)
+        kp = jnp.zeros(pool, store)
+        vp = jnp.zeros(pool, store)
+        ks = vs = None
+        if quant:
+            scales = (layers, num_blocks, block_size, kv_heads)
+            ks = jnp.zeros(scales, jnp.float32)
+            vs = jnp.zeros(scales, jnp.float32)
+        table = jnp.zeros((slots, max_blocks_per_slot), jnp.int32)
+        active = jnp.zeros((slots,), bool)
+        return cls(kp, vp, ks, vs, table, active, block_size=block_size,
+                   quant=quant, dtype=dtype)
+
+    def replace(self, **kw) -> "PagedKVCache":
+        fields = {k: getattr(self, k) for k in self.__slots__}
+        fields.update(kw)
+        return PagedKVCache(
+            fields["kp"], fields["vp"], fields["ks"], fields["vs"],
+            fields["table"], fields["active"],
+            block_size=fields["block_size"], quant=fields["quant"],
+            dtype=fields["dtype"])
+
+    def with_active(self, active) -> "PagedKVCache":
+        return self.replace(active=active)
+
+    # -- geometry ---------------------------------------------------------
+
+    @property
+    def view_len(self) -> int:
+        """Length of the dense gather view: max_blocks * block_size."""
+        return self.table.shape[1] * self.block_size
+
+    # -- decode-registry cache protocol -----------------------------------
+
+    def update(self, layer: int, k, v, pos):
+        """Write each lane's (Hkv, hd) row at its logical ``pos`` and
+        return the table-ordered dense view — the protocol the per-family
+        decode steps consume. ``pos`` is ``(B,)`` (scalar broadcasts).
+        Masked lanes (``active == False``) write to the trash block."""
+        bs = self.block_size
+        pos = jnp.asarray(pos, jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (self.table.shape[0],))
+        rows = jnp.arange(self.table.shape[0])
+        blk = self.table[rows, jnp.clip(pos // bs, 0,
+                                        self.table.shape[1] - 1)]
+        blk = jnp.where(self.active, blk, TRASH_BLOCK)
+        off = pos % bs
+        if self.quant:
+            hd = k.shape[-1]
+            kq, ksc = quantize_blocks(k.astype(jnp.float32),
+                                      wire=self.quant, block=hd)
+            vq, vsc = quantize_blocks(v.astype(jnp.float32),
+                                      wire=self.quant, block=hd)
+            kp = self.kp.at[layer, blk, off].set(kq.astype(self.kp.dtype))
+            vp = self.vp.at[layer, blk, off].set(vq.astype(self.vp.dtype))
+            ks = self.ks.at[layer, blk, off].set(ksc[..., 0])
+            vs = self.vs.at[layer, blk, off].set(vsc[..., 0])
+            new = self.replace(kp=kp, vp=vp, ks=ks, vs=vs)
+        else:
+            kp = self.kp.at[layer, blk, off].set(k.astype(self.kp.dtype))
+            vp = self.vp.at[layer, blk, off].set(v.astype(self.vp.dtype))
+            new = self.replace(kp=kp, vp=vp)
+        ck, cv = new.view(layer)
+        return new, ck, cv
+
+    def view(self, layer: int):
+        """Dense (slots, view_len, Hkv, hd) gather of one layer, ordered
+        by each slot's block table. Unmapped logical positions read the
+        trash block — the attention key mask (key <= pos) hides them, and
+        the engine guarantees every position <= pos is mapped."""
+        bs = self.block_size
+        t = jnp.arange(self.view_len)
+        blk = self.table[:, t // bs]                     # (slots, T)
+        off = t % bs                                     # (T,)
+        ck = self.kp[layer][blk, off]
+        cv = self.vp[layer][blk, off]
+        if self.quant:
+            ks = self.ks[layer][blk, off]                # (slots, T, Hkv)
+            vs = self.vs[layer][blk, off]
+            hd = ck.shape[-1]
+            ck = dequantize_blocks(ck, ks[..., None], block=hd)
+            cv = dequantize_blocks(cv, vs[..., None], block=hd)
+        return ck.astype(self.dtype), cv.astype(self.dtype)
+
+    # -- pytree plumbing --------------------------------------------------
+
+    def tree_flatten(self):
+        children = (self.kp, self.vp, self.ks, self.vs, self.table,
+                    self.active)
+        aux = (self.block_size, self.quant, str(jnp.dtype(self.dtype)))
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        block_size, quant, dtype = aux
+        return cls(*children, block_size=block_size, quant=quant,
+                   dtype=jnp.dtype(dtype))
+
+
+jax.tree_util.register_pytree_node_class(PagedKVCache)
+
+
+class BlockManager:
+    """Host half: free list, refcounts, reservations, the numpy block
+    table mirror. All methods are thread-safe; the engine calls them
+    between jitted steps.
+
+    Accounting invariants (pinned by ``tests/test_serving.py``):
+
+    * every non-trash block is on the free list XOR held by exactly one
+      slot (refcounted — the count is the hook prefix sharing will use);
+    * ``blocks_in_use + len(free) == num_blocks - 1``;
+    * reservations never exceed capacity, so ``ensure()`` cannot fail
+      for an admitted request.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, slots: int,
+                 max_blocks_per_slot: int):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.slots = int(slots)
+        self.max_blocks_per_slot = int(max_blocks_per_slot)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+        self.refcount = np.zeros(num_blocks, np.int64)
+        self.refcount[TRASH_BLOCK] = 1          # pinned forever
+        self.table = np.zeros((slots, max_blocks_per_slot), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        self._reserved = np.zeros(slots, np.int64)
+        self.blocks_in_use = 0
+        self.peak_blocks_in_use = 0
+        self._dirty = True
+        self._dev_table = None
+
+    # -- sizing -----------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the trash block is not allocatable)."""
+        return self.num_blocks - 1
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.block_size))
+
+    def reserved_total(self) -> int:
+        with self._lock:
+            return int(self._reserved.sum())
+
+    # -- admission --------------------------------------------------------
+
+    def can_reserve(self, tokens: int) -> bool:
+        with self._lock:
+            return (int(self._reserved.sum()) + self.blocks_for(tokens)
+                    <= self.capacity)
+
+    def reserve(self, slot: int, tokens: int) -> None:
+        """Reserve the worst case for a request entering ``slot``."""
+        need = self.blocks_for(tokens)
+        with self._lock:
+            if self._reserved[slot]:
+                raise RuntimeError(f"slot {slot} already holds a "
+                                   f"reservation")
+            if int(self._reserved.sum()) + need > self.capacity:
+                raise RuntimeError(
+                    f"pool over-reserved: {need} blocks for slot {slot} "
+                    f"on top of {int(self._reserved.sum())}/"
+                    f"{self.capacity}")
+            self._reserved[slot] = need
+
+    def ensure(self, slot: int, pos: int) -> bool:
+        """Map logical position ``pos`` of ``slot``; allocate the block
+        on first touch. Returns True when a new block was allocated."""
+        b = pos // self.block_size
+        if b >= self.max_blocks_per_slot:
+            raise IndexError(f"position {pos} beyond slot capacity "
+                             f"({self.max_blocks_per_slot} blocks)")
+        with self._lock:
+            if self.table[slot, b] != TRASH_BLOCK:
+                return False
+            if len(self._slot_blocks[slot]) >= self._reserved[slot]:
+                raise RuntimeError(
+                    f"slot {slot} exceeded its reservation "
+                    f"({self._reserved[slot]} blocks)")
+            if not self._free:
+                raise RuntimeError("block pool exhausted despite "
+                                   "reservations — accounting bug")
+            blk = self._free.pop()
+            self.refcount[blk] += 1
+            self.table[slot, b] = blk
+            self._slot_blocks[slot].append(blk)
+            self.blocks_in_use += 1
+            self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                          self.blocks_in_use)
+            self._dirty = True
+            return True
+
+    def release(self, slot: int) -> None:
+        """Return a finished slot's blocks (refcount-decremented) and
+        drop its reservation."""
+        with self._lock:
+            for blk in self._slot_blocks[slot]:
+                self.refcount[blk] -= 1
+                if self.refcount[blk] == 0:
+                    self._free.append(blk)
+                    self.blocks_in_use -= 1
+                elif self.refcount[blk] < 0:
+                    raise RuntimeError(f"block {blk} refcount underflow")
+            self._slot_blocks[slot] = []
+            self.table[slot, :] = TRASH_BLOCK
+            self._reserved[slot] = 0
+            self._dirty = True
+
+    # -- device mirror ----------------------------------------------------
+
+    def device_table(self):
+        """The block table as a device array; re-uploaded only when the
+        host copy changed (admit/evict/alloc), never resized."""
+        with self._lock:
+            if self._dirty or self._dev_table is None:
+                self._dev_table = jnp.asarray(self.table)
+                self._dirty = False
+            return self._dev_table
+
+    def set_device_mirror(self, table) -> None:
+        """Adopt the table array a jitted step RETURNED as the cached
+        mirror. With buffer donation the array previously handed out by
+        :meth:`device_table` is consumed by the step — holding on to it
+        would return a deleted buffer next time; the returned copy is
+        the live alias."""
+        with self._lock:
+            if not self._dirty:
+                self._dev_table = table
+
+    # -- invariants (tests) ----------------------------------------------
+
+    def check(self) -> None:
+        with self._lock:
+            held = [b for blocks in self._slot_blocks for b in blocks]
+            assert len(held) == len(set(held)), \
+                f"block double-assigned: {sorted(held)}"
+            assert not (set(held) & set(self._free)), \
+                "block simultaneously free and held"
+            assert TRASH_BLOCK not in held and TRASH_BLOCK not in self._free
+            assert self.blocks_in_use == len(held)
+            assert self.blocks_in_use + len(self._free) == self.capacity, \
+                (self.blocks_in_use, len(self._free), self.capacity)
+            assert int(self.refcount[1:].sum()) == self.blocks_in_use
+            mapped = set(int(x) for x in self.table.ravel()) - {TRASH_BLOCK}
+            assert mapped == set(held), (mapped, set(held))

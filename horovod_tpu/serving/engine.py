@@ -1,0 +1,760 @@
+"""InferenceEngine: continuous (in-flight) batching over one jitted step.
+
+The engine owns ``slots`` fixed decode lanes. ONE jitted decode step
+advances every occupied lane one token; between steps — plain host
+Python, no recompilation — finished requests are evicted and queued
+requests admitted into the freed lanes. The jit sees only static shapes:
+
+* ``tok``/``pos`` are ``(slots,)`` vectors — per-slot position indices,
+  so lanes at wildly different depths share one program;
+* ``active`` masks dead lanes — their writes land in the paged cache's
+  trash block and their outputs are ignored on the host;
+* the paged block table changes *values* between steps, never shape.
+
+Prefill is chunked and interleaved against decode: a freshly admitted
+prompt is teacher-forced ``prefill_chunk`` tokens at a time through a
+scanned variant of the same step (decode lanes frozen for the duration
+of one chunk — the knob bounds how much a long prompt can stall
+in-flight decodes). With ``prefill_chunk=1`` everything rides the decode
+step and no second program is ever compiled.
+
+Because both drivers run the SAME registry step functions
+(``models/generate.decode_step``), a single-request engine run is
+token-identical to offline ``generate()`` — the parity tests in
+``tests/test_serving.py`` pin all three families.
+
+Observability (PRs 1–2): ``serve_ttft_seconds`` / ``serve_tpot_seconds``
+/ ``serve_queue_wait_seconds`` histograms, ``serve_slots_active`` /
+``serve_queue_depth`` / ``serve_blocks_in_use`` gauges, per-request
+timeline markers, and every device dispatch is registered in the
+pending-collective table so the stall watchdog names a stuck decode
+step like it names a stuck allreduce.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import metrics, tracing
+from horovod_tpu.models.generate import (
+    decode_family, decode_step, greedy_token, t5_decoder_bias, t5_encode,
+)
+from horovod_tpu.serving.cache import BlockManager, PagedKVCache
+from horovod_tpu.serving.scheduler import (
+    Request, RequestQueue, RequestStatus, SlotPool,
+)
+
+__all__ = ["InferenceEngine"]
+
+
+class _SlotState:
+    """Host-side progress of one running request: ``n_fed`` tokens have
+    been fed (prompt first, then the request's own output); the next
+    input goes to position ``n_fed``."""
+
+    __slots__ = ("request", "slot", "n_fed", "span")
+
+    def __init__(self, request: Request, slot: int, span) -> None:
+        self.request = request
+        self.slot = slot
+        self.n_fed = 0
+        self.span = span
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one model's decode program.
+
+    Knob defaults come from ``HOROVOD_SERVE_*`` (:mod:`horovod_tpu
+    .config`); constructor arguments override. ``num_blocks`` sizes the
+    shared KV pool — the default is the dense equivalent (every slot can
+    reach ``max_len``); size it *below* ``slots * ceil(max_len /
+    block_size)`` to serve the same concurrency in less memory when
+    typical requests are shorter than the worst case.
+    """
+
+    def __init__(self, model, params, *, slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 kv_quant: Optional[str] = "__env__",
+                 prefill_chunk: Optional[int] = None,
+                 queue_limit: Optional[int] = None,
+                 max_src_len: Optional[int] = None,
+                 name: str = "engine0"):
+        from horovod_tpu.config import get_config
+        hcfg = get_config()
+        self.name = name
+        self.model = model
+        self.cfg = model.cfg
+        self.family = decode_family(self.cfg)
+        self.family.validate(self.cfg)
+        self.slots = int(slots if slots is not None else hcfg.serve_slots)
+        self.max_len = int(max_len if max_len is not None
+                           else hcfg.serve_max_len)
+        self.block_size = int(block_size if block_size is not None
+                              else hcfg.serve_block_size)
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else hcfg.serve_prefill_chunk)
+        self.kv_quant = (hcfg.serve_kv_quant if kv_quant == "__env__"
+                         else kv_quant) or None
+        queue_limit = int(queue_limit if queue_limit is not None
+                          else hcfg.serve_queue_limit)
+        if self.slots < 1 or self.max_len < 2 or self.block_size < 1 \
+                or self.prefill_chunk < 1:
+            raise ValueError(
+                f"bad engine geometry: slots={self.slots}, "
+                f"max_len={self.max_len}, block_size={self.block_size}, "
+                f"prefill_chunk={self.prefill_chunk}")
+        model_max = getattr(self.cfg, "max_seq_len", None)
+        if model_max is not None and self.max_len > model_max:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's "
+                f"max_seq_len={model_max}")
+
+        self.max_blocks_per_slot = math.ceil(self.max_len / self.block_size)
+        dense_blocks = self.slots * self.max_blocks_per_slot
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else dense_blocks + 1)
+        self.manager = BlockManager(self.num_blocks, self.block_size,
+                                    self.slots, self.max_blocks_per_slot)
+
+        layers = self.family.num_layers(self.cfg)
+        self._cache = PagedKVCache.create(
+            layers, self.family.kv_heads(self.cfg),
+            self.family.head_dim(self.cfg), slots=self.slots,
+            num_blocks=self.num_blocks, block_size=self.block_size,
+            max_blocks_per_slot=self.max_blocks_per_slot,
+            dtype=self.cfg.dtype, quant=self.kv_quant)
+        self.view_len = self._cache.view_len
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._step = decode_step(self.cfg)
+        self._extras = self._init_extras(max_src_len)
+
+        self.queue = RequestQueue(queue_limit)
+        self._slot_pool = SlotPool(self.slots)
+        self._states: Dict[int, _SlotState] = {}
+        self._lock = threading.RLock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.failed: Optional[str] = None
+        self._draining = False
+        #: set by the Dispatcher: called with (engine, orphaned queued
+        #: requests) when the engine fails, so survivors can adopt them
+        #: instead of the queue rejecting them.
+        self.on_fail = None
+        self.step_count = 0
+        self._last_prefill = False
+        self._decode_traces = 0
+        self._prefill_traces = 0
+        self._span = tracing.mint_span("serve_engine", tensor=name,
+                                       traced=True)
+
+        # Donate the cache so XLA updates the K/V pools IN PLACE: the
+        # caller unconditionally replaces self._cache with the returned
+        # one, and without aliasing every token would copy the whole
+        # pool (O(pool) per step, 2x peak memory — the opposite of what
+        # paging buys). CPU's runtime doesn't implement donation; skip
+        # it there to keep test logs warning-free.
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+
+        def _decode_raw(params, cache, tok, pos, active, extras):
+            self._decode_traces += 1          # host effect: fires per TRACE
+            cache = cache.with_active(active)
+            cache, logits = self._step(params, cache, tok, pos, extras)
+            return cache, logits, greedy_token(logits).astype(jnp.int32)
+
+        self._decode_jit = jax.jit(_decode_raw, donate_argnums=donate)
+
+        C, V = self.prefill_chunk, self.cfg.vocab_size
+        view_len = self.view_len
+
+        def _prefill_raw(params, cache, tok_seq, pos0, count, active,
+                         extras):
+            self._prefill_traces += 1
+            base = active
+
+            def body(carry, j):
+                cache, final = carry
+                tok = tok_seq[j]
+                pos = jnp.minimum(pos0 + j, view_len - 1)
+                lane = base & (j < count)
+                cache = cache.with_active(lane)
+                cache, logits = self._step(params, cache, tok, pos,
+                                           extras)
+                final = jnp.where((j == count - 1)[:, None], logits,
+                                  final)
+                return (cache, final), None
+
+            zeros = jnp.zeros((pos0.shape[0], V), jnp.float32)
+            (cache, final), _ = jax.lax.scan(body, (cache, zeros),
+                                             jnp.arange(C))
+            return cache, final, greedy_token(final).astype(jnp.int32)
+
+        self._prefill_jit = jax.jit(_prefill_raw, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # family extras (T5 cross-attention side state)
+    # ------------------------------------------------------------------
+
+    def _init_extras(self, max_src_len: Optional[int]):
+        if self.family.name != "t5":
+            self._max_src_len = None
+            return None
+        cfg = self.cfg
+        self._max_src_len = int(max_src_len or self.max_len)
+        H, hd = cfg.num_heads, cfg.head_dim
+        cross = {i: {"k": jnp.zeros((self.slots, self._max_src_len, H, hd),
+                                    cfg.dtype),
+                     "v": jnp.zeros((self.slots, self._max_src_len, H, hd),
+                                    cfg.dtype)}
+                 for i in range(cfg.num_decoder_layers)}
+        return {"cross": cross,
+                "src_mask": jnp.zeros((self.slots, self._max_src_len),
+                                      bool),
+                "dec_bias": t5_decoder_bias(cfg, self.params,
+                                            self.view_len)}
+
+    def _admit_extras(self, slot: int, req: Request) -> None:
+        """T5: run the encoder once for this request and scatter its
+        cross K/V + source mask into the slot's rows."""
+        if self.family.name != "t5":
+            return
+        cfg = self.cfg
+        src = req.src.reshape(1, -1)
+        pad = np.full((1, self._max_src_len - src.shape[1]), cfg.pad_id,
+                      np.int32)
+        src = jnp.asarray(np.concatenate([src, pad], axis=1))
+        mask = src != cfg.pad_id
+        cross = t5_encode(self.model, cfg, self.params, src, mask)
+        ex = self._extras
+        for i, row in enumerate(cross):
+            ex["cross"][i] = {
+                "k": ex["cross"][i]["k"].at[slot].set(row["k"][0]),
+                "v": ex["cross"][i]["v"].at[slot].set(row["v"][0])}
+        ex["src_mask"] = ex["src_mask"].at[slot].set(mask[0])
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt=None, max_new_tokens: int = 16, **kw) -> Request:
+        """Enqueue one request; returns immediately with a handle whose
+        ``result()`` blocks for the tokens. Over-long and malformed
+        requests are rejected here, a full queue rejects with
+        backpressure — the status/reason is always on the handle."""
+        src = kw.get("src")
+        if self.family.name == "t5":
+            if src is None:
+                req = Request(prompt if prompt is not None else [],
+                              max_new_tokens, **kw)
+                req._finish(RequestStatus.REJECTED,
+                            "t5 requests need src= (encoder tokens)")
+                return self._count_reject(req)
+            if prompt is None or np.asarray(prompt).size == 0:
+                kw_prompt = [self.cfg.pad_id]    # T5: pad doubles as BOS
+            else:
+                kw_prompt = prompt
+            req = Request(kw_prompt, max_new_tokens, **kw)
+            if req.src.size > (self._max_src_len or 0):
+                req._finish(RequestStatus.REJECTED,
+                            f"src length {req.src.size} exceeds "
+                            f"max_src_len={self._max_src_len}")
+                return self._count_reject(req)
+        else:
+            if prompt is None or np.asarray(prompt).size == 0:
+                req = Request([0], max_new_tokens, **kw)
+                req._finish(RequestStatus.REJECTED,
+                            "decoder-only requests need a non-empty "
+                            "prompt")
+                return self._count_reject(req)
+            req = Request(prompt, max_new_tokens, **kw)
+        if req.max_new_tokens < 1:
+            req._finish(RequestStatus.REJECTED,
+                        "max_new_tokens must be >= 1")
+            return self._count_reject(req)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_len:
+            req._finish(RequestStatus.REJECTED,
+                        f"prompt {len(req.prompt)} + {req.max_new_tokens} "
+                        f"new tokens exceeds max_len={self.max_len}")
+            return self._count_reject(req)
+        need = self.manager.blocks_for(total)
+        if need > self.manager.capacity:
+            # Must reject NOW: _admit would requeue it forever (its
+            # worst case can never be reserved), head-of-line blocking
+            # every request behind it.
+            req._finish(RequestStatus.REJECTED,
+                        f"request needs {need} KV blocks but the pool "
+                        f"holds {self.manager.capacity}")
+            return self._count_reject(req)
+        if req.temperature < 0:
+            req._finish(RequestStatus.REJECTED,
+                        f"temperature must be >= 0, got "
+                        f"{req.temperature}")
+            return self._count_reject(req)
+        if req.top_k is not None and not \
+                1 <= req.top_k <= self.cfg.vocab_size:
+            req._finish(RequestStatus.REJECTED,
+                        f"top_k must be in [1, vocab_size="
+                        f"{self.cfg.vocab_size}], got {req.top_k}")
+            return self._count_reject(req)
+        if self.failed or self._stop.is_set():
+            req.retryable = True
+            req._finish(RequestStatus.REJECTED, "engine not serving")
+            return self._count_reject(req)
+        if self._draining:
+            req.retryable = True
+            req._finish(RequestStatus.REJECTED,
+                        "engine draining; not accepting new requests")
+            return self._count_reject(req)
+        # Attach the terminal counter BEFORE enqueueing: the serving
+        # loop can pop and expire a zero-deadline request in the gap,
+        # and every terminal transition after acceptance — done,
+        # expired, cancelled, failed, queue rejections — must land in
+        # serve_requests_total so {status} sums back to {submitted}.
+        req._on_terminal = self._request_terminal
+        self.queue.submit(req)
+        if req.status == RequestStatus.REJECTED:
+            # The callback already counted the rejection; keep only the
+            # timeline event (no double increment).
+            metrics.event("serve_reject", engine=self.name,
+                          request=req.id, reason=req.reason)
+            return req
+        metrics.counter("serve_requests_total", engine=self.name,
+                        status="submitted").inc()
+        self._work.set()
+        return req
+
+    def _request_terminal(self, req: Request) -> None:
+        metrics.counter("serve_requests_total",
+                        engine=req.served_by or self.name,
+                        status=req.status.value).inc()
+
+    def can_serve(self, req: Request) -> bool:
+        """Would THIS engine's geometry accept ``req``? Engines in a
+        dispatch group may differ (max_len, pool size, source window) —
+        failover adoption must re-check against the adopter, not trust
+        the dead engine's validation."""
+        if self.failed or self._stop.is_set() or self._draining:
+            return False
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_len or req.max_new_tokens < 1:
+            return False
+        if self.manager.blocks_for(total) > self.manager.capacity:
+            return False
+        if self.family.name == "t5":
+            if req.src is None or req.src.size > (self._max_src_len or 0):
+                return False
+        if len(req.prompt) == 0:        # every family feeds prompt[0]
+            return False
+        if req.top_k is not None and not \
+                1 <= req.top_k <= self.cfg.vocab_size:
+            return False
+        return True
+
+    def adopt(self, req: Request) -> bool:
+        """Failover path: enqueue an EXISTING request (same handle the
+        caller holds) if this engine can serve it and has queue room;
+        never finalizes the request on refusal, so the dispatcher can
+        try the next survivor."""
+        if not self.can_serve(req):
+            return False
+        if not self.queue.try_submit(req):
+            return False
+        metrics.counter("serve_requests_total", engine=self.name,
+                        status="adopted").inc()
+        self._work.set()
+        return True
+
+    def _count_reject(self, req: Request) -> Request:
+        metrics.counter("serve_requests_total", engine=self.name,
+                        status="rejected").inc()
+        metrics.event("serve_reject", engine=self.name, request=req.id,
+                      reason=req.reason)
+        return req
+
+    # ------------------------------------------------------------------
+    # one engine iteration (host bookkeeping + one device dispatch)
+    # ------------------------------------------------------------------
+
+    def step_once(self) -> int:
+        """Evict, admit, advance every occupied lane one unit of work
+        (one decode token, or one prefill chunk). Returns the number of
+        lanes that advanced — 0 means idle."""
+        with self._lock:
+            now = time.monotonic()
+            self._sweep(now)
+            self._admit(now)
+            lanes = sorted(self._states.items())
+            if not lanes:
+                self._update_gauges()
+                return 0
+            prefill = [(s, st) for s, st in lanes
+                       if st.n_fed < len(st.request.prompt)]
+            wants_chunk = self.prefill_chunk > 1 and any(
+                len(st.request.prompt) - st.n_fed > 1
+                for _, st in prefill)
+            # Alternate chunked prefill with decode: a chunk freezes the
+            # decode lanes, and under a sustained stream of long prompts
+            # "prefill whenever someone needs it" would freeze them
+            # FOREVER. Guaranteeing a decode dispatch between chunks
+            # bounds the added TPOT at one chunk's latency. (Pure-
+            # prefill states — nobody decoding — chunk back-to-back.)
+            only_prefill = len(prefill) == len(lanes)
+            if wants_chunk and (only_prefill or not self._last_prefill):
+                self._run_prefill(prefill)
+                self._last_prefill = True
+            else:
+                self._run_decode(lanes)
+                self._last_prefill = False
+            self.step_count += 1
+            self._sweep(time.monotonic())
+            self._update_gauges()
+            return len(lanes)
+
+    def _sweep(self, now: float) -> None:
+        """Finish lanes that went terminal (deadline, cancel) and free
+        the slots/blocks of every terminal lane."""
+        for slot in list(self._states):
+            st = self._states[slot]
+            req = st.request
+            if not req.status.terminal and req.expired(now):
+                req._finish(RequestStatus.EXPIRED,
+                            "deadline passed mid-generation")
+            if req._cancel_requested and not req.status.terminal:
+                req._finish(RequestStatus.CANCELLED, req.reason)
+            if req.status.terminal:
+                self._evict(slot)
+
+    def _evict(self, slot: int) -> None:
+        st = self._states.pop(slot)
+        self.manager.release(slot)
+        self._slot_pool.release(slot)
+        req = st.request
+        if req.tpot is not None:
+            metrics.histogram("serve_tpot_seconds",
+                              engine=self.name).observe(req.tpot)
+        metrics.counter("serve_tokens_generated_total",
+                        engine=self.name).inc(len(req.tokens))
+        metrics.event("serve_finish", engine=self.name, request=req.id,
+                      status=req.status.value, generated=len(req.tokens),
+                      op_id=st.span.op_id)
+
+    def _admit(self, now: float) -> None:
+        while self._slot_pool.free_count > 0:
+            req = self.queue.pop_ready(now)
+            if req is None:
+                return
+            total = len(req.prompt) + req.max_new_tokens
+            if not self.manager.can_reserve(total):
+                # Head-of-line waits for blocks; FCFS order preserved
+                # (the heap keys on the original sequence number).
+                self.queue.requeue(req)
+                return
+            if not req.start_running():
+                continue    # cancelled in the pop->admit window
+            slot = self._slot_pool.acquire()
+            self.manager.reserve(slot, total)
+            span = tracing.mint_span("serve_request", tensor=req.id,
+                                     traced=True)
+            st = _SlotState(req, slot, span)
+            self._states[slot] = st
+            req.t_admit = now
+            req.served_by = self.name
+            metrics.histogram("serve_queue_wait_seconds",
+                              engine=self.name).observe(req.queue_wait)
+            self._admit_extras(slot, req)
+            metrics.event("serve_admit", engine=self.name, request=req.id,
+                          slot=slot, prompt_len=len(req.prompt),
+                          op_id=span.op_id)
+
+    # -- device dispatches ----------------------------------------------
+
+    def _dispatch(self, phase: str, fn, *args):
+        """Run one jitted call under watchdog + timeline coverage; the
+        pending-collective entry makes a wedged decode step a named
+        stall report instead of a silent hang."""
+        tok = metrics.collective_begin(
+            "serve_step", name=f"{self.name}:{phase}:{self.step_count}")
+        t0 = time.perf_counter()
+        try:
+            with tracing.phase(self._span, phase.upper(),
+                               category="serving", step=self.step_count):
+                out = fn(*args)
+                # Force completion INSIDE the watchdog window: jax
+                # dispatch is async, and an unforced wedge would look
+                # like instant success here and hang at the next use.
+                out = jax.tree_util.tree_map(
+                    lambda a: a.block_until_ready()
+                    if hasattr(a, "block_until_ready") else a, out)
+        finally:
+            metrics.collective_end(tok)
+        metrics.histogram("serve_step_seconds", engine=self.name,
+                          phase=phase).observe(time.perf_counter() - t0)
+        return out
+
+    def _run_decode(self, lanes: List[Tuple[int, _SlotState]]) -> None:
+        tok = np.zeros(self.slots, np.int32)
+        pos = np.zeros(self.slots, np.int32)
+        act = np.zeros(self.slots, bool)
+        for slot, st in lanes:
+            p = st.request.prompt
+            nf = st.n_fed
+            tok[slot] = p[nf] if nf < len(p) else \
+                st.request.tokens[nf - len(p)]
+            pos[slot] = nf
+            act[slot] = True
+            self.manager.ensure(slot, nf)
+        cache = self._cache.replace(table=self.manager.device_table())
+        cache, logits, greedy = self._dispatch(
+            "decode", self._decode_jit, self.params, cache,
+            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(act),
+            self._extras)
+        self._cache = cache
+        self.manager.set_device_mirror(cache.table)
+        greedy_np = np.asarray(greedy)
+        logits_np = self._pull_logits_if_sampling(lanes, logits)
+        metrics.counter("serve_steps_total", engine=self.name,
+                        phase="decode").inc()
+        for slot, st in lanes:
+            nf = st.n_fed
+            st.n_fed += 1
+            if nf >= len(st.request.prompt) - 1:
+                self._commit(st, slot, greedy_np, logits_np)
+
+    def _run_prefill(self, lanes: List[Tuple[int, _SlotState]]) -> None:
+        C = self.prefill_chunk
+        tok_seq = np.zeros((C, self.slots), np.int32)
+        pos0 = np.zeros(self.slots, np.int32)
+        count = np.zeros(self.slots, np.int32)
+        act = np.zeros(self.slots, bool)
+        for slot, st in lanes:
+            p = st.request.prompt
+            c = min(C, len(p) - st.n_fed)
+            tok_seq[:c, slot] = p[st.n_fed:st.n_fed + c]
+            pos0[slot] = st.n_fed
+            count[slot] = c
+            act[slot] = True
+            for q in range(st.n_fed, st.n_fed + c):
+                self.manager.ensure(slot, q)
+        cache = self._cache.replace(table=self.manager.device_table())
+        cache, final, greedy = self._dispatch(
+            "prefill", self._prefill_jit, self.params, cache,
+            jnp.asarray(tok_seq), jnp.asarray(pos0), jnp.asarray(count),
+            jnp.asarray(act), self._extras)
+        self._cache = cache
+        self.manager.set_device_mirror(cache.table)
+        greedy_np = np.asarray(greedy)
+        logits_np = self._pull_logits_if_sampling(lanes, final)
+        metrics.counter("serve_steps_total", engine=self.name,
+                        phase="prefill").inc()
+        for slot, st in lanes:
+            st.n_fed += int(count[slot])
+            if st.n_fed >= len(st.request.prompt):
+                self._commit(st, slot, greedy_np, logits_np)
+
+    @staticmethod
+    def _pull_logits_if_sampling(lanes, logits):
+        """One bulk device->host transfer when ANY lane will host-sample
+        this step; greedy-only steps never pay for logits at all, and
+        sampling lanes share the single pull instead of one slice
+        round-trip each."""
+        if any(st.request.temperature > 0 for _, st in lanes):
+            return np.asarray(logits, np.float64)
+        return None
+
+    def _commit(self, st: _SlotState, slot: int, greedy_np,
+                logits_np) -> None:
+        req = st.request
+        if req.temperature > 0:
+            token = self._host_sample(req, logits_np[slot])
+        else:
+            token = int(greedy_np[slot])
+        first = req.t_first is None
+        req._commit(token)
+        if first:
+            metrics.histogram("serve_ttft_seconds",
+                              engine=self.name).observe(req.ttft)
+            metrics.event("serve_first_token", engine=self.name,
+                          request=req.id, op_id=st.span.op_id)
+        if (req.eos_id is not None and token == req.eos_id) \
+                or len(req.tokens) >= req.max_new_tokens:
+            req._finish(RequestStatus.DONE)
+
+    @staticmethod
+    def _host_sample(req: Request, row: np.ndarray) -> int:
+        """Host-side temperature/top-k sampling (per-request numpy rng —
+        seeded, so a resubmitted request replays identically)."""
+        row = row / req.temperature
+        if req.top_k is not None:
+            kth = np.sort(row)[-req.top_k]
+            row = np.where(row >= kth, row, -np.inf)
+        row = row - row.max()
+        p = np.exp(row)
+        p /= p.sum()
+        return int(req._rng.choice(len(row), p=p))
+
+    # ------------------------------------------------------------------
+    # drive modes
+    # ------------------------------------------------------------------
+
+    def run_until_idle(self, max_steps: int = 100_000) -> int:
+        """Synchronous drive: step until no queued or running work is
+        left (tests, batch jobs). Returns the number of iterations."""
+        steps = 0
+        while steps < max_steps:
+            n = self.step_once()
+            if n == 0 and self.queue.depth() == 0:
+                return steps
+            steps += 1
+        raise RuntimeError(f"engine did not go idle in {max_steps} steps")
+
+    def start(self) -> "InferenceEngine":
+        """Background serving thread (the replica servers use this)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    n = self.step_once()
+                except Exception as e:      # noqa: BLE001 — fail the lanes
+                    self._fail(f"engine loop error: {e!r}")
+                    return
+                if n == 0:
+                    self._work.wait(0.005)
+                    self._work.clear()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"hvd-serve-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def close(self, reason: str = "engine shut down") -> None:
+        """Stop serving and resolve every outstanding request."""
+        self.stop()
+        with self._lock:
+            self.queue.close(reason)
+            for slot in list(self._states):
+                st = self._states[slot]
+                st.request._finish(RequestStatus.REJECTED, reason)
+                self._evict(slot)
+            self._update_gauges()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Graceful drain: finish everything in flight and queued while
+        REJECTING new submissions (reason "engine draining"); True when
+        the engine emptied in time. Draining is one-way — the natural
+        next call is ``close()``."""
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = bool(self._states) or self.queue.depth() > 0
+            if not busy:
+                return True
+            if self._thread is None:
+                self.step_once()
+            else:
+                time.sleep(0.01)
+        return False
+
+    def _fail(self, reason: str) -> None:
+        self.failed = reason
+        metrics.event("serve_engine_failed", engine=self.name,
+                      reason=reason)
+        orphans = []
+        with self._lock:
+            for slot in list(self._states):
+                st = self._states[slot]
+                st.request.retryable = True
+                st.request._finish(RequestStatus.FAILED, reason)
+                self._evict(slot)
+            # Engine death is FAILED (retryable elsewhere), not a
+            # client-error REJECTED: the replica spool respools FAILED
+            # claims for survivors, and the dispatcher re-enqueues the
+            # same handles via on_fail.
+            orphans = [r for r in self.queue.drain()
+                       if not r.status.terminal]
+            self.queue.close(reason)
+            if self.on_fail is None:
+                for r in orphans:
+                    r.retryable = True
+                    r._finish(RequestStatus.FAILED, reason)
+                orphans = []
+            self._update_gauges()
+        if orphans:
+            try:
+                self.on_fail(self, orphans)
+            except Exception:
+                for r in orphans:
+                    r.retryable = True
+                    r._finish(RequestStatus.FAILED, reason)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self.failed is None and not self._stop.is_set()
+
+    @property
+    def decode_compiles(self) -> int:
+        """How many times the decode step was TRACED (== compiled): the
+        continuous-batching contract is that this stays at 1 however
+        requests churn."""
+        return self._decode_traces
+
+    @property
+    def prefill_compiles(self) -> int:
+        return self._prefill_traces
+
+    def load(self) -> int:
+        """Dispatch weight: queued + running requests."""
+        with self._lock:
+            return self.queue.depth() + len(self._states)
+
+    def _update_gauges(self) -> None:
+        metrics.gauge("serve_slots_active", engine=self.name).set(
+            len(self._states))
+        metrics.gauge("serve_queue_depth", engine=self.name).set(
+            self.queue.depth())
+        metrics.gauge("serve_blocks_in_use", engine=self.name).set(
+            self.manager.blocks_in_use)
+        metrics.gauge("serve_blocks_peak", engine=self.name).set(
+            self.manager.peak_blocks_in_use)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "engine": self.name, "alive": self.alive,
+                "slots": self.slots, "active": len(self._states),
+                "queued": self.queue.depth(),
+                "steps": self.step_count,
+                "decode_compiles": self._decode_traces,
+                "prefill_compiles": self._prefill_traces,
+                "blocks_in_use": self.manager.blocks_in_use,
+                "blocks_peak": self.manager.peak_blocks_in_use,
+                "blocks_capacity": self.manager.capacity,
+                "dense_equivalent_tokens": self.slots * self.max_len,
+                "kv_quant": self.kv_quant,
+            }

@@ -1,0 +1,480 @@
+"""Multi-replica dispatch: one engine per rank, least-loaded routing,
+heartbeat failover.
+
+Two layers, matching how the rest of the repo splits in-process vs
+cross-process concerns (``cluster.py`` / ``data/store.py``):
+
+* :class:`Dispatcher` — in-process routing across N engines: submit to
+  the least-loaded live engine (queue depth + running lanes), and when
+  an engine dies mid-flight, re-dispatch its unfinished requests to the
+  survivors. This is what a single-host multi-engine deployment (one
+  engine per device) uses, and what the unit tests pin.
+
+* :class:`ReplicaServer` + the ``submit_file_request`` /
+  ``wait_file_result`` client — a filesystem spool protocol for one
+  engine **per process/rank**, built on the same atomic-rename claims a
+  shared filesystem (or the elastic store prefix) gives every rank:
+
+  .. code-block:: text
+
+      root/spool/  req-*.json     submitted, unclaimed
+      root/claim/rank{K}/         claimed by replica K (atomic rename)
+      root/done/   req-*.json     responses (tokens, timings, served_by)
+      root/hb/     rank{K}.json   heartbeats (mtime = liveness)
+
+  A replica claims spool files only while it has capacity, so queue-
+  depth dispatch falls out of self-limiting claims rather than a
+  central router. Liveness is the heartbeat file's mtime: when a
+  replica goes stale its claimed-but-unfinished requests are moved
+  back to the spool by whichever survivor notices first (rename is
+  atomic — exactly one mover wins), and greedy decoding makes the
+  replay byte-identical. This is the serving-side analogue of the
+  elastic driver's lost-rank drain ("Highly Available Data Parallel ML
+  training on Mesh Networks", PAPERS.md): detect fast, reassign, keep
+  serving.
+
+``tools/serve_smoke.py`` (``make serve-smoke``) runs two real replica
+processes, kills one mid-stream, and asserts the survivor drains the
+full request set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu import metrics
+from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.scheduler import Request, RequestStatus
+
+__all__ = ["Dispatcher", "ReplicaServer", "submit_file_request",
+           "wait_file_result", "read_result"]
+
+
+class Dispatcher:
+    """Route requests across in-process engines by queue depth; adopt a
+    lost engine's work.
+
+    Registration wires each engine's ``on_fail`` hook, so the moment an
+    engine dies its queued (never-started) requests are re-enqueued on
+    the least-loaded survivor — the SAME handles, which simply complete
+    elsewhere. Requests that were already mid-generation on the dead
+    engine finish as ``FAILED`` (the caller holds the partial tokens and
+    the reason); the filesystem replica protocol below goes further and
+    replays those from scratch, because its claims outlive the process.
+    """
+
+    def __init__(self, engines: Sequence[InferenceEngine]):
+        if not engines:
+            raise ValueError("need at least one engine")
+        names = [e.name for e in engines]
+        if len(set(names)) != len(names):
+            raise ValueError(f"engine names must be unique: {names}")
+        self.engines = list(engines)
+        self._lock = threading.Lock()
+        for e in self.engines:
+            e.on_fail = self._adopt
+
+    def live_engines(self) -> List[InferenceEngine]:
+        return [e for e in self.engines if e.alive]
+
+    def submit(self, *args, **kw) -> Request:
+        """Submit to the least-loaded live engine. With every replica
+        gone the request is rejected with a reason, like any other
+        backpressure signal."""
+        with self._lock:
+            live = self.live_engines()
+            if not live:
+                # Build the handle with the caller's REAL spec (same
+                # positional/keyword forms engine.submit accepts), so
+                # ids and shapes in logs/correlation stay truthful.
+                rest = list(args)
+                prompt = kw.pop("prompt", rest.pop(0) if rest else None)
+                mnt = kw.pop("max_new_tokens",
+                             rest.pop(0) if rest else 1)
+                req = Request(prompt if prompt is not None else [0],
+                              mnt, **kw)
+                req.retryable = True
+                req._finish(RequestStatus.REJECTED, "no live replicas")
+                return req
+            ordered = sorted(live, key=lambda e: e.load())
+        req = ordered[0].submit(*args, **kw)
+        # One replica's backpressure is not the fleet's: try the others
+        # before surfacing the rejection.
+        for eng in ordered[1:]:
+            if req.status != RequestStatus.REJECTED:
+                break
+            req = eng.submit(*args, **kw)
+        return req
+
+    def _adopt(self, source: InferenceEngine,
+               orphans: List[Request]) -> int:
+        """Re-enqueue a dead engine's queued requests on survivors.
+
+        Each candidate re-validates against ITS OWN geometry
+        (``engine.adopt``): engines in a group may differ in max_len /
+        pool size, and blindly enqueueing would either wedge the
+        adopter's admission loop or crash its block manager. A request
+        no survivor can hold fails with the reason."""
+        moved = 0
+        for req in orphans:
+            live = [e for e in self.live_engines() if e is not source]
+            placed = False
+            for target in sorted(live, key=lambda e: e.load()):
+                if target.adopt(req):
+                    placed = True
+                    moved += 1
+                    metrics.event("serve_failover", source=source.name,
+                                  target=target.name, request=req.id)
+                    break
+            if not placed:
+                req._finish(RequestStatus.FAILED,
+                            f"replica {source.name} lost and no "
+                            f"survivor can adopt {req.id}")
+        return moved
+
+    def failover(self) -> int:
+        """Manual sweep (normally automatic via ``on_fail``): drain any
+        dead engine's queue into the survivors; returns how many moved."""
+        moved = 0
+        for eng in self.engines:
+            if not eng.alive:
+                moved += self._adopt(eng, [
+                    r for r in eng.queue.drain()
+                    if not r.status.terminal])
+        return moved
+
+    def close(self) -> None:
+        for e in self.engines:
+            e.close()
+
+
+# ---------------------------------------------------------------------------
+# filesystem spool protocol (cross-process replicas)
+# ---------------------------------------------------------------------------
+
+def _dirs(root: str) -> Dict[str, str]:
+    return {k: os.path.join(root, k) for k in
+            ("spool", "claim", "done", "hb")}
+
+
+def _init_root(root: str) -> Dict[str, str]:
+    d = _dirs(root)
+    for p in d.values():
+        os.makedirs(p, exist_ok=True)
+    return d
+
+
+def _write_atomic(path: str, payload: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def submit_file_request(root: str, prompt, max_new_tokens: int, *,
+                        priority: int = 0, request_id: Optional[str] = None,
+                        eos_id: Optional[int] = None,
+                        src=None) -> str:
+    """Drop one request into the spool; returns its id. Any process
+    sharing ``root`` (local disk, NFS, a mounted store prefix) can be
+    the client."""
+    d = _init_root(root)
+    rid = request_id or f"req-{os.getpid()}-{time.monotonic_ns()}"
+    payload = {"id": rid, "prompt": list(map(int, prompt)),
+               "max_new_tokens": int(max_new_tokens),
+               "priority": int(priority), "eos_id": eos_id,
+               "submitted_unix": time.time()}
+    if src is not None:
+        payload["src"] = list(map(int, src))
+    _write_atomic(os.path.join(d["spool"], f"{rid}.json"), payload)
+    return rid
+
+
+def read_result(root: str, request_id: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(_dirs(root)["done"], f"{request_id}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None                # racing writer; caller retries
+
+
+def wait_file_result(root: str, request_id: str,
+                     timeout: float = 60.0,
+                     poll_s: float = 0.05) -> Dict[str, Any]:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        res = read_result(root, request_id)
+        if res is not None:
+            return res
+        time.sleep(poll_s)
+    raise TimeoutError(f"no result for {request_id} within {timeout}s")
+
+
+class ReplicaServer:
+    """One rank's serving loop over the spool: heartbeat, claim while
+    capacity allows, serve, publish, reclaim from stale peers.
+
+    A reclaim can race a replica that is merely SLOW, not dead (e.g. the
+    GIL-heavy first jit compile starving its heartbeat thread). That is
+    safe by construction: claims move by atomic rename (one winner),
+    greedy decode replays identically wherever the request lands, and
+    result publishes are atomic whole-file replaces — the worst case is
+    the same tokens computed twice. Deployments should still warm the
+    engine before heartbeating (serve one dummy request) so compile
+    pauses don't read as death; ``tools/serve_smoke.py`` shows the
+    pattern."""
+
+    def __init__(self, root: str, rank: int, engine: InferenceEngine, *,
+                 heartbeat_s: Optional[float] = None,
+                 stale_after_s: Optional[float] = None):
+        from horovod_tpu.config import get_config
+        self.root = root
+        self.rank = int(rank)
+        self.engine = engine
+        hb = (heartbeat_s if heartbeat_s is not None
+              else get_config().serve_heartbeat_seconds)
+        self.heartbeat_s = float(hb)
+        self.stale_after_s = float(stale_after_s if stale_after_s
+                                   is not None else 3 * self.heartbeat_s)
+        self.dirs = _init_root(root)
+        self.claim_dir = os.path.join(self.dirs["claim"],
+                                      f"rank{self.rank}")
+        os.makedirs(self.claim_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._claimed: Dict[str, Dict[str, Any]] = {}
+        self.served = 0
+        self.reclaimed = 0
+
+    # -- liveness ---------------------------------------------------------
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.dirs["hb"], f"rank{rank}.json")
+
+    def _beat(self) -> None:
+        _write_atomic(self._hb_path(self.rank), {
+            "rank": self.rank, "unix": time.time(),
+            "load": self.engine.load(),
+            "alive": self.engine.alive})
+
+    def _stale_peers(self) -> List[int]:
+        out = []
+        now = time.time()
+        try:
+            names = os.listdir(self.dirs["hb"])
+        except OSError:
+            return out
+        for n in names:
+            if not (n.startswith("rank") and n.endswith(".json")):
+                continue
+            r = int(n[4:-5])
+            if r == self.rank:
+                continue
+            try:
+                age = now - os.path.getmtime(self._hb_path(r))
+            except OSError:
+                continue
+            if age > self.stale_after_s:
+                out.append(r)
+        return out
+
+    # -- work movement ----------------------------------------------------
+
+    def _claim_some(self) -> None:
+        """Claim spool requests while the engine has headroom. The
+        atomic rename is the mutual exclusion: losing a race to a peer
+        is the normal case, not an error."""
+        headroom = self.engine.slots + max(2, self.engine.slots) \
+            - self.engine.load()
+        if headroom <= 0:
+            return
+        try:
+            names = sorted(os.listdir(self.dirs["spool"]))
+        except OSError:
+            return
+        for n in names:
+            if headroom <= 0:
+                break
+            if not n.endswith(".json"):
+                continue
+            src = os.path.join(self.dirs["spool"], n)
+            dst = os.path.join(self.claim_dir, n)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue                      # a peer won the claim
+            try:
+                with open(dst) as f:
+                    payload = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            self._start_request(payload, dst)
+            headroom -= 1
+
+    def _start_request(self, payload: Dict[str, Any],
+                       claim_path: str) -> None:
+        rid = payload["id"]
+        req = self.engine.submit(
+            payload.get("prompt") or None, payload["max_new_tokens"],
+            priority=payload.get("priority", 0),
+            eos_id=payload.get("eos_id"),
+            src=payload.get("src"),
+            request_id=rid)
+        self._claimed[rid] = {"payload": payload, "request": req,
+                              "claim_path": claim_path}
+
+    @staticmethod
+    def _retryable(req: Request) -> bool:
+        """Another replica could still serve this: THIS engine died
+        under it, or pushed back for capacity/lifecycle. Permanent
+        outcomes (validation rejects, expiry, cancel) must be PUBLISHED
+        instead — respooling them would bounce the request between
+        replicas forever with the client polling ``done/`` for nothing.
+        Decided by the machine-readable ``retryable`` flag set at each
+        rejection site, never by parsing reason strings."""
+        return req.status == RequestStatus.FAILED or req.retryable
+
+    def _publish_finished(self) -> None:
+        for rid in list(self._claimed):
+            ent = self._claimed[rid]
+            req: Request = ent["request"]
+            if not req.status.terminal:
+                continue
+            if req.status != RequestStatus.DONE and self._retryable(req):
+                # Return the claim to the spool for another replica.
+                self._return_claim(ent)
+                del self._claimed[rid]
+                continue
+            _write_atomic(
+                os.path.join(self.dirs["done"], f"{rid}.json"),
+                {"id": rid, "status": req.status.value,
+                 "reason": req.reason,
+                 "tokens": list(req.tokens),
+                 "served_by": f"rank{self.rank}",
+                 "ttft": req.ttft, "tpot": req.tpot,
+                 "queue_wait": req.queue_wait})
+            try:
+                os.remove(ent["claim_path"])
+            except OSError:
+                pass
+            del self._claimed[rid]
+            self.served += 1
+
+    def _return_claim(self, ent: Dict[str, Any]) -> None:
+        name = os.path.basename(ent["claim_path"])
+        try:
+            os.rename(ent["claim_path"],
+                      os.path.join(self.dirs["spool"], name))
+        except OSError:
+            pass
+
+    def _reclaim_stale(self) -> None:
+        """Adopt the claims of dead peers: move their claim files back
+        to the spool (the normal claim path then picks them up — maybe
+        by us, maybe by another survivor)."""
+        for r in self._stale_peers():
+            peer_dir = os.path.join(self.dirs["claim"], f"rank{r}")
+            try:
+                names = os.listdir(peer_dir)
+            except OSError:
+                continue
+            for n in names:
+                if not n.endswith(".json"):
+                    continue
+                rid = n[:-5]
+                if read_result(self.root, rid) is not None:
+                    # Finished just before death; response published.
+                    try:
+                        os.remove(os.path.join(peer_dir, n))
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    os.rename(os.path.join(peer_dir, n),
+                              os.path.join(self.dirs["spool"], n))
+                except OSError:
+                    continue                  # another survivor won
+                self.reclaimed += 1
+                metrics.event("serve_reclaim", rank=self.rank,
+                              from_rank=r, request=rid)
+
+    # -- loop -------------------------------------------------------------
+
+    def _retire(self) -> None:
+        """The engine died under us: publish what finished, hand every
+        unfinished claim back to the spool, withdraw the heartbeat so
+        peers fail over IMMEDIATELY (no staleness wait), and stop —
+        a dead replica must not keep out-claiming healthy peers just to
+        bounce requests."""
+        self._publish_finished()
+        for rid in list(self._claimed):
+            self._return_claim(self._claimed.pop(rid))
+        try:
+            os.remove(self._hb_path(self.rank))
+        except OSError:
+            pass
+        metrics.event("serve_replica_retired", rank=self.rank,
+                      reason=self.engine.failed or "engine stopped")
+        self._stop.set()
+
+    def poll_once(self) -> None:
+        if not self.engine.alive:
+            self._retire()
+            return
+        self._beat()
+        self._reclaim_stale()
+        self._claim_some()
+        self._publish_finished()
+
+    def start(self) -> "ReplicaServer":
+        self.engine.start()
+        if self._thread is not None:
+            return self
+
+        def loop():
+            last_beat = 0.0
+            while not self._stop.is_set():
+                if not self.engine.alive:
+                    self._retire()
+                    return
+                now = time.monotonic()
+                if now - last_beat >= min(0.25, self.heartbeat_s / 2):
+                    self._beat()
+                    last_beat = now
+                self._reclaim_stale()
+                self._claim_some()
+                self._publish_finished()
+                self._stop.wait(0.02)
+
+        self._thread = threading.Thread(
+            target=loop, name=f"hvd-replica-{self.rank}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self.engine.stop()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Serve until nothing claimed here is unfinished."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._thread is None:
+                self.poll_once()
+                self.engine.step_once()
+            if not self._claimed:
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,349 @@
+"""Request scheduler: FCFS+priority admission, deadlines, backpressure.
+
+Upstream Horovod never had a request path — its unit of work is the
+synchronous training step. Serving inverts that: work arrives whenever
+users send it, so admission control is where production behaviour is
+decided. The policy here is deliberately boring and fully observable:
+
+* **FCFS within priority**: higher ``priority`` admits first; ties break
+  by submission order (a monotone sequence number, never wall clock).
+* **Deadlines**: a request can carry ``deadline_s`` (relative at submit,
+  absolute monotonic internally). Expired requests are dropped at pop
+  time and mid-flight requests past deadline finish early with partial
+  output and ``RequestStatus.EXPIRED`` — late answers to users who
+  already gave up are pure waste.
+* **Backpressure**: the queue is bounded. When full, ``submit`` returns
+  the request already finalized as ``REJECTED`` with a machine-readable
+  ``reason`` — the caller (or the multi-replica dispatcher) decides to
+  retry elsewhere, shed, or surface the error. Nothing blocks, nothing
+  is silently dropped.
+
+:class:`SlotPool` is the engine-side accounting twin: a fixed set of
+decode-lane indices with acquire/release semantics whose invariants
+(no double-assign, no leak) are pinned by randomized tests.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "RequestQueue", "RequestStatus", "SlotPool"]
+
+_REQ_SEQ = itertools.count(1)
+
+
+class RequestStatus(Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    REJECTED = "rejected"
+    EXPIRED = "expired"
+    CANCELLED = "cancelled"
+    FAILED = "failed"
+
+    @property
+    def terminal(self) -> bool:
+        return self not in (RequestStatus.QUEUED, RequestStatus.RUNNING)
+
+
+class Request:
+    """One generation request: prompt in, streamed tokens out.
+
+    ``tokens`` grows as the engine commits output (``on_token`` fires per
+    commit for streaming consumers); ``result()`` blocks until terminal.
+    Timestamps are monotonic-clock, recorded by the engine: ``t_submit``,
+    ``t_admit``, ``t_first`` (first committed token — TTFT), ``t_done``.
+    """
+
+    def __init__(self, prompt, max_new_tokens: int, *,
+                 priority: int = 0, deadline_s: Optional[float] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: Optional[int] = None, eos_id: Optional[int] = None,
+                 src=None, request_id: Optional[str] = None,
+                 on_token: Optional[Callable[["Request", int], None]] = None):
+        self.seq = next(_REQ_SEQ)
+        self.id = request_id or f"req-{self.seq}"
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.deadline = (time.monotonic() + float(deadline_s)
+                         if deadline_s is not None else None)
+        self.temperature = float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.eos_id = eos_id
+        self.src = None if src is None else np.asarray(src, np.int32)
+        self.on_token = on_token
+        self._rng = (np.random.default_rng(seed)
+                     if temperature > 0 else None)
+        self.tokens: List[int] = []
+        self.status = RequestStatus.QUEUED
+        self.reason: Optional[str] = None
+        #: machine-readable failover hint, set at the rejection site: a
+        #: terminal non-DONE request with ``retryable`` could still be
+        #: served by another replica (capacity/lifecycle push-back, a
+        #: died engine) — as opposed to a permanent outcome (validation
+        #: reject, deadline, cancel). The replica spool keys on THIS,
+        #: never on the human-readable reason string.
+        self.retryable = False
+        self.served_by: Optional[str] = None
+        self.t_submit = time.monotonic()
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._done = threading.Event()
+        self._state_lock = threading.Lock()
+        self._cancel_requested = False
+        #: set by the owning engine once accepted: fired exactly once
+        #: with the request on ANY terminal transition, so the engine's
+        #: serve_requests_total{status} accounting balances even for
+        #: requests that end while still queued (deadline expiry at
+        #: pop, cancel, queue close).
+        self._on_terminal: Optional[Callable[["Request"], None]] = None
+
+    # -- lifecycle (engine-driven) ---------------------------------------
+
+    def _commit(self, token: int) -> None:
+        if self.t_first is None:
+            self.t_first = time.monotonic()
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            try:
+                self.on_token(self, int(token))
+            except Exception:
+                pass
+
+    def _finish(self, status: RequestStatus,
+                reason: Optional[str] = None) -> None:
+        with self._state_lock:
+            if self.status.terminal:
+                return
+            self.status = status
+            self.reason = reason
+            self.t_done = time.monotonic()
+        self._done.set()
+        if self._on_terminal is not None:
+            try:
+                self._on_terminal(self)
+            except Exception:
+                pass
+
+    def start_running(self) -> bool:
+        """Atomic QUEUED -> RUNNING transition (engine admission).
+        Refuses if the request went terminal or was cancelled in the
+        window between the queue pop and admission — without this gate
+        a concurrent ``cancel()`` could be resurrected into a running
+        lane after the caller already saw it cancelled."""
+        with self._state_lock:
+            if self.status != RequestStatus.QUEUED \
+                    or self._cancel_requested:
+                return False
+            self.status = RequestStatus.RUNNING
+            return True
+
+    def cancel(self) -> None:
+        """Cooperative cancel: queued requests never start; running ones
+        stop at the next step boundary with partial output."""
+        with self._state_lock:
+            if self.status.terminal:
+                return
+            self.reason = self.reason or "cancelled by caller"
+            self._cancel_requested = True
+            queued = self.status == RequestStatus.QUEUED
+        if queued:
+            self._finish(RequestStatus.CANCELLED, self.reason)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+    # -- caller surface ---------------------------------------------------
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; returns the (possibly partial) tokens.
+        Raises ``TimeoutError`` if still running at ``timeout``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"{self.id} still {self.status.value} "
+                               f"after {timeout}s")
+        return list(self.tokens)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Mean seconds per output token after the first."""
+        if self.t_first is None or self.t_done is None \
+                or len(self.tokens) < 2:
+            return None
+        return (self.t_done - self.t_first) / (len(self.tokens) - 1)
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    def describe(self) -> Dict[str, Any]:
+        return {"id": self.id, "status": self.status.value,
+                "reason": self.reason, "prompt_len": len(self.prompt),
+                "generated": len(self.tokens),
+                "priority": self.priority, "served_by": self.served_by,
+                "ttft": self.ttft, "tpot": self.tpot,
+                "queue_wait": self.queue_wait}
+
+    def __repr__(self) -> str:
+        return (f"Request({self.id}, {self.status.value}, "
+                f"prompt={len(self.prompt)}, gen={len(self.tokens)}/"
+                f"{self.max_new_tokens})")
+
+
+class RequestQueue:
+    """Bounded priority+FCFS queue with deadline-aware pop."""
+
+    def __init__(self, maxsize: int = 128):
+        if maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+        self.maxsize = int(maxsize)
+        self._lock = threading.Lock()
+        self._heap: List[tuple] = []    # (-priority, seq, request)
+        self._closed = False
+
+    def submit(self, req: Request) -> Request:
+        """Enqueue or reject-with-reason; never blocks. The decision is
+        recorded ON the request (status/reason), so callers and the
+        dispatcher read one object either way."""
+        with self._lock:
+            if self._closed:
+                req.retryable = True
+                req._finish(RequestStatus.REJECTED, "queue closed")
+                return req
+            if not self._has_room_locked():
+                req.retryable = True
+                req._finish(RequestStatus.REJECTED,
+                            f"queue full ({self.maxsize}); backpressure")
+                return req
+            heapq.heappush(self._heap, (-req.priority, req.seq, req))
+        return req
+
+    def _has_room_locked(self) -> bool:
+        """Capacity check under ``self._lock``. The heap holds
+        cancelled/expired corpses until a pop prunes them; when it
+        looks full, prune to the genuinely QUEUED before shedding load
+        the engine could actually serve."""
+        if len(self._heap) >= self.maxsize:
+            self._heap = [e for e in self._heap
+                          if e[2].status == RequestStatus.QUEUED]
+            heapq.heapify(self._heap)
+        return len(self._heap) < self.maxsize
+
+    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+        """Highest-priority FCFS request still worth starting; expires
+        stale and cancelled entries on the way."""
+        now = now if now is not None else time.monotonic()
+        while True:
+            with self._lock:
+                if not self._heap:
+                    return None
+                _, _, req = heapq.heappop(self._heap)
+            if req.status != RequestStatus.QUEUED:
+                continue                       # cancelled while queued
+            if req.expired(now):
+                req._finish(RequestStatus.EXPIRED,
+                            "deadline passed while queued")
+                continue
+            return req
+
+    def try_submit(self, req: Request) -> bool:
+        """Enqueue if there is room; returns False WITHOUT finalizing
+        the request otherwise — for callers (failover adoption) that
+        want to try another queue rather than surface a rejection."""
+        with self._lock:
+            if self._closed or not self._has_room_locked():
+                return False
+            heapq.heappush(self._heap, (-req.priority, req.seq, req))
+        return True
+
+    def requeue(self, req: Request) -> None:
+        """Put a popped-but-unstarted request back (engine found no
+        cache blocks for it). Keyed on the ORIGINAL sequence number, so
+        FCFS order within its priority is preserved; bypasses the size
+        bound — the request was already admitted once."""
+        with self._lock:
+            heapq.heappush(self._heap, (-req.priority, req.seq, req))
+
+    def depth(self) -> int:
+        with self._lock:
+            return sum(1 for *_, r in self._heap
+                       if r.status == RequestStatus.QUEUED)
+
+    def drain(self) -> List[Request]:
+        """Remove and return every still-queued request (dispatcher
+        failover: survivors adopt a lost replica's queue)."""
+        with self._lock:
+            heap, self._heap = self._heap, []
+        return [r for *_, r in heap if r.status == RequestStatus.QUEUED]
+
+    def close(self, reason: str = "engine shut down") -> List[Request]:
+        with self._lock:
+            self._closed = True
+        rejected = self.drain()
+        for r in rejected:
+            r.retryable = True
+            r._finish(RequestStatus.REJECTED, reason)
+        return rejected
+
+
+class SlotPool:
+    """Fixed pool of decode-lane indices with leak-proof accounting."""
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError(f"need at least one slot, got {n}")
+        self.n = int(n)
+        self._lock = threading.Lock()
+        self._free = list(range(n - 1, -1, -1))
+        self._busy: set = set()
+
+    def acquire(self) -> Optional[int]:
+        with self._lock:
+            if not self._free:
+                return None
+            s = self._free.pop()
+            self._busy.add(s)
+            return s
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot not in self._busy:
+                raise RuntimeError(f"slot {slot} released but not held "
+                                   f"(busy: {sorted(self._busy)})")
+            self._busy.remove(slot)
+            self._free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def busy_count(self) -> int:
+        with self._lock:
+            return len(self._busy)
+
+    def check(self) -> None:
+        with self._lock:
+            assert len(self._free) + len(self._busy) == self.n, \
+                (self._free, self._busy)
+            assert len(set(self._free)) == len(self._free)
+            assert not (set(self._free) & self._busy)

@@ -273,6 +273,34 @@ class TestFlusher:
         payload = json.loads(to_json())
         assert payload["counters"]["np_total"][0]["value"] == 5
 
+    def test_atexit_drains_final_snapshot(self, tmp_path):
+        """Satellite fix: a short-lived process (serving replica, one-
+        shot bench) whose lifetime is shorter than the flush interval
+        must still land its FINAL snapshot at interpreter exit — the
+        flusher registers an atexit drain; nobody calls stop or
+        shutdown here."""
+        import os
+        import subprocess
+        import sys
+        import textwrap
+        path = tmp_path / "exit_metrics.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = textwrap.dedent(f"""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, {repo!r})
+            from horovod_tpu import metrics
+            metrics.counter("atexit_probe_total").inc(3)
+            metrics.start_metrics_flusher({str(path)!r},
+                                          interval_s=3600)
+            # fall off the end: only atexit can write the snapshot
+        """)
+        r = subprocess.run([sys.executable, "-c", code], timeout=300,
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+        data = json.loads(path.read_text())
+        assert data["counters"]["atexit_probe_total"][0]["value"] == 3
+
 
 class TestTimelineCrossLink:
     def test_event_marks_active_timeline(self, tmp_path):

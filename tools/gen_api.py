@@ -50,6 +50,11 @@ MODULES = [
     "horovod_tpu.models.t5",
     "horovod_tpu.models.convert",
     "horovod_tpu.models.generate",
+    "horovod_tpu.serving",
+    "horovod_tpu.serving.cache",
+    "horovod_tpu.serving.scheduler",
+    "horovod_tpu.serving.engine",
+    "horovod_tpu.serving.replica",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Serving load generator: Poisson arrivals against one InferenceEngine,
+TTFT / TPOT / throughput percentiles as JSON lines.
+
+Offline bench numbers (``bench.py --model gpt2_decode``) measure the
+decode program's raw token rate; what users feel is different — time to
+*first* token under contention (TTFT), steady-state time per output
+token (TPOT), and how both degrade as the arrival rate climbs. This
+tool measures exactly that: requests arrive on a seeded exponential
+clock, prompt lengths and output budgets drawn from seeded ranges, the
+engine serves them under its real continuous-batching scheduler, and
+the record carries p50/p90/p99 of every latency plus goodput.
+
+One JSON line per run to stdout (append with ``--out``); the same
+record shape lands in ``BENCH_SELF.jsonl`` via ``bench.py --serve``.
+
+Usage::
+
+    python tools/serve_bench.py                 # tiny model, CPU
+    python tools/serve_bench.py --requests 64 --rate 20 --slots 8
+    python tools/serve_bench.py --kv-quant int8 --prefill-chunk 16
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(q / 100 * (len(xs) - 1)))))
+    return round(xs[i], 6)
+
+
+def _summary(xs):
+    return {"p50": _pct(xs, 50), "p90": _pct(xs, 90),
+            "p99": _pct(xs, 99), "n": len(xs)}
+
+
+def run_bench(*, requests: int = 32, rate: float = 50.0,
+              slots: int = 8, max_len: int = 160,
+              block_size: int = 16, prefill_chunk: int = 8,
+              kv_quant=None, num_blocks=None,
+              model_size: str = "tiny", seed: int = 0,
+              metric: str = "serve_tokens_per_sec") -> dict:
+    """Run one load level; returns (and prints) the record."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    if model_size == "tiny":
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        max_len = min(max_len, cfg.max_seq_len)
+    else:
+        # gpt2-medium geometry, the family bench.py's decode bench uses.
+        cfg = GPT2Config(vocab_size=50257, max_seq_len=max(max_len, 1024),
+                         num_layers=24, num_heads=16, d_model=1024,
+                         dtype=jnp.bfloat16)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(seed)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+
+    eng = InferenceEngine(model, params, slots=slots, max_len=max_len,
+                          block_size=block_size,
+                          prefill_chunk=prefill_chunk,
+                          kv_quant=kv_quant, num_blocks=num_blocks,
+                          queue_limit=max(64, 4 * requests),
+                          name="serve-bench")
+    eng.start()
+
+    # Warm both programs outside the measured window, so the record
+    # reports serving latency, not compile latency.
+    warm = eng.submit([1, 2, 3, 4, 5], 4)
+    warm.result(timeout=600)
+
+    gaps = rng.exponential(1.0 / rate, size=requests)
+    prompts = [list(rng.integers(1, cfg.vocab_size - 1,
+                                 int(rng.integers(4, 17))))
+               for _ in range(requests)]
+    budgets = [int(rng.integers(8, 33)) for _ in range(requests)]
+
+    reqs = []
+    t0 = time.perf_counter()
+    for gap, p, n in zip(gaps, prompts, budgets):
+        time.sleep(float(gap))
+        reqs.append(eng.submit(p, n))
+    for r in reqs:
+        try:
+            r.result(timeout=600)
+        except TimeoutError:
+            pass
+    wall = time.perf_counter() - t0
+    eng.stop()
+
+    done = [r for r in reqs if r.status.value == "done"]
+    tokens = sum(len(r.tokens) for r in reqs)
+    rec = {
+        "metric": metric,
+        "value": round(tokens / wall, 2),
+        "unit": "tokens/sec", "vs_baseline": None,
+        "requests": requests, "completed": len(done),
+        "rejected": sum(1 for r in reqs
+                        if r.status.value == "rejected"),
+        "arrival_rate_hz": rate, "wall_s": round(wall, 3),
+        "slots": slots, "max_len": max_len, "block_size": block_size,
+        "prefill_chunk": prefill_chunk, "kv_quant": kv_quant,
+        "model": f"gpt2-{model_size}",
+        "ttft_s": _summary([r.ttft for r in done
+                            if r.ttft is not None]),
+        "tpot_s": _summary([r.tpot for r in done
+                            if r.tpot is not None]),
+        "queue_wait_s": _summary([r.queue_wait for r in done
+                                  if r.queue_wait is not None]),
+        "blocks_peak": eng.manager.peak_blocks_in_use,
+        "blocks_capacity": eng.manager.capacity,
+        "dense_equivalent_blocks": slots * eng.max_blocks_per_slot,
+        "decode_compiles": eng.decode_compiles,
+        "prefill_compiles": eng.prefill_compiles,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="Poisson arrival rate (requests/sec)")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=160)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--prefill-chunk", type=int, default=8)
+    p.add_argument("--kv-quant", choices=["int8", "fp8"], default=None)
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="shared KV pool size (default: dense equivalent)")
+    p.add_argument("--model-size", choices=["tiny", "medium"],
+                   default="tiny")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="append the JSON record to this file")
+    return p
+
+
+def main() -> int:
+    args = _build_parser().parse_args()
+    rec = run_bench(
+        requests=args.requests, rate=args.rate, slots=args.slots,
+        max_len=args.max_len, block_size=args.block_size,
+        prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant,
+        num_blocks=args.num_blocks, model_size=args.model_size,
+        seed=args.seed)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
