@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: trace-smoke overlap-smoke serve-smoke test native
+.PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -26,6 +26,14 @@ overlap-smoke:
 # tier-1 as tests/test_serving.py::TestTwoProcessSmoke.
 serve-smoke:
 	$(PY) tools/serve_smoke.py
+
+# Doctor smoke: 2 CPU processes with a manufactured 250ms straggler and a
+# forced recompile (static arg change); hvd.doctor() over the merged trace
+# + fused metrics snapshots must rank both — the straggler naming rank 1,
+# the recompile naming the blamed argument. Also runs in tier-1 as
+# tests/test_doctor.py::TestTwoProcessSmoke.
+doctor-smoke:
+	$(PY) tools/doctor_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

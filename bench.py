@@ -46,17 +46,12 @@ import horovod_tpu as hvd
 
 BASELINE_IMG_PER_SEC = 600.0
 
-# bf16 peak TFLOP/s by device kind substring.
-_PEAKS = {"TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v4": 275.0,
-          "TPU v5p": 459.0, "TPU v6": 918.0}
-
-
 def _peak_tflops():
-    kind = getattr(jax.devices()[0], "device_kind", "")
-    for k, v in _PEAKS.items():
-        if k in kind:
-            return v
-    return None
+    # Device peaks (and the whole r5 MFU/HFU relabel) live in exactly one
+    # place now: horovod_tpu.profiler. Kept as a module function so tests
+    # can monkeypatch the peak.
+    from horovod_tpu import profiler
+    return profiler.peak_tflops()
 
 
 def _sync(x):
@@ -68,23 +63,30 @@ def _sync(x):
     np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0].ravel()[:1]))
 
 
-def _measure(step, state, extra, steps):
-    lowered = step.lower(*state, *extra)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    flops = float(cost.get("flops", 0.0)) if cost else 0.0
+def _measure(step, state, extra, steps, program="bench_step",
+             model_flops=None):
+    """Register the step's compiled cost analysis in the profiler's
+    program registry (flops/bytes/peak-HBM — the numbers every report
+    field below derives from), then time the jitted step. Returns
+    ``(dt, ProgramRecord)``; the timing also feeds the live
+    ``program_mfu``/``program_hfu`` gauges via ``observe_step``."""
+    from horovod_tpu import profiler
+    compiled = step.lower(*state, *extra).compile()
+    rec = profiler.record_cost(program, compiled, model_flops=model_flops)
 
-    state = step(*state, *extra)          # warm the cache with the compiled fn
-    state = step(*state, *extra)
+    # Time through the SAME compiled executable the cost came from — the
+    # AOT compile doesn't populate jit's cache, so calling `step` here
+    # would compile the program a second time.
+    state = compiled(*state, *extra)      # warm
+    state = compiled(*state, *extra)
     _sync(state)
     t0 = time.perf_counter()
     for _ in range(steps):
-        state = step(*state, *extra)
+        state = compiled(*state, *extra)
     _sync(state)
     dt = (time.perf_counter() - t0) / steps
-    return dt, flops
+    profiler.observe_step(program, dt)
+    return dt, rec
 
 
 def _n_params(tree):
@@ -124,13 +126,13 @@ def _collective_counters():
 
 
 def _report(metric, unit, per_sec, dt, flops, vs_baseline=None,
-            model_flops=None):
+            model_flops=None, peak_hbm_bytes=None):
     """``flops`` is executed (XLA cost analysis) -> hfu; ``model_flops``
     is the analytic remat-invariant count -> mfu. When model_flops is
-    None (vision configs, no remat) the two coincide."""
-    peak = _peak_tflops()
-    if model_flops is None:
-        model_flops = flops
+    None (vision configs, no remat) the two coincide. The split itself
+    lives in ``profiler.utilization`` — bench only formats the line."""
+    from horovod_tpu import profiler
+    u = profiler.utilization(flops, dt, model_flops, peak=_peak_tflops())
     rec = {
         "metric": metric,
         "value": round(per_sec, 2),
@@ -138,12 +140,14 @@ def _report(metric, unit, per_sec, dt, flops, vs_baseline=None,
         "vs_baseline": (round(vs_baseline, 3) if vs_baseline is not None
                         else None),
         "step_ms": round(dt * 1e3, 2),
-        "achieved_tflops": round(flops / dt / 1e12, 1),
-        "model_tflops": round(model_flops / dt / 1e12, 1),
+        "achieved_tflops": round(u["achieved_tflops"], 1),
+        "model_tflops": round(u["model_tflops"], 1),
     }
-    if peak:
-        rec["hfu"] = round(flops / dt / 1e12 / peak, 3)
-        rec["mfu"] = round(model_flops / dt / 1e12 / peak, 3)
+    if peak_hbm_bytes is not None:
+        rec["peak_hbm_bytes"] = int(peak_hbm_bytes)
+    if u["hfu"] is not None:
+        rec["hfu"] = round(u["hfu"], 3)
+        rec["mfu"] = round(u["mfu"], 3)
     rec.update(_collective_counters())
     print(json.dumps(rec), flush=True)
     return rec
@@ -193,11 +197,12 @@ def bench_resnet50(on_tpu):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), batch_stats, opt_state
 
-    dt, flops = _measure(step, (params, batch_stats, opt_state),
-                         (images, labels), steps)
+    dt, rec = _measure(step, (params, batch_stats, opt_state),
+                       (images, labels), steps, program="bench:resnet50")
     return _report("resnet50_images_per_sec_per_chip", "images/sec/chip",
-                   batch / dt, dt, flops,
-                   vs_baseline=batch / dt / BASELINE_IMG_PER_SEC)
+                   batch / dt, dt, rec.flops,
+                   vs_baseline=batch / dt / BASELINE_IMG_PER_SEC,
+                   peak_hbm_bytes=rec.peak_hbm_bytes)
 
 
 def _bench_lm(params, tokens, loss_fn, steps, metric, model_flops=None):
@@ -212,10 +217,12 @@ def _bench_lm(params, tokens, loss_fn, steps, metric, model_flops=None):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
-    dt, flops = _measure(step, (params, opt_state), (), steps)
+    dt, rec = _measure(step, (params, opt_state), (), steps,
+                       program=f"bench:{metric}", model_flops=model_flops)
     n_tokens = tokens.shape[0] * tokens.shape[1]
-    return _report(metric, "tokens/sec/chip", n_tokens / dt, dt, flops,
-                   model_flops=model_flops)
+    return _report(metric, "tokens/sec/chip", n_tokens / dt, dt, rec.flops,
+                   model_flops=rec.model_flops,
+                   peak_hbm_bytes=rec.peak_hbm_bytes)
 
 
 def bench_gpt2(on_tpu):
@@ -309,9 +316,11 @@ def bench_vit(on_tpu):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
-    dt, flops = _measure(step, (params, opt_state), (), steps)
+    dt, rec = _measure(step, (params, opt_state), (), steps,
+                       program="bench:vit")
     return _report("vit_b16_images_per_sec_per_chip", "images/sec/chip",
-                   batch / dt, dt, flops)
+                   batch / dt, dt, rec.flops,
+                   peak_hbm_bytes=rec.peak_hbm_bytes)
 
 
 def bench_mnist(on_tpu):
@@ -339,9 +348,11 @@ def bench_mnist(on_tpu):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
-    dt, flops = _measure(step, (params, opt_state), (), steps)
+    dt, rec = _measure(step, (params, opt_state), (), steps,
+                       program="bench:mnist")
     return _report("mnist_images_per_sec_per_chip", "images/sec/chip",
-                   batch / dt, dt, flops)
+                   batch / dt, dt, rec.flops,
+                   peak_hbm_bytes=rec.peak_hbm_bytes)
 
 
 def bench_allreduce(on_tpu):
@@ -601,20 +612,30 @@ def bench_gpt2_decode(on_tpu):
         params = jax.tree_util.tree_map(
             lambda x: x.astype(jnp.bfloat16), params)
 
-    fn = jax.jit(lambda p, t: generate(model, p, t, N))
-    _sync(fn(params, prompt))                  # compile + warm
+    from horovod_tpu import profiler
+    # The AOT compile serves BOTH the cost capture and the bench loop —
+    # routing the loop through jax.jit would compile the decode scan a
+    # second time (AOT compiles don't populate jit's cache).
+    fn = jax.jit(lambda p, t: generate(model, p, t, N)).lower(
+        params, prompt).compile()
+    prec = profiler.record_cost("bench:gpt2_decode", fn)
+    _sync(fn(params, prompt))                  # warm (already compiled)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(params, prompt)
     _sync(out)
     dt = (time.perf_counter() - t0) / reps
     steps = P + N - 1                          # every scan step decodes
+    # One registry "step" = one full generate() program (the compiled
+    # scan), matching the cost analysis captured above.
+    profiler.observe_step("bench:gpt2_decode", dt)
     rec = {
         "metric": "gpt2_medium_decode_tokens_per_sec_per_chip",
         "value": round(B * steps / dt, 2),
         "unit": "tokens/sec/chip", "vs_baseline": None,
         "step_ms": round(dt * 1e3 / steps, 3),  # per decode step
         "batch": B, "prompt": P, "new_tokens": N,
+        "peak_hbm_bytes": int(prec.peak_hbm_bytes),
     }
     rec.update(_collective_counters())
     print(json.dumps(rec), flush=True)

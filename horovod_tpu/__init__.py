@@ -35,6 +35,12 @@ from horovod_tpu import overlap  # noqa: F401
 # Continuous-batching inference: hvd.serving.InferenceEngine (paged KV
 # cache, request scheduler, multi-replica dispatch — docs/SERVING.md).
 from horovod_tpu import serving  # noqa: F401
+# Always-on roofline introspection: program registry (MFU/HFU/peak-HBM
+# gauges), recompile detection with argument blame, memory accounting,
+# triggered jax.profiler captures, and hvd.doctor() automated diagnosis
+# (docs/OBSERVABILITY.md "Roofline gauges" / "Doctor").
+from horovod_tpu import profiler  # noqa: F401
+from horovod_tpu.profiler import doctor, profile  # noqa: F401
 from horovod_tpu.metrics import reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,

@@ -939,6 +939,21 @@ def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
         # First dispatch of a new program: trace + XLA compile dominate.
         _metrics.counter("collective_compile_total", kind=kind).inc()
         _metrics.histogram("collective_compile_seconds", kind=kind).observe(dt)
+        # Program-registry entry for the eager program (profiler.py): a
+        # new shape legitimately compiles a new program, so this is a
+        # compile COUNT, not a recompile blame — but a registry that
+        # shows 40 allreduce programs is itself the doctor's evidence of
+        # shape churn. Cost analysis is skipped (re-lowering every eager
+        # shape would double compile time for a number nobody reads).
+        try:
+            from horovod_tpu import profiler as _profiler
+            _profiler.count_trace(f"collective:{kind}",
+                                  last_shapes=str(shapes)[:120],
+                                  last_bytes=int(nbytes))
+            _metrics.counter("program_compiles_total",
+                             program=f"collective:{kind}").inc()
+        except Exception:
+            pass
     out_leaves = list(out_leaves)
     if joined and kind == "allreduce" and params[0] == ReduceOp.Average:
         # The compiled program divides by the full world size; joined

@@ -88,6 +88,20 @@ class Config:
     # The same knobs gate metrics.StallWatchdog (auto-started by init()).
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
+    # Profiler subsystem (profiler.py): HOROVOD_PROFILE_ON_STALL=1 lets
+    # the stall watchdog and serving deadline breaches trigger a bounded,
+    # rank-scoped jax.profiler capture; HOROVOD_PROFILE_DIR is where
+    # captures land, HOROVOD_PROFILE_SECONDS bounds each capture and
+    # HOROVOD_PROFILE_MAX_CAPTURES caps captures per process (a stall
+    # storm must not become a disk-filling profile storm).
+    profile_on_stall: bool = False
+    profile_dir: str = "/tmp/horovod_profile"
+    profile_seconds: float = 5.0
+    profile_max_captures: int = 2
+    # HOROVOD_PROFILER_COST: tri-state — None (unset) lets each call site
+    # pick its default (instrumented steps ON, serving engine OFF, whose
+    # capture compiles each phase twice); set forces it for both.
+    profiler_cost: Optional[bool] = None
     # Serving subsystem (serving/, docs/SERVING.md): HOROVOD_SERVE_SLOTS
     # decode lanes per engine, HOROVOD_SERVE_MAX_LEN max prompt+output
     # tokens, HOROVOD_SERVE_BLOCK_SIZE tokens per paged-KV block,
@@ -206,6 +220,16 @@ def refresh() -> Config:
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
         stall_check_time_seconds=_env_float(
             "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+        profile_on_stall=_env_bool("HOROVOD_PROFILE_ON_STALL"),
+        profile_dir=(os.environ.get("HOROVOD_PROFILE_DIR")
+                     or "/tmp/horovod_profile"),
+        profile_seconds=max(
+            0.1, _env_float("HOROVOD_PROFILE_SECONDS", 5.0)),
+        profile_max_captures=_env_posint(
+            "HOROVOD_PROFILE_MAX_CAPTURES", 2),
+        profiler_cost=(None if os.environ.get("HOROVOD_PROFILER_COST",
+                                              "").strip() == ""
+                       else _env_bool("HOROVOD_PROFILER_COST")),
         serve_slots=_env_posint("HOROVOD_SERVE_SLOTS", 8),
         serve_max_len=_env_posint("HOROVOD_SERVE_MAX_LEN", 512),
         serve_block_size=_env_posint("HOROVOD_SERVE_BLOCK_SIZE", 16),

@@ -320,5 +320,8 @@ def build_info() -> dict:
         "xla_latency_hiding": cfg.xla_latency_hiding,
         "autotune": cfg.autotune,
         "autotune_mode": cfg.autotune_mode,
+        "profile_on_stall": cfg.profile_on_stall,
+        "profile_dir": cfg.profile_dir,
+        "profiler_cost": cfg.profiler_cost,
         "inert_env": dict(cfg.inert),
     }

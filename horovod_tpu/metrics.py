@@ -55,7 +55,7 @@ logger = logging.getLogger("horovod_tpu")
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "registry",
     "counter", "gauge", "histogram", "event",
-    "snapshot", "reset_metrics", "to_prometheus", "to_json",
+    "snapshot", "reset_metrics", "to_prometheus", "to_json", "set_help",
     "collective_summary",
     "start_metrics_flusher", "stop_metrics_flusher",
     "collective_begin", "collective_end", "pending_collectives",
@@ -302,9 +302,77 @@ def _timeline_marker(name: str, category: str = "metrics", **args) -> None:
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _PREFIX = "horovod_tpu_"
 
+#: ``# HELP`` text by metric family (pre-prefix name). Instrumentation
+#: sites may add their own via :func:`set_help`; families without an entry
+#: export with a ``# TYPE`` header only.
+_HELP: Dict[str, str] = {
+    "collective_calls_total": "Eager collective dispatches by kind.",
+    "collective_bytes_total": "Payload bytes moved by eager collectives.",
+    "collective_dispatch_seconds": "Host dispatch latency per collective.",
+    "collective_compile_total": "First dispatches of a new program.",
+    "collective_compile_seconds": "Trace + XLA compile latency.",
+    "collective_traced_total": "In-jit collective lowerings (per trace).",
+    "collective_arrival_spread_seconds":
+        "First-to-last rank arrival spread per collective.",
+    "negotiation_rounds_total": "Multi-process negotiation rounds by path.",
+    "fusion_fill_ratio": "Fusion bucket fill vs HOROVOD_FUSION_THRESHOLD.",
+    "stall_events_total": "Stall watchdog fires.",
+    "world_size": "Devices in the global communicator.",
+    "program_compiles_total": "Fingerprinted compilations per program.",
+    "recompiles_total":
+        "Signature-change recompilations per program (profiler.py).",
+    "expected_recompiles_total":
+        "Recompilations tagged by-design (autotuner rebuilds); the "
+        "doctor skips these programs.",
+    "recompile_blame_total":
+        "Recompilations blamed on one argument's signature change.",
+    "program_flops": "Executed FLOPs per call (XLA cost analysis).",
+    "program_bytes_accessed": "HBM bytes accessed per call.",
+    "program_peak_hbm_bytes": "Peak device memory of the compiled program.",
+    "program_mfu": "Model-FLOPs utilization (analytic, remat-invariant).",
+    "program_expected_mfu":
+        "Doctor threshold: program_mfu below 0.8x this is a finding.",
+    "program_hfu": "Hardware-FLOPs utilization (counts remat recompute).",
+    "hbm_bandwidth_utilization": "Bytes-accessed rate over device HBM BW.",
+    "program_step_seconds": "Observed (synced) step time per program.",
+    "memory_pressure_total": "Device HBM high-water crossings.",
+    "serve_requests_total": "Serving requests by terminal status.",
+    "serve_ttft_seconds": "Serving time-to-first-token.",
+    "serve_tpot_seconds": "Serving time-per-output-token.",
+}
+
+
+def set_help(name: str, text: str) -> None:
+    """Register ``# HELP`` text for a metric family (one line; newlines
+    and backslashes are escaped at export)."""
+    _HELP[name] = str(text)
+
 
 def _prom_name(name: str) -> str:
     return _PREFIX + _NAME_RE.sub("_", name)
+
+
+def _help_escape(v: str) -> str:
+    # Exposition format: HELP text escapes backslash and newline only
+    # (quotes are literal there, unlike in label values).
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _family_header(lines: List[str], emitted: set, name: str,
+                   mtype: str) -> bool:
+    """``# HELP`` (when known) + ``# TYPE``, exactly once per family.
+    Returns False when the family name was already exported under
+    another kind (the same name registered as counter AND gauge): the
+    caller must then skip that series entirely — a second sample set
+    under one name is a duplicate timeseries, which scrapers reject."""
+    pname = _prom_name(name)
+    if pname in emitted:
+        return False
+    emitted.add(pname)
+    if name in _HELP:
+        lines.append(f"# HELP {pname} {_help_escape(_HELP[name])}")
+    lines.append(f"# TYPE {pname} {mtype}")
+    return True
 
 
 def _prom_labels(labels: Dict[str, str], extra: str = "") -> str:
@@ -331,25 +399,30 @@ def _prom_num(v: float) -> str:
 
 def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     """Render a snapshot in the Prometheus text exposition format
-    (version 0.0.4: ``# TYPE`` headers, ``_bucket{le=...}`` cumulative
-    histograms with ``_sum``/``_count``)."""
+    (version 0.0.4: ``# HELP``/``# TYPE`` once per family, escaped label
+    values, ``_bucket{le=...}`` cumulative histograms with
+    ``_sum``/``_count``)."""
     snap = snap if snap is not None else snapshot()
     lines: List[str] = []
+    emitted: set = set()
     for name, series in sorted(snap.get("counters", {}).items()):
         pname = _prom_name(name)
-        lines.append(f"# TYPE {pname} counter")
+        if not _family_header(lines, emitted, name, "counter"):
+            continue
         for s in series:
             lines.append(
                 f"{pname}{_prom_labels(s['labels'])} {_prom_num(s['value'])}")
     for name, series in sorted(snap.get("gauges", {}).items()):
         pname = _prom_name(name)
-        lines.append(f"# TYPE {pname} gauge")
+        if not _family_header(lines, emitted, name, "gauge"):
+            continue
         for s in series:
             lines.append(
                 f"{pname}{_prom_labels(s['labels'])} {_prom_num(s['value'])}")
     for name, series in sorted(snap.get("histograms", {}).items()):
         pname = _prom_name(name)
-        lines.append(f"# TYPE {pname} histogram")
+        if not _family_header(lines, emitted, name, "histogram"):
+            continue
         for s in series:
             for le, c in s["buckets"]:
                 le_label = f'le="{_prom_num(le)}"'
@@ -673,6 +746,15 @@ class StallWatchdog:
                 self._on_stall(report)
             except Exception:
                 logger.exception("stall callback failed")
+        # HOROVOD_PROFILE_ON_STALL=1: capture a bounded, rank-scoped
+        # device trace of the stalled window (profiler.py gates on the
+        # knob and its own capture budget).
+        try:
+            from horovod_tpu import profiler as _profiler
+            _profiler.maybe_trigger(
+                f"stall_{report['kind']}_{report['tensor']}")
+        except Exception:
+            pass
 
     def _loop(self) -> None:
         while not self._stop.wait(self._poll_s):

@@ -120,11 +120,23 @@ class AutotunedStep:
         return self._tuner.current_threshold()
 
     def _build(self, threshold: int):
+        from horovod_tpu import profiler as _profiler
         if self._make_arity >= 3:
             t = self._tuner
             alg = getattr(t, "current_algorithm", lambda: "auto")()
             chunks = getattr(t, "current_chunks", lambda: None)()
+            # Tuner rebuilds recompile BY DESIGN (one per probe);
+            # expected=True keeps the count in recompiles_total{program}
+            # without hvd.doctor() flagging the churn as a defect.
+            _profiler.note_trace(
+                "autotuned_step",
+                {"fusion_threshold": str(int(threshold)),
+                 "algorithm": str(alg), "chunks": str(chunks)},
+                expected=True)
             return self._make(threshold, alg, chunks)
+        _profiler.note_trace(
+            "autotuned_step", {"fusion_threshold": str(int(threshold))},
+            expected=True)
         return self._make(threshold)
 
     def _agree_and_rebuild(self) -> None:
@@ -178,6 +190,8 @@ class AutotunedStep:
         # gauge freezes at the last tuned-step value.
         _metrics.gauge("optimizer_step_seconds").set(dt)
         _metrics.histogram("optimizer_step_latency_seconds").observe(dt)
+        from horovod_tpu import profiler as _profiler
+        _profiler.observe_step("autotuned_step", dt)
         if (getattr(self._tuner, "pending_sync", False)
                 or self._tuner.converged
                 or self._tuner.current_threshold() != before):

@@ -1,0 +1,1244 @@
+"""Always-on performance introspection: program registry, roofline gauges,
+recompile detection, memory accounting, triggered profiling, and the
+``hvd.doctor()`` automated diagnosis.
+
+ROOFLINE.md answers "is this step as fast as the hardware allows?" by hand:
+one-off tools lower a train step, read XLA's compiled-program cost analysis,
+and divide by the device peak. This module makes that analysis a permanent
+subsystem — the third observability layer on top of metrics (aggregates)
+and tracing (timelines):
+
+* **Program registry** (:class:`ProgramRegistry` / :func:`instrument`):
+  every jitted step we own — train steps, serving decode/prefill, bench
+  programs — registers its compiled cost analysis (flops, bytes accessed,
+  peak HBM) once per compilation, and every honest step timing fed to
+  :func:`observe_step` updates live ``program_mfu`` / ``program_hfu`` /
+  ``hbm_bandwidth_utilization`` gauges. The MFU/HFU split follows the
+  bench.py r5 convention: **hfu** divides XLA's *executed* FLOPs (counts
+  remat recompute) by the device peak, **mfu** divides the analytic,
+  remat-invariant model FLOPs (PaLM App-B for LMs) by the same peak —
+  configs compare on mfu, hfu explains where the step time went.
+* **Recompile detector** (:meth:`ProgramRegistry.note_trace`): fingerprints
+  (shapes / dtypes / static args) at every call, counts
+  ``recompiles_total{program}``, and **blames the argument whose signature
+  changed** (``recompile_blame_total{program,argument}``). Recompiles are
+  the classic silent perf killer — the serving engine pins
+  ``decode_compiles == 1``; this generalizes that guard to everything.
+* **Memory accounting**: :func:`live_buffer_census` (live jax buffers by
+  platform), per-program ``program_peak_hbm_bytes`` gauges from XLA's
+  memory analysis, and :func:`check_memory_pressure` — ``memory_pressure``
+  events land in the metrics registry and the active timeline when a
+  device's HBM use crosses the high-water fraction.
+* **Triggered profiling**: :func:`profile` (context manager over
+  ``jax.profiler``) and :func:`trigger_profile` — a bounded, rank-scoped
+  capture fired automatically by the StallWatchdog and by serving deadline
+  breaches under ``HOROVOD_PROFILE_ON_STALL=1`` (at most
+  ``HOROVOD_PROFILE_MAX_CAPTURES`` captures of
+  ``HOROVOD_PROFILE_SECONDS`` each).
+* **Doctor** (:func:`doctor` / ``tools/perf_doctor.py``): fuses the
+  metrics snapshot, the merged cross-rank trace (straggler + overlap
+  reports), and the program registry into a **ranked findings report** —
+  straggler rank, recompile churn with the blamed argument, MFU below
+  expectation, fusion fill, overlap efficiency, serving SLO burn — each
+  finding with a concrete knob suggestion (``HOROVOD_FUSION_THRESHOLD``,
+  ``algorithm=``, ``HOROVOD_OVERLAP_CHUNKS``, slot/pool sizing).
+
+"Highly Available Data Parallel ML training on Mesh Networks" (arxiv
+2011.03605) assumes this layer exists for detecting degraded replicas; the
+EQuARX line (arxiv 2506.17615) uses it to decide when comm-side
+optimizations are worth their accuracy cost.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = [
+    "ProgramRecord", "ProgramRegistry", "registry",
+    "peak_tflops", "hbm_gbps", "utilization", "cost_from", "describe",
+    "instrument", "ProfiledStep",
+    "note_trace", "observe_step", "record_cost", "count_trace",
+    "live_buffer_census", "check_memory_pressure",
+    "profile", "trigger_profile", "profile_capture_count",
+    "doctor", "format_report",
+    "PEAK_TFLOPS_BF16", "HBM_GBPS",
+]
+
+# ---------------------------------------------------------------------------
+# device peaks (the denominators of every utilization gauge)
+# ---------------------------------------------------------------------------
+
+#: bf16 peak TFLOP/s by device-kind substring (FMA = 2 FLOPs — the same
+#: convention as XLA's cost analysis, so hfu ratios are honest).
+PEAK_TFLOPS_BF16: Dict[str, float] = {
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v4": 275.0,
+    "TPU v5p": 459.0, "TPU v6": 918.0,
+}
+
+#: HBM bandwidth GB/s by device-kind substring (bounds the decode/BN-stats
+#: regimes where bytes, not FLOPs, set the roofline).
+HBM_GBPS: Dict[str, float] = {
+    "TPU v5 lite": 820.0, "TPU v5e": 820.0, "TPU v4": 1228.0,
+    "TPU v5p": 2765.0, "TPU v6": 1640.0,
+}
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+        return getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        return ""
+
+
+def peak_tflops(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 TFLOP/s of the local device, or None when unknown (CPU
+    test meshes). ``HOROVOD_PEAK_TFLOPS`` overrides — which is also how
+    CPU smokes exercise the utilization gauges deterministically."""
+    env = os.environ.get("HOROVOD_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = device_kind if device_kind is not None else _device_kind()
+    for k, v in PEAK_TFLOPS_BF16.items():
+        if k in kind:
+            return v
+    return None
+
+
+def hbm_gbps(device_kind: Optional[str] = None) -> Optional[float]:
+    """HBM bandwidth GB/s of the local device, or None when unknown.
+    ``HOROVOD_HBM_GBPS`` overrides."""
+    env = os.environ.get("HOROVOD_HBM_GBPS")
+    if env:
+        return float(env)
+    kind = device_kind if device_kind is not None else _device_kind()
+    for k, v in HBM_GBPS.items():
+        if k in kind:
+            return v
+    return None
+
+
+def utilization(flops: float, dt: float, model_flops: Optional[float] = None,
+                peak: Optional[float] = None) -> Dict[str, Optional[float]]:
+    """The r5 accounting split, in exactly one place.
+
+    ``flops`` is executed FLOPs from XLA's cost analysis (counts remat
+    recompute) → **hfu**; ``model_flops`` is the analytic remat-invariant
+    count → **mfu**. When ``model_flops`` is None (vision configs, no
+    remat) the two coincide by construction. Returns achieved/model
+    TFLOP/s plus hfu/mfu fractions (None when the peak is unknown)."""
+    if model_flops is None:
+        model_flops = flops
+    achieved = flops / dt / 1e12 if dt > 0 else 0.0
+    model = model_flops / dt / 1e12 if dt > 0 else 0.0
+    peak = peak if peak is not None else peak_tflops()
+    return {
+        "achieved_tflops": achieved,
+        "model_tflops": model,
+        "hfu": (achieved / peak) if peak else None,
+        "mfu": (model / peak) if peak else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# program registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ProgramRecord:
+    """Everything the subsystem knows about one compiled program."""
+
+    name: str
+    kind: str = "step"
+    #: executed FLOPs per call (XLA cost analysis; counts remat recompute)
+    flops: float = 0.0
+    #: HBM bytes accessed per call (XLA cost analysis)
+    bytes_accessed: float = 0.0
+    #: peak device memory: arguments + outputs + temporaries - aliased
+    peak_hbm_bytes: float = 0.0
+    #: analytic remat-invariant model FLOPs (None => mfu uses ``flops``)
+    model_flops: Optional[float] = None
+    #: doctor threshold: mfu below 0.8x this is a finding
+    expected_mfu: Optional[float] = None
+    #: fingerprinted (re)compiles: first sighting + every signature change
+    compiles: int = 0
+    recompiles: int = 0
+    #: raw trace count (host effects inside jit fire once per TRACE)
+    traces: int = 0
+    #: arguments blamed for the last recompile, with old -> new signatures
+    last_blame: List[str] = field(default_factory=list)
+    blame_detail: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: tuning-driven rebuilds (AutotunedStep) recompile BY DESIGN; the
+    #: doctor skips expected churn instead of flagging it
+    expected_recompiles: bool = False
+    signature: Optional[Dict[str, str]] = None
+    #: every signature ever compiled — jax.jit caches all of them, so a
+    #: REVISIT of a seen signature executes cached code and must read as
+    #: steady, not as a recompile (alternating train/eval batch shapes)
+    seen_signatures: set = field(default_factory=set)
+    last_step_seconds: Optional[float] = None
+    steps: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {
+            "name": self.name, "kind": self.kind, "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "model_flops": self.model_flops,
+            "expected_mfu": self.expected_mfu,
+            "compiles": self.compiles, "recompiles": self.recompiles,
+            "traces": self.traces,
+            "last_blame": list(self.last_blame),
+            "blame_detail": {k: list(v) for k, v in
+                             self.blame_detail.items()},
+            "expected_recompiles": self.expected_recompiles,
+            "signatures_seen": len(self.seen_signatures),
+            "last_step_seconds": self.last_step_seconds,
+            "steps": self.steps, "meta": dict(self.meta),
+        }
+        if self.last_step_seconds:
+            out["utilization"] = utilization(
+                self.flops, self.last_step_seconds, self.model_flops)
+        return out
+
+
+def describe(v: Any) -> str:
+    """Stable signature descriptor of one argument: ``dtype[shape]`` for
+    arrays, ``py<type>[]`` for python scalars (dynamic under jit — their
+    VALUE never recompiles), a bounded leaf digest for pytrees, and
+    ``repr`` for anything else (static args, where the value IS the
+    signature)."""
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        try:
+            return f"{str(v.dtype)}{list(v.shape)}"
+        except Exception:
+            pass
+    if isinstance(v, (bool, int, float, complex)):
+        return f"py{type(v).__name__}[]"
+    if isinstance(v, (str, bytes)) or v is None:
+        return repr(v)[:80]
+    try:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(v)
+    except Exception:
+        return repr(v)[:80]
+    if not leaves:
+        return f"tree0:{str(treedef)[:60]}"
+    descs = [describe(x) for x in leaves]
+    if len(descs) <= 4:
+        return "(" + ",".join(descs) + ")"
+    digest = hashlib.sha1(
+        ("|".join(descs) + str(treedef)).encode()).hexdigest()[:10]
+    return f"tree[{len(descs)} leaves]:{digest}"
+
+
+class ProgramRegistry:
+    """Thread-safe name-keyed store of :class:`ProgramRecord` — the
+    process-global instance is :data:`registry`."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._programs: Dict[str, ProgramRecord] = {}
+        self._steps_total = 0
+
+    def program(self, name: str, kind: str = "step") -> ProgramRecord:
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None:
+                rec = self._programs[name] = ProgramRecord(name=name,
+                                                           kind=kind)
+            return rec
+
+    def get(self, name: str) -> Optional[ProgramRecord]:
+        with self._lock:
+            return self._programs.get(name)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self._steps_total = 0
+
+    # -- fingerprinting -------------------------------------------------
+
+    def note_trace(self, name: str, signature: Dict[str, str], *,
+                   kind: str = "step",
+                   expected: bool = False) -> Tuple[str, List[str]]:
+        """Fingerprint one call. Returns ``(status, blamed)`` where status
+        is ``"compile"`` (first sighting), ``"recompile"`` (a NEVER-seen
+        signature — ``blamed`` names the arguments that changed vs the
+        previous call), or ``"steady"`` (same as last call, or a revisit
+        of a previously compiled signature: jax.jit caches every
+        signature, so alternating train/eval shapes executes cached code
+        and must not read as churn).
+
+        A recompile bumps ``recompiles_total{program}`` and
+        ``recompile_blame_total{program,argument}``, stores old → new
+        signatures on the record, warns, and drops a ``recompile`` marker
+        into the active timeline. ``expected=True`` tags churn that is by
+        design (autotuner rebuilds) so the doctor doesn't flag it."""
+        from horovod_tpu import metrics as _metrics
+        sig_key = tuple(sorted(signature.items()))
+        with self._lock:
+            rec = self.program(name, kind)
+            if expected:
+                rec.expected_recompiles = True
+            if rec.signature is None:
+                rec.signature = dict(signature)
+                rec.seen_signatures.add(sig_key)
+                rec.compiles += 1
+                _metrics.counter("program_compiles_total",
+                                 program=name).inc()
+                return "compile", []
+            if signature == rec.signature:
+                return "steady", []
+            if sig_key in rec.seen_signatures:
+                rec.signature = dict(signature)
+                return "steady", []
+            rec.seen_signatures.add(sig_key)
+            old = rec.signature
+            blamed = sorted(k for k in set(old) | set(signature)
+                            if old.get(k) != signature.get(k))
+            rec.blame_detail = {
+                k: (old.get(k, "<absent>"), signature.get(k, "<absent>"))
+                for k in blamed}
+            rec.last_blame = blamed
+            rec.signature = dict(signature)
+            rec.recompiles += 1
+            rec.compiles += 1
+        _metrics.counter("program_compiles_total", program=name).inc()
+        _metrics.counter("recompiles_total", program=name).inc()
+        if rec.expected_recompiles:
+            # The by-design tag must ride the exported snapshot too, or an
+            # offline doctor (perf_doctor.py over flusher files, no live
+            # registry) would flag healthy autotuner churn as a defect.
+            _metrics.counter("expected_recompiles_total", program=name).inc()
+        for k in blamed:
+            _metrics.counter("recompile_blame_total", program=name,
+                             argument=k).inc()
+        detail = "; ".join(
+            f"{k}: {rec.blame_detail[k][0]} -> {rec.blame_detail[k][1]}"
+            for k in blamed)
+        if not expected:
+            logger.warning(
+                "horovod_tpu: program %r recompiled (#%d) — changed "
+                "argument(s): %s", name, rec.recompiles, detail)
+        _timeline_marker("recompile", program=name, arguments=blamed,
+                         detail=detail)
+        return "recompile", blamed
+
+    def count_trace(self, name: str, **meta) -> None:
+        """Raw trace-time counter: call from a host effect INSIDE the
+        jitted function (fires once per trace), the ground truth the
+        fingerprint detector approximates from outside."""
+        with self._lock:
+            rec = self.program(name)
+            rec.traces += 1
+            if meta:
+                rec.meta.update(meta)
+
+    # -- cost + timing ---------------------------------------------------
+
+    def record_cost(self, name: str, compiled, *,
+                    model_flops: Optional[float] = None,
+                    expected_mfu: Optional[float] = None,
+                    kind: str = "step") -> ProgramRecord:
+        """Attach a compiled program's cost/memory analysis to the record
+        and publish the static gauges (``program_flops``,
+        ``program_bytes_accessed``, ``program_peak_hbm_bytes``)."""
+        from horovod_tpu import metrics as _metrics
+        cost = cost_from(compiled)
+        with self._lock:
+            rec = self.program(name, kind)
+            rec.flops = cost["flops"]
+            rec.bytes_accessed = cost["bytes_accessed"]
+            rec.peak_hbm_bytes = cost["peak_hbm_bytes"]
+            if model_flops is not None:
+                rec.model_flops = float(model_flops)
+            if expected_mfu is not None:
+                rec.expected_mfu = float(expected_mfu)
+                # Exported so an OFFLINE doctor (fresh process, empty
+                # registry) can still compare program_mfu to expectation.
+                _metrics.gauge("program_expected_mfu", program=name).set(
+                    rec.expected_mfu)
+        _metrics.gauge("program_flops", program=name).set(rec.flops)
+        _metrics.gauge("program_bytes_accessed", program=name).set(
+            rec.bytes_accessed)
+        _metrics.gauge("program_peak_hbm_bytes", program=name).set(
+            rec.peak_hbm_bytes)
+        return rec
+
+    def observe_step(self, name: str, seconds: float) -> None:
+        """Feed one honest (synced) step time; updates the live roofline
+        gauges ``program_mfu`` / ``program_hfu`` /
+        ``hbm_bandwidth_utilization`` for the program. Call sites that
+        already pay a blocking sync (AutotunedStep tuning steps, serving
+        dispatches, bench loops) feed this for free — the profiler never
+        forces its own sync into a hot path."""
+        from horovod_tpu import metrics as _metrics
+        seconds = float(seconds)
+        with self._lock:
+            rec = self.program(name)
+            rec.last_step_seconds = seconds
+            rec.steps += 1
+            self._steps_total += 1
+            n = self._steps_total
+            flops, model_flops = rec.flops, rec.model_flops
+            nbytes = rec.bytes_accessed
+        _metrics.histogram("program_step_seconds", program=name).observe(
+            seconds)
+        if seconds <= 0:
+            return
+        peak = peak_tflops()
+        if peak and flops:
+            u = utilization(flops, seconds, model_flops, peak=peak)
+            _metrics.gauge("program_hfu", program=name).set(u["hfu"])
+            _metrics.gauge("program_mfu", program=name).set(u["mfu"])
+        bw = hbm_gbps()
+        if bw and nbytes:
+            _metrics.gauge("hbm_bandwidth_utilization", program=name).set(
+                nbytes / seconds / 1e9 / bw)
+        if n % 32 == 0:
+            check_memory_pressure()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {name: rec.snapshot()
+                    for name, rec in sorted(self._programs.items())}
+
+
+#: the process-global program registry
+registry = ProgramRegistry()
+
+
+def note_trace(name: str, signature: Dict[str, str], **kw):
+    return registry.note_trace(name, signature, **kw)
+
+
+def observe_step(name: str, seconds: float) -> None:
+    registry.observe_step(name, seconds)
+
+
+def record_cost(name: str, compiled, **kw) -> ProgramRecord:
+    return registry.record_cost(name, compiled, **kw)
+
+
+def count_trace(name: str, **meta) -> None:
+    registry.count_trace(name, **meta)
+
+
+def cost_from(compiled) -> Dict[str, float]:
+    """Extract flops / bytes accessed / peak HBM from a
+    ``jax.stages.Compiled`` (or ``Lowered``) — tolerant of backends that
+    return lists, partial dicts, or no memory analysis at all."""
+    flops = nbytes = 0.0
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+    except Exception:
+        pass
+    peak = 0.0
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            peak = (float(getattr(mem, "argument_size_in_bytes", 0))
+                    + float(getattr(mem, "output_size_in_bytes", 0))
+                    + float(getattr(mem, "temp_size_in_bytes", 0))
+                    - float(getattr(mem, "alias_size_in_bytes", 0)))
+    except Exception:
+        pass
+    return {"flops": flops, "bytes_accessed": nbytes,
+            "peak_hbm_bytes": max(0.0, peak)}
+
+
+def _cost_capture_enabled(default: bool = True) -> bool:
+    """Compiled-cost capture re-lowers the program once per new signature
+    (the same lower+compile bench.py always paid). ``HOROVOD_PROFILER_COST``
+    forces it on (``1``) or off (``0``) for every call site; unset falls
+    back to ``default`` — True for instrumented steps, False for the
+    serving engine (whose capture compiles each phase a second time
+    through the pure twin). Same truthy set as config._env_bool; the
+    resolved tri-state is surfaced as ``build_info()['profiler_cost']``.
+    Read live (not from the cached Config) so the knob works before
+    ``hvd.init`` and under test monkeypatching."""
+    v = os.environ.get("HOROVOD_PROFILER_COST", "").strip().lower()
+    if not v:
+        return default
+    return v in ("1", "true", "yes", "on")
+
+
+# ---------------------------------------------------------------------------
+# instrument(): a jitted step with fingerprinting + cost capture built in
+# ---------------------------------------------------------------------------
+
+class ProfiledStep:
+    """``jax.jit`` plus the registry contract: every call is
+    fingerprinted (recompiles counted and blamed by argument name), and
+    each new signature's compiled cost analysis lands in the registry.
+
+    Captured signatures execute through the SAME compiled program the
+    cost analysis came from (AOT compiles don't populate jit's cache, so
+    routing through jit would compile everything twice); semantics
+    (donation, static args, errors) match ``jax.jit``'s, with a jit
+    fallback if the AOT call convention rejects the arguments.
+    ``timed=True``
+    additionally blocks on the result and feeds :func:`observe_step`
+    (honest but sync-per-call; bench-style loops should instead time
+    externally and call ``observe_step`` themselves)."""
+
+    def __init__(self, fn: Callable, name: str, *,
+                 model_flops: Optional[float] = None,
+                 expected_mfu: Optional[float] = None,
+                 static_argnums: Tuple[int, ...] = (),
+                 donate_argnums: Tuple[int, ...] = (),
+                 capture_cost: Optional[bool] = None,
+                 timed: bool = False, kind: str = "step"):
+        import inspect
+        import jax
+        self.fn = fn
+        self.name = name
+        self.kind = kind
+        self.model_flops = model_flops
+        self.expected_mfu = expected_mfu
+        self.timed = timed
+        self._static = tuple(static_argnums)
+        self._capture = (_cost_capture_enabled() if capture_cost is None
+                         else capture_cost)
+        self._jit = jax.jit(fn, static_argnums=static_argnums or None,
+                            donate_argnums=donate_argnums or None)
+        try:
+            self._argnames = [p.name for p in
+                              inspect.signature(fn).parameters.values()]
+        except (TypeError, ValueError):
+            self._argnames = []
+        #: AOT executables by signature key — the call path for captured
+        #: signatures (one compile serves both cost analysis and execution)
+        self._compiled: Dict[Tuple, Any] = {}
+        self._aot_ok = True
+        registry.program(name, kind)
+
+    def _signature(self, args, kwargs) -> Dict[str, str]:
+        # No identity memo here, deliberately: functional training hands a
+        # FRESH params/opt-state pytree every step (a memo would never hit,
+        # while its strong reference pins the previous step's entire state
+        # in device memory when arguments are not donated). describe() is
+        # O(leaves) string work — tens of µs against ms-scale steps. The
+        # serving engine memoizes instead because its params object is
+        # static and engine-held.
+        sig: Dict[str, str] = {}
+        for i, a in enumerate(args):
+            label = (self._argnames[i] if i < len(self._argnames)
+                     else f"arg{i}")
+            sig[label] = (repr(a)[:80] if i in self._static
+                          else describe(a))
+        for k, v in kwargs.items():
+            sig[k] = describe(v)
+        return sig
+
+    def __call__(self, *args, **kwargs):
+        sig = self._signature(args, kwargs)
+        sig_key = tuple(sorted(sig.items()))
+        status, _ = registry.note_trace(self.name, sig, kind=self.kind)
+        if status != "steady" and self._capture:
+            try:
+                compiled = self._jit.lower(*args, **kwargs).compile()
+                mf = (self.model_flops(*args, **kwargs)
+                      if callable(self.model_flops) else self.model_flops)
+                registry.record_cost(self.name, compiled, model_flops=mf,
+                                     expected_mfu=self.expected_mfu,
+                                     kind=self.kind)
+                self._compiled[sig_key] = compiled
+            except Exception:
+                logger.debug("profiler: cost capture failed for %r",
+                             self.name, exc_info=True)
+        # The AOT compile above does NOT populate jax.jit's cache, so EVERY
+        # call of a captured signature routes through the stored Compiled —
+        # cost capture costs one compile total, not two (Compiled takes
+        # dynamic args only; a call-convention surprise falls back to jit).
+        compiled = self._compiled.get(sig_key) if self._aot_ok else None
+        if compiled is not None:
+            call = compiled
+            call_args = (tuple(a for i, a in enumerate(args)
+                               if i not in self._static)
+                         if self._static else args)
+        else:
+            call, call_args = self._jit, args
+        import jax
+        t0 = time.perf_counter()
+        try:
+            out = call(*call_args, **kwargs)
+        except (TypeError, ValueError):
+            # Compiled rejects arg-convention / sharding mismatches the
+            # fingerprint can't see (it keys on shape/dtype only).
+            if call is self._jit:
+                raise
+            self._aot_ok = False
+            self._compiled.clear()
+            call, call_args = self._jit, args
+            t0 = time.perf_counter()
+            out = call(*call_args, **kwargs)
+        if self.timed:
+            jax.block_until_ready(out)
+            registry.observe_step(self.name, time.perf_counter() - t0)
+        return out
+
+    def record(self) -> ProgramRecord:
+        return registry.program(self.name)
+
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+
+def instrument(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+               **kw) -> Any:
+    """Wrap ``fn`` as a :class:`ProfiledStep` (usable as a decorator)::
+
+        step = hvd.profiler.instrument(train_step, name="train",
+                                       model_flops=analytic_flops,
+                                       donate_argnums=(0, 1))
+    """
+    def wrap(f):
+        return ProfiledStep(f, name or getattr(f, "__name__", "program"),
+                            **kw)
+    return wrap if fn is None else wrap(fn)
+
+
+# ---------------------------------------------------------------------------
+# memory accounting
+# ---------------------------------------------------------------------------
+
+def live_buffer_census() -> Dict[str, Dict[str, float]]:
+    """Census of live jax device buffers by platform: count and bytes.
+    Publishes ``device_live_buffer_bytes{platform}`` /
+    ``device_live_buffer_count{platform}`` gauges and returns the dict."""
+    from horovod_tpu import metrics as _metrics
+    out: Dict[str, Dict[str, float]] = {}
+    try:
+        import jax
+        for a in jax.live_arrays():
+            try:
+                plat = a.devices().pop().platform if hasattr(a, "devices") \
+                    else "unknown"
+            except Exception:
+                plat = "unknown"
+            d = out.setdefault(plat, {"count": 0, "bytes": 0.0})
+            d["count"] += 1
+            d["bytes"] += float(getattr(a, "nbytes", 0))
+    except Exception:
+        logger.debug("live_buffer_census failed", exc_info=True)
+        return out
+    for plat, d in out.items():
+        _metrics.gauge("device_live_buffer_bytes", platform=plat).set(
+            d["bytes"])
+        _metrics.gauge("device_live_buffer_count", platform=plat).set(
+            d["count"])
+    return out
+
+
+#: HBM use above this fraction of the device limit emits memory_pressure
+MEMORY_PRESSURE_FRACTION = 0.92
+
+_PRESSURE_LOCK = threading.Lock()
+_PRESSURE_FIRED: set = set()
+
+
+def check_memory_pressure(threshold: float = MEMORY_PRESSURE_FRACTION
+                          ) -> Optional[float]:
+    """Read per-device memory stats (TPU runtimes expose them; CPU returns
+    None), publish ``device_hbm_bytes_in_use{device}`` gauges, and emit ONE
+    ``memory_pressure`` event (counter + timeline marker) per device the
+    first time its usage crosses ``threshold``. Returns the worst
+    in-use fraction seen, or None when no device reports stats."""
+    from horovod_tpu import metrics as _metrics
+    worst: Optional[float] = None
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = float(stats.get("bytes_in_use", 0))
+        limit = float(stats.get("bytes_limit",
+                                stats.get("bytes_reservable_limit", 0)))
+        _metrics.gauge("device_hbm_bytes_in_use", device=str(i)).set(in_use)
+        if limit > 0:
+            _metrics.gauge("device_hbm_bytes_limit", device=str(i)).set(
+                limit)
+            frac = in_use / limit
+            worst = frac if worst is None else max(worst, frac)
+            if frac >= threshold:
+                with _PRESSURE_LOCK:
+                    fresh = i not in _PRESSURE_FIRED
+                    _PRESSURE_FIRED.add(i)
+                if fresh:
+                    _metrics.event("memory_pressure", device=i,
+                                   bytes_in_use=int(in_use),
+                                   bytes_limit=int(limit),
+                                   fraction=round(frac, 4))
+    return worst
+
+
+def _timeline_marker(name: str, **args) -> None:
+    try:
+        from horovod_tpu import timeline as _tl
+        t = _tl.get_timeline()
+        if t is not None:
+            t.marker(name, category="profiler", **args)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# triggered profiling
+# ---------------------------------------------------------------------------
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_ACTIVE = False
+#: "manual" (hvd.profile) or "trigger" (watchdog / deadline) while active
+_PROFILE_SOURCE: Optional[str] = None
+#: generation token: bumped per capture so a preempted trigger's stop
+#: timer cannot stop or unflag a newer capture
+_PROFILE_GEN = 0
+_PROFILE_CAPTURES = 0
+
+
+def profile_capture_count() -> int:
+    """How many triggered captures fired this process."""
+    with _PROFILE_LOCK:
+        return _PROFILE_CAPTURES
+
+
+def _profile_dir(reason: str) -> str:
+    from horovod_tpu.config import get_config
+    base = get_config().profile_dir
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
+    return os.path.join(base, f"{safe}.{os.getpid()}.{int(time.time())}")
+
+
+@contextmanager
+def profile(logdir: Optional[str] = None):
+    """``hvd.profile()``: capture a ``jax.profiler`` device trace for the
+    body of the ``with`` block, into ``logdir`` (default: a fresh
+    subdirectory of ``HOROVOD_PROFILE_DIR``). Yields the capture
+    directory; timeline markers bracket the window so host and device
+    traces correlate. Nesting manual captures raises; a BACKGROUND
+    triggered capture that happens to be running is preempted (stopped
+    early) instead — an asynchronous observability event must never
+    crash the training script's own profile window."""
+    import jax
+    global _PROFILE_ACTIVE, _PROFILE_SOURCE, _PROFILE_GEN
+    logdir = logdir or _profile_dir("manual")
+    with _PROFILE_LOCK:
+        if _PROFILE_ACTIVE and _PROFILE_SOURCE == "manual":
+            raise RuntimeError("a profile capture is already active")
+        preempted = _PROFILE_ACTIVE
+        _PROFILE_ACTIVE = True
+        _PROFILE_SOURCE = "manual"
+        _PROFILE_GEN += 1          # the trigger's stop timer becomes a no-op
+        gen = _PROFILE_GEN
+        if preempted:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.debug("stopping preempted capture failed",
+                             exc_info=True)
+    if preempted:
+        logger.warning("horovod_tpu: hvd.profile() preempted an active "
+                       "triggered capture")
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        _timeline_marker("profile_start", logdir=logdir)
+        jax.profiler.start_trace(logdir)
+    except BaseException:
+        # A failed start (unwritable dir, another profiler session) must
+        # not wedge the flag — that would disable every future capture.
+        with _PROFILE_LOCK:
+            if _PROFILE_GEN == gen:
+                _PROFILE_ACTIVE = False
+                _PROFILE_SOURCE = None
+        raise
+    try:
+        yield logdir
+    finally:
+        with _PROFILE_LOCK:
+            mine = _PROFILE_GEN == gen
+            try:
+                if mine:
+                    jax.profiler.stop_trace()
+            finally:
+                if mine:
+                    _PROFILE_ACTIVE = False
+                    _PROFILE_SOURCE = None
+        _timeline_marker("profile_stop", logdir=logdir)
+
+
+def trigger_profile(reason: str, seconds: Optional[float] = None,
+                    logdir: Optional[str] = None) -> Optional[str]:
+    """Fire one bounded, rank-scoped background capture (the automatic
+    path behind ``HOROVOD_PROFILE_ON_STALL=1``): starts a ``jax.profiler``
+    trace now and stops it after ``seconds`` (default
+    ``HOROVOD_PROFILE_SECONDS``) from a daemon timer. At most
+    ``HOROVOD_PROFILE_MAX_CAPTURES`` captures per process, never two at
+    once — a stall storm must not turn into a disk-filling profile storm.
+    Returns the capture directory, or None when refused."""
+    import jax
+    from horovod_tpu import metrics as _metrics
+    from horovod_tpu.config import get_config
+    global _PROFILE_ACTIVE, _PROFILE_SOURCE, _PROFILE_GEN, _PROFILE_CAPTURES
+    cfg = get_config()
+    seconds = float(seconds if seconds is not None else cfg.profile_seconds)
+    with _PROFILE_LOCK:
+        if _PROFILE_ACTIVE or _PROFILE_CAPTURES >= cfg.profile_max_captures:
+            return None
+        _PROFILE_ACTIVE = True
+        _PROFILE_SOURCE = "trigger"
+        _PROFILE_GEN += 1
+        gen = _PROFILE_GEN
+        _PROFILE_CAPTURES += 1
+    logdir = logdir or _profile_dir(reason)
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        with _PROFILE_LOCK:
+            if _PROFILE_GEN == gen:
+                _PROFILE_ACTIVE = False
+                _PROFILE_SOURCE = None
+            # a capture that never started must not burn budget — a
+            # transiently unwritable dir would otherwise disable
+            # triggered profiling for the rest of the process
+            _PROFILE_CAPTURES -= 1
+        logger.exception("triggered profile failed to start (%s)", reason)
+        return None
+    _metrics.event("profile_capture", reason=reason, logdir=logdir,
+                   seconds=seconds)
+    logger.warning("horovod_tpu: triggered profile capture (%s) -> %s "
+                   "(%.1fs)", reason, logdir, seconds)
+
+    def _stop():
+        global _PROFILE_ACTIVE, _PROFILE_SOURCE
+        time.sleep(seconds)
+        # Stop under the lock and only if this capture is still the live
+        # generation — a manual hvd.profile() may have preempted it.
+        with _PROFILE_LOCK:
+            if _PROFILE_GEN != gen:
+                return
+            try:
+                import jax as _jax
+                _jax.profiler.stop_trace()
+            except Exception:
+                logger.debug("profile stop failed", exc_info=True)
+            _PROFILE_ACTIVE = False
+            _PROFILE_SOURCE = None
+        _timeline_marker("profile_stop", logdir=logdir)
+
+    threading.Thread(target=_stop, name="hvd-profile-stop",
+                     daemon=True).start()
+    return logdir
+
+
+def maybe_trigger(reason: str) -> Optional[str]:
+    """Gate a triggered capture on ``HOROVOD_PROFILE_ON_STALL`` — the
+    single hook the StallWatchdog and the serving deadline path call."""
+    try:
+        from horovod_tpu.config import get_config
+        if not get_config().profile_on_stall:
+            return None
+        return trigger_profile(reason)
+    except Exception:
+        logger.debug("maybe_trigger(%s) failed", reason, exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# hvd.doctor(): ranked automated diagnosis
+# ---------------------------------------------------------------------------
+
+def _series(snap: Dict, group: str, name: str) -> List[Dict]:
+    return snap.get(group, {}).get(name, []) or []
+
+
+def _sum_counter(snap: Dict, name: str, **match) -> float:
+    total = 0.0
+    for s in _series(snap, "counters", name):
+        if all(str(s.get("labels", {}).get(k)) == str(v)
+               for k, v in match.items()):
+            total += float(s.get("value", 0))
+    return total
+
+
+def _gauge_value(snap: Dict, name: str, **match) -> Optional[float]:
+    for s in _series(snap, "gauges", name):
+        if all(str(s.get("labels", {}).get(k)) == str(v)
+               for k, v in match.items()):
+            return float(s.get("value", 0))
+    return None
+
+
+def _hist_stats(snap: Dict, name: str, **match) -> Tuple[int, float]:
+    count, total = 0, 0.0
+    for s in _series(snap, "histograms", name):
+        if all(str(s.get("labels", {}).get(k)) == str(v)
+               for k, v in match.items()):
+            count += int(s.get("count", 0))
+            total += float(s.get("sum", 0.0))
+    return count, total
+
+
+def _load_snapshot(snapshot) -> Dict[str, Any]:
+    if snapshot is None:
+        from horovod_tpu import metrics as _metrics
+        return _metrics.snapshot()
+    if isinstance(snapshot, str):
+        with open(snapshot) as f:
+            return json.load(f)
+    return snapshot
+
+
+def _load_report(trace) -> Optional[Dict[str, Any]]:
+    """Normalize the ``trace`` input to a straggler report dict: accepts a
+    merged-trace dict, a bare report dict, a merged-trace JSON path, or a
+    shard base path / glob / directory (merged on the fly)."""
+    if trace is None:
+        return None
+    if isinstance(trace, dict):
+        if "stragglerReport" in trace:
+            return trace["stragglerReport"]
+        if "collectives" in trace:
+            return trace
+        return None
+    if os.path.isfile(trace):
+        try:
+            with open(trace) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and "stragglerReport" in doc:
+                return doc["stragglerReport"]
+        except ValueError:
+            pass
+    from horovod_tpu.trace_merge import merge_timelines
+    return merge_timelines(trace, feed_metrics=False)["stragglerReport"]
+
+
+def _finding(category: str, severity: float, title: str, detail: str,
+             suggestion: str, **evidence) -> Dict[str, Any]:
+    return {"category": category, "severity": round(min(1.0, severity), 3),
+            "title": title, "detail": detail, "suggestion": suggestion,
+            "evidence": evidence}
+
+
+def _check_stalls(snap) -> List[Dict]:
+    n = _sum_counter(snap, "stall_events_total")
+    if n <= 0:
+        return []
+    pend = snap.get("pending_collectives", [])
+    names = ", ".join(p.get("tensor", "?") for p in pend[:3])
+    return [_finding(
+        "stall", 0.95, f"{int(n)} collective stall event(s)",
+        f"the stall watchdog fired {int(n)} time(s)"
+        + (f"; still pending: {names}" if names else ""),
+        "a rank is stuck or dead: check the watchdog report's "
+        "waiting_ranks / likely_late_processes, the merged trace blame "
+        "rollup, and the host named there; elastic mode can evict it. "
+        "HOROVOD_PROFILE_ON_STALL=1 captures a device trace at the next "
+        "fire.", stall_events=int(n))]
+
+
+def _check_straggler(report) -> List[Dict]:
+    if not report:
+        return []
+    blame = {int(r): float(v)
+             for r, v in (report.get("blame_seconds_by_rank") or {}).items()}
+    if not blame:
+        return []
+    worst = max(blame, key=blame.get)
+    worst_s = blame[worst]
+    if worst_s < 0.02:
+        return []
+    n_ops = len(report.get("collectives", []))
+    crit = float(report.get("critical_path_seconds", 0.0))
+    out = [_finding(
+        "straggler", 0.5 + min(0.4, worst_s),
+        f"rank {worst} blamed for {worst_s * 1e3:.0f}ms of "
+        f"collective wait",
+        f"across {n_ops} correlated collectives, rank {worst} arrived "
+        f"last often enough to be charged {worst_s:.3f}s of peer wait "
+        f"(critical-path estimate {crit:.3f}s); per-rank blame: "
+        f"{ {r: round(v, 3) for r, v in sorted(blame.items())} }",
+        f"inspect the host of rank {worst} (input pipeline, CPU "
+        "throttling, pre-step host work); negotiation_arrival_stats() "
+        "names late processes live; persistent stragglers on an elastic "
+        "mesh should be removed and re-admitted.",
+        blamed_rank=worst, blame_seconds=worst_s)]
+    return out
+
+
+def _check_recompiles(snap, programs) -> List[Dict]:
+    out = []
+    # Fused multi-rank snapshots concatenate one identically-labeled
+    # series per rank; a synchronized shape drift recompiles once PER
+    # RANK, so take the per-series max, not the cross-rank sum (which
+    # would report "recompiled 256x" for one recompile on a 256-rank job).
+    per: Dict[str, List[float]] = {}
+    for s in _series(snap, "counters", "recompiles_total"):
+        prog = s.get("labels", {}).get("program", "?")
+        per.setdefault(prog, []).append(float(s.get("value", 0)))
+    expected_progs = {
+        s.get("labels", {}).get("program", "?")
+        for s in _series(snap, "counters", "expected_recompiles_total")
+        if float(s.get("value", 0)) > 0}
+    for prog, vals in sorted(per.items()):
+        n, ranks = max(vals), len(vals)
+        if n <= 0:
+            continue
+        rec = (programs or {}).get(prog, {})
+        if rec.get("expected_recompiles") or prog in expected_progs:
+            continue
+        blamed = rec.get("last_blame") or sorted({
+            b.get("labels", {}).get("argument", "?")
+            for b in _series(snap, "counters", "recompile_blame_total")
+            if b.get("labels", {}).get("program") == prog})
+        detail_map = rec.get("blame_detail") or {}
+        changes = "; ".join(f"{k}: {v[0]} -> {v[1]}"
+                            for k, v in detail_map.items())
+        across = f" on each of {ranks} rank(s)" if ranks > 1 else ""
+        out.append(_finding(
+            "recompile", 0.45 + min(0.35, 0.05 * n),
+            f"program {prog!r} recompiled {int(n)}x{across} (blamed "
+            f"argument: {', '.join(blamed) if blamed else 'unknown'})",
+            f"the trace fingerprint of {prog!r} changed {int(n)} "
+            f"time(s){across}" + (f" — {changes}" if changes else ""),
+            "hold shapes/dtypes/static arguments constant across steps: "
+            "pad ragged batches (horovod_tpu.data static-shape iterator), "
+            "hoist changing scalars into traced args, pin serving "
+            "geometry. Each recompile stalls the step for a full XLA "
+            "compile.",
+            program=prog, recompiles=int(n), ranks=ranks,
+            blamed_arguments=blamed))
+    return out
+
+
+def _mfu_finding(name, mfu, hfu, expected, step_ms) -> Optional[Dict]:
+    if mfu is None or not expected or mfu >= 0.8 * expected:
+        return None
+    at = f" at {step_ms:.1f}ms/step" if step_ms else ""
+    return _finding(
+        "low_mfu", 0.3 + 0.5 * (1.0 - mfu / expected),
+        f"program {name!r} MFU {mfu:.1%} is below the "
+        f"{expected:.0%} expectation",
+        f"measured mfu={mfu:.3f}"
+        + (f" (hfu={hfu:.3f})" if hfu is not None else "") + at
+        + "; hfu >> mfu means remat recompute, hfu ~= mfu with both low "
+        "means the step is memory- or latency-bound",
+        "try remat_policy='dots' (saves MXU outputs), tuned flash "
+        "tiles (tools/tune_tiles.py), a larger per-chip batch, and "
+        "check hbm_bandwidth_utilization{program=...} to decide "
+        "compute- vs bandwidth-bound before tuning further.",
+        program=name, mfu=mfu, hfu=hfu, expected_mfu=expected)
+
+
+def _check_mfu(programs, snap) -> List[Dict]:
+    out = []
+    seen = set()
+    for name, rec in (programs or {}).items():
+        seen.add(name)
+        u = rec.get("utilization") or {}
+        f = _mfu_finding(name, u.get("mfu"), u.get("hfu"),
+                         rec.get("expected_mfu"),
+                         (rec.get("last_step_seconds") or 0) * 1e3)
+        if f:
+            out.append(f)
+    # Offline path: a fused snapshot carries the program_mfu /
+    # program_expected_mfu gauges even though this process's registry
+    # (``programs``) is empty.
+    for s in _series(snap, "gauges", "program_mfu"):
+        name = s.get("labels", {}).get("program", "?")
+        if name in seen:
+            continue
+        seen.add(name)
+        f = _mfu_finding(
+            name, float(s.get("value", 0)),
+            _gauge_value(snap, "program_hfu", program=name),
+            _gauge_value(snap, "program_expected_mfu", program=name),
+            None)
+        if f:
+            out.append(f)
+    return out
+
+
+def _check_fusion(snap) -> List[Dict]:
+    count, total = _hist_stats(snap, "fusion_fill_ratio")
+    if count < 3:
+        return []
+    mean = total / count
+    if mean >= 0.5:
+        return []
+    return [_finding(
+        "fusion_fill", 0.3 + 0.2 * (0.5 - mean) / 0.5,
+        f"fusion buckets fill only {mean:.0%} of the threshold on average",
+        f"{count} buckets averaged {mean:.2f} fill of "
+        "HOROVOD_FUSION_THRESHOLD — collectives are paying per-dispatch "
+        "latency for mostly-empty buffers",
+        "lower HOROVOD_FUSION_THRESHOLD toward the observed bucket bytes, "
+        "or let the tuner pick it (HOROVOD_AUTOTUNE=1 / hvd.AutotunedStep).",
+        mean_fill_ratio=mean, buckets=count)]
+
+
+def _check_overlap(snap, report=None) -> List[Dict]:
+    eff = _gauge_value(snap, "overlap_efficiency_estimate", source="merge")
+    if eff is None and report:
+        # Offline path: merge_timelines(feed_metrics=False) never feeds
+        # the gauge, but the report carries the same overlap section.
+        # Require enough EXEC spans on some rank for "serialized" to be
+        # meaningful — a 3-collective smoke is not an overlap signal.
+        ov = report.get("overlap") or {}
+        spans = max((int(r.get("exec_spans", 0))
+                     for r in (ov.get("by_rank") or {}).values()),
+                    default=0)
+        if spans >= 4:
+            eff = ov.get("overlap_efficiency")
+    if eff is None or eff >= 0.15:
+        return []
+    big = _sum_counter(snap, "allreduce_algorithm_total",
+                       algorithm="chunked_rs_ag")
+    return [_finding(
+        "low_overlap", 0.35 + 0.2 * (0.15 - eff) / 0.15,
+        f"collective overlap efficiency is {eff:.0%}",
+        "the merged trace shows collective EXEC spans almost fully "
+        "serialized (overlap_efficiency_estimate{source=merge} = "
+        f"{eff:.3f}); gradient sync is not hiding behind backward "
+        "compute" + ("" if big else
+                     " and no bucket used the chunked pipeline"),
+        "set algorithm='chunked_rs_ag' (HOROVOD_ALLREDUCE_ALGORITHM) with "
+        "HOROVOD_OVERLAP_CHUNKS=4..8 on large buckets, enable "
+        "DistributedOptimizer(overlap=True) or hvd.grad(overlap=True), "
+        "and HOROVOD_XLA_LATENCY_HIDING=1 on TPU.",
+        overlap_efficiency=eff)]
+
+
+def _check_serving(snap) -> List[Dict]:
+    out = []
+    submitted = _sum_counter(snap, "serve_requests_total",
+                             status="submitted")
+    expired = _sum_counter(snap, "serve_requests_total", status="expired")
+    if submitted > 0 and expired > 0:
+        frac = expired / submitted
+        out.append(_finding(
+            "serving_slo", 0.4 + min(0.5, frac),
+            f"serving SLO burn: {int(expired)}/{int(submitted)} requests "
+            f"expired ({frac:.0%})",
+            "requests are missing their deadlines (queued expiry or "
+            "mid-flight EXPIRED)",
+            "add decode lanes (HOROVOD_SERVE_SLOTS) or replicas, shrink "
+            "HOROVOD_SERVE_PREFILL_CHUNK so long prompts stall decodes "
+            "less, check serve_queue_wait_seconds for admission backlog, "
+            "and size the KV pool (num_blocks) above peak "
+            "serve_blocks_peak.",
+            submitted=int(submitted), expired=int(expired)))
+    rejected = _sum_counter(snap, "serve_requests_total", status="rejected")
+    # No submitted > 0 gate here: an engine rejecting EVERYTHING has
+    # submitted == 0 — the worst backpressure case must not read healthy.
+    if rejected > 0 and rejected > 0.1 * (submitted + rejected):
+        out.append(_finding(
+            "serving_backpressure", 0.4,
+            f"{int(rejected)} requests rejected at submit",
+            "the request queue is bouncing work (backpressure or "
+            "geometry rejections)",
+            "raise HOROVOD_SERVE_QUEUE_LIMIT if rejections are "
+            "backpressure; geometry rejections (max_len / KV pool) need "
+            "a bigger engine or request-side truncation.",
+            rejected=int(rejected)))
+    return out
+
+
+def _check_memory(snap) -> List[Dict]:
+    n = _sum_counter(snap, "memory_pressure_total")
+    if n <= 0:
+        return []
+    return [_finding(
+        "memory_pressure", 0.85,
+        f"{int(n)} device memory-pressure event(s)",
+        "device HBM crossed the high-water fraction "
+        f"({MEMORY_PRESSURE_FRACTION:.0%} of the limit); allocation "
+        "failure / fragmentation thrash is next",
+        "enable remat (remat_policy='full'), shard state (FSDP / "
+        "sharded_adamw), quantize serving KV blocks "
+        "(HOROVOD_SERVE_KV_QUANT=int8), or shrink the per-chip batch; "
+        "program_peak_hbm_bytes{program=...} names the heavy programs.",
+        events=int(n))]
+
+
+def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
+    """Automated performance diagnosis (``hvd.doctor()``).
+
+    Fuses the metrics ``snapshot`` (live registry by default, or a
+    flusher-written JSON path), the merged cross-rank ``trace`` (merged
+    dict / report dict / merged-json path / shard base path — stragglers
+    and overlap come from here), and the program registry ``programs``
+    (live by default) into a **ranked** findings list, most severe first.
+    Each finding carries a category, a severity in [0, 1], human-readable
+    title/detail, machine-readable evidence, and a concrete knob
+    suggestion. Returns ``{"findings": [...], "healthy": bool,
+    "inputs": {...}}``; render with :func:`format_report`."""
+    snap = _load_snapshot(snapshot)
+    report = _load_report(trace)
+    progs = programs if programs is not None else registry.snapshot()
+
+    findings: List[Dict[str, Any]] = []
+    findings += _check_stalls(snap)
+    findings += _check_straggler(report)
+    findings += _check_recompiles(snap, progs)
+    findings += _check_memory(snap)
+    findings += _check_serving(snap)
+    findings += _check_mfu(progs, snap)
+    findings += _check_overlap(snap, report)
+    findings += _check_fusion(snap)
+    findings.sort(key=lambda f: (-f["severity"], f["category"], f["title"]))
+    for i, f in enumerate(findings):
+        f["rank"] = i + 1
+    return {
+        "findings": findings,
+        "healthy": not any(f["severity"] >= 0.5 for f in findings),
+        "inputs": {
+            "snapshot": "live" if snapshot is None else "provided",
+            "trace": "none" if report is None else "provided",
+            "programs": sorted(progs or {}),
+        },
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Render a :func:`doctor` report as terminal-friendly text."""
+    lines = []
+    findings = report.get("findings", [])
+    if not findings:
+        lines.append("hvd.doctor(): no findings — nothing looks sick "
+                     "from here.")
+    else:
+        lines.append(f"hvd.doctor(): {len(findings)} finding(s), most "
+                     "severe first")
+    for f in findings:
+        lines.append(f"  #{f['rank']} [{f['severity']:.2f}] "
+                     f"{f['category']}: {f['title']}")
+        lines.append(f"      {f['detail']}")
+        lines.append(f"      fix: {f['suggestion']}")
+    return "\n".join(lines)

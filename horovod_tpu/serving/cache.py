@@ -125,6 +125,21 @@ class PagedKVCache:
         """Length of the dense gather view: max_blocks * block_size."""
         return self.table.shape[1] * self.block_size
 
+    @property
+    def bytes_per_block(self) -> int:
+        """Device bytes one pool block costs across all layers (K + V +
+        quantization scales) — the unit the profiler's KV-occupancy
+        gauges multiply by ``blocks_in_use``."""
+        return self.pool_bytes // self.kp.shape[1]
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total device bytes of the shared K/V pool (+ scale pools)."""
+        total = self.kp.nbytes + self.vp.nbytes
+        if self.ks is not None:
+            total += self.ks.nbytes + self.vs.nbytes
+        return total
+
     # -- decode-registry cache protocol -----------------------------------
 
     def update(self, layer: int, k, v, pos):

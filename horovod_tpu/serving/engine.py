@@ -43,7 +43,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from horovod_tpu import metrics, tracing
+from horovod_tpu import metrics, profiler, tracing
 from horovod_tpu.models.generate import (
     decode_family, decode_step, greedy_token, t5_decoder_bias, t5_encode,
 )
@@ -167,20 +167,24 @@ class InferenceEngine:
         # it there to keep test logs warning-free.
         donate = (1,) if jax.default_backend() != "cpu" else ()
 
-        def _decode_raw(params, cache, tok, pos, active, extras):
-            self._decode_traces += 1          # host effect: fires per TRACE
+        def _decode_pure(params, cache, tok, pos, active, extras):
             cache = cache.with_active(active)
             cache, logits = self._step(params, cache, tok, pos, extras)
             return cache, logits, greedy_token(logits).astype(jnp.int32)
 
+        def _decode_raw(params, cache, tok, pos, active, extras):
+            self._decode_traces += 1          # host effect: fires per TRACE
+            profiler.count_trace(f"serve:{name}:decode")
+            return _decode_pure(params, cache, tok, pos, active, extras)
+
+        self._decode_pure = _decode_pure
         self._decode_jit = jax.jit(_decode_raw, donate_argnums=donate)
 
         C, V = self.prefill_chunk, self.cfg.vocab_size
         view_len = self.view_len
 
-        def _prefill_raw(params, cache, tok_seq, pos0, count, active,
-                         extras):
-            self._prefill_traces += 1
+        def _prefill_pure(params, cache, tok_seq, pos0, count, active,
+                          extras):
             base = active
 
             def body(carry, j):
@@ -200,7 +204,29 @@ class InferenceEngine:
                                              jnp.arange(C))
             return cache, final, greedy_token(final).astype(jnp.int32)
 
+        def _prefill_raw(params, cache, tok_seq, pos0, count, active,
+                         extras):
+            self._prefill_traces += 1
+            profiler.count_trace(f"serve:{name}:prefill")
+            return _prefill_pure(params, cache, tok_seq, pos0, count,
+                                 active, extras)
+
+        self._prefill_pure = _prefill_pure
         self._prefill_jit = jax.jit(_prefill_raw, donate_argnums=donate)
+        self._donate = donate
+        # Profiler contract (generalizing the decode_compiles == 1
+        # guard): every dispatch is fingerprinted, so a shape/dtype drift
+        # is counted in recompiles_total{program} and BLAMED by argument
+        # instead of silently recompiling. HOROVOD_PROFILER_COST=1
+        # additionally captures the compiled cost analysis per phase
+        # (one extra compile each, through the pure twin — opt-in here,
+        # unlike the free fingerprint; same parser as ProfiledStep).
+        self._capture_cost = profiler._cost_capture_enabled(default=False)
+        self._cost_captured: set = set()
+        # Descriptor memo for the one heavy, engine-pinned dispatch arg:
+        # params is the SAME object on every dispatch, so its pytree
+        # descriptor (hundreds of leaves) is computed once, not per token.
+        self._params_desc: Optional[Tuple[Any, str]] = None
 
     # ------------------------------------------------------------------
     # family extras (T5 cross-attention side state)
@@ -431,6 +457,10 @@ class InferenceEngine:
             if not req.status.terminal and req.expired(now):
                 req._finish(RequestStatus.EXPIRED,
                             "deadline passed mid-generation")
+                # A mid-flight deadline breach is the serving analogue of
+                # a collective stall: under HOROVOD_PROFILE_ON_STALL=1
+                # capture a bounded device trace of the slow window.
+                profiler.maybe_trigger(f"serve_deadline_{req.id}")
             if req._cancel_requested and not req.status.terminal:
                 req._finish(RequestStatus.CANCELLED, req.reason)
             if req.status.terminal:
@@ -480,10 +510,34 @@ class InferenceEngine:
 
     # -- device dispatches ----------------------------------------------
 
+    #: dispatch argument names per phase — the recompile detector blames
+    #: by name, so a drifting signature reads "tok: int32[8] -> int32[16]"
+    _ARGNAMES = {
+        "decode": ("params", "cache", "tok", "pos", "active", "extras"),
+        "prefill": ("params", "cache", "tok_seq", "pos0", "count",
+                    "active", "extras"),
+    }
+
     def _dispatch(self, phase: str, fn, *args):
         """Run one jitted call under watchdog + timeline coverage; the
         pending-collective entry makes a wedged decode step a named
         stall report instead of a silent hang."""
+        prog = f"serve:{self.name}:{phase}"
+        names = self._ARGNAMES.get(phase)
+        if names:
+            sig = {}
+            for n, a in zip(names, args):
+                if n == "params":
+                    hit = self._params_desc
+                    if hit is None or hit[0] is not a:
+                        hit = self._params_desc = (a, profiler.describe(a))
+                    sig[n] = hit[1]
+                else:
+                    sig[n] = profiler.describe(a)
+            profiler.note_trace(prog, sig, kind="serving")
+            if self._capture_cost and phase not in self._cost_captured:
+                self._cost_captured.add(phase)
+                self._register_cost(prog, phase, args)
         tok = metrics.collective_begin(
             "serve_step", name=f"{self.name}:{phase}:{self.step_count}")
         t0 = time.perf_counter()
@@ -499,9 +553,28 @@ class InferenceEngine:
                     if hasattr(a, "block_until_ready") else a, out)
         finally:
             metrics.collective_end(tok)
+        dt = time.perf_counter() - t0
         metrics.histogram("serve_step_seconds", engine=self.name,
-                          phase=phase).observe(time.perf_counter() - t0)
+                          phase=phase).observe(dt)
+        # The dispatch already blocks for the watchdog, so this timing is
+        # an honest device step — it feeds the program's roofline gauges
+        # (program_hfu / hbm_bandwidth_utilization) for free.
+        profiler.observe_step(prog, dt)
         return out
+
+    def _register_cost(self, prog: str, phase: str, args) -> None:
+        """Capture the phase program's cost analysis through its PURE
+        twin — lowering the counting wrapper would bump the trace
+        counters and break the ``decode_compiles == 1`` contract."""
+        pure = self._decode_pure if phase == "decode" else \
+            self._prefill_pure
+        try:
+            compiled = jax.jit(pure, donate_argnums=self._donate).lower(
+                *args).compile()
+            profiler.record_cost(prog, compiled, kind="serving")
+        except Exception:
+            metrics.logger.debug("serve cost capture failed for %s",
+                                 prog, exc_info=True)
 
     def _run_decode(self, lanes: List[Tuple[int, _SlotState]]) -> None:
         tok = np.zeros(self.slots, np.int32)
@@ -742,6 +815,14 @@ class InferenceEngine:
             self.manager.blocks_in_use)
         metrics.gauge("serve_blocks_peak", engine=self.name).set(
             self.manager.peak_blocks_in_use)
+        # KV-pool occupancy in BYTES: the memory-accounting view the
+        # profiler's doctor reads next to program_peak_hbm_bytes —
+        # blocks_in_use says "how full", this says "how much HBM that is".
+        bpb = self._cache.bytes_per_block
+        metrics.gauge("serve_kv_pool_bytes_in_use", engine=self.name).set(
+            self.manager.blocks_in_use * bpb)
+        metrics.gauge("serve_kv_pool_bytes_capacity",
+                      engine=self.name).set(self._cache.pool_bytes)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

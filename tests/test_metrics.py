@@ -196,14 +196,16 @@ class TestExporters:
 
     def test_prometheus_text_format_parses(self):
         """Acceptance: the exporter output passes a format-validity check —
-        every line is a `# TYPE` header or matches the exposition grammar,
-        histogram buckets are cumulative and end at +Inf, and _count equals
-        the +Inf bucket."""
+        every line is a `# HELP`/`# TYPE` header or matches the exposition
+        grammar, histogram buckets are cumulative and end at +Inf, and
+        _count equals the +Inf bucket."""
         self._populate()
         text = to_prometheus()
         assert text.endswith("\n")
         types = {}
         for line in text.strip().splitlines():
+            if line.startswith("# HELP "):
+                continue
             if line.startswith("# TYPE "):
                 _, _, name, kind = line.split()
                 types[name] = kind
@@ -228,6 +230,79 @@ class TestExporters:
         line = [l for l in text.splitlines() if "esc_total{" in l][0]
         assert _PROM_LINE.match(line)
         assert '\\"' in line and "\\n" in line
+
+    def test_family_headers_once_with_help(self):
+        """Satellite: `# HELP`/`# TYPE` exactly once per family — even when
+        the same name exists in two metric kinds — and HELP text escapes
+        backslash/newline per the exposition format."""
+        from horovod_tpu.metrics import set_help
+        self._populate()
+        # Same family name as counter AND gauge: headers must not repeat,
+        # and the second kind's samples are skipped entirely — one name
+        # emitting two samples with the same labelset is a duplicate
+        # timeseries, which scrapers reject.
+        registry.counter("dup_family").inc()
+        registry.gauge("dup_family").set(1)
+        set_help("calls_total", "weird\nhelp\\text")
+        text = to_prometheus()
+        lines = text.strip().splitlines()
+        for prefix in ("# HELP ", "# TYPE "):
+            names = [l.split()[2] for l in lines if l.startswith(prefix)]
+            assert len(names) == len(set(names)), (
+                f"duplicate {prefix.strip()} headers: {names}")
+        samples = [l for l in lines
+                   if l.startswith("horovod_tpu_dup_family")]
+        assert len(samples) == 1, samples
+        help_line = [l for l in lines
+                     if l.startswith("# HELP horovod_tpu_calls_total ")][0]
+        assert "\\n" in help_line and "\\\\" in help_line
+        assert "\n" not in help_line[len("# HELP "):]
+        # Every family with samples has a TYPE header before its samples.
+        typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+        for l in lines:
+            if not l.startswith("#"):
+                fam = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)", l).group(1)
+                base = re.sub(r"_(bucket|sum|count)$", "", fam)
+                assert fam in typed or base in typed, l
+
+    def test_prometheus_roundtrip_parse(self):
+        """Satellite acceptance: parse the exposition text back into
+        {family: {labels: value}} and recover exactly the snapshot's
+        counter/gauge values and histogram sum/count."""
+        self._populate()
+        registry.counter("esc2_total", path='a\\b"c\nd').inc(5)
+        snap = snapshot()
+        parsed = {}
+        for line in to_prometheus(snap).strip().splitlines():
+            if line.startswith("#"):
+                continue
+            m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                         r"(?:\{(.*)\})? (\S+)$", line)
+            assert m, f"unparseable line: {line!r}"
+            name, labelstr, value = m.groups()
+            labels = {}
+            for lm in re.finditer(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"',
+                    labelstr or ""):
+                k, v = lm.groups()
+                labels[k] = (v.replace("\\n", "\n").replace('\\"', '"')
+                             .replace("\\\\", "\\"))
+            parsed.setdefault(name, {})[
+                tuple(sorted(labels.items()))] = float(value)
+        for name, series in snap["counters"].items():
+            for s in series:
+                key = tuple(sorted(s["labels"].items()))
+                assert parsed[f"horovod_tpu_{name}"][key] == s["value"]
+        for name, series in snap["gauges"].items():
+            for s in series:
+                key = tuple(sorted(s["labels"].items()))
+                assert parsed[f"horovod_tpu_{name}"][key] == s["value"]
+        for name, series in snap["histograms"].items():
+            for s in series:
+                key = tuple(sorted(s["labels"].items()))
+                assert parsed[f"horovod_tpu_{name}_count"][key] == s["count"]
+                assert parsed[f"horovod_tpu_{name}_sum"][key] == \
+                    pytest.approx(s["sum"])
 
     def test_json_roundtrip(self):
         self._populate()

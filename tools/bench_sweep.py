@@ -1,6 +1,10 @@
 """Perf sweep for the ResNet-50 headline bench: try batch sizes / variants,
 print img/s + achieved TFLOP/s + MFU for each. Run on the real chip.
 
+Cost analysis, device peaks, and the MFU/HFU accounting all come from the
+profiler's program registry (``horovod_tpu.profiler``) — this tool keeps no
+private copy of any of them.
+
 Usage: python tools/bench_sweep.py [--batches 128,256,512]
 """
 
@@ -18,20 +22,11 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
-
-PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5e": 197.0}
-
-
-def peak_for(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_TFLOPS.items():
-        if k in kind:
-            return v
-    return 197.0
+from horovod_tpu import profiler
 
 
 def run_one(batch, steps=30, size=224):
+    from horovod_tpu.models import ResNet50
     model = ResNet50(num_classes=1000)
     rng = jax.random.PRNGKey(0)
     images = jnp.asarray(
@@ -61,36 +56,35 @@ def run_one(batch, steps=30, size=224):
         params = optax.apply_updates(params, updates)
         return params, batch_stats, opt_state, loss
 
-    lowered = train_step.lower(params, batch_stats, opt_state, images, labels)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    flops_per_step = cost.get("flops", 0.0) if cost else 0.0
+    program = f"sweep:resnet50:bs{batch}"
+    # Sweep through the compiled executable itself: the AOT compile that
+    # feeds the cost analysis doesn't populate jit's cache, so calling
+    # train_step afterwards would compile everything a second time.
+    compiled = train_step.lower(params, batch_stats, opt_state, images,
+                                labels).compile()
+    rec = profiler.record_cost(program, compiled)
 
     for _ in range(3):
-        params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, loss = compiled(
             params, batch_stats, opt_state, images, labels)
     float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, loss = compiled(
             params, batch_stats, opt_state, images, labels)
     float(loss)
-    dt = time.perf_counter() - t0
+    dt = (time.perf_counter() - t0) / steps
+    profiler.observe_step(program, dt)
 
-    img_s = batch * steps / dt
-    step_ms = dt / steps * 1e3
-    achieved_tflops = flops_per_step * steps / dt / 1e12
-    peak = peak_for(jax.devices()[0])
-    # analytic: ~12.3 GFLOP/image fwd+bwd for ResNet-50 @224
-    analytic_tflops = img_s * 12.3e9 / 1e12
-    print(f"batch={batch:4d} step={step_ms:8.2f}ms img/s={img_s:9.1f} "
-          f"xla_flops/step={flops_per_step/1e9:8.1f}G "
-          f"achieved={achieved_tflops:6.1f} TF/s (xla) "
-          f"analytic={analytic_tflops:6.1f} TF/s "
-          f"MFU={100*analytic_tflops/peak:5.1f}%", flush=True)
+    img_s = batch / dt
+    u = profiler.utilization(rec.flops, dt)   # no remat: mfu == hfu
+    mfu = f"{100 * u['mfu']:5.1f}%" if u["mfu"] is not None else "  n/a"
+    print(f"batch={batch:4d} step={dt * 1e3:8.2f}ms img/s={img_s:9.1f} "
+          f"xla_flops/step={rec.flops / 1e9:8.1f}G "
+          f"achieved={u['achieved_tflops']:6.1f} TF/s "
+          f"peak_hbm={rec.peak_hbm_bytes / 2**30:5.2f}GiB "
+          f"MFU={mfu}", flush=True)
 
 
 def main():

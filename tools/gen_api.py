@@ -50,6 +50,7 @@ MODULES = [
     "horovod_tpu.models.t5",
     "horovod_tpu.models.convert",
     "horovod_tpu.models.generate",
+    "horovod_tpu.profiler",
     "horovod_tpu.serving",
     "horovod_tpu.serving.cache",
     "horovod_tpu.serving.scheduler",

@@ -1,34 +1,68 @@
-import os, sys, time
+"""Capture a 5-step jax.profiler trace of the ResNet-50 headline step.
+
+Rides the profiler subsystem: the train step is an instrumented program
+(cost analysis + recompile fingerprinting in the registry) and the device
+trace is captured through ``hvd.profile()`` so host timeline markers
+bracket the window. Prints the registry record at the end.
+"""
+
+import os
+import sys
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from functools import partial
-import jax, jax.numpy as jnp, numpy as np, optax
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu import profiler
+
 
 def main():
     from horovod_tpu.models import ResNet50
     batch = 128
-    images = jnp.asarray(np.random.default_rng(0).standard_normal((batch,224,224,3)), jnp.bfloat16)
-    labels = jnp.asarray(np.random.default_rng(1).integers(0,1000,(batch,)), jnp.int32)
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, 224, 224, 3)),
+        jnp.bfloat16)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 1000, (batch,)), jnp.int32)
     model = ResNet50(num_classes=1000)
     v = model.init(jax.random.PRNGKey(0), images, train=True)
     params, bs = v["params"], v["batch_stats"]
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
+
     def loss_fn(params, bs, images, labels):
-        logits, upd = model.apply({"params": params, "batch_stats": bs}, images, train=True, mutable=["batch_stats"])
+        logits, upd = model.apply(
+            {"params": params, "batch_stats": bs}, images, train=True,
+            mutable=["batch_stats"])
         logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, labels[:,None],1)), upd["batch_stats"]
-    @partial(jax.jit, donate_argnums=(0,1,2))
+        return (-jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1)),
+                upd["batch_stats"])
+
+    @profiler.instrument(name="profile:resnet50", donate_argnums=(0, 1, 2))
     def step(params, bs, opt_state, images, labels):
-        (l, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(params, bs, images, labels)
+        (l, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, bs, images, labels)
         u, opt_state = tx.update(g, opt_state, params)
         return optax.apply_updates(params, u), bs, opt_state, l
+
     for _ in range(3):
-        params, bs, opt_state, l = step(params, bs, opt_state, images, labels)
+        params, bs, opt_state, l = step(params, bs, opt_state, images,
+                                        labels)
     float(l)
-    with jax.profiler.trace("/tmp/rn50_trace"):
+    with profiler.profile("/tmp/rn50_trace") as logdir:
         for _ in range(5):
-            params, bs, opt_state, l = step(params, bs, opt_state, images, labels)
+            params, bs, opt_state, l = step(params, bs, opt_state, images,
+                                            labels)
         float(l)
-    print("trace done")
+    rec = step.record()
+    print(f"trace done -> {logdir}")
+    print(f"program: flops/step={rec.flops / 1e9:.1f}G "
+          f"bytes={rec.bytes_accessed / 1e9:.2f}G "
+          f"peak_hbm={rec.peak_hbm_bytes / 2**30:.2f}GiB "
+          f"compiles={rec.compiles} recompiles={rec.recompiles}")
+
 
 main()
