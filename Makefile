@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke test native
+.PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
+	test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -34,6 +35,15 @@ serve-smoke:
 # tests/test_doctor.py::TestTwoProcessSmoke.
 doctor-smoke:
 	$(PY) tools/doctor_smoke.py
+
+# Quantized-wire smoke: 2 CPU processes allreduce the same payload on the
+# exact fp32 wire and the block-quantized int8 wire; every rank must hold
+# byte-identical dequantized results, the quantized value must sit inside
+# the int8 block error bound, and allreduce_wire_bytes_total must show a
+# >= 3x wire-byte reduction. Also runs in tier-1 as
+# tests/test_quantized_and_sharded.py::TestTwoProcessQuantSmoke.
+quant-smoke:
+	$(PY) tools/quant_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

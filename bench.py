@@ -115,10 +115,22 @@ def _collective_counters():
     try:
         from horovod_tpu.collective import negotiation_stats
         from horovod_tpu.config import get_config
-        from horovod_tpu.metrics import collective_summary
+        from horovod_tpu.metrics import collective_summary, snapshot
         cfg = get_config()
+        # Cumulative wire bytes the compiled allreduce buckets put on the
+        # interconnect per ring traversal (trace-time counter, summed over
+        # algorithm x wire labels) — the number the quantized formats cut.
+        snap = snapshot()
+        wire_bytes = sum(
+            float(c.get("value", 0)) for c in
+            snap.get("counters", {}).get("allreduce_wire_bytes_total", []))
+        from horovod_tpu.overlap import parse_algorithm
+        wire = (parse_algorithm(cfg.allreduce_algorithm)[1]
+                or cfg.allreduce_wire)
         return {"allreduce_alg": cfg.allreduce_algorithm,
+                "wire": wire,
                 "overlap_chunks": cfg.overlap_chunks,
+                "allreduce_wire_bytes": int(wire_bytes),
                 "negotiation": negotiation_stats(),
                 "collectives": collective_summary()}
     except Exception:
@@ -395,13 +407,16 @@ def bench_allreduce(on_tpu):
                   out_specs=P("x"))
         def psum_fn(v, n=n):
             # Honors HOROVOD_ALLREDUCE_ALGORITHM / --allreduce-alg, so
-            # --sweep-comm measures the real per-algorithm lowering here.
+            # --sweep-comm measures the real per-algorithm lowering here
+            # (including the quantized int8/fp8 wires).
             if alg in ("psum", "auto"):
                 return jax.lax.psum(v, "x")
             from horovod_tpu import overlap as _overlap
-            chunks = cfg.overlap_chunks if alg == "chunked_rs_ag" else 1
+            base, qwire = _overlap.parse_algorithm(alg)
+            chunks = cfg.overlap_chunks if base == "chunked_rs_ag" else 1
             return _overlap.chunked_rs_ag_psum(
-                v.ravel(), "x", n, chunks=chunks).reshape(v.shape)
+                v.ravel(), "x", n, chunks=chunks,
+                wire=qwire).reshape(v.shape)
 
         _sync(psum_fn(x))                       # compile + warm
         t0 = time.perf_counter()
@@ -431,6 +446,17 @@ def bench_allreduce(on_tpu):
         "detail": detail,
     }
     rec.update(_collective_counters())
+    # This bench drives overlap.chunked_rs_ag_psum directly (no fused
+    # allreduce buckets), so compute the per-traversal wire bytes of the
+    # measured payload here instead of reading the bucket counter. The
+    # bench lowering only quantizes when the ALGORITHM names a wire —
+    # the config wire knob does not apply to it, so exact algorithms
+    # are stamped fp32 whatever HOROVOD_ALLREDUCE_WIRE says.
+    from horovod_tpu import overlap as _overlap
+    wire = _overlap.parse_algorithm(alg)[1] or "fp32"
+    rec["wire"] = wire
+    rec["allreduce_wire_bytes"] = _overlap.wire_bytes(
+        payload_bytes // 4, wire)
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -656,13 +682,18 @@ def _apply_comm_flags(args):
     exactly the knob surface users set."""
     if getattr(args, "allreduce_alg", None):
         os.environ["HOROVOD_ALLREDUCE_ALGORITHM"] = args.allreduce_alg
+    if getattr(args, "allreduce_wire", None):
+        os.environ["HOROVOD_ALLREDUCE_WIRE"] = args.allreduce_wire
     if getattr(args, "overlap_chunks", None):
         os.environ["HOROVOD_OVERLAP_CHUNKS"] = str(args.overlap_chunks)
 
 
 #: --sweep-comm measures one line per algorithm (auto is skipped: it
-#: resolves to one of the explicit three per bucket size).
-SWEEP_ALGS = ("psum", "rs_ag", "chunked_rs_ag")
+#: resolves to one of the explicit lowerings per bucket size). The
+#: quantized wires ride the chunked pipeline — the shape they'd resolve
+#: to on real gradient buckets.
+SWEEP_ALGS = ("psum", "rs_ag", "chunked_rs_ag",
+              "chunked_rs_ag_int8", "chunked_rs_ag_fp8")
 
 
 def _load_serve_bench():
@@ -834,6 +865,8 @@ def _supervise(args) -> int:
            "--model", args.model, "--inner"]
     if getattr(args, "allreduce_alg", None):
         cmd += ["--allreduce-alg", args.allreduce_alg]
+    if getattr(args, "allreduce_wire", None):
+        cmd += ["--allreduce-wire", args.allreduce_wire]
     if getattr(args, "overlap_chunks", None):
         cmd += ["--overlap-chunks", str(args.overlap_chunks)]
     if getattr(args, "sweep_comm", False):
@@ -868,9 +901,15 @@ def _build_parser():
     p.add_argument("--inner", action="store_true",
                    help="run directly in-process (no probe/supervision)")
     p.add_argument("--allreduce-alg", dest="allreduce_alg", default=None,
-                   choices=["auto", "psum", "rs_ag", "chunked_rs_ag"],
+                   choices=["auto", "psum", "rs_ag", "chunked_rs_ag",
+                            "rs_ag_int8", "chunked_rs_ag_int8",
+                            "rs_ag_fp8", "chunked_rs_ag_fp8"],
                    help="gradient-sync algorithm for this run "
                         "(HOROVOD_ALLREDUCE_ALGORITHM)")
+    p.add_argument("--allreduce-wire", dest="allreduce_wire", default=None,
+                   choices=["fp32", "bf16", "int8", "fp8"],
+                   help="default allreduce wire precision "
+                        "(HOROVOD_ALLREDUCE_WIRE)")
     p.add_argument("--overlap-chunks", dest="overlap_chunks", type=int,
                    default=None,
                    help="chunked_rs_ag pipeline depth "

@@ -44,7 +44,7 @@ from horovod_tpu.profiler import doctor, profile  # noqa: F401
 from horovod_tpu.metrics import reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,
-    accumulation_has_updated,
+    ErrorFeedbackState, accumulation_has_updated, reset_error_feedback,
     grad, value_and_grad, allreduce_gradients, broadcast_parameters,
     broadcast_optimizer_state, broadcast_variables,
 )

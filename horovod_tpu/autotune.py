@@ -316,11 +316,16 @@ class BayesianAutotuner:
     ALGORITHM_CHOICES = ("psum", "rs_ag", "chunked_rs_ag")
     #: chunk-count rungs for chunked_rs_ag (log2-embedded)
     CHUNK_CHOICES = (1, 2, 4, 8)
+    #: wire-precision axis (overlap.WIRES order): the payload format the
+    #: RS+AG decomposition puts on the wire per bucket — fp32 (exact),
+    #: bf16 cast, or the block-quantized 1-byte formats.
+    WIRE_CHOICES = ("fp32", "bf16", "int8", "fp8")
 
     def __init__(self, lo_bytes: int = _MB, hi_bytes: int = 256 * _MB,
                  probes: int = 6, samples_per_probe: int = 10,
                  tune_compression: bool = False,
-                 tune_algorithm: bool = False):
+                 tune_algorithm: bool = False,
+                 tune_wire: bool = False):
         import math
         self._lo = math.log2(lo_bytes)
         self._hi = math.log2(hi_bytes)
@@ -328,8 +333,9 @@ class BayesianAutotuner:
         self._samples = samples_per_probe
         self._tune_comp = tune_compression
         self._tune_alg = tune_algorithm
+        self._tune_wire = tune_wire
         # (normalized threshold coord, compression index, algorithm
-        # index, chunk index) per probe
+        # index, chunk index, wire index) per probe
         self._xs: List[tuple] = []
         self._ys: List[float] = []   # median step seconds per probe
         self._pending: List[float] = []
@@ -338,6 +344,7 @@ class BayesianAutotuner:
         self._best_compression: Optional[str] = None
         self._best_algorithm: Optional[str] = None
         self._best_chunks: Optional[int] = None
+        self._best_wire: Optional[str] = None
         #: True whenever a fresh GP proposal is live and has not yet been
         #: agreed across processes (see class docstring). The first point
         #: is fixed, so no sync is needed until a probe completes.
@@ -378,6 +385,18 @@ class BayesianAutotuner:
             return self._best_chunks
         return self.CHUNK_CHOICES[self._cur[3]]
 
+    def current_wire(self) -> str:
+        """Current wire-precision pick (the config wire when wire tuning
+        is off). Compose with the algorithm via
+        ``overlap.compose_algorithm(current_algorithm(), current_wire())``
+        — psum picks stay exact by construction."""
+        if not self._tune_wire:
+            from horovod_tpu.config import get_config
+            return get_config().allreduce_wire
+        if self._best_wire is not None:
+            return self._best_wire
+        return self.WIRE_CHOICES[self._cur[4]]
+
     def record(self, step_seconds: float) -> None:
         if self._best is not None:
             return
@@ -397,12 +416,15 @@ class BayesianAutotuner:
             if self._tune_alg:
                 self._best_algorithm = self.ALGORITHM_CHOICES[self._xs[i][2]]
                 self._best_chunks = self.CHUNK_CHOICES[self._xs[i][3]]
+            if self._tune_wire:
+                self._best_wire = self.WIRE_CHOICES[self._xs[i][4]]
             gauge("autotune_threshold_bytes").set(self._best)
             event("autotune_converged", mode="bayes",
                   threshold_bytes=self._best,
                   compression=self._best_compression,
                   algorithm=self.current_algorithm(),
-                  chunks=self.current_chunks() if self._tune_alg else None)
+                  chunks=self.current_chunks() if self._tune_alg else None,
+                  wire=self.current_wire() if self._tune_wire else None)
         else:
             self._cur = self._next_point()
             # points 2-3 of the initial design are timing-independent and
@@ -413,6 +435,8 @@ class BayesianAutotuner:
                   compression=self.COMPRESSION_CHOICES[self._cur[1]],
                   algorithm=(self.ALGORITHM_CHOICES[self._cur[2]]
                              if self._tune_alg else "auto"),
+                  wire=(self.WIRE_CHOICES[self._cur[4]]
+                        if self._tune_wire else None),
                   median_step_s=round(med, 6))
 
     def current_point(self) -> tuple:
@@ -422,32 +446,37 @@ class BayesianAutotuner:
 
     def set_current_point(self, point) -> None:
         point = tuple(point)
-        if len(point) == 2:            # legacy (threshold, compression)
-            point = point + self._cur[2:]
-        x01, comp, alg, chunk = point
-        self._cur = (float(x01), int(comp), int(alg), int(chunk))
+        if len(point) < 5:             # legacy shorter points: keep the
+            point = point + self._cur[len(point):]   # local trailing axes
+        x01, comp, alg, chunk, wire = point
+        self._cur = (float(x01), int(comp), int(alg), int(chunk),
+                     int(wire))
         self.pending_sync = False
 
     def summary(self) -> str:
         lines = [f"bayesian autotune: {len(self._xs)} probes"]
-        for (x, c, a, ch), y in zip(self._xs, self._ys):
+        for (x, c, a, ch, w), y in zip(self._xs, self._ys):
             alg = (f" {self.ALGORITHM_CHOICES[a]}x{self.CHUNK_CHOICES[ch]}"
                    if self._tune_alg else "")
+            wire = (f" wire={self.WIRE_CHOICES[w]}"
+                    if self._tune_wire else "")
             lines.append(f"  {self._denorm(x) / _MB:8.1f} MB "
-                         f"{self.COMPRESSION_CHOICES[c]:5s}{alg} -> "
+                         f"{self.COMPRESSION_CHOICES[c]:5s}{alg}{wire} -> "
                          f"{y * 1e3:8.2f} ms/step")
         if self._best is not None:
             alg = (f" {self._best_algorithm}x{self._best_chunks}"
                    if self._tune_alg else "")
+            wire = (f" wire={self._best_wire}" if self._tune_wire else "")
             lines.append(f"best: {self._best / _MB:.1f} MB "
-                         f"{self._best_compression}{alg}")
+                         f"{self._best_compression}{alg}{wire}")
         return "\n".join(lines)
 
     # -- GP machinery -----------------------------------------------------
     def _denorm(self, x01: float) -> int:
         return int(round(2 ** (self._lo + x01 * (self._hi - self._lo))))
 
-    def _embed(self, x01: float, comp: int, alg: int = 0, chunk: int = 0):
+    def _embed(self, x01: float, comp: int, alg: int = 0, chunk: int = 0,
+               wire: int = 0):
         import math
 
         import numpy as np
@@ -465,6 +494,10 @@ class BayesianAutotuner:
             span = math.log2(max(self.CHUNK_CHOICES))
             coords.append(math.log2(self.CHUNK_CHOICES[chunk])
                           / max(span, 1.0))
+        if self._tune_wire:
+            onehot = [0.0] * len(self.WIRE_CHOICES)
+            onehot[wire] = 1.0
+            coords += onehot
         return np.array(coords)
 
     def _next_point(self) -> tuple:
@@ -474,11 +507,13 @@ class BayesianAutotuner:
         n_comp = len(self.COMPRESSION_CHOICES) if self._tune_comp else 1
         n_alg = len(self.ALGORITHM_CHOICES) if self._tune_alg else 1
         n_chunk = len(self.CHUNK_CHOICES) if self._tune_alg else 1
+        n_wire = len(self.WIRE_CHOICES) if self._tune_wire else 1
         n = len(self._xs)
         if n < 3:
             # fixed space-filling start: ends + middle of the log range,
             # cycling the categorical choices so every axis gets data
-            return ((0.0, 0.5, 1.0)[n], n % n_comp, n % n_alg, n % n_chunk)
+            return ((0.0, 0.5, 1.0)[n], n % n_comp, n % n_alg,
+                    n % n_chunk, n % n_wire)
         X = np.stack([self._embed(*p) for p in self._xs])
         y = np.asarray(self._ys)
         y_mu, y_sd = y.mean(), max(y.std(), 1e-12)
@@ -491,10 +526,13 @@ class BayesianAutotuner:
 
         K = kern(X, X) + sn2 * np.eye(n)
         # candidates: dense threshold grid x every category combination
-        grid = np.linspace(0.0, 1.0, 65)
-        cands = [(g, c, a, ch)
-                 for ch in range(n_chunk) for a in range(n_alg)
-                 for c in range(n_comp) for g in grid]
+        # (the grid coarsens as categorical axes multiply so the EI argmax
+        # stays a few-thousand-point scan)
+        grid = np.linspace(0.0, 1.0, 65 if n_wire == 1 else 33)
+        cands = [(g, c, a, ch, w)
+                 for w in range(n_wire) for ch in range(n_chunk)
+                 for a in range(n_alg) for c in range(n_comp)
+                 for g in grid]
         Xc = np.stack([self._embed(*p) for p in cands])
         Ks = kern(Xc, X)
         sol = np.linalg.solve(K, np.eye(n))

@@ -194,12 +194,19 @@ def _identity_for(op: int, x: jnp.ndarray) -> jnp.ndarray:
 # in-trace (SPMD) implementations
 # ---------------------------------------------------------------------------
 
-def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks):
+def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks,
+                wire=None):
     """Bandwidth-optimal lowering of a Sum/Average fusion bucket:
     reduce-scatter + all-gather over the full axis (``overlap.py``),
     optionally as ``chunks`` pipelined pieces. Same masked-subset
     contract as :func:`_allreduce_leaf` — members contribute their
-    value, non-members zeros, and non-members get their input back."""
+    value, non-members zeros, and non-members get their input back.
+
+    ``wire="int8"``/``"fp8"`` runs the quantized-wire pipeline: the
+    bucket is reduced in fp32 through the block-scaled two-phase
+    exchange (non-member zeros quantize to exact-zero payloads, so a
+    subset's masking survives quantization), with Average dividing the
+    reduced partial by the MEMBER count before re-quantization."""
     from horovod_tpu import overlap as _overlap
     if op not in (ReduceOp.Sum, ReduceOp.Average):
         raise ValueError("rs_ag decomposition applies to Sum/Average only")
@@ -210,11 +217,17 @@ def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks):
     if prescale != 1.0:
         x = x * jnp.asarray(prescale, x.dtype)
     masked = jnp.where(member, x, jnp.zeros_like(x)) if is_subset else x
-    out = _overlap.chunked_rs_ag_psum(masked, ps.axis, core.size(),
-                                      chunks=chunks)
-    if op == ReduceOp.Average:
-        out = out / jnp.asarray(k, out.dtype) if jnp.issubdtype(
-            out.dtype, jnp.floating) else out // k
+    if wire is not None:
+        out = _overlap.chunked_rs_ag_psum(
+            masked.astype(jnp.float32), ps.axis, core.size(), chunks=chunks,
+            wire=wire, mean_k=float(k) if op == ReduceOp.Average else None)
+        out = out.astype(x.dtype)
+    else:
+        out = _overlap.chunked_rs_ag_psum(masked, ps.axis, core.size(),
+                                          chunks=chunks)
+        if op == ReduceOp.Average:
+            out = out / jnp.asarray(k, out.dtype) if jnp.issubdtype(
+                out.dtype, jnp.floating) else out // k
     if postscale != 1.0:
         out = out * jnp.asarray(postscale, out.dtype)
     return jnp.where(member, out, x_in) if is_subset else out
@@ -262,24 +275,43 @@ def _allreduce_leaf(x, op, ps: ProcessSet, prescale, postscale):
     return jnp.where(member, out, x_in) if is_subset else out
 
 
+def _wire_label(dtype) -> str:
+    """Metrics label for an UNQUANTIZED payload dtype. Must never
+    collide with the quantized-wire labels: an exact exchange of an
+    int8-dtype tensor is ``raw-int8``, so ``wire="int8"`` always means
+    the block-scaled quantized format (wire_bytes would otherwise add
+    phantom scale overhead and the doctor would report quantization
+    that never happened)."""
+    d = jnp.dtype(dtype)
+    name = {"float32": "fp32", "bfloat16": "bf16", "float16": "fp16",
+            "float64": "fp64"}.get(d.name, d.name)
+    from horovod_tpu import overlap as _overlap
+    return f"raw-{name}" if name in _overlap.QUANT_WIRES else name
+
+
 def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
                     fusion_threshold, algorithm="auto",
-                    overlap_chunks=None, reverse=False):
+                    overlap_chunks=None, reverse=False, wire="fp32"):
     if op not in _SCALING_OPS and (prescale != 1.0 or postscale != 1.0):
         raise ValueError("prescale/postscale only apply to Sum/Average/Adasum")
     from horovod_tpu import overlap as _overlap
     if overlap_chunks is None:
         overlap_chunks = _overlap.DEFAULT_CHUNKS
 
-    wire = getattr(compression, "wire", None)
-    if wire is not None:
+    marker_wire = getattr(compression, "wire", None)
+    if marker_wire is not None:
         # Quantized allreduce restructures the reduction itself (EQuARX
         # two-phase); see ops/quantized.py. The fusion buffer is packed
         # with every leaf padded to a whole number of quantization blocks,
         # so one leaf's magnitude can never set another leaf's scale.
+        # (The algorithm-axis spelling of the same wire —
+        # ``algorithm="chunked_rs_ag_int8"`` — takes the fused RS+AG
+        # path below instead; this marker path keeps upstream's
+        # ``compression=`` API surface.)
         if op not in (ReduceOp.Sum, ReduceOp.Average):
             raise ValueError(
-                f"{wire} quantized allreduce supports Sum and Average")
+                f"{marker_wire} quantized allreduce supports Sum and "
+                "Average")
         from horovod_tpu.ops.quantized import BLOCK, quantized_allreduce
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -306,13 +338,23 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
             spans.append((off, flat.shape[0]))
             off += m
         buf = jnp.concatenate(padded)
+        # Wire-byte telemetry, same accounting as the algorithm-axis path.
+        _metrics.counter(
+            "allreduce_wire_bytes_total", algorithm="compression",
+            wire=marker_wire).inc(
+                _overlap.wire_bytes(int(buf.size), marker_wire))
+        if buf.size:
+            _metrics.gauge("allreduce_compression_ratio",
+                           wire=marker_wire).set(
+                4 * int(buf.size)
+                / _overlap.wire_bytes(int(buf.size), marker_wire))
         # Honor the fusion threshold: quantize + reduce in BLOCK-aligned
         # pieces so peak staging stays bounded like the fused fp path.
         seg = max(BLOCK, (int(fusion_threshold) // 4) // BLOCK * BLOCK)
         pieces = [
             quantized_allreduce(buf[s:s + seg], ps.axis, core.size(),
                                 average=(op == ReduceOp.Average),
-                                wire=wire, ranks=ps.ranks)
+                                wire=marker_wire, ranks=ps.ranks)
             for s in range(0, buf.shape[0], seg)
         ]
         out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
@@ -330,30 +372,66 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
 
     def reduce_buffer(buf):
         c, ctx = compression.compress(buf)
+        reducible = op in (ReduceOp.Sum, ReduceOp.Average)
+        quantizable = reducible and jnp.issubdtype(c.dtype, jnp.floating)
+        # bf16 wire: cast the payload for the collective and back — the
+        # knob-level analogue of Compression.bf16, applied per bucket.
+        wire_cast = None
+        if wire == "bf16" and quantizable and c.dtype != jnp.bfloat16:
+            wire_cast = c.dtype
+            c = c.astype(jnp.bfloat16)
         nbytes = int(c.size) * jnp.dtype(c.dtype).itemsize
         alg = _overlap.resolve_algorithm(
-            algorithm, nbytes, op, core.size(),
-            reducible=op in (ReduceOp.Sum, ReduceOp.Average))
-        # Per-bucket algorithm telemetry (trace-time: one count per
-        # compiled bucket, like the fusion counters).
+            algorithm, nbytes, op, core.size(), reducible=reducible,
+            wire=wire if quantizable else None)
+        base, qwire = _overlap.parse_algorithm(alg)
+        if qwire is not None and not quantizable:
+            # Integer buckets (step counters, masks) and pass-through ops
+            # must round-trip exactly: strip the wire, keep the base.
+            alg, qwire = base, None
+        # Per-bucket algorithm + wire-byte telemetry (trace-time: one
+        # count per compiled bucket, like the fusion counters). Wire
+        # bytes count the payload actually put on the wire per ring
+        # traversal — 1-byte elements + fp32 block scales for quantized
+        # wires — so the fp32/int8 counter ratio IS the compression.
         _metrics.counter("allreduce_algorithm_total", algorithm=alg).inc()
+        eff_wire = qwire or _wire_label(c.dtype)
+        wb = _overlap.wire_bytes(int(c.size), eff_wire,
+                                 jnp.dtype(c.dtype).itemsize)
+        logical = int(buf.size) * jnp.dtype(buf.dtype).itemsize
+        _metrics.counter("allreduce_wire_bytes_total",
+                         algorithm=alg, wire=eff_wire).inc(wb)
+        if logical and wb:
+            _metrics.gauge("allreduce_compression_ratio",
+                           wire=eff_wire).set(logical / wb)
         span = _tracing.current_span()
         if span is not None:
             _metrics._timeline_marker(
                 "allreduce_algorithm", category="overlap",
                 op_id=span.op_id, tensor=span.tensor, algorithm=alg,
-                bytes=nbytes,
-                chunks=overlap_chunks if alg == "chunked_rs_ag" else 1)
+                bytes=nbytes, wire=eff_wire, wire_bytes=wb,
+                chunks=overlap_chunks if base == "chunked_rs_ag" else 1)
         if alg == "psum":
             r = _allreduce_leaf(c, op, ps, prescale, postscale)
         else:
             r = _rs_ag_leaf(c, op, ps, prescale, postscale,
                             chunks=overlap_chunks
-                            if alg == "chunked_rs_ag" else 1)
+                            if base == "chunked_rs_ag" else 1,
+                            wire=qwire)
+        if wire_cast is not None:
+            r = r.astype(wire_cast)
         return compression.decompress(r, ctx)
 
+    # Quantized wires get BLOCK-aligned leaves inside each bucket so one
+    # leaf's magnitude can never set another leaf's quantization scale.
+    pad_elems = 1
+    if _overlap.parse_algorithm(algorithm)[1] is not None \
+            or wire in _overlap.QUANT_WIRES:
+        from horovod_tpu.ops.quantized import BLOCK as _qblock
+        pad_elems = _qblock
     return _fusion.fused_apply(reduce_buffer, tree, fusion_threshold,
-                               reverse=reverse, pin_order=reverse)
+                               reverse=reverse, pin_order=reverse,
+                               pad_elems=pad_elems)
 
 
 def _broadcast_leaf(x, root_rank, ps: ProcessSet):
@@ -853,9 +931,10 @@ def _eager_run_inner(kind, tree, params, param_key, negotiate_key,
         if kind == "allreduce" and params[1].ranks is None:
             # Everything a joined peer needs to replay this collective
             # with neutral contributions (all picklable by reference).
-            op_, _ps_, pre_, post_, comp_, fus_, alg_, chk_, rev_ = params
+            (op_, _ps_, pre_, post_, comp_, fus_, alg_, chk_, rev_,
+             wire_) = params
             desc = ("allreduce", shapes, op_, pre_, post_, comp_, fus_,
-                    alg_, chk_, rev_)
+                    alg_, chk_, rev_, wire_)
         joined = _negotiate(kind, (shapes, param_key, negotiate_key),
                             service_desc=desc, span=span)
         if joined:
@@ -988,6 +1067,7 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
               fusion_threshold_bytes: Optional[int] = None,
               algorithm: Optional[str] = None,
               overlap_chunks: Optional[int] = None,
+              wire: Optional[str] = None,
               _reverse_issue: bool = False):
     """Allreduce a tensor or pytree across the communicator (``hvd.allreduce``).
 
@@ -1007,13 +1087,26 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
     * ``"chunked_rs_ag"`` — the bucket split into ``overlap_chunks``
       pipelined RS+AG pairs so XLA can overlap chunk i's all-gather with
       chunk i+1's reduce-scatter (see ``overlap.py``);
+    * ``"rs_ag_int8"`` / ``"chunked_rs_ag_int8"`` / ``"rs_ag_fp8"`` /
+      ``"chunked_rs_ag_fp8"`` — the same decompositions with an
+      EQuARX-style quantized wire: per-block scaled 1-byte payloads on
+      both legs, exact fp32 reduction at the owning shard (wire traffic
+      ~1/4 of fp32; pair with ``DistributedOptimizer(error_feedback=
+      True)`` for training);
     * ``"auto"`` (default via ``HOROVOD_ALLREDUCE_ALGORITHM``) — per
       bucket by size: small buckets psum, large rs_ag, largest chunked.
 
-    Quantized wire compression (``Compression.grouped_*``) restructures
-    the reduction itself and ignores ``algorithm``. ``_reverse_issue`` is
-    internal (gradient overlap): buckets issue in reverse order with
-    pinned scheduling.
+    ``wire`` (default ``HOROVOD_ALLREDUCE_WIRE``) sets the default wire
+    precision: ``"bf16"`` casts each bucket for the collective and back;
+    ``"int8"``/``"fp8"`` make ``auto`` pick the quantized variants for
+    its rs_ag-sized buckets. An explicit quantized ``algorithm`` always
+    wins. ``allreduce_wire_bytes_total{algorithm,wire}`` /
+    ``allreduce_compression_ratio`` record the achieved wire traffic.
+
+    Quantized wire compression (``Compression.int8``/``fp8``)
+    restructures the reduction itself and ignores ``algorithm``.
+    ``_reverse_issue`` is internal (gradient overlap): buckets issue in
+    reverse order with pinned scheduling.
     """
     from horovod_tpu.config import get_config
     cfg = get_config()
@@ -1023,11 +1116,17 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         algorithm = cfg.allreduce_algorithm
     if overlap_chunks is None:
         overlap_chunks = cfg.overlap_chunks
+    if wire is None:
+        wire = cfg.allreduce_wire
     from horovod_tpu import overlap as _overlap
     if algorithm not in _overlap.ALGORITHMS:
         raise ValueError(
             f"unknown allreduce algorithm {algorithm!r}; expected one of "
             f"{_overlap.ALGORITHMS}")
+    if wire not in _overlap.WIRES:
+        raise ValueError(
+            f"unknown allreduce wire {wire!r}; expected one of "
+            f"{_overlap.WIRES} (HOROVOD_ALLREDUCE_WIRE)")
     overlap_chunks = int(overlap_chunks)
     if overlap_chunks < 1:
         raise ValueError(
@@ -1035,7 +1134,7 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
     ps = _resolve_ps(process_set)
     args = (op, ps, float(prescale_factor), float(postscale_factor),
             compression, int(fusion_threshold_bytes), algorithm,
-            overlap_chunks, bool(_reverse_issue))
+            overlap_chunks, bool(_reverse_issue), wire)
     if _is_traced(tensor):
         # Trace-time telemetry: one count per compiled lowering (the
         # in-jit analogue of collective_calls_total; steps re-USE the
@@ -1047,7 +1146,7 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
             return _allreduce_tree(tensor, *args)
     pk = (op, _ps_key(ps), float(prescale_factor), float(postscale_factor),
           compression.__name__, int(fusion_threshold_bytes), algorithm,
-          overlap_chunks, bool(_reverse_issue))
+          overlap_chunks, bool(_reverse_issue), wire)
     if op == ReduceOp.Adasum:
         # Hierarchical mode changes the compiled program; key it.
         groups = _hierarchical_adasum_groups(ps)
@@ -1483,7 +1582,7 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
                                      (ReduceOp.Sum, ps, 1.0, 1.0,
                                       Compression.none,
                                       _fusion.DEFAULT_FUSION_THRESHOLD_BYTES,
-                                      "psum", 1, False),
+                                      "psum", 1, False, "fp32"),
                                      ("barrier", _ps_key(ps)),
                                      op_name="barrier"))
 
@@ -1572,7 +1671,7 @@ def _join_service_round() -> bool:
             "joined process cannot service this eager collective (no "
             "descriptor — only global-set allreduce is join-serviceable)")
     (kind, shapes, op, prescale, postscale, compression, fusion,
-     algorithm, chunks, reverse) = desc
+     algorithm, chunks, reverse, wire) = desc
     _check_join_avg_dtypes(op, shapes)
     # broadcast_to: O(1) host memory for the full (n, ...) stacked view —
     # place() only reads this process's rows anyway.
@@ -1591,14 +1690,14 @@ def _join_service_round() -> bool:
     # parked inside the device collective.
     ps = _resolve_ps(None)
     pk = (op, _ps_key(ps), prescale, postscale, compression.__name__,
-          fusion, algorithm, chunks, reverse)
+          fusion, algorithm, chunks, reverse, wire)
     if op == ReduceOp.Adasum:
         groups = _hierarchical_adasum_groups(ps)
         pk = pk + (None if groups is None
                    else tuple(tuple(g) for g in groups),)
     _eager_run(kind, tree,
                (op, ps, prescale, postscale, compression, fusion,
-                algorithm, chunks, reverse),
+                algorithm, chunks, reverse, wire),
                pk, _skip_negotiate=True)
     return False
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
-           "Compression"]
+           "Int8Compressor", "FP8Compressor", "Compression"]
 
 
 class Compressor:
@@ -73,6 +73,15 @@ class _QuantizedMarker(Compressor):
     re-quantize → all_gather). Sum/Average over the global set only.
     ``compress``/``decompress`` are identity so any accidental use outside
     allreduce degrades to uncompressed, never to wrong numbers.
+
+    The same wire formats are also spelled on the ``algorithm=`` axis
+    (``hvd.allreduce(algorithm="chunked_rs_ag_int8")`` /
+    ``HOROVOD_ALLREDUCE_WIRE``), where they ride the fused per-bucket
+    RS+AG decomposition with chunk pipelining, per-bucket auto
+    selection, and `DistributedOptimizer` error-feedback residuals —
+    prefer that spelling for training; the marker keeps upstream's
+    ``compression=`` API surface (docs/PERFORMANCE.md "Quantized wire
+    formats").
     """
 
     wire = None  # "int8" | "fp8"

@@ -45,13 +45,18 @@ class Config:
     # Fusion (fusion_buffer_manager.cc): HOROVOD_FUSION_THRESHOLD bytes.
     fusion_threshold_bytes: int = 64 * _MB
     # Gradient-sync algorithm axis (overlap.py):
-    # HOROVOD_ALLREDUCE_ALGORITHM in {auto, psum, rs_ag, chunked_rs_ag}
-    # picks the per-bucket allreduce lowering; HOROVOD_OVERLAP_CHUNKS is
-    # the pipeline depth of chunked_rs_ag; HOROVOD_XLA_LATENCY_HIDING=1
-    # wires the XLA latency-hiding-scheduler flags at init so async
-    # collectives overlap compute (TPU only; must be set before the
-    # backend initializes).
+    # HOROVOD_ALLREDUCE_ALGORITHM in {auto, psum, rs_ag, chunked_rs_ag,
+    # rs_ag_int8, chunked_rs_ag_int8, rs_ag_fp8, chunked_rs_ag_fp8}
+    # picks the per-bucket allreduce lowering; HOROVOD_ALLREDUCE_WIRE in
+    # {fp32, bf16, int8, fp8} sets the default wire precision (auto
+    # resolution upgrades its rs_ag picks to the quantized variants,
+    # bf16 casts the payload around the collective);
+    # HOROVOD_OVERLAP_CHUNKS is the pipeline depth of chunked_rs_ag;
+    # HOROVOD_XLA_LATENCY_HIDING=1 wires the XLA latency-hiding-scheduler
+    # flags at init so async collectives overlap compute (TPU only; must
+    # be set before the backend initializes).
     allreduce_algorithm: str = "auto"
+    allreduce_wire: str = "fp32"
     overlap_chunks: int = 4
     xla_latency_hiding: bool = False
     # Timeline (timeline.cc): HOROVOD_TIMELINE=<path> starts the Chrome
@@ -156,6 +161,17 @@ def _env_algorithm() -> str:
     return v
 
 
+def _env_wire() -> str:
+    from horovod_tpu.overlap import WIRES
+    v = os.environ.get("HOROVOD_ALLREDUCE_WIRE", "").strip().lower()
+    if v in ("", "none", "off"):
+        return "fp32"
+    if v not in WIRES:
+        raise ValueError(
+            f"HOROVOD_ALLREDUCE_WIRE={v!r}: expected one of {WIRES}")
+    return v
+
+
 def _env_chunks() -> int:
     v = os.environ.get("HOROVOD_OVERLAP_CHUNKS")
     if not v:
@@ -202,6 +218,7 @@ def refresh() -> Config:
         fusion_threshold_bytes=_env_bytes("HOROVOD_FUSION_THRESHOLD",
                                           64 * _MB),
         allreduce_algorithm=_env_algorithm(),
+        allreduce_wire=_env_wire(),
         overlap_chunks=_env_chunks(),
         xla_latency_hiding=_env_bool("HOROVOD_XLA_LATENCY_HIDING"),
         timeline_path=os.environ.get("HOROVOD_TIMELINE") or None,

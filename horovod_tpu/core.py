@@ -194,10 +194,14 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         # algorithm labels are zeroed so a re-init with a different knob
         # (bench --sweep-comm) leaves exactly one label at 1.
         from horovod_tpu.overlap import ALGORITHMS as _algs
+        from horovod_tpu.overlap import WIRES as _wires
         for _a in _algs:
             _metrics.gauge("config_allreduce_algorithm",
                            algorithm=_a).set(
                 1 if _a == cfg.allreduce_algorithm else 0)
+        for _w in _wires:
+            _metrics.gauge("config_allreduce_wire", wire=_w).set(
+                1 if _w == cfg.allreduce_wire else 0)
         _metrics.gauge("config_overlap_chunks").set(cfg.overlap_chunks)
         _metrics.gauge("config_xla_latency_hiding").set(
             1 if lhs_applied else 0)
@@ -316,6 +320,7 @@ def build_info() -> dict:
         # no TPU mechanism.
         "fusion_threshold_bytes": cfg.fusion_threshold_bytes,
         "allreduce_algorithm": cfg.allreduce_algorithm,
+        "allreduce_wire": cfg.allreduce_wire,
         "overlap_chunks": cfg.overlap_chunks,
         "xla_latency_hiding": cfg.xla_latency_hiding,
         "autotune": cfg.autotune,

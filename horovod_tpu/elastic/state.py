@@ -141,11 +141,18 @@ class JaxState(State):
 
     def sync(self) -> None:
         """After re-init: broadcast committed state from the coordinator so
-        joiners agree (multi-process), then restore locally."""
+        joiners agree (multi-process), then restore locally. Quantized-wire
+        error-feedback residuals restart at zero — they are per-rank local
+        error from the previous communicator epoch, and the coordinator's
+        copy would re-inject rank 0's error on every joiner."""
         from horovod_tpu import collective as C
         if jax.process_count() > 1:
             self._saved_pytrees = C.broadcast_object(self._saved_pytrees, 0)
             self._saved_attrs = _sync_attrs(self._saved_attrs, self._warn)
+        from horovod_tpu.optimizer import reset_error_feedback
+        self._saved_pytrees = {
+            k: reset_error_feedback(v)
+            for k, v in self._saved_pytrees.items()}
         self.restore()
 
     def save(self, path: str) -> None:

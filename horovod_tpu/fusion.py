@@ -100,7 +100,8 @@ def _split_oversize(leaves, threshold_bytes: int):
 
 
 def fuse(leaves: Sequence[Any],
-         threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+         threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
+         pad_elems: int = 1
          ) -> Tuple[List[jnp.ndarray], Callable[[List[jnp.ndarray]], List[Any]]]:
     """Pack ``leaves`` into fusion buckets.
 
@@ -108,6 +109,13 @@ def fuse(leaves: Sequence[Any],
     (one per dtype-bucket, each at most ``threshold_bytes`` — oversize
     leaves are split across several) and ``unpack`` restores the original
     list of leaves from same-shaped buckets.
+
+    ``pad_elems > 1`` zero-pads every packed segment to a multiple of
+    that many *elements* inside its bucket (``unpack`` slices the real
+    spans back out). The quantized-wire allreduce passes the quantization
+    block size here so per-block scales never straddle two leaves — a
+    large-magnitude layer sharing a bucket with a small-magnitude one
+    cannot flush the latter to zero through a shared scale.
     """
     leaves = [jnp.asarray(x) for x in leaves]
     # Stable greedy packing, grouped by dtype (a fused buffer must be
@@ -116,6 +124,11 @@ def fuse(leaves: Sequence[Any],
     # (cpp/hvdtpu_core.cpp:hvd_fusion_plan), Python fallback otherwise.
     segments, split_leaves = _split_oversize(leaves, threshold_bytes)
     itemsize = [jnp.dtype(l.dtype).itemsize for l in leaves]
+    pad_elems = max(1, int(pad_elems))
+
+    def _padded_len(s: int) -> int:
+        n = segments[s][2]
+        return -(-n // pad_elems) * pad_elems
 
     by_dtype: dict = {}                 # dtype -> segment indices (stable)
     for s, (i, _, _) in enumerate(segments):
@@ -124,7 +137,7 @@ def fuse(leaves: Sequence[Any],
     plan: List[List[int]] = []          # bucket -> segment indices
     causes: List[str] = []              # why each bucket was closed
     for segs in by_dtype.values():
-        sizes = [segments[s][2] * itemsize[segments[s][0]] for s in segs]
+        sizes = [_padded_len(s) * itemsize[segments[s][0]] for s in segs]
         assignment = _plan_buckets(sizes, threshold_bytes)
         groups: dict = {}
         for s, b in zip(segs, assignment):
@@ -172,9 +185,13 @@ def fuse(leaves: Sequence[Any],
     def _segment_slice(s: int) -> jnp.ndarray:
         i, start, n = segments[s]
         flat = leaves[i].ravel()
-        if start == 0 and n == flat.shape[0]:
-            return flat
-        return lax.slice(flat, (start,), (start + n,))
+        if not (start == 0 and n == flat.shape[0]):
+            flat = lax.slice(flat, (start,), (start + n,))
+        padded = _padded_len(s)
+        if padded != n:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((padded - n,), flat.dtype)])
+        return flat
 
     buckets = [
         _segment_slice(segs[0]) if len(segs) == 1
@@ -192,9 +209,10 @@ def fuse(leaves: Sequence[Any],
                 i, start, n = segments[s]
                 # Static slice: offsets are python ints, so XLA
                 # constant-folds the split (no dynamic-slice ops).
+                # Padded tail elements (pad_elems alignment) are skipped.
                 piece = lax.slice(buf, (off,), (off + n,))
                 pieces.setdefault(i, []).append((start, piece))
-                off += n
+                off += _padded_len(s)
         out: List[Any] = [None] * len(leaves)
         for i, parts in pieces.items():
             parts.sort(key=lambda p: p[0])
@@ -212,7 +230,8 @@ def unfuse(buckets, unpack):
 
 def fused_apply(fn: Callable[[jnp.ndarray], jnp.ndarray], tree: Any,
                 threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
-                reverse: bool = False, pin_order: bool = False) -> Any:
+                reverse: bool = False, pin_order: bool = False,
+                pad_elems: int = 1) -> Any:
     """Apply a 1-D-buffer collective ``fn`` to every leaf of ``tree`` through
     fusion buckets, preserving structure.
 
@@ -223,12 +242,13 @@ def fused_apply(fn: Callable[[jnp.ndarray], jnp.ndarray], tree: Any,
     collectives through ``lax.optimization_barrier`` so the issue order
     survives scheduling — each collective still depends only on its own
     bucket's data plus the previous collective's completion, leaving XLA
-    free to overlap it with unrelated compute.
+    free to overlap it with unrelated compute. ``pad_elems`` forwards to
+    :func:`fuse` (quantization-block alignment of leaves in buckets).
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
-    buckets, unpack = fuse(leaves, threshold_bytes)
+    buckets, unpack = fuse(leaves, threshold_bytes, pad_elems=pad_elems)
     order = range(len(buckets) - 1, -1, -1) if reverse \
         else range(len(buckets))
     results: List[Any] = [None] * len(buckets)

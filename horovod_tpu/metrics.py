@@ -335,6 +335,18 @@ _HELP: Dict[str, str] = {
     "program_hfu": "Hardware-FLOPs utilization (counts remat recompute).",
     "hbm_bandwidth_utilization": "Bytes-accessed rate over device HBM BW.",
     "program_step_seconds": "Observed (synced) step time per program.",
+    "allreduce_algorithm_total":
+        "Per-bucket allreduce lowerings by resolved algorithm "
+        "(trace-time: one count per compiled bucket).",
+    "allreduce_wire_bytes_total":
+        "Bytes a compiled allreduce bucket puts on the wire per ring "
+        "traversal, by algorithm and wire format (quantized wires count "
+        "1-byte payload + fp32 block scales).",
+    "allreduce_compression_ratio":
+        "Bucket logical bytes over wire bytes for the last compiled "
+        "bucket of each wire format (~3.9 for int8/fp8 vs fp32).",
+    "config_allreduce_wire":
+        "Resolved HOROVOD_ALLREDUCE_WIRE (one-hot over wire labels).",
     "memory_pressure_total": "Device HBM high-water crossings.",
     "serve_requests_total": "Serving requests by terminal status.",
     "serve_ttft_seconds": "Serving time-to-first-token.",

@@ -51,6 +51,27 @@ _F8 = jnp.float8_e4m3fn
 _F8_MAX = 448.0
 
 
+#: bytes of the fp32 scale shipped per quantization block
+SCALE_BYTES = 4
+
+
+def wire_overhead_bytes(nelems: int, block: int = BLOCK) -> int:
+    """Scale-tensor bytes riding alongside a quantized payload of
+    ``nelems`` 1-byte values (one fp32 scale per started block)."""
+    return SCALE_BYTES * (-(-nelems // block))
+
+
+def _pad_tail(x: jnp.ndarray, block: int):
+    """Zero-pad the last axis up to a block multiple. Zero padding is
+    scale-neutral: it can never raise a tail block's max-abs, so real
+    elements quantize exactly as they would in a full block."""
+    L = x.shape[-1]
+    pad = (-L) % block
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x, L
+
+
 def _blockify(x: jnp.ndarray, block: int = BLOCK):
     shape = x.shape
     return x.reshape(shape[:-1] + (shape[-1] // block, block)), shape
@@ -58,13 +79,21 @@ def _blockify(x: jnp.ndarray, block: int = BLOCK):
 
 def quantize_blocks(x: jnp.ndarray, wire: str = "int8",
                     block: int = BLOCK):
-    """(..., L) with L % block == 0 -> (1-byte (..., L), scales
-    (..., L/block)) using symmetric per-block max-abs scales.
+    """(..., L) -> (1-byte (..., L), scales (..., ceil(L/block))) using
+    symmetric per-block max-abs scales.
+
+    ``L`` need not be a block multiple: a ragged tail is zero-padded
+    internally (padding never perturbs a scale) and sliced back, so the
+    payload keeps the input's shape while the scale tensor covers every
+    *started* block. Inputs are quantized in fp32 (bf16 in, fp32 scales
+    out — the wire carries 1-byte payload + fp32 scales either way).
 
     ``block`` defaults to the wire-format granularity the quantized
     allreduce ships (one fp32 scale per 256 values); other consumers pick
     their own natural block — the paged KV cache (``serving/cache.py``)
     quantizes per (token, head) vector, i.e. ``block=head_dim``."""
+    x = x.astype(jnp.float32)
+    x, L = _pad_tail(x, block)
     blocks, shape = _blockify(x, block)
     absmax = jnp.max(jnp.abs(blocks), axis=-1)
     if wire == "int8":
@@ -89,16 +118,23 @@ def quantize_blocks(x: jnp.ndarray, wire: str = "int8",
     else:
         raise ValueError(f"unknown wire format {wire!r}; expected one of "
                          f"{WIRE_FORMATS}")
-    return q.reshape(shape), scale
+    q = q.reshape(shape)
+    if shape[-1] != L:
+        q = lax.slice_in_dim(q, 0, L, axis=-1)
+    return q, scale
 
 
 def dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray,
                       block: int = BLOCK) -> jnp.ndarray:
-    """Inverse of :func:`quantize_blocks` (fp32 out)."""
+    """Inverse of :func:`quantize_blocks` (fp32 out); accepts the same
+    ragged tails (``scale`` covers every started block)."""
+    q, L = _pad_tail(q.astype(jnp.float32), block)
     shape = q.shape
-    blocks = q.astype(jnp.float32).reshape(
-        shape[:-1] + (shape[-1] // block, block))
-    return (blocks * scale[..., None]).reshape(shape)
+    blocks = q.reshape(shape[:-1] + (shape[-1] // block, block))
+    out = (blocks * scale[..., None]).reshape(shape)
+    if shape[-1] != L:
+        out = lax.slice_in_dim(out, 0, L, axis=-1)
+    return out
 
 
 # The allreduce below predates the public names; keep its call sites.

@@ -49,15 +49,54 @@ from jax import lax
 from horovod_tpu import metrics as _metrics
 
 __all__ = [
-    "ALGORITHMS", "resolve_algorithm", "rs_ag_psum", "chunked_rs_ag_psum",
+    "ALGORITHMS", "WIRES", "resolve_algorithm", "parse_algorithm",
+    "compose_algorithm", "wire_bytes", "rs_ag_psum", "chunked_rs_ag_psum",
     "make_grad_sync_tap", "tap_params", "enable_latency_hiding",
     "RS_AG_MIN_BYTES", "CHUNKED_MIN_BYTES",
 ]
 
 log = logging.getLogger("horovod_tpu")
 
-#: the ``algorithm=`` axis of ``hvd.allreduce``
-ALGORITHMS = ("auto", "psum", "rs_ag", "chunked_rs_ag")
+#: the ``algorithm=`` axis of ``hvd.allreduce``. The ``_int8``/``_fp8``
+#: variants run the same RS+AG decomposition with an EQuARX-style 1-byte
+#: wire: each chunk is block-quantized before its reduce-scatter leg,
+#: reduced exactly in fp32 at the owning shard, re-quantized for the
+#: all-gather leg, with per-block fp32 scales riding alongside — the wire
+#: carries quantized bytes end to end (see ``ops/quantized.py``).
+ALGORITHMS = ("auto", "psum", "rs_ag", "chunked_rs_ag",
+              "rs_ag_int8", "chunked_rs_ag_int8",
+              "rs_ag_fp8", "chunked_rs_ag_fp8")
+
+#: the ``HOROVOD_ALLREDUCE_WIRE`` axis (config.py): the default payload
+#: precision on the allreduce wire. ``fp32`` = whatever the bucket dtype
+#: is (no recoding), ``bf16`` = cast for the collective and back, ``int8``
+#: / ``fp8`` = block-scaled quantization inside the RS+AG decomposition
+#: (``auto`` algorithm resolution upgrades rs_ag picks to the quantized
+#: variant; explicit ``psum`` stays exact).
+WIRES = ("fp32", "bf16", "int8", "fp8")
+
+#: wire formats that restructure the reduction (quantized payloads)
+QUANT_WIRES = ("int8", "fp8")
+
+
+def parse_algorithm(algorithm: str):
+    """Split an algorithm name into ``(base, wire)`` — e.g.
+    ``"chunked_rs_ag_int8" -> ("chunked_rs_ag", "int8")``;
+    unquantized names return ``(name, None)``."""
+    for w in QUANT_WIRES:
+        if algorithm.endswith("_" + w):
+            return algorithm[: -len(w) - 1], w
+    return algorithm, None
+
+
+def compose_algorithm(base: str, wire) -> str:
+    """Attach a wire format to a base algorithm name. ``fp32``/``bf16``/
+    ``None`` leave the base unchanged (bf16 is a cast around the
+    collective, not a restructured reduction); ``psum`` has no RS+AG
+    shape to quantize inside and stays exact."""
+    if wire not in QUANT_WIRES or base == "psum":
+        return base
+    return f"{base}_{wire}"
 
 # auto-selection size cutoffs, per fusion bucket. Below RS_AG_MIN the
 # single psum's one-collective latency wins; above it the ring
@@ -74,7 +113,7 @@ DEFAULT_CHUNKS = 4
 
 
 def resolve_algorithm(requested: str, nbytes: int, op: int, world: int,
-                      reducible: bool) -> str:
+                      reducible: bool, wire: Optional[str] = None) -> str:
     """Resolve the per-bucket algorithm.
 
     ``requested`` is the user/config choice (one of :data:`ALGORITHMS`);
@@ -83,6 +122,13 @@ def resolve_algorithm(requested: str, nbytes: int, op: int, world: int,
     Adasum pass through to their existing lowerings — requesting
     ``rs_ag`` for an Adasum allreduce is a no-op by design, so one
     training script can set a global algorithm without branching on op).
+
+    ``wire`` is the default wire precision (``HOROVOD_ALLREDUCE_WIRE``):
+    when ``"int8"``/``"fp8"``, ``auto`` resolution upgrades its rs_ag
+    picks to the quantized variants — the size cutoffs are unchanged, so
+    small buckets keep the exact one-op psum and only bandwidth-bound
+    buckets pay the quantize/dequantize math. An explicit ``requested``
+    algorithm always wins over the wire default.
     """
     if requested not in ALGORITHMS:
         raise ValueError(
@@ -93,9 +139,9 @@ def resolve_algorithm(requested: str, nbytes: int, op: int, world: int,
     if requested != "auto":
         return requested
     if nbytes >= CHUNKED_MIN_BYTES:
-        return "chunked_rs_ag"
+        return compose_algorithm("chunked_rs_ag", wire)
     if nbytes >= RS_AG_MIN_BYTES:
-        return "rs_ag"
+        return compose_algorithm("rs_ag", wire)
     return "psum"
 
 
@@ -112,6 +158,21 @@ def _split_sizes(m: int, n: int, chunks: int) -> Tuple[int, int]:
     return per, chunks
 
 
+def wire_bytes(nelems: int, wire: str, elem_bytes: int = 4) -> int:
+    """Bytes a bucket of ``nelems`` elements puts on the wire per ring
+    traversal under ``wire`` (one of :data:`WIRES`, or a dtype-ish label
+    like ``"fp16"``). Quantized wires count the 1-byte payload plus the
+    fp32 per-block scales that ride alongside; the constant ring factor
+    2(n-1)/n is identical across formats and deliberately excluded, so
+    ratios between formats are exact."""
+    from horovod_tpu.ops.quantized import wire_overhead_bytes
+    if wire in QUANT_WIRES:
+        return nelems + wire_overhead_bytes(nelems)
+    if wire == "bf16" or wire == "fp16":
+        return 2 * nelems
+    return elem_bytes * nelems
+
+
 def rs_ag_psum(x: jnp.ndarray, axis: str, world: int) -> jnp.ndarray:
     """Bandwidth-optimal sum-allreduce of a 1-D buffer: reduce-scatter
     then all-gather over ``axis`` (2(n-1)/n bytes per device on a ring
@@ -121,7 +182,9 @@ def rs_ag_psum(x: jnp.ndarray, axis: str, world: int) -> jnp.ndarray:
 
 
 def chunked_rs_ag_psum(x: jnp.ndarray, axis: str, world: int,
-                       chunks: int = DEFAULT_CHUNKS) -> jnp.ndarray:
+                       chunks: int = DEFAULT_CHUNKS,
+                       wire: Optional[str] = None,
+                       mean_k: Optional[float] = None) -> jnp.ndarray:
     """Sum-allreduce a 1-D buffer as ``chunks`` pipelined RS+AG pairs.
 
     The chunk reduce-scatters are chained with
@@ -132,10 +195,26 @@ def chunked_rs_ag_psum(x: jnp.ndarray, axis: str, world: int,
     Numerically this is the same per-element sum of ``world``
     contributions as one psum (each element is reduced exactly once, by
     one scatter shard).
+
+    ``wire="int8"``/``"fp8"`` runs the same pipeline with an EQuARX-style
+    quantized wire (``ops/quantized.py`` block scaling): each chunk is
+    quantized per destination shard (fresh per-block scales), exchanged
+    with ``all_to_all`` (the reduce-scatter leg — 1-byte payload + fp32
+    scales on the wire), dequantized and reduced **exactly in fp32** at
+    the owning shard, then re-quantized for the ``all_gather`` leg. The
+    input must be fp32 on this path (callers cast); ``mean_k`` divides
+    the reduced partial *before* re-quantization (Average in a subset of
+    ``k`` members) so the second quantization grid matches the returned
+    magnitudes.
     """
     if x.ndim != 1:
         raise ValueError(f"rs+ag operates on 1-D fusion buffers, got "
                          f"shape {x.shape}")
+    if mean_k is not None and wire is None:
+        raise ValueError("mean_k applies to the quantized wire path only")
+    if wire is not None:
+        return _chunked_rs_ag_quantized(x, axis, world, chunks, wire,
+                                        mean_k)
     m = x.shape[0]
     if m == 0 or world <= 1:
         return x
@@ -172,6 +251,71 @@ def chunked_rs_ag_psum(x: jnp.ndarray, axis: str, world: int,
         scattered.append(s)
         prev = s
     gathered = [lax.all_gather(s, axis, tiled=True) for s in scattered]
+    out = gathered[0] if chunks == 1 else jnp.concatenate(gathered)
+    return out if total == m else lax.slice(out, (0,), (m,))
+
+
+def _chunked_rs_ag_quantized(x: jnp.ndarray, axis: str, world: int,
+                             chunks: int, wire: str,
+                             mean_k: Optional[float]) -> jnp.ndarray:
+    """Quantized-wire body of :func:`chunked_rs_ag_psum` (two-phase
+    exchange per pipelined chunk)."""
+    from horovod_tpu.ops.quantized import (BLOCK, WIRE_FORMATS,
+                                           dequantize_blocks,
+                                           quantize_blocks)
+    if wire not in WIRE_FORMATS:
+        raise ValueError(f"unknown quantized wire {wire!r}; expected one "
+                         f"of {WIRE_FORMATS}")
+    if x.dtype != jnp.float32:
+        raise ValueError("quantized rs+ag reduces in fp32; cast the "
+                         f"buffer first (got {x.dtype})")
+    m = x.shape[0]
+    if m == 0 or world <= 1:
+        if mean_k is not None and world <= 1 and m:
+            return x / jnp.float32(mean_k)
+        return x
+    # Chunk geometry: every chunk splits into one BLOCK-aligned row per
+    # destination shard, so per must be a multiple of world * BLOCK.
+    per, chunks = _split_sizes(m, world * BLOCK, chunks)
+    total = per * chunks
+    if total != m:
+        x = jnp.concatenate([x, jnp.zeros((total - m,), x.dtype)])
+    c = per // world                      # owned sub-chunk per device
+    wbytes = wire_bytes(per, wire)
+    for i in range(chunks):
+        _metrics.histogram("allreduce_chunk_bytes",
+                           buckets=_metrics.SIZE_BUCKETS).observe(wbytes)
+    try:
+        from horovod_tpu import profiler as _profiler
+        _profiler.count_trace(f"overlap:chunked_rs_ag_{wire}",
+                              chunks=chunks, chunk_wire_bytes=wbytes,
+                              buffer_bytes=m * 4)
+    except Exception:
+        pass
+    scattered = []
+    prev = None
+    for i in range(chunks):
+        piece = lax.slice(x, (i * per,), ((i + 1) * per,))
+        if prev is not None:
+            # Same issue-order pinning as the exact pipeline: chunk i's
+            # reduced partial gates chunk i+1's quantization, so XLA can
+            # overlap chunk i's all-gather with chunk i+1's exchange.
+            piece, prev = lax.optimization_barrier((piece, prev))
+        rows = piece.reshape(world, c)    # row j -> destination shard j
+        q, scale = quantize_blocks(rows, wire)
+        q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+        s_recv = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0)
+        part = jnp.sum(dequantize_blocks(q_recv, s_recv), axis=0)  # (c,)
+        if mean_k is not None:
+            part = part / jnp.float32(mean_k)
+        scattered.append(part)
+        prev = part
+    gathered = []
+    for part in scattered:
+        q2, s2 = quantize_blocks(part, wire)
+        qg = lax.all_gather(q2, axis)                    # (world, c)
+        sg = lax.all_gather(s2, axis)
+        gathered.append(dequantize_blocks(qg, sg).reshape(world * c))
     out = gathered[0] if chunks == 1 else jnp.concatenate(gathered)
     return out if total == m else lax.slice(out, (0,), (m,))
 

@@ -1135,6 +1135,66 @@ def _check_overlap(snap, report=None) -> List[Dict]:
         overlap_efficiency=eff)]
 
 
+#: cumulative (trace-time, per compiled bucket) unquantized allreduce
+#: wire bytes above which the doctor suggests a quantized wire. One
+#: compiled pass over a >=32MB gradient set is real bandwidth exposure;
+#: tiny test meshes never get near it.
+WIRE_SUGGEST_MIN_BYTES = 32 * 1024 * 1024
+
+
+def _check_wire(snap) -> List[Dict]:
+    """Wire-compression accounting for the allreduce path: report the
+    achieved compression when a quantized wire is active, and suggest
+    enabling one when heavy uncompressed traffic rides the wire."""
+    per: Dict[str, float] = {}
+    for s in _series(snap, "counters", "allreduce_wire_bytes_total"):
+        w = s.get("labels", {}).get("wire", "?")
+        per[w] = per.get(w, 0.0) + float(s.get("value", 0))
+    if not per:
+        return []
+    quant = {w: v for w, v in per.items() if w in ("int8", "fp8") and v}
+    plain = sum(v for w, v in per.items() if w not in ("int8", "fp8"))
+    if quant:
+        parts, ratios = [], []
+        for w, v in sorted(quant.items()):
+            r = _gauge_value(snap, "allreduce_compression_ratio", wire=w)
+            ratios.append(r or 0.0)
+            parts.append(f"{w}: {v / 1e6:.1f}MB on the wire"
+                         + (f" ({r:.1f}x vs the bucket dtype)" if r
+                            else ""))
+        # Informational: achieved compression, ranked below real defects.
+        return [_finding(
+            "wire_compression", 0.05,
+            f"quantized allreduce wire active "
+            f"({max(ratios):.1f}x compression)",
+            "allreduce buckets are riding the block-scaled 1-byte wire — "
+            + "; ".join(parts)
+            + (f"; {plain / 1e6:.1f}MB still uncompressed (small buckets "
+               "resolve to exact psum under auto)" if plain else ""),
+            "nothing to fix: pair with DistributedOptimizer("
+            "error_feedback=True) for training, and watch the MNIST-"
+            "parity-style convergence guardrail if you tighten formats.",
+            wire_bytes_by_format={k: int(v) for k, v in per.items()})]
+    if plain >= WIRE_SUGGEST_MIN_BYTES:
+        return [_finding(
+            "wire_uncompressed", 0.3,
+            f"allreduce wire is uncompressed "
+            f"({plain / 1e6:.0f}MB of fp32/bf16 payload per compiled "
+            "pass)",
+            "gradient synchronization is putting full-precision buckets "
+            "on the interconnect; if steps are bandwidth-bound "
+            "(overlap_efficiency low, busbw near the link ceiling) a "
+            "block-quantized wire cuts those bytes ~4x for ~1.6% scale "
+            "overhead",
+            "set HOROVOD_ALLREDUCE_WIRE=int8 (or algorithm="
+            "'chunked_rs_ag_int8') with DistributedOptimizer("
+            "error_feedback=True); fp8 keeps relative precision inside "
+            "outlier blocks. See docs/PERFORMANCE.md 'Quantized wire "
+            "formats'.",
+            plain_wire_bytes=int(plain))]
+    return []
+
+
 def _check_serving(snap) -> List[Dict]:
     out = []
     submitted = _sum_counter(snap, "serve_requests_total",
@@ -1212,6 +1272,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_mfu(progs, snap)
     findings += _check_overlap(snap, report)
     findings += _check_fusion(snap)
+    findings += _check_wire(snap)
     findings.sort(key=lambda f: (-f["severity"], f["category"], f["title"]))
     for i, f in enumerate(findings):
         f["rank"] = i + 1

@@ -183,6 +183,58 @@ class TestBayesianAutotuner:
             tuner.record(t)
         assert tuner.current_compression() == "fp16"
 
+    def test_tunes_wire_precision_axis(self):
+        """The per-bucket wire-precision GP axis (PR 6): a bandwidth-bound
+        objective where the quantized wires cut step time proportionally
+        to their wire bytes must converge onto a 1-byte format."""
+        from horovod_tpu.autotune import BayesianAutotuner
+        tuner = BayesianAutotuner(probes=10, samples_per_probe=1,
+                                  tune_wire=True)
+        speed = {"fp32": 1.0, "bf16": 0.75, "int8": 0.55, "fp8": 0.55}
+        while not tuner.converged:
+            assert tuner.current_wire() in tuner.WIRE_CHOICES
+            t = self._quadratic(tuner.current_threshold())
+            tuner.record(t * speed[tuner.current_wire()])
+        assert tuner.current_wire() in ("int8", "fp8")
+        assert "wire=" in tuner.summary()
+
+    def test_wire_axis_off_reports_config_wire(self, clean_env):
+        import horovod_tpu.config as hconfig
+        from horovod_tpu.autotune import BayesianAutotuner
+        clean_env.setenv("HOROVOD_ALLREDUCE_WIRE", "fp8")
+        hconfig.refresh()
+        try:
+            tuner = BayesianAutotuner(probes=3, samples_per_probe=1)
+            assert tuner.current_wire() == "fp8"
+        finally:
+            clean_env.delenv("HOROVOD_ALLREDUCE_WIRE")
+            hconfig.refresh()
+
+    def test_wire_axis_sync_protocol(self):
+        """5-tuple points (threshold, comp, alg, chunks, wire) must ride
+        the same rank-0 broadcast handshake; legacy 4-tuples from an old
+        coordinator keep the local wire coordinate."""
+        from horovod_tpu.autotune import BayesianAutotuner
+        r0 = BayesianAutotuner(probes=6, samples_per_probe=1,
+                               tune_algorithm=True, tune_wire=True)
+        r1 = BayesianAutotuner(probes=6, samples_per_probe=1,
+                               tune_algorithm=True, tune_wire=True)
+        while not r0.converged:
+            for t in (r0, r1):
+                if t.pending_sync:
+                    t.set_current_point(r0.current_point())
+            assert r0.current_point() == r1.current_point()
+            assert len(r0.current_point()) == 5
+            t = self._quadratic(r0.current_threshold())
+            r0.record(t)
+            r1.record(t)
+        # legacy 4-tuple: wire coordinate is preserved locally
+        fresh = BayesianAutotuner(probes=6, samples_per_probe=1,
+                                  tune_wire=True)
+        wire_before = fresh.current_point()[4]
+        fresh.set_current_point((0.5, 0, 0, 0))
+        assert fresh.current_point() == (0.5, 0, 0, 0, wire_before)
+
     def test_mode_env_selects_bayes(self, clean_env):
         torch = pytest.importorskip("torch")
         import horovod_tpu.config as hconfig

@@ -259,6 +259,56 @@ class TestGoldenReport:
         assert not [f for f in report["findings"]
                     if f["category"] == "low_overlap"]
 
+    def test_uncompressed_wire_suggests_quantization(self):
+        snap = {
+            "counters": {"allreduce_wire_bytes_total": [
+                _ctr("allreduce_wire_bytes_total", 48 * 1024 * 1024,
+                     algorithm="chunked_rs_ag", wire="fp32"),
+            ]},
+            "gauges": {}, "histograms": {}, "pending_collectives": [],
+        }
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        wire = [f for f in rep["findings"]
+                if f["category"] == "wire_uncompressed"]
+        assert len(wire) == 1
+        assert "HOROVOD_ALLREDUCE_WIRE=int8" in wire[0]["suggestion"]
+        assert "error_feedback" in wire[0]["suggestion"]
+
+    def test_quantized_wire_reports_achieved_compression(self):
+        snap = {
+            "counters": {"allreduce_wire_bytes_total": [
+                _ctr("allreduce_wire_bytes_total", 13 * 1024 * 1024,
+                     algorithm="chunked_rs_ag_int8", wire="int8"),
+                _ctr("allreduce_wire_bytes_total", 1 * 1024 * 1024,
+                     algorithm="psum", wire="fp32"),
+            ]},
+            "gauges": {"allreduce_compression_ratio": [
+                {"labels": {"wire": "int8"}, "value": 3.94},
+            ]},
+            "histograms": {}, "pending_collectives": [],
+        }
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        wire = [f for f in rep["findings"]
+                if f["category"] == "wire_compression"]
+        assert len(wire) == 1
+        assert "3.9x" in wire[0]["title"]
+        assert rep["healthy"]           # informational, not a defect
+        # no double finding: the uncompressed suggestion must not fire
+        assert not [f for f in rep["findings"]
+                    if f["category"] == "wire_uncompressed"]
+
+    def test_small_uncompressed_traffic_is_quiet(self):
+        snap = {
+            "counters": {"allreduce_wire_bytes_total": [
+                _ctr("allreduce_wire_bytes_total", 1024,
+                     algorithm="psum", wire="fp32"),
+            ]},
+            "gauges": {}, "histograms": {}, "pending_collectives": [],
+        }
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        assert not [f for f in rep["findings"]
+                    if f["category"].startswith("wire")]
+
     def test_format_report_renders_every_finding(self):
         report = doctor(snapshot=_fixture_snapshot(),
                         trace=_fixture_trace_report(),

@@ -46,6 +46,26 @@ class TestState:
         s.extra = 42
         assert s.extra == 42
 
+    def test_sync_zeroes_error_feedback_residuals(self):
+        """Elastic re-init must restart quantized-wire error-feedback
+        residuals at zero: they are per-rank local error from the OLD
+        communicator epoch (PR 6)."""
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       algorithm="chunked_rs_ag_int8")
+        params = {"w": jnp.ones((7,))}
+        opt_state = opt.init(params)
+        assert isinstance(opt_state, hvd.ErrorFeedbackState)
+        opt_state = hvd.ErrorFeedbackState(
+            opt_state.inner, {"w": jnp.full((7,), 0.25)})
+        s = JaxState(params=params, opt_state=opt_state, epoch=3)
+        s.commit()
+        s.sync()
+        np.testing.assert_array_equal(
+            np.asarray(s.opt_state.residual["w"]), 0.0)
+        # inner optimizer state and everything else survive untouched
+        assert s.epoch == 3
+        np.testing.assert_array_equal(np.asarray(s.params["w"]), 1.0)
+
 
 class TestFrameworkStates:
     def test_torch_state_commit_restore_sync(self):

@@ -112,6 +112,194 @@ class TestAlgorithmParity:
                                    rtol=2e-6, atol=1e-5)
 
 
+QALGS = ("rs_ag_int8", "chunked_rs_ag_int8", "rs_ag_fp8",
+         "chunked_rs_ag_fp8")
+
+
+def _qtol(alg, x, k):
+    """Absolute error bound vs the exact psum for a quantized wire:
+    two quantization points (per-contribution + re-quantized partial),
+    each within half a step of the block max-abs."""
+    steps = 127 if "int8" in alg else 8
+    return 3.0 * k * float(np.abs(np.asarray(x, np.float32)).max()) / steps
+
+
+class TestQuantizedAlgorithmParity:
+    """The acceptance parity matrix: quantized algorithms agree with
+    ``psum`` within per-format error bounds across Sum/Average x
+    fp32/bf16 x process-set subsets x traced/eager."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+    @pytest.mark.parametrize("alg", QALGS)
+    def test_matrix_eager(self, rng, dtype, op, alg):
+        n = hvd.size()
+        x = jnp.asarray(rng.standard_normal((n, 777)), dtype)
+        base = np.asarray(hvd.allreduce(x, op=op, algorithm="psum")
+                          ).astype(np.float64)
+        got_j = hvd.allreduce(x, op=op, algorithm=alg, overlap_chunks=3)
+        assert got_j.dtype == x.dtype       # wire is internal; dtype kept
+        got = np.asarray(got_j).astype(np.float64)
+        k = n if op == hvd.Sum else 1
+        # bf16 inputs carry their own rounding on the exact path too.
+        bound = _qtol(alg, x, k) + (0.1 * k if dtype == jnp.bfloat16
+                                    else 0.0)
+        assert np.abs(got - base).max() < bound, \
+            f"{alg} vs psum, op={op} dtype={dtype}"
+
+    @pytest.mark.parametrize("alg", ["chunked_rs_ag_int8",
+                                     "chunked_rs_ag_fp8"])
+    @pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+    def test_subset_process_set(self, rng, alg, op):
+        n = hvd.size()
+        members = [1, 3, 6]
+        ps = hvd.add_process_set(members)
+        try:
+            x = rng.standard_normal((n, 515)).astype(np.float32)
+            got = np.asarray(hvd.allreduce(
+                jnp.asarray(x), op=op, process_set=ps, algorithm=alg,
+                overlap_chunks=2))
+            want = (x[members].sum(0) if op == hvd.Sum
+                    else x[members].mean(0))
+            k = len(members) if op == hvd.Sum else 1
+            for m in members:
+                assert np.abs(got[m] - want).max() < _qtol(alg, x, k)
+            # members agree exactly (same wire bytes dequantized)
+            for m in members[1:]:
+                np.testing.assert_array_equal(got[m], got[members[0]])
+            # non-members get their input back exactly
+            np.testing.assert_array_equal(got[0], x[0])
+        finally:
+            hvd.remove_process_set(ps)
+
+    @pytest.mark.parametrize("alg", QALGS)
+    def test_traced_lowering_matches(self, rng, alg):
+        n = hvd.size()
+        x = rng.standard_normal((n, 1029)).astype(np.float32)
+        fn = hvd.spmd(lambda v: hvd.allreduce(v, op=hvd.Average,
+                                              algorithm=alg,
+                                              overlap_chunks=4),
+                      in_specs=P("hvd"), out_specs=P("hvd"))
+        got = np.asarray(fn(jnp.asarray(x)))[0]
+        assert np.abs(got - x.mean(0)).max() < _qtol(alg, x, 1)
+
+    def test_non_decomposable_ops_pass_through_exact(self, rng):
+        n = hvd.size()
+        x = jnp.asarray(rng.standard_normal((n, 64)), jnp.float32)
+        for op in (hvd.Min, hvd.Max):
+            base = np.asarray(hvd.allreduce(x, op=op, algorithm="psum"))
+            got = np.asarray(hvd.allreduce(x, op=op,
+                                           algorithm="chunked_rs_ag_int8"))
+            np.testing.assert_array_equal(got, base)
+
+    def test_integer_leaves_stay_exact(self, rng):
+        n = hvd.size()
+        xi = jnp.asarray(rng.integers(-50, 50, (n, 37)), jnp.int32)
+        got = np.asarray(hvd.allreduce(xi, op=hvd.Sum,
+                                       algorithm="rs_ag_int8"))
+        np.testing.assert_array_equal(got[0], np.asarray(xi).sum(0))
+
+    def test_mixed_magnitude_leaves_survive(self, rng):
+        """BLOCK-aligned leaf packing: a 100.0-magnitude layer fused with
+        a 1e-3 layer must not flush the small one (per-leaf blocks)."""
+        n = hvd.size()
+        big = np.full((n, 4), 100.0, np.float32)
+        small = np.full((n, 1000), 1e-3, np.float32)
+        out_big, out_small = hvd.allreduce(
+            [big, small], op=hvd.Average, algorithm="chunked_rs_ag_int8")
+        np.testing.assert_allclose(np.asarray(out_big)[0], 100.0,
+                                   rtol=1e-2)
+        np.testing.assert_allclose(np.asarray(out_small)[0], 1e-3,
+                                   rtol=2e-2)
+
+    def test_prescale_postscale(self, rng):
+        n = hvd.size()
+        x = rng.standard_normal((n, 300)).astype(np.float32)
+        want = x.sum(0) * 0.5 * 3.0
+        got = np.asarray(hvd.allreduce(
+            jnp.asarray(x), op=hvd.Sum, prescale_factor=0.5,
+            postscale_factor=3.0, algorithm="rs_ag_int8"))[0]
+        assert np.abs(got - want).max() < 3.0 * _qtol("int8", x, n)
+
+
+class TestWireBytesMetrics:
+    def test_int8_at_least_3x_fewer_bytes_on_4mb_bucket(self, rng):
+        """Acceptance: allreduce_wire_bytes_total shows >= 3x fewer bytes
+        for the int8 wire vs fp32 on a >= 4MB bucket."""
+        hvd.reset_metrics()
+        n = hvd.size()
+        m = (4 * 1024 * 1024) // 4          # 1M fp32 elements = 4MB
+        x = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+        hvd.allreduce(x, op=hvd.Sum, algorithm="rs_ag")
+        hvd.allreduce(x, op=hvd.Sum, algorithm="rs_ag_int8")
+        snap = hvd.metrics()
+        by_wire = {}
+        for c in snap["counters"]["allreduce_wire_bytes_total"]:
+            w = c["labels"]["wire"]
+            by_wire[w] = by_wire.get(w, 0) + c["value"]
+        assert by_wire["fp32"] >= 4 * 1024 * 1024
+        assert by_wire["fp32"] >= 3.0 * by_wire["int8"], by_wire
+        ratios = {g["labels"]["wire"]: g["value"]
+                  for g in snap["gauges"]["allreduce_compression_ratio"]}
+        assert ratios["int8"] > 3.0
+        assert ratios["fp32"] == pytest.approx(1.0)
+
+    def test_int8_dtype_payload_not_labeled_as_quantized_wire(self, rng):
+        """An EXACT exchange of an int8-dtype tensor must label as
+        raw-int8: wire="int8" always means the quantized format (else
+        phantom scale bytes and a false doctor finding)."""
+        hvd.reset_metrics()
+        n = hvd.size()
+        x = jnp.asarray(rng.integers(-100, 100, (n, 512)), jnp.int8)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum, algorithm="psum"))
+        np.testing.assert_array_equal(
+            got[0], np.asarray(x).astype(np.int64).sum(0).astype(np.int8))
+        snap = hvd.metrics()
+        wires = {c["labels"]["wire"]: c["value"]
+                 for c in snap["counters"]["allreduce_wire_bytes_total"]}
+        assert "int8" not in wires
+        # per-device bucket: 512 elems x 1 B, no phantom scale bytes
+        assert wires["raw-int8"] == 512
+
+    def test_env_algorithm_auto_enables_error_feedback(self, monkeypatch):
+        """HOROVOD_ALLREDUCE_ALGORITHM=chunked_rs_ag_int8 with no
+        algorithm kwarg must still wrap the optimizer in error feedback
+        (review finding: the env spelling trained uncompensated)."""
+        import optax
+        from horovod_tpu import config as hconfig
+        monkeypatch.setenv("HOROVOD_ALLREDUCE_ALGORITHM",
+                           "chunked_rs_ag_int8")
+        hconfig.refresh()
+        try:
+            opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+            state = opt.init({"w": jnp.ones(4)})
+            assert isinstance(state, hvd.ErrorFeedbackState)
+        finally:
+            monkeypatch.delenv("HOROVOD_ALLREDUCE_ALGORITHM")
+            hconfig.refresh()
+        # and the exact default stays unwrapped
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+        assert not isinstance(opt.init({"w": jnp.ones(4)}),
+                              hvd.ErrorFeedbackState)
+
+    def test_bf16_wire_halves_bytes(self, rng):
+        hvd.reset_metrics()
+        n = hvd.size()
+        x = jnp.asarray(rng.standard_normal((n, 4096)), jnp.float32)
+        base = np.asarray(hvd.allreduce(x, op=hvd.Average,
+                                        algorithm="rs_ag"))
+        got = np.asarray(hvd.allreduce(x, op=hvd.Average,
+                                       algorithm="rs_ag", wire="bf16"))
+        assert got.dtype == np.float32      # cast back after the wire
+        np.testing.assert_allclose(got[0], base[0], rtol=2e-2, atol=2e-2)
+        snap = hvd.metrics()
+        by_wire = {}
+        for c in snap["counters"]["allreduce_wire_bytes_total"]:
+            by_wire[c["labels"]["wire"]] = \
+                by_wire.get(c["labels"]["wire"], 0) + c["value"]
+        assert by_wire["fp32"] == 2 * by_wire["bf16"]
+
+
 class TestAutoSelection:
     def test_size_cutoffs(self):
         r = overlap.resolve_algorithm
@@ -127,6 +315,50 @@ class TestAutoSelection:
         assert r("chunked_rs_ag", 1 << 30, hvd.Min, 8, False) == "psum"
         # a single device has nothing to scatter
         assert r("rs_ag", 1 << 30, hvd.Sum, 1, True) == "psum"
+        # quantized requests pass through identically
+        assert r("chunked_rs_ag_int8", 1 << 30, hvd.Min, 8,
+                 False) == "psum"
+
+    def test_wire_upgrades_auto_picks(self):
+        r = overlap.resolve_algorithm
+        # the wire default upgrades auto's rs_ag picks, leaves psum exact
+        assert r("auto", 1024, hvd.Sum, 8, True, wire="int8") == "psum"
+        assert r("auto", overlap.RS_AG_MIN_BYTES, hvd.Sum, 8, True,
+                 wire="int8") == "rs_ag_int8"
+        assert r("auto", overlap.CHUNKED_MIN_BYTES, hvd.Sum, 8, True,
+                 wire="fp8") == "chunked_rs_ag_fp8"
+        # bf16 wire is a cast, not a restructured reduction: names stay
+        assert r("auto", overlap.RS_AG_MIN_BYTES, hvd.Sum, 8, True,
+                 wire="bf16") == "rs_ag"
+        # explicit algorithm wins over the wire default
+        assert r("psum", overlap.CHUNKED_MIN_BYTES, hvd.Sum, 8, True,
+                 wire="int8") == "psum"
+
+    def test_parse_compose_roundtrip(self):
+        assert overlap.parse_algorithm("chunked_rs_ag_int8") == \
+            ("chunked_rs_ag", "int8")
+        assert overlap.parse_algorithm("rs_ag_fp8") == ("rs_ag", "fp8")
+        assert overlap.parse_algorithm("rs_ag") == ("rs_ag", None)
+        assert overlap.compose_algorithm("rs_ag", "int8") == "rs_ag_int8"
+        assert overlap.compose_algorithm("rs_ag", "bf16") == "rs_ag"
+        assert overlap.compose_algorithm("psum", "int8") == "psum"
+        for alg in overlap.ALGORITHMS:
+            base, w = overlap.parse_algorithm(alg)
+            assert overlap.compose_algorithm(base, w) == alg
+
+    def test_wire_bytes_accounting(self):
+        from horovod_tpu.ops.quantized import BLOCK
+        n = 4 * BLOCK
+        assert overlap.wire_bytes(n, "fp32") == 4 * n
+        assert overlap.wire_bytes(n, "bf16") == 2 * n
+        assert overlap.wire_bytes(n, "int8") == n + 16
+        assert overlap.wire_bytes(n, "fp8") == n + 16
+        # ragged tail: one extra started block's scale
+        assert overlap.wire_bytes(n + 1, "int8") == n + 1 + 20
+
+    def test_unknown_wire_rejected(self):
+        with pytest.raises(ValueError, match="wire"):
+            hvd.allreduce(jnp.zeros((hvd.size(), 2)), wire="int4")
 
     def test_unknown_algorithm_raises(self):
         with pytest.raises(ValueError, match="swing"):
@@ -278,6 +510,35 @@ class TestConfigKnobs:
             hconfig.refresh()
         monkeypatch.delenv("HOROVOD_ALLREDUCE_ALGORITHM")
         hconfig.refresh()
+
+    def test_wire_env_plumbing(self, monkeypatch):
+        from horovod_tpu import config as hconfig
+        monkeypatch.setenv("HOROVOD_ALLREDUCE_WIRE", "int8")
+        cfg = hconfig.refresh()
+        try:
+            assert cfg.allreduce_wire == "int8"
+            assert hvd.build_info()["allreduce_wire"] == "int8"
+        finally:
+            monkeypatch.delenv("HOROVOD_ALLREDUCE_WIRE")
+            hconfig.refresh()
+        assert hconfig.refresh().allreduce_wire == "fp32"
+
+    def test_invalid_wire_env_raises(self, monkeypatch):
+        from horovod_tpu import config as hconfig
+        monkeypatch.setenv("HOROVOD_ALLREDUCE_WIRE", "int4")
+        with pytest.raises(ValueError, match="int4"):
+            hconfig.refresh()
+        monkeypatch.delenv("HOROVOD_ALLREDUCE_WIRE")
+        hconfig.refresh()
+
+    def test_wire_gauge_visible(self):
+        snap = hvd.metrics()
+        if "config_allreduce_wire" not in snap.get("gauges", {}):
+            hvd.init()
+            snap = hvd.metrics()
+        wires = {g["labels"]["wire"]: g["value"]
+                 for g in snap["gauges"]["config_allreduce_wire"]}
+        assert sum(wires.values()) == 1     # one-hot on the resolved wire
 
     def test_invalid_chunks_env_raises(self, monkeypatch):
         from horovod_tpu import config as hconfig

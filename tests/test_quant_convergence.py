@@ -1,0 +1,168 @@
+"""Convergence guardrails for the quantized allreduce wire (PR 6).
+
+Training on a 1-byte wire is only safe with error feedback: the
+quantizer's per-step error must be re-injected at the next step or it
+accumulates as bias. Three guardrails pin that here:
+
+* **MNIST loss-curve parity** — a short MnistCNN run with
+  ``chunked_rs_ag_int8`` + error feedback must track the fp32-wire
+  (psum) loss curve within tolerance (the acceptance criterion).
+* **The no-error-feedback control** — a deterministic mixed-magnitude
+  problem where one coordinate's gradient sets the int8 block scale and
+  every other coordinate's gradient sits below half a quantization step:
+  without error feedback those coordinates FREEZE (every step flushes
+  their gradient to zero — exactly the failure the residual exists to
+  prevent); with it they track the exact path within half a step.
+* **GPT-2 step-loss check** — a tiny GPT2 config trained 3 steps on the
+  int8 wire matches the fp32-wire step losses to ~1e-4 (transformer
+  gradients are well-conditioned for block scaling).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+def _run_train(params, loss_of_shard, batches, opt, steps):
+    """Shared spmd train loop: ``batches`` is (n, ...) per-rank stacked
+    data (sharded on axis 0), loss averaged across ranks for the curve."""
+    state = opt.init(params)
+
+    def step(p, s, b):
+        l, g = jax.value_and_grad(loss_of_shard)(p, b)
+        l = hvd.allreduce(l, op=hvd.Average)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    fn = hvd.spmd(step, in_specs=(P(), P(), P("hvd")),
+                  out_specs=(P(), P(), P()))
+    p, s = params, state
+    losses = []
+    for _ in range(steps):
+        p, s, l = fn(p, s, batches)
+        losses.append(float(l))
+    return np.asarray(losses), p
+
+
+class TestMnistLossCurveParity:
+    STEPS = 10
+
+    def _setup(self, rng):
+        from horovod_tpu.models.mnist import MnistCNN
+        n = hvd.size()
+        model = MnistCNN()
+        imgs = rng.standard_normal((n, 4, 14, 14, 1)).astype(np.float32)
+        labels = rng.integers(0, 10, (n, 4)).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 14, 14, 1)), train=False)["params"]
+        batches = (jnp.asarray(imgs), jnp.asarray(labels))
+
+        def loss_of_shard(p, b):
+            x, y = b[0][0], b[1][0]
+            logits = model.apply({"params": p}, x, train=False)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        return params, loss_of_shard, batches
+
+    def _train(self, setup, algorithm, error_feedback):
+        params, loss_of_shard, batches = setup
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                       algorithm=algorithm,
+                                       error_feedback=error_feedback)
+        return _run_train(params, loss_of_shard, batches, opt, self.STEPS)
+
+    def test_int8_with_error_feedback_matches_fp32_curve(self, rng):
+        setup = self._setup(rng)         # ONE dataset for both runs
+        ref, _ = self._train(setup, "psum", False)
+        quant, _ = self._train(setup, "chunked_rs_ag_int8", True)
+        # the run must actually learn, or "parity" is vacuous
+        assert ref[-1] < 0.6 * ref[0]
+        np.testing.assert_allclose(quant, ref, atol=0.08, err_msg=(
+            "int8 wire + error feedback drifted from the fp32 loss "
+            "curve"))
+
+    def test_no_error_feedback_control_still_within_short_run_drift(
+            self, rng):
+        """On a SHORT run the uncompensated drift is small too — the
+        control documenting the failure mode is the flush-regime test
+        below, where the bias is systematic rather than noise."""
+        setup = self._setup(rng)
+        ref, _ = self._train(setup, "psum", False)
+        noef, _ = self._train(setup, "chunked_rs_ag_int8", False)
+        np.testing.assert_allclose(noef, ref, atol=0.15)
+
+
+class TestWhyErrorFeedbackExists:
+    """The no-EF control: gradients below half an int8 step of their
+    block's max-abs flush to zero EVERY step — without the residual those
+    coordinates never train."""
+
+    D = 256          # one quantization block
+    STEPS = 20
+    LR = 0.01
+
+    def _train(self, algorithm, error_feedback):
+        c = np.full(self.D, 0.1, np.float32)
+        c[0] = 100.0     # sets the block scale; half-step = 100/254 > 0.1
+        c_j = jnp.asarray(c)
+        w0 = jnp.zeros(self.D, jnp.float32)
+        opt = hvd.DistributedOptimizer(optax.sgd(self.LR),
+                                       algorithm=algorithm,
+                                       error_feedback=error_feedback)
+        _, w = _run_train(
+            w0, lambda w, _b: jnp.dot(w, c_j),
+            jnp.zeros((hvd.size(), 1), jnp.float32), opt, self.STEPS)
+        return np.asarray(w)
+
+    def test_flushed_coordinates_freeze_without_error_feedback(self):
+        ref = self._train("psum", False)
+        ef = self._train("chunked_rs_ag_int8", True)
+        noef = self._train("chunked_rs_ag_int8", False)
+        # exact path moves every coordinate by STEPS * LR * 0.1
+        np.testing.assert_allclose(ref[1:], -self.STEPS * self.LR * 0.1,
+                                   rtol=1e-5)
+        # without the residual, the small-gradient coordinates are
+        # FROZEN at exactly zero: every step quantized their gradient
+        # to nothing.
+        np.testing.assert_array_equal(noef[1:], 0.0)
+        # with it, the accumulated residual crosses the quantization
+        # step and the coordinates track the exact path within half an
+        # int8 step's worth of drift.
+        assert np.abs(ef[1:] - ref[1:]).max() < self.LR * (100.0 / 254)
+        # the dominant coordinate trains identically either way
+        np.testing.assert_allclose(ef[0], ref[0], rtol=1e-3)
+
+
+class TestGpt2StepLoss:
+    def test_tiny_gpt2_int8_step_losses_match(self, rng):
+        from horovod_tpu.models.gpt2 import (GPT2, GPT2Config,
+                                             loss_fn as gpt2_loss)
+        n = hvd.size()
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (n, 2, 32)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 32), jnp.int32))["params"]
+
+        def loss_of_shard(p, t):
+            logits = model.apply({"params": p}, t[0])
+            return gpt2_loss(logits, t[0])
+
+        def train(algorithm, error_feedback):
+            opt = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                           algorithm=algorithm,
+                                           error_feedback=error_feedback)
+            return _run_train(params, loss_of_shard, toks, opt, 3)[0]
+
+        ref = train("psum", False)
+        quant = train("chunked_rs_ag_int8", True)
+        assert ref[-1] < ref[0]              # it learns
+        np.testing.assert_allclose(quant, ref, atol=5e-3)

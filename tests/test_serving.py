@@ -768,4 +768,7 @@ class TestTwoProcessSmoke:
             import serve_smoke
         finally:
             sys.path.remove(os.path.join(_REPO, "tools"))
-        assert serve_smoke.run_smoke(str(tmp_path)) == 0
+        # run_smoke returns (rc, failure_text) — the text feeds the
+        # rendezvous-flake retry in tools/smoke_util.py.
+        rc, text = serve_smoke.run_smoke(str(tmp_path))
+        assert rc == 0, text

@@ -71,7 +71,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
+def run_smoke(workdir: str, timeout_s: float = 240.0):
+    """One attempt: returns ``(rc, failure_text)``; a rendezvous-flavored
+    failure text gets the attempt retried by ``smoke_util``."""
     trace = os.path.join(workdir, "trace.json")
     metfiles = [os.path.join(workdir, f"metrics.r{r}.json") for r in (0, 1)]
     port = _free_port()
@@ -85,7 +87,7 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
         if p.returncode != 0 or "DOCTOR-OK" not in out:
             print(f"worker failed (rc={p.returncode}):\n{out}",
                   file=sys.stderr)
-            return 1
+            return 1, "\n".join(outs)
 
     sys.path.insert(0, REPO)
     sys.path.insert(0, os.path.join(REPO, "tools"))
@@ -104,40 +106,48 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
     sev = [f["severity"] for f in findings]
     if sev != sorted(sev, reverse=True):
         print(f"findings are not ranked: {sev}", file=sys.stderr)
-        return 1
+        return 1, ""
 
     stragglers = [f for f in findings if f["category"] == "straggler"]
     if not stragglers:
         print("no straggler finding", file=sys.stderr)
-        return 1
+        return 1, ""
     s = stragglers[0]
     if s["evidence"].get("blamed_rank") != 1 \
             or s["evidence"].get("blame_seconds", 0) < 0.2:
         print(f"straggler finding does not blame rank 1 for the 250ms "
               f"sleep: {s['evidence']}", file=sys.stderr)
-        return 1
+        return 1, ""
 
     recompiles = [f for f in findings if f["category"] == "recompile"
                   and "train_step" in f["title"]]
     if not recompiles:
         print("no recompile finding for train_step", file=sys.stderr)
-        return 1
+        return 1, ""
     blamed = recompiles[0]["evidence"].get("blamed_arguments") or []
     if "seq_len" not in blamed:
         print(f"recompile finding does not blame seq_len: {blamed}",
               file=sys.stderr)
-        return 1
+        return 1, ""
 
     print(f"doctor-smoke OK: straggler rank "
           f"{s['evidence']['blamed_rank']} "
           f"({s['evidence']['blame_seconds'] * 1e3:.0f}ms blame), "
           f"recompile blamed on {blamed}")
-    return 0
+    return 0, ""
+
+
+def _attempt():
+    # Fresh workdir per attempt: a retry must not merge the failed
+    # attempt's stale trace shards.
+    with tempfile.TemporaryDirectory(prefix="hvd_doctor_smoke_") as td:
+        return run_smoke(td)
 
 
 def main() -> int:
-    with tempfile.TemporaryDirectory(prefix="hvd_doctor_smoke_") as td:
-        return run_smoke(td)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import smoke_util
+    return smoke_util.main_with_retry(_attempt, name="doctor-smoke")
 
 
 if __name__ == "__main__":

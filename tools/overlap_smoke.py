@@ -87,7 +87,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_smoke(timeout_s: float = 240.0) -> int:
+def run_smoke(timeout_s: float = 240.0):
+    """One attempt: returns ``(rc, failure_text)`` — failure text feeds
+    the rendezvous-flake detector in ``smoke_util``."""
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, "-c", WORKER, str(pid), str(port)],
@@ -96,16 +98,18 @@ def run_smoke(timeout_s: float = 240.0) -> int:
     outs = [p.communicate(timeout=timeout_s)[0] for p in procs]
     for p, out in zip(procs, outs):
         if p.returncode != 0 or "OVERLAP-OK" not in out:
-            print(f"worker failed (rc={p.returncode}):\n{out}",
-                  file=sys.stderr)
-            return 1
+            msg = f"worker failed (rc={p.returncode}):\n{out}"
+            print(msg, file=sys.stderr)
+            return 1, "\n".join(outs)
     print("overlap-smoke OK")
-    return 0
+    return 0, ""
 
 
 def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import smoke_util
     with tempfile.TemporaryDirectory():
-        return run_smoke()
+        return smoke_util.main_with_retry(run_smoke, name="overlap-smoke")
 
 
 if __name__ == "__main__":

@@ -83,7 +83,9 @@ def _claims(root, rank):
         return []
 
 
-def run_smoke(workdir: str, timeout_s: float = 300.0) -> int:
+def run_smoke(workdir: str, timeout_s: float = 300.0):
+    """One attempt: returns ``(rc, failure_text)``; rendezvous-flavored
+    failure text gets the attempt retried by ``smoke_util``."""
     sys.path.insert(0, REPO)
     from horovod_tpu.serving.replica import (
         read_result, submit_file_request)
@@ -101,13 +103,15 @@ def run_smoke(workdir: str, timeout_s: float = 300.0) -> int:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        texts = [msg]
         for i, p in enumerate(procs):
             try:
                 out = p.communicate(timeout=10)[0]
             except subprocess.TimeoutExpired:
                 out = "<no output>"
             print(f"--- replica {i} output ---\n{out}", file=sys.stderr)
-        return 1
+            texts.append(out)
+        return 1, "\n".join(texts)
 
     # 1. both replicas up (engine compiled, server loop beating).
     while time.monotonic() < deadline:
@@ -195,12 +199,20 @@ def run_smoke(workdir: str, timeout_s: float = 300.0) -> int:
         procs[0].wait(timeout=10)
     except subprocess.TimeoutExpired:
         procs[0].kill()
-    return 0
+    return 0, ""
+
+
+def _attempt():
+    # Fresh workdir per attempt: a retry must not reuse the failed
+    # attempt's spool (stale claims/results).
+    with tempfile.TemporaryDirectory(prefix="hvd_serve_smoke_") as td:
+        return run_smoke(td)
 
 
 def main() -> int:
-    with tempfile.TemporaryDirectory(prefix="hvd_serve_smoke_") as td:
-        return run_smoke(td)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import smoke_util
+    return smoke_util.main_with_retry(_attempt, name="serve-smoke")
 
 
 if __name__ == "__main__":

@@ -61,7 +61,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
+def run_smoke(workdir: str, timeout_s: float = 240.0):
+    """One attempt: returns ``(rc, failure_text)``; rendezvous-flavored
+    failure text gets the attempt retried by ``smoke_util``."""
     trace = os.path.join(workdir, "trace.json")
     port = _free_port()
     procs = [subprocess.Popen(
@@ -73,13 +75,13 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
         if p.returncode != 0 or "TRACE-OK" not in out:
             print(f"worker failed (rc={p.returncode}):\n{out}",
                   file=sys.stderr)
-            return 1
+            return 1, "\n".join(outs)
 
     shards = [os.path.join(workdir, f"trace.rank{r}.json") for r in (0, 1)]
     for s in shards:
         if not os.path.exists(s):
             print(f"missing shard {s}", file=sys.stderr)
-            return 1
+            return 1, ""
 
     sys.path.insert(0, REPO)
     from horovod_tpu.trace_merge import merge_timelines
@@ -94,14 +96,14 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
     if not {0, 1} <= pids:
         print(f"expected per-rank tracks pid 0 and 1, got {pids}",
               file=sys.stderr)
-        return 1
+        return 1, ""
 
     # 2. straggler report non-empty
     report = doc["stragglerReport"]
     if not report["collectives"]:
         print("straggler report is empty (no cross-rank collectives "
               "correlated)", file=sys.stderr)
-        return 1
+        return 1, ""
     blame = {r: v for r, v in report["blame_seconds_by_rank"].items()
              if v > 0}
     print(f"straggler report: {len(report['collectives'])} collectives, "
@@ -124,7 +126,7 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
     if not full:
         print(f"no op-id has NEGOTIATE/QUEUE/EXEC on both shards: "
               f"{per_shard_phases}", file=sys.stderr)
-        return 1
+        return 1, ""
     print(f"op-ids with all three phases on both ranks: {sorted(full)}")
 
     # 4. the manufactured straggler (rank 1) carries blame
@@ -133,12 +135,20 @@ def run_smoke(workdir: str, timeout_s: float = 240.0) -> int:
               "(spread attribution may be below tolerance)",
               file=sys.stderr)
     print("trace-smoke OK")
-    return 0
+    return 0, ""
+
+
+def _attempt():
+    # Fresh workdir per attempt: a retry must not merge the failed
+    # attempt's stale trace shards.
+    with tempfile.TemporaryDirectory(prefix="hvd_trace_smoke_") as td:
+        return run_smoke(td)
 
 
 def main() -> int:
-    with tempfile.TemporaryDirectory(prefix="hvd_trace_smoke_") as td:
-        return run_smoke(td)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import smoke_util
+    return smoke_util.main_with_retry(_attempt, name="trace-smoke")
 
 
 if __name__ == "__main__":
