@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
-	test native
+	preempt-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -44,6 +44,17 @@ doctor-smoke:
 # tests/test_quantized_and_sharded.py::TestTwoProcessQuantSmoke.
 quant-smoke:
 	$(PY) tools/quant_smoke.py
+
+# Preemption smoke: 2 CPU worker processes + 1 hot spare; rank 1 is
+# SIGKILLed mid-epoch by HOROVOD_FAULT_PLAN, the launcher promotes the
+# spare into the dead rank's slot, and the relaunched world restores from
+# the last published sharded manifest. Exits nonzero unless recovery is
+# within 2 steps of the kill, every resumed loss BIT-matches an
+# uninterrupted golden run, and hvd.doctor() reports the measured
+# recovery time as a ranked finding. Also runs in tier-1 as
+# tests/test_checkpoint_sharded.py::TestTwoProcessPreemptSmoke.
+preempt-smoke:
+	$(PY) tools/preempt_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

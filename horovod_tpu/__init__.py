@@ -51,6 +51,18 @@ from horovod_tpu.optimizer import (  # noqa: F401
 from horovod_tpu.optimizer_sharded import (  # noqa: F401
     ShardedAdamWState, sharded_adamw,
 )
+# Preemption tolerance (docs/ELASTIC.md): commit/restore elastic states
+# (hvd.elastic), async sharded checkpoints with two-phase-commit manifests
+# (hvd.checkpoint_sharded), instrumented full-state orbax checkpoints
+# (hvd.checkpoint), and the fault-injection harness (hvd.faults,
+# HOROVOD_FAULT_PLAN).
+from horovod_tpu import checkpoint  # noqa: F401
+from horovod_tpu import checkpoint_sharded  # noqa: F401
+from horovod_tpu import elastic  # noqa: F401
+from horovod_tpu import faults  # noqa: F401
+from horovod_tpu.checkpoint_sharded import (  # noqa: F401
+    ShardedCheckpointManager,
+)
 from horovod_tpu.process_set import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,
 )

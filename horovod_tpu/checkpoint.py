@@ -10,6 +10,7 @@ running, and restore re-places arrays with the current mesh sharding.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
@@ -23,9 +24,35 @@ def _manager(directory: str, max_to_keep: int = 3):
             max_to_keep=max_to_keep, create=True))
 
 
+#: last full-save wall time per checkpoint directory (module-level so the
+#: cadence gauge survives one-shot save_checkpoint()'s throwaway managers)
+_LAST_SAVE_WALL: dict = {}
+
+
+def _tree_bytes(state: Any) -> int:
+    import jax
+    import numpy as np
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = np.asarray(leaf).nbytes
+        total += int(nbytes)
+    return total
+
+
 class CheckpointManager:
     """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` with the
-    framework's state conventions (a dict of pytrees + scalars)."""
+    framework's state conventions (a dict of pytrees + scalars).
+
+    Instrumented like the sharded path (``checkpoint_sharded.py``):
+    ``checkpoint_save_seconds`` / ``checkpoint_restore_seconds``
+    histograms, ``checkpoint_bytes_total{kind=full}``, and timeline
+    ``CHECKPOINT`` markers — one metric surface for both checkpoint
+    flavors, so ``hvd.doctor()``'s cadence check sees full-state saves
+    too. The save timer covers the *dispatch* (orbax's async writer does
+    the durable part), which is exactly the cost the training loop pays.
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
@@ -33,20 +60,53 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
         import orbax.checkpoint as ocp
+
+        from horovod_tpu import metrics as _metrics
+        t0 = time.perf_counter()
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
+        _metrics.histogram("checkpoint_save_seconds", kind="full").observe(
+            time.perf_counter() - t0)
+        _metrics.counter("checkpoint_bytes_total", kind="full").inc(
+            _tree_bytes(state))
+        _metrics.gauge("checkpoint_last_step", kind="full").set(step)
+        now = time.time()
+        prev = _LAST_SAVE_WALL.get(self.directory)
+        if prev is not None:
+            # kind-labeled so a slow full-save cadence can't mask (or be
+            # masked by) per-step sharded publishes — the doctor reads
+            # the MIN across kinds as the durable-loss window. Tracked
+            # per DIRECTORY, not per manager: the one-shot
+            # save_checkpoint() builds a fresh manager per call, and
+            # hourly one-shot saves are exactly the cadence the doctor's
+            # preemption check exists to catch.
+            _metrics.gauge("checkpoint_interval_seconds", kind="full").set(
+                now - prev)
+        _LAST_SAVE_WALL[self.directory] = now
+        _metrics._timeline_marker("CHECKPOINT", category="checkpoint",
+                                  phase="save", kind="full", step=step)
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Any] = None) -> Any:
         import orbax.checkpoint as ocp
+
+        from horovod_tpu import metrics as _metrics
+        t0 = time.perf_counter()
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         if template is not None:
-            return self._mgr.restore(
+            out = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template))
-        return self._mgr.restore(step)
+        else:
+            out = self._mgr.restore(step)
+        _metrics.histogram("checkpoint_restore_seconds",
+                           kind="full").observe(time.perf_counter() - t0)
+        _metrics.gauge("checkpoint_restored_step", kind="full").set(step)
+        _metrics._timeline_marker("CHECKPOINT", category="checkpoint",
+                                  phase="restore", kind="full", step=step)
+        return out
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

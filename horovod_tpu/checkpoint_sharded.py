@@ -1,0 +1,934 @@
+"""Async sharded checkpointing: lose seconds, not epochs.
+
+"Highly Available Data Parallel ML training on Mesh Networks" (PAPERS.md,
+arxiv 2011.03605) is the blueprint: a preempted TPU-VM host must cost the
+job the seconds since the last durable snapshot, not everything since the
+last synchronous full-state save. "Automatic Cross-Replica Sharding of
+Weight Update" (``optimizer_sharded.py``) makes that nearly free — under
+ZeRO-1 each rank already *owns* 1/n of the optimizer state, so durability
+can be sharded too: every rank writes only its owned shard, off the
+critical path, and a manifest stitches the shards into one restorable
+step.
+
+Mechanics:
+
+* **Shard-major layout** — the unit of persistence is a pytree whose
+  array leaves have leading dimension ``num_shards``: shard ``s`` of
+  every leaf belongs to rank ``s``. :func:`pack_opt_state` converts a
+  :class:`~horovod_tpu.optimizer_sharded.ShardedAdamWState` (``(n*c,)``
+  flat moments, ``(n,)`` step counters) into this layout and back.
+* **Async writer** — :meth:`ShardedCheckpointManager.save` snapshots
+  references, starts the device-to-host copies (``copy_to_host_async``)
+  so the DMA overlaps the next forward, enqueues, and returns; a
+  background thread does the blocking host fetch and file IO.
+* **Two-phase commit** — phase 1: every rank writes its shard files
+  (tmp + atomic rename) plus a per-rank ``.ok`` receipt; phase 2: rank
+  0's writer waits for all receipts (a filesystem barrier — collectives
+  from a background thread would race the training step's) and publishes
+  ``manifest-<step>.json`` atomically. A restore only ever reads
+  manifests, so it can never see a torn step: an unpublished step is
+  invisible to ``latest_step()`` and a *requested* torn step fails
+  loudly.
+* **N→M resharding** — restore re-places shards under the *current*
+  mesh: when the world shrank (or grew), ``(n, c)`` leaves are
+  flattened, stripped to their recorded unpadded length, and re-chunked
+  for ``m`` shards — a survivor set adopts a dead rank's shard by simply
+  restoring at the new world size. Per-shard ``(n,)`` counters (which
+  advance in lockstep) collapse to their max and refill.
+
+Instrumented throughout: ``checkpoint_save_seconds`` /
+``checkpoint_restore_seconds`` histograms, ``checkpoint_bytes_total{kind
+=full|shard}``, ``checkpoint_interval_seconds`` (publish-to-publish — the
+cadence hvd.doctor() compares against the preemption-notice budget), and
+timeline ``CHECKPOINT`` markers for save/publish/restore. The writer
+honors the ``slow_write`` fault (``faults.py``) so the harness can prove
+a slow durable store stalls but never tears a commit.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = [
+    "ShardedCheckpointManager", "Restored", "TornCheckpointError",
+    "pack_opt_state", "unpack_opt_state", "reshard_opt_state",
+    "owned_shards",
+    "save_state", "adopt_state",
+]
+
+logger = logging.getLogger("horovod_tpu")
+
+_OK_POLL_S = 0.05
+
+
+class TornCheckpointError(RuntimeError):
+    """A step directory exists but its manifest was never published (the
+    job died between phase 1 and phase 2) — restoring it would resurrect
+    a torn step, so it fails loudly instead."""
+
+
+class Restored(NamedTuple):
+    step: int
+    shards: Any          # pytree (or {keystr: array} without a template)
+    replicated: Any
+    meta: Dict[str, Any]
+    manifest: Dict[str, Any]
+
+
+def _step_dirname(step: int) -> str:
+    return f"step-{step:08d}"
+
+
+def _manifest_name(step: int) -> str:
+    return f"manifest-{step:08d}.json"
+
+
+def _shard_filename(s: int, num_shards: int) -> str:
+    return f"shard-{s:05d}-of-{num_shards:05d}.npz"
+
+
+def _flatten_with_keys(tree):
+    """-> (list[(keystr, leaf)], treedef)."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp), leaf) for kp, leaf in flat], treedef
+
+
+def _world():
+    try:
+        import jax
+        return jax.process_count(), jax.process_index()
+    except Exception:
+        return 1, 0
+
+
+def owned_shards(num_shards: int) -> List[int]:
+    """Which shard ids this process durably owns. With an initialized
+    communicator whose mesh matches ``num_shards``, ownership follows
+    device placement (shard ``s`` lives with mesh position ``s``);
+    otherwise shards round-robin over processes."""
+    nproc, pid = _world()
+    if nproc == 1:
+        return list(range(num_shards))
+    try:
+        from horovod_tpu import core
+        if core.is_initialized():
+            devs = list(core.mesh().devices.ravel())
+            if len(devs) == num_shards:
+                return [i for i, d in enumerate(devs)
+                        if d.process_index == pid]
+    except Exception:
+        pass
+    return [s for s in range(num_shards) if s % nproc == pid]
+
+
+def _shard_part(leaf, s: int):
+    """Shard ``s``'s slice of a shard-major leaf, without materializing
+    non-addressable rows (multi-process global arrays)."""
+    import jax
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        for sh in leaf.addressable_shards:
+            sl = sh.index[0] if sh.index else slice(None)
+            start = sl.start or 0
+            stop = sl.stop if sl.stop is not None else leaf.shape[0]
+            if start <= s < stop:
+                return sh.data[s - start]
+        raise ValueError(
+            f"shard {s} is not addressable on process {_world()[1]} — "
+            f"pass owned= matching this process's mesh placement")
+    return leaf[s]
+
+
+class _SaveJob(NamedTuple):
+    step: int
+    parts: Dict[int, Dict[str, Any]]    # shard id -> {key: device/host arr}
+    replicated: Optional[List]          # [(key, arr)] or None (not rank 0)
+    meta: Dict[str, Any]
+    unpadded: Dict[str, int]
+    num_shards: int
+    num_ranks: int
+    rank: int
+    attempt: int                        # elastic restart count (receipt salt)
+    enqueued_at: float
+
+
+class ShardedCheckpointManager:
+    """Per-rank shard files + an atomically published manifest.
+
+    ``directory`` must be shared by all ranks (the TPU-VM analogue is a
+    GCS bucket / NFS export; tests use tmp dirs). One background writer
+    thread per manager keeps every save off the training thread's
+    critical path.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 publish_timeout_s: float = 120.0):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max(1, int(max_to_keep))
+        self.publish_timeout_s = float(publish_timeout_s)
+        os.makedirs(self.directory, exist_ok=True)
+        self._q: "queue.Queue[Optional[_SaveJob]]" = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._last_publish_wall: Optional[float] = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, step: int, shards=None, replicated=None,
+             meta: Optional[Dict[str, Any]] = None, *,
+             unpadded: Optional[Dict[str, int]] = None,
+             num_shards: Optional[int] = None,
+             owned: Optional[List[int]] = None,
+             wait: bool = False) -> None:
+        """Snapshot ``shards`` (shard-major pytree: every array leaf is
+        ``(num_shards, ...)``) and ``replicated`` (any pytree; written by
+        rank 0 only) for ``step``, asynchronously.
+
+        ``meta`` is a JSON-able dict published in the manifest (step
+        counters, RNG key, data-stream cursor). ``unpadded`` maps a shard
+        leaf's key to its true flat length so N→M resharding can strip
+        world-size-dependent padding. ``wait=True`` blocks until the
+        manifest is published (rank 0) / this rank's receipt is written.
+
+        Donation caveat: the async path snapshots *references* and starts
+        the D2H copies immediately, so with an ordinary functional step
+        (old state replaced, not donated) the overlap is safe. If the
+        training step DONATES these buffers back to XLA
+        (``donate_argnums``), a dispatch racing the copy can invalidate
+        them — the writer then fails loudly (surfaced on the next
+        ``save()``/``wait()``), never publishing a torn step, but that
+        step's checkpoint is lost: pass ``wait=True`` (or snapshot to
+        host first) when donating.
+        """
+        self._raise_pending()
+        nproc, pid = _world()
+        flat: List = []
+        if shards is not None:
+            flat, _ = _flatten_with_keys(shards)
+        if flat:
+            for key, leaf in flat:
+                if getattr(leaf, "ndim", 0) < 1:
+                    raise ValueError(
+                        f"shard leaf {key} is a scalar — shard-major "
+                        f"leaves need a leading num_shards dimension")
+            if num_shards is None:
+                num_shards = int(flat[0][1].shape[0])
+            for key, leaf in flat:
+                if int(leaf.shape[0]) != num_shards:
+                    raise ValueError(
+                        f"shard leaf {key} has leading dim "
+                        f"{leaf.shape[0]} != num_shards {num_shards}")
+        elif num_shards is None:
+            # no shard leaves at all (shards=None or an empty pytree):
+            # a replicated/meta-only save
+            num_shards = 0
+        own = list(owned) if owned is not None else owned_shards(num_shards)
+        parts: Dict[int, Dict[str, Any]] = {}
+        for s in own:
+            parts[s] = {}
+            for key, leaf in flat:
+                part = _shard_part(leaf, s)
+                # Start the D2H DMA now so it overlaps the next forward;
+                # the writer thread pays the (already-started) wait.
+                try:
+                    part.copy_to_host_async()
+                except AttributeError:
+                    pass
+                parts[s][key] = part
+        rep = None
+        if pid == 0 and replicated is not None:
+            rep = _flatten_with_keys(replicated)[0]
+            for _, leaf in rep:
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:
+                    pass
+        job = _SaveJob(step=int(step), parts=parts, replicated=rep,
+                       meta=dict(meta or {}), unpadded=dict(unpadded or {}),
+                       num_shards=int(num_shards), num_ranks=nproc,
+                       rank=pid,
+                       attempt=int(os.environ.get(
+                           "HVD_TPU_ELASTIC_RESTART", "0")),
+                       enqueued_at=time.perf_counter())
+        self._ensure_thread()
+        self._q.put(job)
+        from horovod_tpu import metrics as _metrics
+        _metrics.gauge("checkpoint_pending_saves").set(self._q.qsize())
+        if wait:
+            self.wait()
+
+    def wait(self) -> None:
+        """Block until every enqueued save is durable (and, on rank 0,
+        published); re-raises a writer failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=max(10.0, self.publish_timeout_s))
+            self._thread = None
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                f"sharded checkpoint writer failed: {err!r}") from err
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="hvd-ckpt-writer")
+            self._thread.start()
+
+    # -- writer thread ---------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                self._write(job)
+            except BaseException as e:   # noqa: BLE001 — surfaced on wait()
+                logger.error("sharded checkpoint save(step=%s) failed: %s",
+                             job.step, e)
+                with self._lock:
+                    self._error = e
+            finally:
+                self._q.task_done()
+                from horovod_tpu import metrics as _metrics
+                _metrics.gauge("checkpoint_pending_saves").set(
+                    self._q.qsize())
+
+    def _atomic_write_npz(self, path: str, arrays: Dict[str, np.ndarray],
+                          delay_s: float) -> int:
+        tmp = path + ".tmp"
+        if delay_s > 0:
+            time.sleep(delay_s)   # injected slow_write fault
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+        return os.path.getsize(path)
+
+    def _write(self, job: _SaveJob) -> None:
+        from horovod_tpu import faults as _faults
+        from horovod_tpu import metrics as _metrics
+        t0 = time.perf_counter()
+        step_dir = os.path.join(self.directory, _step_dirname(job.step))
+        os.makedirs(step_dir, exist_ok=True)
+        delay = _faults.slow_write_seconds()
+        files: Dict[str, Dict[str, Any]] = {}
+        leaves: Dict[str, Dict[str, Any]] = {}
+        # Phase 1a: this rank's owned shard files (tmp + atomic rename).
+        for s, part in sorted(job.parts.items()):
+            host = {k: np.asarray(v) for k, v in part.items()}
+            for k, a in host.items():
+                info = leaves.setdefault(k, {
+                    "shape": list(a.shape), "dtype": str(a.dtype)})
+                if k in job.unpadded:
+                    info["unpadded"] = int(job.unpadded[k])
+            fname = _shard_filename(s, job.num_shards)
+            nbytes = self._atomic_write_npz(
+                os.path.join(step_dir, fname), host, delay)
+            files[fname] = {"bytes": nbytes, "shard": s}
+            _metrics.counter("checkpoint_bytes_total", kind="shard").inc(
+                nbytes)
+        if job.replicated is not None:
+            host = {k: np.asarray(v) for k, v in job.replicated}
+            nbytes = self._atomic_write_npz(
+                os.path.join(step_dir, "replicated.npz"), host, delay)
+            files["replicated.npz"] = {"bytes": nbytes}
+            _metrics.counter("checkpoint_bytes_total", kind="full").inc(
+                nbytes)
+        # Phase 1b: per-rank receipt — the filesystem barrier token.
+        # Receipts are SALTED with the elastic attempt so a torn save of
+        # this same step by a previous incarnation of the job cannot
+        # satisfy the publish barrier: rank 0 would otherwise publish a
+        # manifest mixing the dead attempt's shards with this one's.
+        # Each rank also clears its own stale receipts (other attempts)
+        # as hygiene — only rank-local files, so no cross-rank races.
+        for stale in glob.glob(os.path.join(
+                step_dir, f"rank-{job.rank:05d}-of-*.ok")):
+            if not stale.endswith(self._receipt_name(job.rank, job)):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+        ok = {"rank": job.rank, "num_ranks": job.num_ranks,
+              "attempt": job.attempt,
+              "files": files, "leaves": leaves,
+              "wall_time": time.time()}
+        ok_tmp = os.path.join(
+            step_dir, self._receipt_name(job.rank, job) + ".tmp")
+        with open(ok_tmp, "w") as f:
+            json.dump(ok, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(ok_tmp, ok_tmp[:-4])
+        _metrics._timeline_marker(
+            "CHECKPOINT", category="checkpoint", phase="save",
+            step=job.step, shards=sorted(job.parts))
+        # Observed BEFORE the publish barrier: the histogram measures
+        # this rank's own durable-write cost; the cross-rank receipt
+        # wait (peer skew) is its own series.
+        _metrics.histogram("checkpoint_save_seconds", kind="shard").observe(
+            time.perf_counter() - t0)
+        # Phase 2: rank 0 waits for every receipt, then publishes.
+        if job.rank == 0:
+            t1 = time.perf_counter()
+            self._publish(job, step_dir)
+            _metrics.histogram("checkpoint_publish_seconds").observe(
+                time.perf_counter() - t1)
+
+    @staticmethod
+    def _receipt_name(rank: int, job: _SaveJob) -> str:
+        return (f"rank-{rank:05d}-of-{job.num_ranks:05d}"
+                f".a{job.attempt}.ok")
+
+    def _publish(self, job: _SaveJob, step_dir: str) -> None:
+        from horovod_tpu import metrics as _metrics
+        deadline = time.monotonic() + self.publish_timeout_s
+        receipts = {}
+        while len(receipts) < job.num_ranks:
+            for r in range(job.num_ranks):
+                if r in receipts:
+                    continue
+                # Current-attempt receipts only (see _write): a previous
+                # incarnation's torn save must not unblock the barrier.
+                p = os.path.join(step_dir, self._receipt_name(r, job))
+                if os.path.exists(p):
+                    with open(p) as f:
+                        receipts[r] = json.load(f)
+            if len(receipts) < job.num_ranks:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"step {job.step}: only {sorted(receipts)} of "
+                        f"{job.num_ranks} rank receipts after "
+                        f"{self.publish_timeout_s}s — not publishing a "
+                        f"torn manifest")
+                time.sleep(_OK_POLL_S)
+        files: Dict[str, Dict[str, Any]] = {}
+        leaves: Dict[str, Dict[str, Any]] = {}
+        for r in sorted(receipts):
+            files.update(receipts[r]["files"])
+            leaves.update(receipts[r]["leaves"])
+        manifest = {
+            "format": 1,
+            "step": job.step,
+            "num_shards": job.num_shards,
+            "num_ranks": job.num_ranks,
+            "dir": _step_dirname(job.step),
+            "files": files,
+            "leaves": leaves,
+            "meta": job.meta,
+            "wall_time": time.time(),
+        }
+        tmp = os.path.join(self.directory,
+                           _manifest_name(job.step) + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, tmp[:-4])
+        now = time.time()
+        with self._lock:
+            prev, self._last_publish_wall = self._last_publish_wall, now
+        if prev is not None:
+            _metrics.gauge("checkpoint_interval_seconds",
+                           kind="shard").set(now - prev)
+        _metrics.gauge("checkpoint_last_step", kind="shard").set(job.step)
+        _metrics._timeline_marker(
+            "CHECKPOINT", category="checkpoint", phase="publish",
+            step=job.step, ranks=job.num_ranks)
+        self._prune()
+
+    def _prune(self) -> None:
+        steps = self.all_steps()
+        for step in steps[:-self.max_to_keep]:
+            # Manifest first: the step becomes invisible before its files
+            # disappear, so a concurrent restore never sees a half-step.
+            try:
+                os.remove(os.path.join(self.directory,
+                                       _manifest_name(step)))
+            except FileNotFoundError:
+                pass
+            sd = os.path.join(self.directory, _step_dirname(step))
+            for p in glob.glob(os.path.join(sd, "*")):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            try:
+                os.rmdir(sd)
+            except OSError:
+                pass
+
+    # -- restore ---------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        """Published steps, ascending (unpublished/torn steps excluded)."""
+        out = []
+        for p in glob.glob(os.path.join(self.directory, "manifest-*.json")):
+            base = os.path.basename(p)
+            try:
+                out.append(int(base[len("manifest-"):-len(".json")]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def read_manifest(self, step: int) -> Dict[str, Any]:
+        path = os.path.join(self.directory, _manifest_name(step))
+        if not os.path.exists(path):
+            if os.path.isdir(os.path.join(self.directory,
+                                          _step_dirname(step))):
+                raise TornCheckpointError(
+                    f"step {step} in {self.directory} has shard files but "
+                    f"no published manifest — the save died between "
+                    f"phase 1 and phase 2; refusing to restore a torn "
+                    f"step")
+            raise FileNotFoundError(
+                f"no checkpoint manifest for step {step} in "
+                f"{self.directory}")
+        with open(path) as f:
+            return json.load(f)
+
+    def restore(self, step: Optional[int] = None, *,
+                num_shards: Optional[int] = None,
+                shards_template=None, replicated_template=None) -> Restored:
+        """Load a published step, resharding to ``num_shards`` when it
+        differs from the manifest's world size. Without templates the
+        shard/replicated trees come back as ``{keystr: np.ndarray}``;
+        with templates they are unflattened into the template structure
+        (keys must match exactly — a checkpoint from a different model
+        fails loudly)."""
+        from horovod_tpu import metrics as _metrics
+        t0 = time.perf_counter()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no published checkpoint in {self.directory}")
+        manifest = self.read_manifest(step)
+        step_dir = os.path.join(self.directory, manifest["dir"])
+        missing = [f for f in manifest["files"]
+                   if not os.path.exists(os.path.join(step_dir, f))]
+        if missing:
+            raise FileNotFoundError(
+                f"step {step} manifest lists {len(manifest['files'])} "
+                f"file(s) but {missing} are missing from {step_dir} — "
+                f"the checkpoint is damaged; refusing a partial restore")
+        n = int(manifest["num_shards"])
+        bytes_read = 0
+        per_shard: Dict[int, Dict[str, np.ndarray]] = {}
+        for fname, info in manifest["files"].items():
+            path = os.path.join(step_dir, fname)
+            bytes_read += os.path.getsize(path)
+            if "shard" in info:
+                with np.load(path) as z:
+                    per_shard[int(info["shard"])] = {
+                        k: z[k] for k in z.files}
+        shards_dict: Dict[str, np.ndarray] = {}
+        if per_shard:
+            present = sorted(per_shard)
+            if present != list(range(n)):
+                raise FileNotFoundError(
+                    f"step {step}: manifest promises shards 0..{n - 1} "
+                    f"but only {present} are on disk")
+            for key in per_shard[0]:
+                shards_dict[key] = np.stack(
+                    [per_shard[s][key] for s in range(n)], axis=0)
+        m = num_shards or n
+        if shards_dict and m != n:
+            shards_dict = {
+                key: _reshard(key, arr, m,
+                              manifest["leaves"].get(key, {}).get(
+                                  "unpadded"))
+                for key, arr in shards_dict.items()}
+        replicated_dict: Dict[str, np.ndarray] = {}
+        rep_path = os.path.join(step_dir, "replicated.npz")
+        if "replicated.npz" in manifest["files"]:
+            with np.load(rep_path) as z:
+                replicated_dict = {k: z[k] for k in z.files}
+        shards_out = (_unflatten_like(shards_template, shards_dict)
+                      if shards_template is not None else shards_dict)
+        rep_out = (_unflatten_like(replicated_template, replicated_dict)
+                   if replicated_template is not None else replicated_dict)
+        dt = time.perf_counter() - t0
+        _metrics.histogram("checkpoint_restore_seconds",
+                           kind="shard").observe(dt)
+        _metrics.gauge("checkpoint_restored_step", kind="shard").set(step)
+        _metrics._timeline_marker(
+            "CHECKPOINT", category="checkpoint", phase="restore",
+            step=step, num_shards=m, bytes=bytes_read)
+        _record_recovery(manifest)
+        return Restored(step=step, shards=shards_out, replicated=rep_out,
+                        meta=dict(manifest.get("meta", {})),
+                        manifest=manifest)
+
+
+#: how long after init()'s stash a restore still counts as THE recovery;
+#: anything later is an eval/rollback restore that must not record a
+#: bogus hours-long "recovery".
+RECOVERY_STAMP_STALE_S = 900.0
+
+#: [(failed_at_wall, stashed_monotonic)] — filled by stash_failure_stamp.
+_RECOVERY_STASH: List = []
+
+
+def stash_failure_stamp() -> None:
+    """Consume ``HVD_TPU_ELASTIC_FAILED_AT`` process-wide (called by
+    ``init()``): the stamp is held for the first restore to measure
+    recovery against, then discarded — it must not leak into restores
+    that happen long after the relaunch."""
+    v = os.environ.pop("HVD_TPU_ELASTIC_FAILED_AT", None)
+    if not v:
+        return
+    try:
+        _RECOVERY_STASH[:] = [(float(v), time.monotonic())]
+    except ValueError:
+        _RECOVERY_STASH[:] = []
+
+
+def _record_recovery(manifest: Dict[str, Any]) -> None:
+    """Recovery-time accounting: when the elastic launcher stamped the
+    failure instant (``HVD_TPU_ELASTIC_FAILED_AT``), the gap to *now* —
+    restore complete, training about to resume — is the measured recovery
+    time hvd.doctor() reports as a ranked finding. Recorded at most once
+    per stamp, and only while the stamp is fresh."""
+    if _RECOVERY_STASH:
+        failed_at, stashed = _RECOVERY_STASH.pop()
+        if time.monotonic() - stashed > RECOVERY_STAMP_STALE_S:
+            return
+    else:
+        # Restore before init() (or outside an elastic job): fall back to
+        # consuming the env var directly.
+        v = os.environ.pop("HVD_TPU_ELASTIC_FAILED_AT", None)
+        if not v:
+            return
+        try:
+            failed_at = float(v)
+        except ValueError:
+            return
+    dt = max(0.0, time.time() - failed_at)
+    from horovod_tpu import metrics as _metrics
+    _metrics.gauge("elastic_recovery_seconds").set(dt)
+    _metrics.event("elastic_recovery", seconds=round(dt, 3),
+                   restored_step=manifest.get("step"))
+
+
+def _reshard(key: str, arr: np.ndarray, m: int,
+             unpadded: Optional[int]) -> np.ndarray:
+    """``(n, ...)`` shard-major leaf → ``(m, ...)`` for the new world."""
+    n = arr.shape[0]
+    if arr.ndim == 1:
+        # Per-shard counters advance in lockstep — collapse and refill.
+        return np.full((m,), arr.max(), dtype=arr.dtype)
+    if arr.ndim != 2:
+        raise ValueError(
+            f"cannot reshard leaf {key} of shape {arr.shape} from {n} to "
+            f"{m} shards — only flat (n, c) layouts reshard; restore at "
+            f"the original world size instead")
+    flat = arr.reshape(-1)
+    length = int(unpadded) if unpadded else flat.shape[0]
+    flat = flat[:length]
+    c = -(-length // m)
+    flat = np.pad(flat, (0, m * c - length))
+    return flat.reshape(m, c)
+
+
+def _unflatten_like(template, flat_dict: Dict[str, np.ndarray]):
+    import jax
+    flat, treedef = _flatten_with_keys(template)
+    keys = [k for k, _ in flat]
+    missing = sorted(set(keys) - set(flat_dict))
+    extra = sorted(set(flat_dict) - set(keys))
+    if missing or extra:
+        raise KeyError(
+            f"checkpoint does not match the template: missing leaves "
+            f"{missing}, unexpected leaves {extra}")
+    leaves = []
+    for key, tleaf in flat:
+        a = flat_dict[key]
+        dtype = getattr(tleaf, "dtype", None)
+        leaves.append(a if dtype is None else a.astype(dtype, copy=False))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 adapters
+# ---------------------------------------------------------------------------
+
+def pack_opt_state(opt_state, unpadded_len: Optional[int] = None):
+    """``ShardedAdamWState`` (optionally ``ErrorFeedbackState``-wrapped) →
+    ``(shards_tree, unpadded, info)`` in the manager's shard-major layout.
+
+    Error-feedback residuals are deliberately NOT packed: they are this
+    rank's local quantization error from the current communicator epoch —
+    a restored (possibly re-meshed) job restarts them at zero exactly as
+    elastic re-init does (``hvd.reset_error_feedback``); ``info`` records
+    that the wrapper existed so :func:`unpack_opt_state` can rebuild it.
+    """
+    from horovod_tpu.optimizer import ErrorFeedbackState
+    from horovod_tpu.optimizer_sharded import ShardedAdamWState
+    info = {"error_feedback": isinstance(opt_state, ErrorFeedbackState)}
+    if info["error_feedback"]:
+        opt_state = opt_state.inner
+    if not isinstance(opt_state, ShardedAdamWState):
+        raise TypeError(
+            f"pack_opt_state expects a ShardedAdamWState (or an "
+            f"ErrorFeedbackState wrapping one); got {type(opt_state)!r}")
+    n = int(opt_state.step.shape[0])
+    total = int(opt_state.mu.shape[0])
+    if total % n:
+        raise ValueError(
+            f"ShardedAdamWState moments ({total}) are not divisible by "
+            f"the shard count ({n})")
+    c = total // n
+    shards = {"step": opt_state.step,
+              "mu": opt_state.mu.reshape(n, c),
+              "nu": opt_state.nu.reshape(n, c)}
+    unpadded = {}
+    if unpadded_len is not None:
+        unpadded = {"['mu']": int(unpadded_len), "['nu']": int(unpadded_len)}
+    return shards, unpadded, info
+
+
+def reshard_opt_state(opt_state, num_shards: int,
+                      unpadded_len: Optional[int] = None):
+    """In-memory N→M reshard of a ``ShardedAdamWState`` — the same
+    canonicalise/strip/re-pad transform a manifest restore applies, for
+    callers that survived with the state still in host memory (elastic
+    re-mesh without process loss)."""
+    packed, unpadded, info = pack_opt_state(opt_state,
+                                            unpadded_len=unpadded_len)
+    out = {}
+    for key, arr in (("step", packed["step"]), ("mu", packed["mu"]),
+                     ("nu", packed["nu"])):
+        out[key] = _reshard(key, np.asarray(arr), num_shards,
+                            unpadded.get(f"['{key}']"))
+    return unpack_opt_state(out)
+
+
+def unpack_opt_state(shards, params=None, error_feedback: bool = False):
+    """Inverse of :func:`pack_opt_state` for the restored (possibly
+    resharded) arrays: rebuilds a ``ShardedAdamWState`` whose per-shard
+    chunk width matches the restored world, re-wrapping in a fresh
+    zero-residual ``ErrorFeedbackState`` (``params`` supplies the
+    residual structure) when the save had one."""
+    import jax
+    import jax.numpy as jnp
+    step = shards["step"] if isinstance(shards, dict) else shards.step
+    mu = shards["mu"] if isinstance(shards, dict) else shards.mu
+    nu = shards["nu"] if isinstance(shards, dict) else shards.nu
+    from horovod_tpu.optimizer_sharded import ShardedAdamWState
+    state = ShardedAdamWState(
+        step=jnp.asarray(np.asarray(step), jnp.int32),
+        mu=jnp.asarray(np.asarray(mu).reshape(-1), jnp.float32),
+        nu=jnp.asarray(np.asarray(nu).reshape(-1), jnp.float32))
+    if not error_feedback:
+        return state
+    if params is None:
+        raise ValueError(
+            "rebuilding an ErrorFeedbackState needs params for the "
+            "zero-residual structure")
+    from horovod_tpu.optimizer import ErrorFeedbackState
+    residual = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return ErrorFeedbackState(state, residual)
+
+
+# ---------------------------------------------------------------------------
+# elastic-state bridge (hot-spare adoption path)
+# ---------------------------------------------------------------------------
+
+def _is_sharded_value(v) -> bool:
+    from horovod_tpu.optimizer import ErrorFeedbackState
+    from horovod_tpu.optimizer_sharded import ShardedAdamWState
+    if isinstance(v, ErrorFeedbackState):
+        v = v.inner
+    return isinstance(v, ShardedAdamWState)
+
+
+def _flat_len(tree) -> int:
+    import jax
+    return sum(
+        int(np.prod(np.asarray(l).shape)) if np.asarray(l).shape else 1
+        for l in jax.tree_util.tree_leaves(tree))
+
+
+def _infer_unpadded_len(state, tree) -> Optional[int]:
+    """Best-effort recovery of the TRUE flat parameter length behind a
+    committed ``ShardedAdamWState`` — needed so N→M resharding re-chunks
+    to exactly what ``sharded_adamw(...).init`` would produce at the new
+    world instead of carrying old-world padding as data. An
+    error-feedback residual is params-shaped (unambiguous); otherwise
+    any single replicated pytree whose flat length is consistent with
+    the padded moments is the model. ``None`` = keep padded length
+    (values still align; widths just stay old-world-padded)."""
+    from horovod_tpu.optimizer import ErrorFeedbackState
+    if isinstance(tree, ErrorFeedbackState):
+        return _flat_len(tree.residual)
+    inner = tree
+    total = int(np.asarray(inner.mu).shape[0])
+    n = int(np.asarray(inner.step).shape[0])
+    candidates = set()
+    for other in state._saved_pytrees.values():
+        if _is_sharded_value(other):
+            continue
+        flat = _flat_len(other)
+        if flat <= total and -(-flat // n) * n == total:
+            candidates.add(flat)
+    return candidates.pop() if len(candidates) == 1 else None
+
+
+def save_state(mgr: ShardedCheckpointManager, step: int, state, *,
+               meta: Optional[Dict[str, Any]] = None,
+               wait: bool = False) -> None:
+    """Persist a :class:`~horovod_tpu.elastic.state.JaxState`'s **last
+    commit** through the sharded manager: ``ShardedAdamWState`` pytrees
+    go down the per-rank shard path, everything else (params) rides the
+    rank-0 replicated file, and the state's plain attributes (epoch,
+    step, data-stream cursor) plus ``meta`` publish in the manifest."""
+    shards: Dict[str, Any] = {}
+    replicated: Dict[str, Any] = {}
+    info: Dict[str, Any] = {}
+    unpadded: Dict[str, int] = {}
+    for name, tree in state._saved_pytrees.items():
+        if _is_sharded_value(tree):
+            packed, leaf_unpadded, tree_info = pack_opt_state(
+                tree, unpadded_len=_infer_unpadded_len(state, tree))
+            shards[name] = packed
+            info[name] = tree_info
+            # pack's keys are relative ("['mu']"); the manager sees them
+            # nested under the pytree name.
+            unpadded.update({f"['{name}']{k}": v
+                             for k, v in leaf_unpadded.items()})
+        else:
+            replicated[name] = tree
+    attrs = {}
+    for k, v in state._saved_attrs.items():
+        try:
+            json.dumps(v)
+            attrs[k] = v
+        except TypeError:
+            logger.warning(
+                "sharded checkpoint: attribute %r is not JSON-able; "
+                "excluded from the manifest", k)
+    full_meta = {"attrs": attrs, "sharded": info,
+                 "commit_count": getattr(state, "commit_count", 0)}
+    full_meta.update(meta or {})
+    mgr.save(step, shards=shards or None,
+             replicated=replicated or None, meta=full_meta,
+             unpadded=unpadded or None, wait=wait)
+
+
+def adopt_state(mgr: ShardedCheckpointManager, state,
+                step: Optional[int] = None) -> int:
+    """Hot-spare adoption: load the last published manifest into an
+    elastic state's committed snapshot, resharded for the CURRENT world —
+    a surviving/standby rank takes over a dead rank's optimizer shard and
+    data-stream cursor. Runs inside the ``@hvd.elastic.run`` re-init path
+    (before ``state.sync()``); error-feedback residuals restart at zero
+    and the profiler's recompile fingerprints were already re-anchored by
+    ``init()``. Returns the adopted step."""
+    from horovod_tpu import core
+    m = core.size() if core.is_initialized() else None
+    target = step if step is not None else mgr.latest_step()
+    if target is None:
+        raise FileNotFoundError(
+            f"no published checkpoint in {mgr.directory}")
+    man_cc = mgr.read_manifest(target).get("meta", {}).get(
+        "commit_count", -1)
+    mem_cc = int(getattr(state, "commit_count", 0) or 0)
+    if man_cc >= 0 and mem_cc > man_cc:
+        # The in-memory commit OUTRAN the last published manifest (commit
+        # cadence faster than save cadence, or an in-flight save died
+        # unpublished). An in-process survivor must not silently roll
+        # committed work back to the manifest — keep the newer commit and
+        # only reshard its sharded trees for the current world.
+        _reshard_committed(state, m)
+        state.restore()
+        return target
+    r = mgr.restore(step=target, num_shards=m)
+    info = r.meta.get("sharded", {})
+    for name in list(state._saved_pytrees):
+        prefix = f"['{name}']"
+        if name in info:
+            # keys look like "['opt_state']['mu']" — strip the name
+            # prefix, then the bracket quoting around the leaf name.
+            packed = {key[len(prefix):].strip("[]'"): v
+                      for key, v in r.shards.items()
+                      if key.startswith(prefix)}
+            inner = unpack_opt_state(packed)
+            if info[name].get("error_feedback", False):
+                # Zero-residual rebuild. The residual template comes
+                # from the state's OWN current wrapper (pytree names are
+                # user-chosen kwargs — nothing guarantees a tree called
+                # 'params'), falling back to a 'params' pytree if the
+                # current value lost the wrapper.
+                from horovod_tpu.optimizer import ErrorFeedbackState
+                cur = state._saved_pytrees.get(name)
+                template = (cur.residual
+                            if isinstance(cur, ErrorFeedbackState)
+                            else state._saved_pytrees.get("params"))
+                if template is None:
+                    raise ValueError(
+                        f"cannot rebuild the error-feedback residual for "
+                        f"{name!r}: the state's current value is not an "
+                        f"ErrorFeedbackState and no 'params' pytree "
+                        f"exists to shape the zeros")
+                import jax
+                import jax.numpy as jnp
+                inner = ErrorFeedbackState(inner, jax.tree_util.tree_map(
+                    lambda x: jnp.zeros_like(jnp.asarray(x)), template))
+            state._saved_pytrees[name] = inner
+        else:
+            sub = {k[len(prefix):]: v for k, v in r.replicated.items()
+                   if k.startswith(prefix)}
+            if sub:
+                state._saved_pytrees[name] = _unflatten_like(
+                    state._saved_pytrees[name], sub)
+    for k, v in r.meta.get("attrs", {}).items():
+        state._saved_attrs[k] = v
+    state.restore()
+    return r.step
+
+
+def _reshard_committed(state, num_shards: Optional[int]) -> None:
+    """Re-chunk every committed ``ShardedAdamWState`` (optionally
+    ``ErrorFeedbackState``-wrapped) in a state's snapshot for the
+    current world; residuals restart at zero as on any re-init."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.optimizer import ErrorFeedbackState
+    for name, tree in list(state._saved_pytrees.items()):
+        if not _is_sharded_value(tree):
+            continue
+        ef = isinstance(tree, ErrorFeedbackState)
+        inner = tree.inner if ef else tree
+        m = num_shards or int(np.asarray(inner.step).shape[0])
+        resharded = reshard_opt_state(
+            inner, m, unpadded_len=_infer_unpadded_len(state, tree))
+        if ef:
+            residual = jax.tree_util.tree_map(
+                lambda x: jnp.zeros_like(jnp.asarray(x)), tree.residual)
+            resharded = ErrorFeedbackState(resharded, residual)
+        state._saved_pytrees[name] = resharded

@@ -124,6 +124,17 @@ class Config:
     serve_heartbeat_seconds: float = 2.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
+    # Preemption tolerance (checkpoint_sharded.py / faults.py /
+    # docs/ELASTIC.md): HOROVOD_PREEMPTION_NOTICE is the seconds of
+    # warning the platform gives before a host disappears (GCP TPU-VM
+    # preemption notice ~30s) — hvd.doctor() flags a checkpoint cadence
+    # slower than this budget, because then a preemption loses more than
+    # the notice window could have saved. HOROVOD_FAULT_PLAN is the
+    # fault-injection schedule (kill/stall/slow_write at a chosen
+    # rank+step; grammar in faults.py) — validated here so a typo'd plan
+    # fails at init instead of silently never firing.
+    preemption_notice_seconds: float = 30.0
+    fault_plan: str = ""
     # Subset-barrier wait (collective.barrier on a process set); its own
     # knob so tuning elastic failover never shortens unrelated barriers.
     barrier_timeout_seconds: float = 600.0
@@ -211,6 +222,14 @@ def _env_kv_quant() -> str:
     return v
 
 
+def _env_fault_plan() -> str:
+    v = os.environ.get("HOROVOD_FAULT_PLAN", "").strip()
+    if v:
+        from horovod_tpu.faults import parse_plan
+        parse_plan(v)   # grammar check: a bad plan fails here, at init
+    return v
+
+
 def refresh() -> Config:
     """Re-read ``HOROVOD_*`` from the environment (called by ``init()``)."""
     global _CONFIG
@@ -256,6 +275,9 @@ def refresh() -> Config:
         serve_heartbeat_seconds=max(
             0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
+        preemption_notice_seconds=max(
+            0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),
+        fault_plan=_env_fault_plan(),
         barrier_timeout_seconds=max(
             1.0, _env_float("HOROVOD_BARRIER_TIMEOUT", 600.0)),
         log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),

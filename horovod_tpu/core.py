@@ -105,6 +105,22 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
     import os
     import time as _time
     t0 = _time.perf_counter()
+    if os.environ.get("HVD_TPU_ELASTIC_SPARE") == "1":
+        # A hot spare that skipped the standby barrier would rendezvous
+        # as an independent world-of-1 job and could publish bogus
+        # manifests into the real job's shared checkpoint directory.
+        raise RuntimeError(
+            "this process was launched as an elastic hot spare "
+            "(HVD_TPU_ELASTIC_SPARE=1) and has not been promoted: call "
+            "hvd.elastic.standby_if_spare() before hvd.init() — "
+            "promotion installs the rendezvous contract and clears the "
+            "flag")
+    # Consume the launcher's failure stamp process-wide: only the first
+    # restore after this (re)init may record recovery time (a rank that
+    # resumes via state.sync() must not carry the stamp into an
+    # unrelated restore hours later).
+    from horovod_tpu import checkpoint_sharded as _cks
+    _cks.stash_failure_stamp()
     if coordinator_address is None and num_processes is None and \
             os.environ.get("HVD_TPU_COORDINATOR"):
         # Launched by horovod_tpu.runner: pick up the rendezvous contract.
@@ -150,6 +166,14 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         _ps._reset_for_init(m, axis_name)
         global _INIT_EPOCH
         _INIT_EPOCH += 1
+        if _INIT_EPOCH > 1:
+            # Elastic re-init (or any re-mesh): every jitted program
+            # retraces against the new mesh BY DESIGN, and a hot spare
+            # adopting a dead rank's shard traces from scratch — neither
+            # may read as recompile churn or blame an argument. Same
+            # contract as the autotuner's expected=True, but epoch-wide.
+            from horovod_tpu import profiler as _prof
+            _prof.registry.reanchor()
         if cfg.timeline_path:
             from horovod_tpu import timeline as _tl
             if _tl.get_timeline() is None:
@@ -205,6 +229,10 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         _metrics.gauge("config_overlap_chunks").set(cfg.overlap_chunks)
         _metrics.gauge("config_xla_latency_hiding").set(
             1 if lhs_applied else 0)
+        # Exported so an OFFLINE doctor (perf_doctor over flusher files)
+        # can judge checkpoint cadence against the same budget.
+        _metrics.gauge("config_preemption_notice_seconds").set(
+            cfg.preemption_notice_seconds)
 
 
 def shutdown() -> None:

@@ -32,6 +32,7 @@ def restart_count() -> int:
     return int(_os.environ.get("HVD_TPU_ELASTIC_RESTART", "0"))
 from horovod_tpu.elastic.driver import (  # noqa: F401
     run, HostsUpdatedInterrupt, WorkerNotificationManager,
+    is_spare, standby, standby_if_spare, promote_spare, list_spares,
 )
 from horovod_tpu.elastic.discovery import (  # noqa: F401
     HostDiscovery, FixedHostDiscovery, ScriptHostDiscovery,

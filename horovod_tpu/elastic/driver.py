@@ -17,6 +17,8 @@ step functions retrace automatically because the mesh object changed.
 from __future__ import annotations
 
 import functools
+import json
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -26,7 +28,8 @@ from horovod_tpu import metrics as _metrics
 from horovod_tpu.elastic.discovery import DeviceDiscovery
 
 __all__ = ["run", "HostsUpdatedInterrupt", "WorkerNotificationManager",
-           "notification_manager"]
+           "notification_manager", "is_spare", "standby",
+           "standby_if_spare", "promote_spare", "list_spares"]
 
 
 class HostsUpdatedInterrupt(Exception):
@@ -113,7 +116,7 @@ def run(func: Callable) -> Callable:
     @functools.wraps(func)
     def wrapper(state, *args, reset_limit: Optional[int] = None,
                 min_size: int = 1, discovery: Optional[DeviceDiscovery] = None,
-                **kwargs):
+                checkpoint=None, **kwargs):
         resets = 0
         if notification_manager._thread is None:
             notification_manager.init(discovery)
@@ -122,6 +125,7 @@ def run(func: Callable) -> Callable:
                 try:
                     return func(state, *args, **kwargs)
                 except HostsUpdatedInterrupt:
+                    t0 = time.monotonic()
                     resets += 1
                     _metrics.event("elastic_reset", resets=resets)
                     if reset_limit is not None and resets > reset_limit:
@@ -129,11 +133,177 @@ def run(func: Callable) -> Callable:
                             f"elastic reset limit ({reset_limit}) exceeded")
                     notification_manager.acknowledge()
                     _reinitialize(min_size, discovery)
+                    if checkpoint is not None:
+                        # Shard adoption under the NEW mesh: the last
+                        # published manifest is resharded for the surviving
+                        # world, so this (possibly standby) rank takes over
+                        # the dead rank's optimizer shard and data-stream
+                        # cursor before the commit is re-broadcast. Only
+                        # the coordinator reads the manifest — sync()
+                        # broadcasts its committed snapshot to every other
+                        # rank anyway, so N full-checkpoint reads against
+                        # shared storage at the most latency-critical
+                        # moment would be wasted I/O.
+                        import jax as _jax
+                        if _jax.process_index() == 0:
+                            from horovod_tpu import core as _core
+                            from horovod_tpu import \
+                                checkpoint_sharded as _cs
+                            if checkpoint.latest_step() is not None:
+                                step = _cs.adopt_state(checkpoint, state)
+                                _metrics.event("elastic_shard_adoption",
+                                               step=step)
+                            else:
+                                # No published manifest yet (host lost
+                                # before the first save published): the
+                                # in-memory commit recovers via sync();
+                                # just reshard its sharded trees for the
+                                # new world — crashing here would make
+                                # checkpoint= strictly WORSE than not
+                                # passing it.
+                                _cs._reshard_committed(state,
+                                                       _core.size())
                     state.sync()
+                    dt = time.monotonic() - t0
+                    _metrics.gauge("elastic_recovery_seconds").set(dt)
+                    _metrics.event("elastic_recovery",
+                                   seconds=round(dt, 3))
         finally:
             notification_manager.stop()
 
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# hot-spare (standby rank) semantics
+# ---------------------------------------------------------------------------
+#
+# A spare is a warm process provisioned alongside the job: it has paid the
+# interpreter/jax import cost, registered itself with discovery (a
+# heartbeat file in the elastic state dir), and idles at the standby
+# barrier. When a peer dies, the launcher *promotes* it — hands it the
+# dead rank's slot in the relaunched world — and it adopts that rank's
+# optimizer shard and data-stream cursor from the last sharded-checkpoint
+# manifest, exactly the way the serving Dispatcher adopts a dead engine's
+# queue (PR 4's failover pattern, generalized to training). The
+# promote/registration protocol is file-based for the same reason the
+# two-phase checkpoint commit is: a process that has not joined a
+# communicator yet cannot ride collectives.
+
+def _spares_dir(state_dir: str) -> str:
+    return os.path.join(state_dir, "spares")
+
+
+def is_spare() -> bool:
+    """Was this process launched as a hot spare
+    (``HVD_TPU_ELASTIC_SPARE=1``, set by ``run_elastic(spares=N)``)?"""
+    return os.environ.get("HVD_TPU_ELASTIC_SPARE", "") == "1"
+
+
+def standby(state_dir: Optional[str] = None, poll_s: float = 0.2,
+            timeout_s: Optional[float] = None) -> dict:
+    """Register with discovery and idle at the standby barrier until
+    promoted.
+
+    Writes ``spares/spare-<pid>.json`` (heartbeat: mtime refreshed every
+    poll) under the elastic state dir, then blocks until the launcher
+    writes the matching ``.promote.json`` naming this spare's rank in the
+    relaunched world. On promotion the rendezvous contract
+    (``HVD_TPU_*``) is installed into the environment so the caller's
+    ordinary ``hvd.init()`` path joins the new world unchanged, and the
+    promotion dict (``rank``, ``world``, ``coordinator``, ``restart``,
+    ``failed_at``) is returned."""
+    from horovod_tpu import elastic as _elastic
+    sdir = state_dir or _elastic.state_dir()
+    if not sdir:
+        raise RuntimeError(
+            "standby() needs an elastic state dir "
+            "(HVD_TPU_ELASTIC_STATE_DIR, set by run_elastic)")
+    spdir = _spares_dir(sdir)
+    os.makedirs(spdir, exist_ok=True)
+    # Identity: the launcher-assigned token when present (the launcher's
+    # Popen may be a wrapper script, so its pid is not ours), else pid.
+    me = os.environ.get("HVD_TPU_ELASTIC_SPARE_ID") \
+        or f"spare-{os.getpid()}"
+    reg = os.path.join(spdir, f"{me}.json")
+    with open(reg, "w") as f:
+        json.dump({"pid": os.getpid(), "registered_at": time.time()}, f)
+    _metrics.event("elastic_spare_registered")
+    promote_path = os.path.join(spdir, f"{me}.promote.json")
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while not os.path.exists(promote_path):
+        if deadline is not None and time.monotonic() > deadline:
+            try:
+                os.remove(reg)
+            except OSError:
+                pass
+            raise TimeoutError(
+                f"spare {me}: not promoted within {timeout_s}s")
+        os.utime(reg)   # heartbeat: a stale mtime reads as a dead spare
+        time.sleep(poll_s)
+    with open(promote_path) as f:
+        promo = json.load(f)
+    os.environ["HVD_TPU_COORDINATOR"] = promo["coordinator"]
+    os.environ["HVD_TPU_NUM_PROCESSES"] = str(promo["world"])
+    os.environ["HVD_TPU_PROCESS_ID"] = str(promo["rank"])
+    os.environ["HVD_TPU_ELASTIC_RESTART"] = str(promo["restart"])
+    if promo.get("failed_at") is not None:
+        os.environ["HVD_TPU_ELASTIC_FAILED_AT"] = str(promo["failed_at"])
+    os.environ.pop("HVD_TPU_ELASTIC_SPARE", None)
+    os.environ.pop("HVD_TPU_ELASTIC_SPARE_ID", None)
+    try:
+        os.remove(reg)
+        os.remove(promote_path)
+    except OSError:
+        pass
+    _metrics.event("elastic_spare_promoted", rank=promo.get("rank"))
+    return promo
+
+
+def standby_if_spare(**kwargs) -> Optional[dict]:
+    """No-op for ordinary workers; spares block in :func:`standby` until
+    promoted. Lets one worker script serve both roles::
+
+        hvd.elastic.standby_if_spare()
+        hvd.init()   # spares join here with the promoted contract
+    """
+    if not is_spare():
+        return None
+    return standby(**kwargs)
+
+
+def list_spares(state_dir: str, stale_s: float = 5.0) -> list:
+    """Registered, live (heartbeat fresher than ``stale_s``) spares in
+    promotion-file order — the launcher's discovery view."""
+    spdir = _spares_dir(state_dir)
+    if not os.path.isdir(spdir):
+        return []
+    out = []
+    now = time.time()
+    for name in sorted(os.listdir(spdir)):
+        if not name.endswith(".json") or ".promote." in name:
+            continue
+        path = os.path.join(spdir, name)
+        try:
+            if now - os.path.getmtime(path) <= stale_s:
+                out.append(name[:-len(".json")])
+        except OSError:
+            continue
+    return out
+
+
+def promote_spare(state_dir: str, spare: str, *, rank: int, world: int,
+                  coordinator: str, restart: int,
+                  failed_at: Optional[float] = None) -> None:
+    """Hand a registered spare a slot in the relaunched world (atomic
+    promote-file publish; the spare's :func:`standby` loop picks it up)."""
+    spdir = _spares_dir(state_dir)
+    tmp = os.path.join(spdir, f"{spare}.promote.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"rank": rank, "world": world,
+                   "coordinator": coordinator, "restart": restart,
+                   "failed_at": failed_at}, f)
+    os.replace(tmp, tmp[:-len(".tmp")])
 
 
 def _reinitialize(min_size: int, discovery: Optional[DeviceDiscovery],

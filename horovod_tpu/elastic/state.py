@@ -16,17 +16,27 @@ __all__ = ["State", "JaxState", "FsdpState", "TorchState",
 logger = logging.getLogger("horovod_tpu")
 
 
-def _copy_attrs(attrs: Dict[str, Any], warned: set) -> Dict[str, Any]:
+def _copy_attrs(attrs: Dict[str, Any], warned: set):
     """Deep-copy tracked attributes, falling back to by-reference (with a
     one-time warning) for values deepcopy cannot handle (locks, loggers,
     loader handles) — every public attribute is tracked so counters roll
     back on restore(), but a stateful helper object must not turn commit()
-    into a crash."""
+    into a crash.
+
+    Returns ``(copied, uncopyable_keys)``: the caller records which keys
+    fell back by reference so ``restore()`` can say — EVERY time, not
+    once per process — that rolling those attributes back is a no-op
+    (the "snapshot" IS the live mutated object). The old silent fallback
+    was a footgun: a failed deepcopy at commit meant restore() quietly
+    kept post-failure values for exactly the attributes the user thought
+    they had rolled back."""
     out = {}
+    failed = []
     for k, v in attrs.items():
         try:
             out[k] = copy.deepcopy(v)
         except Exception:
+            failed.append(k)
             if k not in warned:
                 warned.add(k)
                 logger.warning(
@@ -34,7 +44,19 @@ def _copy_attrs(attrs: Dict[str, Any], warned: set) -> Dict[str, Any]:
                     "is kept by reference and will NOT roll back on "
                     "restore()", k)
             out[k] = v
-    return out
+    return out, failed
+
+
+def _warn_no_rollback(no_rollback: set) -> None:
+    """Per-restore (NOT once-per-process) warning that some attributes
+    cannot actually roll back — silence here would let a failed deepcopy
+    masquerade as a successful restore."""
+    if no_rollback:
+        logger.warning(
+            "elastic restore(): attribute(s) %s could not be deep-copied "
+            "at commit; their rollback is a NO-OP — the live (possibly "
+            "post-failure) object is kept by reference",
+            sorted(no_rollback))
 
 
 def _picklable_attrs(attrs: Dict[str, Any], warned: set) -> Dict[str, Any]:
@@ -130,14 +152,18 @@ class JaxState(State):
         self._saved_pytrees = {
             k: jax.tree_util.tree_map(lambda x: np.asarray(x), v)
             for k, v in self._pytrees.items()}
-        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
+        self._saved_attrs, failed = _copy_attrs(self._attrs, self._warn)
+        self._no_rollback = set(failed)
         self.commit_count += 1
 
     def restore(self) -> None:
         self._pytrees = {
             k: jax.tree_util.tree_map(jax.numpy.asarray, v)
             for k, v in self._saved_pytrees.items()}
-        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
+        attrs, failed = _copy_attrs(self._saved_attrs, self._warn)
+        self._attrs = attrs
+        _warn_no_rollback(getattr(self, "_no_rollback", set())
+                          | set(failed))
 
     def sync(self) -> None:
         """After re-init: broadcast committed state from the coordinator so
@@ -289,7 +315,8 @@ class FsdpState(State):
             # per-shard counters advance in lockstep -> one scalar
             snap["step"] = int(np.max(np.asarray(self.opt_state.step)))
         self._saved = snap
-        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
+        self._saved_attrs, failed = _copy_attrs(self._attrs, self._warn)
+        self._no_rollback = set(failed)
         self.commit_count += 1
 
     def restore(self, num_shards: Optional[int] = None) -> None:
@@ -309,7 +336,10 @@ class FsdpState(State):
                 step=jnp.full((n,), self._saved["step"], jnp.int32),
                 mu=jnp.asarray(self._pad(self._saved["mu"], n)),
                 nu=jnp.asarray(self._pad(self._saved["nu"], n)))
-        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
+        attrs, failed = _copy_attrs(self._saved_attrs, self._warn)
+        self._attrs = attrs
+        _warn_no_rollback(getattr(self, "_no_rollback", set())
+                          | set(failed))
 
     def sync(self, num_shards: Optional[int] = None) -> None:
         """After re-init on the new mesh: broadcast the canonical commit
@@ -461,7 +491,8 @@ class TorchState(_AttrState):
 
     def commit(self) -> None:
         self._saved = self._snapshot()
-        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
+        self._saved_attrs, failed = _copy_attrs(self._attrs, self._warn)
+        self._no_rollback = set(failed)
         self.commit_count += 1
 
     def restore(self) -> None:
@@ -470,7 +501,10 @@ class TorchState(_AttrState):
         if "optimizer" in self._saved and self.optimizer is not None:
             self.optimizer.load_state_dict(
                 copy.deepcopy(self._saved["optimizer"]))
-        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
+        attrs, failed = _copy_attrs(self._saved_attrs, self._warn)
+        self._attrs = attrs
+        _warn_no_rollback(getattr(self, "_no_rollback", set())
+                          | set(failed))
 
     def sync(self) -> None:
         if jax.process_count() > 1:
@@ -515,7 +549,8 @@ class TensorFlowKerasState(_AttrState):
         snap["opt"] = {self._var_key(v): np.asarray(v)
                        for v in self._opt_vars()}
         self._saved = snap
-        self._saved_attrs = _copy_attrs(self._attrs, self._warn)
+        self._saved_attrs, failed = _copy_attrs(self._attrs, self._warn)
+        self._no_rollback = set(failed)
         self.commit_count += 1
 
     def restore(self) -> None:
@@ -537,7 +572,10 @@ class TensorFlowKerasState(_AttrState):
                 # keeping post-failure momenta/iteration counts would pair
                 # stale state with rolled-back weights.
                 var.assign(np.zeros(var.shape, np.asarray(var).dtype))
-        self._attrs = _copy_attrs(self._saved_attrs, self._warn)
+        attrs, failed = _copy_attrs(self._saved_attrs, self._warn)
+        self._attrs = attrs
+        _warn_no_rollback(getattr(self, "_no_rollback", set())
+                          | set(failed))
 
     def sync(self) -> None:
         # The TF frontend has no async handle queue to race (its

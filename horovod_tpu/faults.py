@@ -1,0 +1,236 @@
+"""Fault-injection harness for preemption-tolerance testing.
+
+"Highly Available Data Parallel ML training on Mesh Networks" (PAPERS.md)
+treats failure as a first-class input: you cannot claim a recovery bound
+you have never measured. This module turns a declarative schedule —
+``HOROVOD_FAULT_PLAN`` — into deterministic faults at chosen ranks and
+steps, so the preemption smoke (``tools/preempt_smoke.py`` /
+``make preempt-smoke``) and CI can SIGKILL a rank mid-epoch on purpose
+and assert the job recovers from the last sharded manifest within a
+bounded number of steps.
+
+Plan grammar (semicolon-separated actions)::
+
+    HOROVOD_FAULT_PLAN="kill@rank=1,step=5;stall@rank=0,step=7,seconds=2"
+
+Each action is ``kind@key=value,key=value`` with:
+
+* ``kind`` — one of ``kill`` (SIGKILL this process: the TPU-VM preemption
+  model, no goodbye), ``stall`` (sleep ``seconds``: a degraded peer the
+  stall watchdog should name), ``slow_write`` (arm a per-shard-file delay
+  of ``seconds`` in the sharded checkpoint writer: a slow durable store
+  must not corrupt the two-phase commit).
+* ``rank=R`` — the process index the action targets (required).
+* ``step=S`` — the training step it fires at (required; the training
+  loop, or any instrumented subsystem, reports steps via
+  :func:`fault_point`).
+* ``seconds=X`` — duration for ``stall`` / ``slow_write`` (default 1.0).
+* ``restart=N`` — which elastic attempt the action belongs to (default
+  ``0``: first launch only, so a relaunched job does not re-kill itself
+  forever; ``restart=*`` fires on every attempt).
+
+Every fired action is timeline-marked (``FAULT``, category ``fault``) and
+counted in ``fault_injected_total{kind}`` — on a SIGKILL the marker is
+necessarily best-effort (the point of ``kill`` is that nothing gets to
+say goodbye; surviving ranks' shards still carry their own markers).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+__all__ = ["FaultAction", "parse_plan", "get_plan", "fault_point",
+           "slow_write_seconds", "reset"]
+
+logger = logging.getLogger("horovod_tpu")
+
+_KINDS = ("kill", "stall", "slow_write")
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    kind: str                      # kill | stall | slow_write
+    rank: int                      # process index the action targets
+    step: int                      # training step it fires at
+    seconds: float = 1.0           # stall / slow_write duration
+    restart: Optional[int] = 0    # elastic attempt (None = every attempt)
+
+    def describe(self) -> str:
+        extra = ""
+        if self.kind in ("stall", "slow_write"):
+            extra = f",seconds={self.seconds:g}"
+        r = "*" if self.restart is None else str(self.restart)
+        return (f"{self.kind}@rank={self.rank},step={self.step}"
+                f"{extra},restart={r}")
+
+
+def parse_plan(text: str) -> List[FaultAction]:
+    """Parse a ``HOROVOD_FAULT_PLAN`` string; raises ``ValueError`` with
+    the offending entry on any grammar violation (config.refresh calls
+    this, so a typo'd plan fails at init, not silently never-fires)."""
+    actions: List[FaultAction] = []
+    for raw in (text or "").split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: expected "
+                f"'kind@rank=R,step=S[,seconds=X][,restart=N|*]'")
+        kind, _, rest = entry.partition("@")
+        kind = kind.strip().lower()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: unknown kind "
+                f"{kind!r} (expected one of {_KINDS})")
+        fields = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"HOROVOD_FAULT_PLAN entry {entry!r}: field {kv!r} "
+                    f"is not key=value")
+            k, _, v = kv.partition("=")
+            fields[k.strip().lower()] = v.strip()
+        unknown = set(fields) - {"rank", "step", "seconds", "restart"}
+        if unknown:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: unknown field(s) "
+                f"{sorted(unknown)}")
+        for req in ("rank", "step"):
+            if req not in fields:
+                raise ValueError(
+                    f"HOROVOD_FAULT_PLAN entry {entry!r}: missing "
+                    f"required field {req!r}")
+        try:
+            rank = int(fields["rank"])
+            step = int(fields["step"])
+            seconds = float(fields.get("seconds", 1.0))
+            restart: Optional[int]
+            if fields.get("restart", "0") == "*":
+                restart = None
+            else:
+                restart = int(fields.get("restart", "0"))
+        except ValueError as e:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: {e}") from None
+        if rank < 0 or step < 0 or seconds < 0 or (
+                restart is not None and restart < 0):
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: rank/step/seconds/"
+                f"restart must be non-negative")
+        actions.append(FaultAction(kind=kind, rank=rank, step=step,
+                                   seconds=seconds, restart=restart))
+    return actions
+
+
+# -- module state ------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_FIRED: set = set()            # indices into the active plan
+_SLOW_WRITE: float = 0.0       # armed per-shard-file write delay
+_PLAN_CACHE: tuple = ("", [])  # (plan_text, parsed) — fault_point runs
+                               # every step; steady state is one compare
+
+
+def _cached_plan(text: str) -> List[FaultAction]:
+    global _PLAN_CACHE
+    if _PLAN_CACHE[0] != text:
+        _PLAN_CACHE = (text, parse_plan(text))
+    return _PLAN_CACHE[1]
+
+
+def get_plan() -> List[FaultAction]:
+    """The active plan (from the resolved config's ``fault_plan``)."""
+    from horovod_tpu.config import get_config
+    return _cached_plan(get_config().fault_plan)
+
+
+def _my_rank() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _restart_count() -> int:
+    return int(os.environ.get("HVD_TPU_ELASTIC_RESTART", "0"))
+
+
+def fault_point(step: int, rank: Optional[int] = None) -> None:
+    """Declare a step boundary: fire every not-yet-fired plan action that
+    matches (this rank, this step, this elastic attempt).
+
+    Call once per training step — ``tools/preempt_smoke.py``'s loop does;
+    a no-op (one env read, no jax work) when no plan is set. A matured
+    ``kill`` never returns."""
+    from horovod_tpu.config import get_config
+    plan_text = get_config().fault_plan
+    if not plan_text:
+        return
+    actions = _cached_plan(plan_text)
+    me = _my_rank() if rank is None else rank
+    attempt = _restart_count()
+    for i, a in enumerate(actions):
+        if a.rank != me or a.step != step:
+            continue
+        if a.restart is not None and a.restart != attempt:
+            continue
+        with _LOCK:
+            key = (i, attempt)
+            if key in _FIRED:
+                continue
+            _FIRED.add(key)
+        _fire(a)
+
+
+def _fire(action: FaultAction) -> None:
+    from horovod_tpu import metrics as _metrics
+    _metrics.counter("fault_injected_total", kind=action.kind).inc()
+    _metrics._timeline_marker("FAULT", category="fault",
+                              kind=action.kind, rank=action.rank,
+                              step=action.step,
+                              seconds=action.seconds)
+    logger.warning("horovod_tpu.faults: injecting %s", action.describe())
+    if action.kind == "kill":
+        # Flush what we can — the timeline shard stays salvageable and the
+        # survivors' merge shows where the victim went dark — then die the
+        # way a preempted TPU-VM dies: no atexit, no finally blocks.
+        try:
+            from horovod_tpu import timeline as _tl
+            t = _tl.get_timeline()
+            if t is not None:
+                t.flush()
+        except Exception:
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif action.kind == "stall":
+        time.sleep(action.seconds)
+    elif action.kind == "slow_write":
+        global _SLOW_WRITE
+        with _LOCK:
+            _SLOW_WRITE = max(_SLOW_WRITE, action.seconds)
+
+
+def slow_write_seconds() -> float:
+    """The armed per-shard-file write delay (consumed by the sharded
+    checkpoint writer thread; 0.0 = no fault armed)."""
+    with _LOCK:
+        return _SLOW_WRITE
+
+
+def reset() -> None:
+    """Clear fired-action memory and armed delays (tests)."""
+    global _SLOW_WRITE
+    with _LOCK:
+        _FIRED.clear()
+        _SLOW_WRITE = 0.0

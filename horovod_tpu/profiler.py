@@ -269,6 +269,22 @@ class ProgramRegistry:
             self._programs.clear()
             self._steps_total = 0
 
+    def reanchor(self) -> None:
+        """Forget every program's trace fingerprint, keeping its history
+        (compile/recompile counters, cost, timings).
+
+        Called by ``init()`` on elastic re-init — a re-mesh retraces
+        EVERY program by design (the mesh object changed), and a hot
+        spare adopting a dead rank's shard retraces from scratch; neither
+        is churn the doctor should blame. The next ``note_trace`` of each
+        program reads as a fresh ``compile``, so ``recompiles_total`` /
+        ``recompile_blame_total`` only ever count drift *within* a
+        communicator epoch."""
+        with self._lock:
+            for rec in self._programs.values():
+                rec.signature = None
+                rec.seen_signatures.clear()
+
     # -- fingerprinting -------------------------------------------------
 
     def note_trace(self, name: str, signature: Dict[str, str], *,
@@ -1195,6 +1211,65 @@ def _check_wire(snap) -> List[Dict]:
     return []
 
 
+def _check_recovery(snap) -> List[Dict]:
+    """Preemption-tolerance findings (docs/ELASTIC.md): report the
+    measured recovery time of the last elastic re-init / relaunch (from
+    the ``elastic_recovery_seconds`` gauge, anchored either at the
+    launcher's failure stamp or the driver's interrupt — the live
+    counterpart of the ``elastic_epoch`` trace anchors), and flag a
+    checkpoint cadence slower than the preemption-notice budget: a save
+    interval longer than the platform's warning window means a
+    preemption loses work no notice handler could have saved."""
+    out = []
+    budget = _gauge_value(snap, "config_preemption_notice_seconds")
+    if budget is None:
+        from horovod_tpu.config import get_config
+        budget = get_config().preemption_notice_seconds
+    rec_s = _gauge_value(snap, "elastic_recovery_seconds")
+    if rec_s:
+        restored = _gauge_value(snap, "checkpoint_restored_step")
+        adoptions = _sum_counter(snap, "elastic_spare_promoted_total")
+        sev = 0.15 if budget and rec_s <= 2 * budget else 0.55
+        out.append(_finding(
+            "recovery", sev,
+            f"elastic recovery took {rec_s:.1f}s",
+            f"the last membership change cost {rec_s:.1f}s from failure "
+            f"to restored state"
+            + (f" (resumed from published step {int(restored)})"
+               if restored is not None else "")
+            + (f"; {int(adoptions)} hot-spare promotion(s)"
+               if adoptions else ""),
+            "recovery = detection + relaunch/re-init + restore; shrink "
+            "detection with HOROVOD_STALL_CHECK_TIME_SECONDS, keep "
+            "restore cheap with sharded manifests "
+            "(ShardedCheckpointManager), and provision hot spares "
+            "(run_elastic(spares=N)) so the world never shrinks.",
+            recovery_seconds=rec_s))
+    # Min across kinds: per-step sharded publishes bound the durable-loss
+    # window even when a full orbax save also runs hourly (and vice
+    # versa) — the fastest flavor is the one a preemption falls back to.
+    intervals = [float(s.get("value", 0)) for s in
+                 _series(snap, "gauges", "checkpoint_interval_seconds")]
+    interval = min([v for v in intervals if v > 0], default=None)
+    if interval and budget and interval > budget:
+        out.append(_finding(
+            "checkpoint_cadence", 0.35 + min(0.4, 0.1 * interval / budget),
+            f"checkpoint cadence {interval:.0f}s exceeds the "
+            f"{budget:.0f}s preemption-notice budget",
+            f"the last two published checkpoints are {interval:.1f}s "
+            f"apart, but the platform only promises "
+            f"{budget:.0f}s of warning (HOROVOD_PREEMPTION_NOTICE) — a "
+            f"preemption in this window loses up to {interval:.0f}s of "
+            "training no notice handler could flush in time",
+            "checkpoint more often — the async sharded path "
+            "(ShardedCheckpointManager.save) costs one D2H copy of 1/n "
+            "of the optimizer state off the critical path, so per-step "
+            "cadence is affordable; or raise HOROVOD_PREEMPTION_NOTICE "
+            "if your platform genuinely warns earlier.",
+            interval_seconds=interval, budget_seconds=budget))
+    return out
+
+
 def _check_serving(snap) -> List[Dict]:
     out = []
     submitted = _sum_counter(snap, "serve_requests_total",
@@ -1268,6 +1343,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_straggler(report)
     findings += _check_recompiles(snap, progs)
     findings += _check_memory(snap)
+    findings += _check_recovery(snap)
     findings += _check_serving(snap)
     findings += _check_mfu(progs, snap)
     findings += _check_overlap(snap, report)

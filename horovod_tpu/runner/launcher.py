@@ -252,7 +252,8 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
                 state_dir: Optional[str] = None,
                 extra_env: Optional[Dict[str, str]] = None,
                 timeout: Optional[float] = None,
-                discovery=None, max_np: Optional[int] = None) -> int:
+                discovery=None, max_np: Optional[int] = None,
+                spares: int = 0) -> int:
     """Fault-tolerant multi-process launch (upstream
     ``horovod/runner/elastic/driver.py``).
 
@@ -276,6 +277,15 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
     for were never provisioned; elastic executors that may START below
     their provision cap pass ``max_np`` explicitly). Without it the world
     only shrinks (survivors).
+
+    ``spares``: hot-spare processes provisioned alongside the job
+    (``HVD_TPU_ELASTIC_SPARE=1``): each runs the same command, registers
+    with discovery, and idles in ``hvd.elastic.standby_if_spare()`` until
+    a worker dies — then it is *promoted* into the dead rank's slot so
+    the relaunched world keeps its size (instead of shrinking to the
+    survivors), adopting the dead rank's optimizer shard from the last
+    sharded-checkpoint manifest (docs/ELASTIC.md). The spare pool is not
+    replenished; once spent, further failures shrink the world as before.
     """
     import tempfile
     import time
@@ -289,80 +299,153 @@ def run_elastic(command: Sequence[str], np: int = 2, min_np: int = 1,
         timeout = float(os.environ["HOROVOD_ELASTIC_TIMEOUT"])
     if state_dir is None:
         state_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
+
+    def _spawn_spare(idx: int):
+        # Launcher-assigned identity token: the registering interpreter
+        # may be a grandchild of the Popen handle (command wrapped in a
+        # shell script), so the promote handshake cannot assume
+        # Popen.pid == os.getpid() of the process that calls standby().
+        token = f"spare-{os.getpid()}-{idx}"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HVD_TPU_ELASTIC_SPARE"] = "1"
+        env["HVD_TPU_ELASTIC_SPARE_ID"] = token
+        env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
+        if extra_env:
+            env.update(extra_env)
+        return subprocess.Popen(list(command), env=env), token
+
+    spare_pool = [_spawn_spare(i) for i in range(max(0, spares))]
     world = np
     restarts = 0
-    while True:
-        coordinator = f"127.0.0.1:{coordinator_port + restarts}"
-        procs = []
-        for pid in range(world):
-            env = build_worker_env(pid, world, coordinator,
-                                   base_env=dict(os.environ))
-            # Same platform policy as run(): multiple local workers cannot
-            # share one accelerator; a single survivor keeps the ambient.
-            if world > 1:
-                env["JAX_PLATFORMS"] = "cpu"
-            else:
-                env.setdefault("JAX_PLATFORMS", "cpu")
-            env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
-            env["HVD_TPU_ELASTIC_RESTART"] = str(restarts)
-            if extra_env:
-                env.update(extra_env)
-            procs.append(subprocess.Popen(list(command), env=env))
+    promoted: list = []   # [(Popen, rank)] carried into the next attempt
+    failed_at: Optional[float] = None
+    try:
+        while True:
+            coordinator = f"127.0.0.1:{coordinator_port + restarts}"
+            procs = []
+            taken = {r for _, r in promoted}
+            fresh_ranks = [r for r in range(world) if r not in taken]
+            for pid in fresh_ranks:
+                env = build_worker_env(pid, world, coordinator,
+                                       base_env=dict(os.environ))
+                # Same platform policy as run(): multiple local workers
+                # cannot share one accelerator; a single survivor keeps
+                # the ambient.
+                if world > 1:
+                    env["JAX_PLATFORMS"] = "cpu"
+                else:
+                    env.setdefault("JAX_PLATFORMS", "cpu")
+                env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
+                env["HVD_TPU_ELASTIC_RESTART"] = str(restarts)
+                if failed_at is not None:
+                    # Recovery-time anchor: workers (and the doctor)
+                    # measure death -> restored from this stamp.
+                    env["HVD_TPU_ELASTIC_FAILED_AT"] = str(failed_at)
+                if extra_env:
+                    env.update(extra_env)
+                procs.append(subprocess.Popen(list(command), env=env))
+            procs.extend(p for p, _ in promoted)
+            promoted = []
 
-        failed = 0
-        deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(procs)
-        while pending and not failed:
-            for p in list(pending):
-                code = p.poll()
-                if code is None:
-                    continue
-                pending.remove(p)
-                if code:
-                    failed += 1
-            if pending and deadline is not None and \
-                    time.monotonic() > deadline:
-                for p in procs:
-                    if p.poll() is None:
-                        p.kill()
-                raise TimeoutError(
-                    f"elastic workers still running after {timeout}s")
-            time.sleep(0.05)
+            failed = 0
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            pending = list(procs)
+            while pending and not failed:
+                for p in list(pending):
+                    code = p.poll()
+                    if code is None:
+                        continue
+                    pending.remove(p)
+                    if code:
+                        failed += 1
+                # A spare dying is capacity loss, not job failure.
+                for entry in list(spare_pool):
+                    if entry[0].poll() is not None:
+                        spare_pool.remove(entry)
+                        logger.warning("elastic: spare %s exited "
+                                       "(%d spare(s) left)", entry[1],
+                                       len(spare_pool))
+                if pending and deadline is not None and \
+                        time.monotonic() > deadline:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    raise TimeoutError(
+                        f"elastic workers still running after {timeout}s")
+                time.sleep(0.05)
 
-        if not failed:
-            return restarts
+            if not failed:
+                return restarts
+            failed_at = time.time()
 
-        # A worker died: tear the job down (survivors are blocked on the
-        # dead rank's collectives) and relaunch over the remaining world.
-        for p in procs:
+            # A worker died: tear the job down (survivors are blocked on
+            # the dead rank's collectives) and relaunch over the remaining
+            # world.
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            # Only organically-failed workers (nonzero exit before
+            # teardown) count as lost hosts; survivors we terminated
+            # relaunch.
+            world = world - failed
+            if discovery is not None:
+                # Upstream's host-discovery hook (--host-discovery-script
+                # / elastic driver polling): consult it between attempts
+                # so recovered capacity scales the job back UP, capped at
+                # the provision limit (max_np, defaulting to the original
+                # np).
+                try:
+                    world = max(world, min(int(discovery()), max_np or np))
+                except Exception as e:
+                    logger.warning("elastic discovery hook failed (%s); "
+                                   "continuing with world=%d", e, world)
+            restarts += 1
+            # Hot-spare promotion: refill lost slots from the standby
+            # pool so the relaunched world keeps its size; the promoted
+            # spare joins the new rendezvous in the dead rank's slot and
+            # adopts its shard from the last manifest (docs/ELASTIC.md).
+            if spare_pool and world < (max_np or np):
+                from horovod_tpu.elastic import driver as _edriver
+                # Promote only spares that are ALIVE and have actually
+                # reached standby() (registration heartbeat fresh): a
+                # dead spare would burn a restart on an instant failure,
+                # and a wedged one that never registered would leave the
+                # relaunched rendezvous waiting for a rank that never
+                # joins until the elastic timeout.
+                registered = set(_edriver.list_spares(state_dir))
+                ready = [e for e in spare_pool
+                         if e[0].poll() is None and e[1] in registered]
+                n_promote = min(len(ready), (max_np or np) - world)
+                next_world = world + n_promote
+                next_coord = f"127.0.0.1:{coordinator_port + restarts}"
+                for i in range(n_promote):
+                    p, token = ready[i]
+                    spare_pool.remove(ready[i])
+                    rank = world + i   # highest ranks of the new world
+                    _edriver.promote_spare(
+                        state_dir, token, rank=rank,
+                        world=next_world, coordinator=next_coord,
+                        restart=restarts, failed_at=failed_at)
+                    promoted.append((p, rank))
+                world = next_world
+            if world < min_np:
+                raise RuntimeError(
+                    f"elastic job below min_np: {world} < {min_np} after "
+                    f"{restarts} restart(s)")
+            if restarts > max_restarts:
+                raise RuntimeError(
+                    f"elastic job exceeded max_restarts={max_restarts}")
+    finally:
+        for p in [e[0] for e in spare_pool] + [p for p, _ in promoted]:
             if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
                 p.kill()
-        # Only organically-failed workers (nonzero exit before teardown)
-        # count as lost hosts; survivors we terminated relaunch.
-        world = world - failed
-        if discovery is not None:
-            # Upstream's host-discovery hook (--host-discovery-script /
-            # elastic driver polling): consult it between attempts so
-            # recovered capacity scales the job back UP, capped at the
-            # provision limit (max_np, defaulting to the original np).
-            try:
-                world = max(world, min(int(discovery()), max_np or np))
-            except Exception as e:
-                logger.warning("elastic discovery hook failed (%s); "
-                               "continuing with world=%d", e, world)
-        restarts += 1
-        if world < min_np:
-            raise RuntimeError(
-                f"elastic job below min_np: {world} < {min_np} after "
-                f"{restarts} restart(s)")
-        if restarts > max_restarts:
-            raise RuntimeError(
-                f"elastic job exceeded max_restarts={max_restarts}")
 
 
 _FUNC_WORKER = """\

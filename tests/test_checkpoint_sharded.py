@@ -1,0 +1,797 @@
+"""Preemption-tolerant training: async sharded checkpoints (two-phase
+manifest commit, N->M reshard), fault-injection plans, hot-spare
+adoption, and the deterministic-resume matrix (ISSUE 7)."""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint_sharded as cs
+from horovod_tpu import faults
+from horovod_tpu.elastic import JaxState
+
+N = 8
+D = 24          # flat model size (w: D, b: scalar)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _restore_world():
+    yield
+    faults.reset()
+    os.environ.pop("HOROVOD_FAULT_PLAN", None)
+    os.environ.pop("HVD_TPU_ELASTIC_FAILED_AT", None)
+    from horovod_tpu import config
+    config.refresh()
+    hvd.init()   # restore the full 8-device mesh after each test
+
+
+def _params():
+    rng = np.random.default_rng(7)
+    return {"b": jnp.zeros((), jnp.float32),
+            "w": jnp.asarray(rng.standard_normal(D).astype(np.float32))}
+
+
+def _data(step):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((16, D)).astype(np.float32)
+    y = rng.standard_normal((16,)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(p, x, y):
+    pred = x @ p["w"] + p["b"]
+    return jnp.mean(jnp.square(pred - y))
+
+
+def _make_step(opt):
+    """One spmd training step. The batch is replicated (every device
+    computes the full-batch gradient) so the global math is identical at
+    any world size; the optimizer state is genuinely 1/n-sharded."""
+
+    def step(params, opt_state, x, y):
+        loss, g = jax.value_and_grad(_loss_fn)(params, x, y)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return hvd.spmd(step, in_specs=(P(), P("hvd"), P(), P()),
+                    out_specs=(P(), P("hvd"), P()))
+
+
+def _train(opt, params, opt_state, first, last, mgr=None):
+    """Steps ``first..last`` inclusive; returns (params, opt_state,
+    losses). With a manager, saves every step asynchronously (shards +
+    replicated params + step meta)."""
+    fn = _make_step(opt)
+    losses = []
+    for s in range(first, last + 1):
+        x, y = _data(s)
+        params, opt_state, loss = fn(params, opt_state, x, y)
+        losses.append(float(loss))
+        if mgr is not None:
+            packed, unpadded, _ = cs.pack_opt_state(opt_state,
+                                                    unpadded_len=D + 1)
+            mgr.save(s, shards=packed, replicated={"params": params},
+                     meta={"step": s}, unpadded=unpadded)
+    if mgr is not None:
+        mgr.wait()
+    return params, opt_state, losses
+
+
+def _restore_training(mgr, step=None, num_shards=None):
+    r = mgr.restore(step=step, num_shards=num_shards)
+    params = cs._unflatten_like({"params": _params()},
+                                r.replicated)["params"]
+    opt_state = cs.unpack_opt_state(
+        {"step": r.shards["['step']"], "mu": r.shards["['mu']"],
+         "nu": r.shards["['nu']"]})
+    return r.step, params, opt_state, r.meta
+
+
+class TestManager:
+    def test_save_restore_roundtrip_bits(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        opt = hvd.sharded_adamw(5e-2)
+        params = _params()
+        opt_state = opt.init(params)
+        params, opt_state, _ = _train(opt, params, opt_state, 1, 2, m)
+        step, p2, s2, meta = _restore_training(m)
+        assert step == 2 and meta["step"] == 2
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(params[k]),
+                                          np.asarray(p2[k]))
+        np.testing.assert_array_equal(np.asarray(opt_state.mu),
+                                      np.asarray(s2.mu))
+        np.testing.assert_array_equal(np.asarray(opt_state.nu),
+                                      np.asarray(s2.nu))
+        np.testing.assert_array_equal(np.asarray(opt_state.step),
+                                      np.asarray(s2.step))
+        m.close()
+
+    def test_latest_and_prune(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"), max_to_keep=2)
+        for s in (1, 2, 3):
+            m.save(s, shards={"v": jnp.full((N, 2), float(s))}, wait=True)
+        assert m.all_steps() == [2, 3]
+        assert m.latest_step() == 3
+        # pruned step is gone from disk, not just the index
+        assert not os.path.isdir(str(tmp_path / "c" / "step-00000001"))
+        m.close()
+
+    def test_async_save_publishes_on_wait(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(5, shards={"v": jnp.ones((N, 3))}, meta={"rng": [1, 2]})
+        m.wait()
+        assert m.latest_step() == 5
+        r = m.restore()
+        assert r.meta["rng"] == [1, 2]
+        m.close()
+
+    def test_torn_manifest_fails_loudly(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(4, shards={"v": jnp.ones((N, 3))}, wait=True)
+        # Simulate dying between phase 1 and phase 2 for step 9: shard
+        # files exist, manifest never published.
+        os.makedirs(str(tmp_path / "c" / "step-00000009"))
+        with open(str(tmp_path / "c" / "step-00000009" /
+                      "shard-00000-of-00008.npz"), "wb") as f:
+            f.write(b"partial")
+        # the torn step is invisible to latest_step ...
+        assert m.latest_step() == 4
+        # ... and an explicit restore of it refuses, loudly
+        with pytest.raises(cs.TornCheckpointError, match="torn"):
+            m.restore(step=9)
+        m.close()
+
+    def test_missing_shard_fails_loudly(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(3, shards={"v": jnp.ones((N, 3))}, wait=True)
+        victim = str(tmp_path / "c" / "step-00000003" /
+                     "shard-00004-of-00008.npz")
+        os.remove(victim)
+        with pytest.raises(FileNotFoundError, match="shard-00004"):
+            m.restore(step=3)
+        m.close()
+
+    def test_template_mismatch_raises(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(1, replicated={"params": {"w": jnp.ones(3)}}, wait=True)
+        with pytest.raises(KeyError, match="does not match"):
+            m.restore(step=1,
+                      replicated_template={"params": {"v": jnp.ones(3)}})
+        m.close()
+
+    def test_reshard_preserves_values(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        flat = np.arange(N * 5, dtype=np.float32)
+        m.save(1, shards={"mu": jnp.asarray(flat.reshape(N, 5)),
+                          "step": jnp.full((N,), 12, jnp.int32)},
+               unpadded={"['mu']": 37}, wait=True)
+        r = m.restore(step=1, num_shards=4)
+        mu4 = r.shards["['mu']"]
+        assert mu4.shape == (4, 10)   # ceil(37/4) = 10
+        np.testing.assert_array_equal(mu4.reshape(-1)[:37], flat[:37])
+        np.testing.assert_array_equal(mu4.reshape(-1)[37:], 0)
+        np.testing.assert_array_equal(r.shards["['step']"],
+                                      np.full((4,), 12))
+        # growing back: 4-shard file set restores at 8 again
+        r8 = m.restore(step=1, num_shards=8)
+        np.testing.assert_array_equal(r8.shards["['mu']"].reshape(-1),
+                                      flat)
+        m.close()
+
+    def test_empty_shards_tree_saves_replicated_only(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(1, shards={}, replicated={"x": jnp.ones(3)}, wait=True)
+        r = m.restore()
+        assert r.shards == {} and "['x']" in r.replicated
+        m.close()
+
+    def test_bad_shard_leaves_rejected(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        with pytest.raises(ValueError, match="scalar"):
+            m.save(1, shards={"v": jnp.asarray(1.0)})
+        with pytest.raises(ValueError, match="leading dim"):
+            m.save(1, shards={"a": jnp.ones((N, 2)),
+                              "b": jnp.ones((N + 1, 2))})
+        m.close()
+
+    def test_receipts_are_attempt_salted(self, tmp_path):
+        """A torn save of the SAME step by a previous incarnation of the
+        job must not satisfy the publish barrier: receipts carry the
+        elastic attempt, the publisher only counts its own attempt's,
+        and a rank overwriting its shard clears its stale receipts."""
+        d = str(tmp_path / "c")
+        stale_dir = os.path.join(d, "step-00000002")
+        os.makedirs(stale_dir)
+        stale = os.path.join(stale_dir, "rank-00000-of-00001.a0.ok")
+        with open(stale, "w") as f:
+            json.dump({"rank": 0, "num_ranks": 1, "attempt": 0,
+                       "files": {}, "leaves": {},
+                       "wall_time": 0.0}, f)
+        os.environ["HVD_TPU_ELASTIC_RESTART"] = "1"
+        try:
+            m = cs.ShardedCheckpointManager(d)
+            m.save(2, shards={"v": jnp.ones((N, 2))}, wait=True)
+        finally:
+            os.environ.pop("HVD_TPU_ELASTIC_RESTART")
+        names = os.listdir(stale_dir)
+        assert "rank-00000-of-00001.a1.ok" in names
+        assert "rank-00000-of-00001.a0.ok" not in names   # hygiene
+        assert m.latest_step() == 2
+        m.close()
+
+    def test_one_shot_full_saves_record_cadence(self, tmp_path):
+        """save_checkpoint() builds a throwaway manager per call; the
+        cadence gauge must still see consecutive one-shot saves — that
+        hourly-full-save pattern is exactly what the doctor's
+        preemption-notice check exists to catch."""
+        from horovod_tpu.checkpoint import save_checkpoint
+        hvd.reset_metrics()
+        d = str(tmp_path / "full")
+        save_checkpoint(d, {"x": jnp.asarray(1.0)}, step=1)
+        time.sleep(0.05)
+        save_checkpoint(d, {"x": jnp.asarray(2.0)}, step=2)
+        snap = hvd.metrics()
+        series = {g["labels"].get("kind"): g["value"]
+                  for g in snap["gauges"]["checkpoint_interval_seconds"]}
+        assert series.get("full", 0) > 0
+
+    def test_recovery_stamp_consumed_once(self, tmp_path):
+        """Only the FIRST restore after a relaunch is the recovery: a
+        later eval/rollback restore must not overwrite the measurement
+        with time-since-the-original-failure."""
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(1, shards={"v": jnp.ones((N, 2))}, wait=True)
+        hvd.reset_metrics()
+        os.environ["HVD_TPU_ELASTIC_FAILED_AT"] = str(time.time() - 2.0)
+        m.restore()
+        assert "HVD_TPU_ELASTIC_FAILED_AT" not in os.environ
+        snap = hvd.metrics()
+        first = snap["gauges"]["elastic_recovery_seconds"][0]["value"]
+        assert 1.5 <= first <= 30.0
+        time.sleep(0.05)
+        m.restore()   # an hour later, figuratively
+        snap = hvd.metrics()
+        assert snap["gauges"]["elastic_recovery_seconds"][0][
+            "value"] == first
+        m.close()
+
+    def test_metrics_and_interval(self, tmp_path):
+        hvd.reset_metrics()
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        m.save(1, shards={"v": jnp.ones((N, 3))}, wait=True)
+        m.save(2, shards={"v": jnp.ones((N, 3))}, wait=True)
+        m.restore()
+        snap = hvd.metrics()
+        kinds = {c["labels"]["kind"]: c["value"]
+                 for c in snap["counters"]["checkpoint_bytes_total"]}
+        assert kinds["shard"] > 0
+        hists = snap["histograms"]
+        assert hists["checkpoint_save_seconds"][0]["count"] == 2
+        assert hists["checkpoint_restore_seconds"][0]["count"] == 1
+        gauges = {g["labels"].get("kind", ""): g["value"]
+                  for g in snap["gauges"]["checkpoint_last_step"]}
+        assert gauges["shard"] == 2
+        assert snap["gauges"]["checkpoint_interval_seconds"][0]["value"] > 0
+        m.close()
+
+
+class TestAdapters:
+    def test_pack_unpack_roundtrip(self):
+        opt = hvd.sharded_adamw(1e-2)
+        params = _params()
+        st = opt.init(params)
+        packed, unpadded, info = cs.pack_opt_state(st, unpadded_len=D + 1)
+        assert not info["error_feedback"]
+        assert unpadded == {"['mu']": D + 1, "['nu']": D + 1}
+        back = cs.unpack_opt_state(
+            {k: np.asarray(v) for k, v in packed.items()})
+        np.testing.assert_array_equal(np.asarray(st.mu),
+                                      np.asarray(back.mu))
+        assert back.step.dtype == jnp.int32
+
+    def test_error_feedback_stripped_and_rebuilt_zero(self):
+        """A restored/adopted rank must NOT inherit quantized-wire
+        error-feedback residuals — they are the dead rank's local error
+        from the previous communicator epoch (PR 6 contract)."""
+        from horovod_tpu.optimizer import ErrorFeedbackState
+        opt = hvd.sharded_adamw(1e-2)
+        params = _params()
+        inner = opt.init(params)
+        residual = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 0.25), params)
+        ef = ErrorFeedbackState(inner, residual)
+        packed, _, info = cs.pack_opt_state(ef)
+        assert info["error_feedback"]
+        assert set(packed) == {"step", "mu", "nu"}   # residuals not packed
+        back = cs.unpack_opt_state(packed, params=params,
+                                   error_feedback=True)
+        assert isinstance(back, ErrorFeedbackState)
+        for leaf in jax.tree_util.tree_leaves(back.residual):
+            np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+    def test_unpack_ef_without_params_raises(self):
+        opt = hvd.sharded_adamw(1e-2)
+        packed, _, _ = cs.pack_opt_state(opt.init(_params()))
+        with pytest.raises(ValueError, match="params"):
+            cs.unpack_opt_state(packed, error_feedback=True)
+
+
+class TestDeterministicResume:
+    """The ISSUE acceptance matrix: save at step k, 'die', restore on the
+    same / a shrunk world — losses must bit-match a run that never
+    died."""
+
+    K, T = 3, 6
+
+    def _fresh(self):
+        opt = hvd.sharded_adamw(5e-2, weight_decay=0.01)
+        params = _params()
+        return opt, params, opt.init(params)
+
+    def test_same_world_resume_bit_exact_sharded(self, tmp_path):
+        opt, params, opt_state = self._fresh()
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"),
+                                          max_to_keep=self.T)
+        _, _, golden = _train(opt, params, opt_state, 1, self.T, mgr)
+        # "kill": discard live state, restore step K from the manifest.
+        step, p2, s2, _ = _restore_training(mgr, step=self.K)
+        assert step == self.K
+        _, _, resumed = _train(opt, p2, s2, self.K + 1, self.T)
+        assert resumed == golden[self.K:], (resumed, golden[self.K:])
+        mgr.close()
+
+    def test_shrunk_world_resume_bit_exact_sharded(self, tmp_path):
+        """Restore a world-8 checkpoint on 4 survivors. Reference: the
+        same run re-meshed in memory at step K (elastic commit/restore
+        semantics) — the disk round-trip must add ZERO numerical drift
+        on top of the re-mesh itself, and the 4-survivor set adopts the
+        dead ranks' shards from the manifest."""
+        opt, params, opt_state = self._fresh()
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"),
+                                          max_to_keep=self.T)
+        params, opt_state, _ = _train(opt, params, opt_state, 1, self.K,
+                                      mgr)
+        # ---- reference: in-memory remesh to 4 devices at step K
+        state_np = jax.tree_util.tree_map(np.asarray, opt_state)
+        params_np = jax.tree_util.tree_map(np.asarray, params)
+        hvd.init(devices=jax.devices()[:4])
+        ref_state = cs.reshard_opt_state(state_np, 4, unpadded_len=D + 1)
+        ref_params = jax.tree_util.tree_map(jnp.asarray, params_np)
+        _, _, ref_losses = _train(opt, ref_params, ref_state,
+                                  self.K + 1, self.T)
+        # ---- resumed: restore the manifest on the shrunk world
+        hvd.init()   # back to 8 so the fixture state is clean
+        hvd.init(devices=jax.devices()[:4])
+        step, p2, s2, _ = _restore_training(mgr, step=self.K,
+                                            num_shards=4)
+        assert np.asarray(s2.mu).shape == np.asarray(ref_state.mu).shape
+        _, _, resumed = _train(opt, p2, s2, self.K + 1, self.T)
+        assert resumed == ref_losses, (resumed, ref_losses)
+        mgr.close()
+
+    def test_same_world_resume_bit_exact_plain_adamw(self, tmp_path):
+        """Plain (replicated) AdamW rides the rank-0 replicated file:
+        the whole optax state round-trips through the manifest."""
+        opt = optax.adamw(5e-2, weight_decay=0.01)
+        params = _params()
+        opt_state = opt.init(params)
+
+        def step_fn(params, opt_state, x, y):
+            loss, g = jax.value_and_grad(_loss_fn)(params, x, y)
+            updates, opt_state = opt.update(g, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"),
+                                          max_to_keep=self.T)
+        golden, p, s = [], params, opt_state
+        for i in range(1, self.T + 1):
+            x, y = _data(i)
+            p, s, loss = step_fn(p, s, x, y)
+            golden.append(float(loss))
+            mgr.save(i, replicated={"params": p, "opt_state": s},
+                     meta={"step": i})
+        mgr.wait()
+        r = mgr.restore(step=self.K,
+                        replicated_template={"params": params,
+                                             "opt_state": opt_state})
+        p2, s2 = r.replicated["params"], r.replicated["opt_state"]
+        resumed = []
+        for i in range(self.K + 1, self.T + 1):
+            x, y = _data(i)
+            p2, s2, loss = step_fn(p2, s2, x, y)
+            resumed.append(float(loss))
+        assert resumed == golden[self.K:]
+        mgr.close()
+
+
+class TestFaultPlan:
+    def test_grammar_roundtrip(self):
+        plan = faults.parse_plan(
+            "kill@rank=1,step=5;stall@rank=0,step=7,seconds=2.5;"
+            "slow_write@rank=2,step=3,seconds=0.5,restart=*")
+        assert [a.kind for a in plan] == ["kill", "stall", "slow_write"]
+        assert plan[0].restart == 0 and plan[2].restart is None
+        assert plan[1].seconds == 2.5
+        assert faults.parse_plan("") == []
+
+    @pytest.mark.parametrize("bad", [
+        "boom@rank=0,step=1",              # unknown kind
+        "kill@rank=0",                      # missing step
+        "kill@step=1",                      # missing rank
+        "kill rank=0 step=1",               # no @
+        "kill@rank=0,step=1,volume=11",     # unknown field
+        "kill@rank=x,step=1",               # non-integer
+        "kill@rank=-1,step=1",              # negative
+        "kill@rank=1,step=5,restart=-1",    # unreachable attempt
+    ])
+    def test_grammar_rejects(self, bad):
+        with pytest.raises(ValueError, match="HOROVOD_FAULT_PLAN"):
+            faults.parse_plan(bad)
+
+    def test_config_validates_plan(self):
+        from horovod_tpu import config
+        os.environ["HOROVOD_FAULT_PLAN"] = "kill@rank=0"
+        with pytest.raises(ValueError):
+            config.refresh()
+        os.environ.pop("HOROVOD_FAULT_PLAN")
+        config.refresh()
+
+    def test_stall_fires_once_and_counts(self):
+        from horovod_tpu import config
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "stall@rank=0,step=2,seconds=0.2"
+        config.refresh()
+        hvd.reset_metrics()
+        t0 = time.perf_counter()
+        faults.fault_point(1)
+        assert time.perf_counter() - t0 < 0.15
+        t0 = time.perf_counter()
+        faults.fault_point(2)
+        assert time.perf_counter() - t0 >= 0.2
+        t0 = time.perf_counter()
+        faults.fault_point(2)   # already fired this attempt
+        assert time.perf_counter() - t0 < 0.15
+        snap = hvd.metrics()
+        stalls = [c for c in snap["counters"]["fault_injected_total"]
+                  if c["labels"]["kind"] == "stall"]
+        assert stalls and stalls[0]["value"] == 1
+
+    def test_restart_gating(self):
+        from horovod_tpu import config
+        os.environ["HOROVOD_FAULT_PLAN"] = "stall@rank=0,step=1,seconds=5"
+        os.environ["HVD_TPU_ELASTIC_RESTART"] = "1"
+        try:
+            config.refresh()
+            t0 = time.perf_counter()
+            faults.fault_point(1)   # restart=0 action must NOT fire
+            assert time.perf_counter() - t0 < 0.5
+        finally:
+            os.environ.pop("HVD_TPU_ELASTIC_RESTART")
+
+    def test_slow_write_delays_checkpoint(self, tmp_path):
+        from horovod_tpu import config
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "slow_write@rank=0,step=1,seconds=0.15"
+        config.refresh()
+        faults.fault_point(1)
+        assert faults.slow_write_seconds() == 0.15
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        t0 = time.perf_counter()
+        m.save(1, shards={"v": jnp.ones((N, 2))}, wait=True)
+        # 8 shard files x 0.15s injected delay each
+        assert time.perf_counter() - t0 >= 8 * 0.15
+        assert m.latest_step() == 1   # slow, but never torn
+        m.close()
+
+
+class TestHotSpareAdoption:
+    def test_adopt_state_resumes_commit_and_zeroes_residuals(self,
+                                                             tmp_path):
+        """The satellite regression: an adopted rank inherits the dead
+        rank's shard and data cursor but NOT its error-feedback residuals
+        or recompile blame."""
+        from horovod_tpu.optimizer import ErrorFeedbackState
+        opt = hvd.sharded_adamw(1e-2)
+        params = _params()
+        inner = opt.init(params)
+        residual = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 0.5), params)
+        st = JaxState(params=params,
+                      opt_state=ErrorFeedbackState(inner, residual),
+                      epoch=1, data_cursor=42)
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        cs.save_state(mgr, 5, st, wait=True)
+        # the spare: fresh state object (new process semantics), stale
+        # values everywhere
+        spare = JaxState(params=jax.tree_util.tree_map(jnp.zeros_like,
+                                                       params),
+                         opt_state=ErrorFeedbackState(
+                             opt.init(params),
+                             jax.tree_util.tree_map(
+                                 lambda x: jnp.full_like(x, 9.0), params)),
+                         epoch=0, data_cursor=0)
+        step = cs.adopt_state(mgr, spare)
+        assert step == 5
+        assert spare.epoch == 1 and spare.data_cursor == 42
+        np.testing.assert_array_equal(np.asarray(spare.params["w"]),
+                                      np.asarray(params["w"]))
+        assert isinstance(spare.opt_state, ErrorFeedbackState)
+        for leaf in jax.tree_util.tree_leaves(spare.opt_state.residual):
+            np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+        np.testing.assert_array_equal(np.asarray(spare.opt_state.inner.mu),
+                                      np.asarray(inner.mu))
+        mgr.close()
+
+    def test_adoption_across_world_shrink_matches_fresh_init(self,
+                                                             tmp_path):
+        """The @hvd.elastic.run bridge (save_state/adopt_state) must
+        reshard to EXACTLY the widths sharded_adamw(...).init would
+        produce at the new world — old-world padding must not survive as
+        data (the unpadded length is inferred from the state's own
+        pytrees)."""
+        opt = hvd.sharded_adamw(5e-2)
+        params = _params()          # flat len D+1 = 25
+        st = JaxState(params=params, opt_state=opt.init(params), step=0)
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        cs.save_state(mgr, 2, st, wait=True)
+        hvd.init(devices=jax.devices()[:4])
+        step = cs.adopt_state(mgr, st)
+        assert step == 2
+        want = opt.init(params)      # world-4 geometry
+        assert np.asarray(st.opt_state.mu).shape == \
+            np.asarray(want.mu).shape    # 4 * ceil(25/4) = 28, not 32
+        assert np.asarray(st.opt_state.step).shape == (4,)
+        # and a real training step runs at the new world
+        fn = _make_step(opt)
+        x, y = _data(1)
+        st.params, st.opt_state, loss = fn(st.params, st.opt_state, x, y)
+        assert np.isfinite(float(loss))
+        mgr.close()
+
+    def test_elastic_run_without_published_manifest_still_recovers(self):
+        """checkpoint= must never make elastic recovery WORSE: with no
+        manifest published yet, the re-init path falls back to the
+        in-memory commit (resharded) instead of crashing."""
+        import tempfile
+
+        from horovod_tpu.elastic import run, HostsUpdatedInterrupt
+        from horovod_tpu.elastic.discovery import DeviceDiscovery
+        all_devices = jax.devices()
+        current = {"devs": all_devices}
+        disco = DeviceDiscovery(probe=lambda: current["devs"])
+        opt = hvd.sharded_adamw(5e-2)
+        params = _params()
+        state = JaxState(params=params, opt_state=opt.init(params), step=0)
+        mgr = cs.ShardedCheckpointManager(
+            tempfile.mkdtemp(prefix="hvd_empty_ckpt_"))   # never saved to
+
+        @run
+        def train(state):
+            fn = _make_step(opt)
+            while state.step < 4:
+                x, y = _data(state.step + 1)
+                state.params, state.opt_state, _ = fn(
+                    state.params, state.opt_state, x, y)
+                state.step += 1
+                state.commit()
+                if state.step == 2 and len(current["devs"]) == 8:
+                    current["devs"] = all_devices[:4]
+                    raise HostsUpdatedInterrupt("simulated preemption")
+            return state.step
+
+        assert train(state, discovery=disco, checkpoint=mgr) == 4
+        assert hvd.size() == 4
+        mgr.close()
+
+    def test_adoption_with_custom_pytree_names(self, tmp_path):
+        """Pytree names are user-chosen kwargs — adoption must rebuild
+        the zero residual from the state's own wrapper, not a tree that
+        happens to be called 'params'."""
+        from horovod_tpu.optimizer import ErrorFeedbackState
+        opt = hvd.sharded_adamw(1e-2)
+        weights = _params()
+        residual = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, 0.5), weights)
+        st = JaxState(model=weights,
+                      opt_state=ErrorFeedbackState(opt.init(weights),
+                                                   residual),
+                      epoch=2)
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        cs.save_state(mgr, 4, st, wait=True)
+        st.model = jax.tree_util.tree_map(jnp.zeros_like, weights)
+        st.commit()   # make the manifest the newer source
+        st._saved_attrs["epoch"] = 0
+        object.__setattr__(st, "commit_count", 0)
+        step = cs.adopt_state(mgr, st)
+        assert step == 4 and st.epoch == 2
+        np.testing.assert_array_equal(np.asarray(st.model["w"]),
+                                      np.asarray(weights["w"]))
+        assert isinstance(st.opt_state, ErrorFeedbackState)
+        for leaf in jax.tree_util.tree_leaves(st.opt_state.residual):
+            np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+        mgr.close()
+
+    def test_adopt_keeps_newer_in_memory_commit(self, tmp_path):
+        """An in-process survivor whose commits OUTRAN the save cadence
+        must not be rolled back to an older manifest — adoption keeps the
+        newer in-memory commit and only reshards it."""
+        opt = hvd.sharded_adamw(5e-2)
+        params = _params()
+        st = JaxState(params=params, opt_state=opt.init(params), step=0)
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        cs.save_state(mgr, 1, st, wait=True)      # manifest @ commit 1
+        fn = _make_step(opt)
+        x, y = _data(1)
+        st.params, st.opt_state, _ = fn(st.params, st.opt_state, x, y)
+        st.step = 1
+        st.commit()                               # newer, never saved
+        newer_w = np.asarray(st.params["w"]).copy()
+        st.params = jax.tree_util.tree_map(jnp.zeros_like, st.params)
+        cs.adopt_state(mgr, st)
+        np.testing.assert_array_equal(np.asarray(st.params["w"]), newer_w)
+        assert st.step == 1
+        assert int(np.asarray(st.opt_state.step)[0]) == 1
+        mgr.close()
+
+    def test_init_refuses_unpromoted_spare(self):
+        """A spare that skipped the standby barrier must not rendezvous
+        as a rogue world-of-1 job next to the real one."""
+        os.environ["HVD_TPU_ELASTIC_SPARE"] = "1"
+        try:
+            with pytest.raises(RuntimeError, match="hot spare"):
+                hvd.init()
+        finally:
+            os.environ.pop("HVD_TPU_ELASTIC_SPARE")
+        hvd.init()
+
+    def test_reinit_reanchors_recompile_fingerprints(self):
+        """Elastic re-init (and hence hot-spare adoption, which rides the
+        same init path) must not blame the mandatory retrace as recompile
+        churn."""
+        from horovod_tpu import profiler
+        hvd.reset_metrics()
+        profiler.registry.note_trace("adopt_prog", {"x": "f32[2]"})
+        hvd.init()   # elastic re-init
+        status, blamed = profiler.registry.note_trace(
+            "adopt_prog", {"x": "f32[4]"})
+        assert status == "compile" and blamed == []
+        snap = hvd.metrics()
+        assert not [c for c in snap["counters"].get("recompiles_total", [])
+                    if c["labels"]["program"] == "adopt_prog"]
+
+    def test_elastic_run_with_checkpoint_adopts_on_reinit(self, tmp_path):
+        """@hvd.elastic.run(checkpoint=mgr): on a membership change the
+        re-init path adopts the last manifest under the new mesh and
+        records the recovery time."""
+        from horovod_tpu.elastic import run, HostsUpdatedInterrupt
+        from horovod_tpu.elastic.discovery import DeviceDiscovery
+        all_devices = jax.devices()
+        current = {"devs": all_devices}
+        disco = DeviceDiscovery(probe=lambda: current["devs"])
+        opt = hvd.sharded_adamw(5e-2)
+        params = _params()
+        state = JaxState(params=params, opt_state=opt.init(params), step=0)
+        mgr = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        hvd.reset_metrics()
+        events = []
+
+        @run
+        def train(state):
+            fn = _make_step(opt)
+            while state.step < 5:
+                x, y = _data(state.step + 1)
+                state.params, state.opt_state, loss = fn(
+                    state.params, state.opt_state, x, y)
+                state.step += 1
+                state.commit()
+                cs.save_state(mgr, state.step, state, wait=True)
+                events.append((state.step, hvd.size()))
+                if state.step == 3 and len(current["devs"]) == 8:
+                    current["devs"] = all_devices[:4]
+                    raise HostsUpdatedInterrupt("simulated preemption")
+            return float(np.asarray(state.params["w"])[0])
+
+        train(state, discovery=disco, checkpoint=mgr)
+        # steps 1..3 at world 8, adoption, steps 4..5 at world 4
+        assert events[:3] == [(1, 8), (2, 8), (3, 8)]
+        assert events[3:] == [(4, 4), (5, 4)]
+        assert int(np.asarray(state.opt_state.step)[0]) == 5
+        snap = hvd.metrics()
+        assert snap["gauges"]["elastic_recovery_seconds"][0]["value"] > 0
+        assert snap["counters"]["elastic_shard_adoption_total"][0][
+            "value"] == 1
+        mgr.close()
+
+
+class TestDoctorRecovery:
+    def _snap(self, **gauges):
+        return {"counters": {}, "histograms": {},
+                "gauges": {name: [{"labels": {}, "value": v}]
+                           for name, v in gauges.items()}}
+
+    def test_reports_recovery_time(self):
+        from horovod_tpu.profiler import doctor
+        rep = doctor(snapshot=self._snap(
+            elastic_recovery_seconds=4.2, checkpoint_restored_step=17,
+            config_preemption_notice_seconds=30.0), programs={})
+        rec = [f for f in rep["findings"] if f["category"] == "recovery"]
+        assert rec and "4.2s" in rec[0]["title"]
+        assert "step 17" in rec[0]["detail"]
+        assert rec[0]["severity"] < 0.5   # within 2x budget: informational
+
+    def test_slow_recovery_ranks_high(self):
+        from horovod_tpu.profiler import doctor
+        rep = doctor(snapshot=self._snap(
+            elastic_recovery_seconds=120.0,
+            config_preemption_notice_seconds=30.0), programs={})
+        rec = [f for f in rep["findings"] if f["category"] == "recovery"]
+        assert rec and rec[0]["severity"] >= 0.5
+
+    def test_flags_cadence_over_notice_budget(self):
+        from horovod_tpu.profiler import doctor
+        rep = doctor(snapshot=self._snap(
+            checkpoint_interval_seconds=90.0,
+            config_preemption_notice_seconds=30.0), programs={})
+        cad = [f for f in rep["findings"]
+               if f["category"] == "checkpoint_cadence"]
+        assert cad and "90s" in cad[0]["title"]
+        rep2 = doctor(snapshot=self._snap(
+            checkpoint_interval_seconds=5.0,
+            config_preemption_notice_seconds=30.0), programs={})
+        assert not [f for f in rep2["findings"]
+                    if f["category"] == "checkpoint_cadence"]
+
+
+class TestTwoProcessPreemptSmoke:
+    def test_preempt_smoke_two_process(self):
+        """Acceptance drive: 2 real processes + 1 hot spare, rank 1
+        SIGKILLed mid-epoch by the fault plan; the job must recover from
+        the last sharded manifest with step-for-step deterministic
+        losses and hvd.doctor() must report the measured recovery time
+        (tools/preempt_smoke.py, also `make preempt-smoke`)."""
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "preempt_smoke.py")],
+            capture_output=True, text=True, timeout=540)
+        assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+        assert "preempt-smoke OK" in r.stdout
+
+
+class TestCopyAttrsFootgun:
+    def test_restore_warns_every_time_for_uncopyable_attrs(self, caplog):
+        """The satellite fix: a failed deepcopy at commit must not let
+        restore() silently 'roll back' to the live mutated object — every
+        restore says so."""
+        class Uncopyable:
+            def __deepcopy__(self, memo):
+                raise TypeError("nope")
+        s = JaxState(params={"w": jnp.ones(2)}, step=0)
+        s.helper = Uncopyable()
+        s.commit()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            s.restore()
+            s.restore()
+        hits = [r for r in caplog.records
+                if "NO-OP" in r.getMessage()
+                and "helper" in r.getMessage()]
+        assert len(hits) == 2   # per restore, not once per process
+
+    def test_clean_restore_does_not_warn(self, caplog):
+        s = JaxState(params={"w": jnp.ones(2)}, step=0)
+        s.commit()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            s.restore()
+        assert not [r for r in caplog.records if "NO-OP" in r.getMessage()]

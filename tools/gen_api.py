@@ -37,8 +37,11 @@ MODULES = [
     "horovod_tpu.timeline",
     "horovod_tpu.autotune",
     "horovod_tpu.checkpoint",
+    "horovod_tpu.checkpoint_sharded",
+    "horovod_tpu.faults",
     "horovod_tpu.data",
     "horovod_tpu.elastic",
+    "horovod_tpu.elastic.driver",
     "horovod_tpu.runner.launcher",
     "horovod_tpu.parallel",
     "horovod_tpu.parallel.pipeline",
