@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
-	preempt-smoke test native
+	preempt-smoke topo-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -55,6 +55,15 @@ quant-smoke:
 # tests/test_checkpoint_sharded.py::TestTwoProcessPreemptSmoke.
 preempt-smoke:
 	$(PY) tools/preempt_smoke.py
+
+# Topology smoke: 4 CPU processes simulate a 2x2 torus
+# (HOROVOD_TOPOLOGY=2x2) and allreduce the same payload through
+# rs_ag_2d / chunked_rs_ag_2d / swing / rs_ag_2d_int8; every rank must
+# hold byte-identical results, each schedule must match psum, and the
+# per-phase wire-byte legs must be observable. Also runs in tier-1 as
+# tests/test_topology.py::TestFourProcessTopoSmoke.
+topo-smoke:
+	$(PY) tools/topo_smoke.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

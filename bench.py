@@ -31,6 +31,7 @@ update under one jit with donated state.
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -124,13 +125,27 @@ def _collective_counters():
         wire_bytes = sum(
             float(c.get("value", 0)) for c in
             snap.get("counters", {}).get("allreduce_wire_bytes_total", []))
+        # Per-phase split of the same counter (the multi-leg 2D/swing
+        # lowerings label each RS/AG leg separately; psum is phase-less).
+        wire_bytes_by_phase = {}
+        for c in snap.get("counters", {}).get(
+                "allreduce_wire_bytes_total", []):
+            ph = c.get("labels", {}).get("phase")
+            if ph:
+                wire_bytes_by_phase[ph] = (wire_bytes_by_phase.get(ph, 0)
+                                           + int(c.get("value", 0)))
+        from horovod_tpu import core as _core
         from horovod_tpu.overlap import parse_algorithm
         wire = (parse_algorithm(cfg.allreduce_algorithm)[1]
                 or cfg.allreduce_wire)
+        topo = (_core.topology_str() if _core.is_initialized()
+                else (cfg.topology or ""))
         return {"allreduce_alg": cfg.allreduce_algorithm,
                 "wire": wire,
+                "topology": topo,
                 "overlap_chunks": cfg.overlap_chunks,
                 "allreduce_wire_bytes": int(wire_bytes),
+                "allreduce_wire_bytes_by_phase": wire_bytes_by_phase,
                 "negotiation": negotiation_stats(),
                 "collectives": collective_summary()}
     except Exception:
@@ -367,6 +382,27 @@ def bench_mnist(on_tpu):
                    peak_hbm_bytes=rec.peak_hbm_bytes)
 
 
+def _bench_torus(n):
+    """Torus dims for an n-device bench ring: the HOROVOD_TOPOLOGY
+    override when it factors exactly this n (the sweep shrinks n below
+    the full world, where the override no longer applies), else the
+    most-square factorization — the shape a real slice's detected mesh
+    would approximate."""
+    spec = os.environ.get("HOROVOD_TOPOLOGY")
+    if spec:
+        from horovod_tpu.parallel.mesh import parse_topology
+        try:
+            dims = parse_topology(spec)
+            if int(np.prod(dims)) == n:
+                return dims
+        except ValueError:
+            pass
+    for d in range(int(math.isqrt(n)), 1, -1):
+        if n % d == 0:
+            return (d, n // d)
+    return (n,)
+
+
 def bench_allreduce(on_tpu):
     """Allreduce scaling (BASELINE's "8->256 chip scaling efficiency"
     row, measured on whatever mesh this host exposes — a virtual-CPU ICI
@@ -408,11 +444,22 @@ def bench_allreduce(on_tpu):
         def psum_fn(v, n=n):
             # Honors HOROVOD_ALLREDUCE_ALGORITHM / --allreduce-alg, so
             # --sweep-comm measures the real per-algorithm lowering here
-            # (including the quantized int8/fp8 wires).
+            # (including the quantized int8/fp8 wires and the topology-
+            # aware 2D/swing schedules).
             if alg in ("psum", "auto"):
                 return jax.lax.psum(v, "x")
             from horovod_tpu import overlap as _overlap
             base, qwire = _overlap.parse_algorithm(alg)
+            if base == "swing":
+                # every measured n is a power of two (counts above)
+                return _overlap.swing_psum(v.ravel(), "x",
+                                           n).reshape(v.shape)
+            if base.endswith("_2d"):
+                chunks = (cfg.overlap_chunks
+                          if base == "chunked_rs_ag_2d" else 1)
+                return _overlap.chunked_rs_ag_2d_psum(
+                    v.ravel(), "x", n, dims=_bench_torus(n),
+                    chunks=chunks, wire=qwire).reshape(v.shape)
             chunks = cfg.overlap_chunks if base == "chunked_rs_ag" else 1
             return _overlap.chunked_rs_ag_psum(
                 v.ravel(), "x", n, chunks=chunks,
@@ -453,10 +500,16 @@ def bench_allreduce(on_tpu):
     # the config wire knob does not apply to it, so exact algorithms
     # are stamped fp32 whatever HOROVOD_ALLREDUCE_WIRE says.
     from horovod_tpu import overlap as _overlap
-    wire = _overlap.parse_algorithm(alg)[1] or "fp32"
+    base, qwire = _overlap.parse_algorithm(alg)
+    wire = qwire or "fp32"
+    n_max = counts[-1]
+    dims = _bench_torus(n_max) if base.endswith("_2d") else None
+    phases = _overlap.wire_bytes_by_phase(base, payload_bytes // 4, wire,
+                                          n_max, dims=dims)
     rec["wire"] = wire
-    rec["allreduce_wire_bytes"] = _overlap.wire_bytes(
-        payload_bytes // 4, wire)
+    rec["topology"] = "x".join(str(d) for d in (dims or (n_max,)))
+    rec["allreduce_wire_bytes"] = sum(phases.values())
+    rec["allreduce_wire_bytes_by_phase"] = phases
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -686,14 +739,18 @@ def _apply_comm_flags(args):
         os.environ["HOROVOD_ALLREDUCE_WIRE"] = args.allreduce_wire
     if getattr(args, "overlap_chunks", None):
         os.environ["HOROVOD_OVERLAP_CHUNKS"] = str(args.overlap_chunks)
+    if getattr(args, "topology", None):
+        os.environ["HOROVOD_TOPOLOGY"] = args.topology
 
 
 #: --sweep-comm measures one line per algorithm (auto is skipped: it
 #: resolves to one of the explicit lowerings per bucket size). The
 #: quantized wires ride the chunked pipeline — the shape they'd resolve
-#: to on real gradient buckets.
+#: to on real gradient buckets — and the topology-aware schedules run
+#: on the _bench_torus factorization of each device count.
 SWEEP_ALGS = ("psum", "rs_ag", "chunked_rs_ag",
-              "chunked_rs_ag_int8", "chunked_rs_ag_fp8")
+              "chunked_rs_ag_int8", "chunked_rs_ag_fp8",
+              "rs_ag_2d", "chunked_rs_ag_2d", "swing")
 
 
 def _load_serve_bench():
@@ -869,6 +926,8 @@ def _supervise(args) -> int:
         cmd += ["--allreduce-wire", args.allreduce_wire]
     if getattr(args, "overlap_chunks", None):
         cmd += ["--overlap-chunks", str(args.overlap_chunks)]
+    if getattr(args, "topology", None):
+        cmd += ["--topology", args.topology]
     if getattr(args, "sweep_comm", False):
         cmd += ["--sweep-comm"]
     if getattr(args, "serve", False):
@@ -903,7 +962,11 @@ def _build_parser():
     p.add_argument("--allreduce-alg", dest="allreduce_alg", default=None,
                    choices=["auto", "psum", "rs_ag", "chunked_rs_ag",
                             "rs_ag_int8", "chunked_rs_ag_int8",
-                            "rs_ag_fp8", "chunked_rs_ag_fp8"],
+                            "rs_ag_fp8", "chunked_rs_ag_fp8",
+                            "rs_ag_2d", "chunked_rs_ag_2d",
+                            "rs_ag_2d_int8", "chunked_rs_ag_2d_int8",
+                            "rs_ag_2d_fp8", "chunked_rs_ag_2d_fp8",
+                            "swing"],
                    help="gradient-sync algorithm for this run "
                         "(HOROVOD_ALLREDUCE_ALGORITHM)")
     p.add_argument("--allreduce-wire", dest="allreduce_wire", default=None,
@@ -914,6 +977,9 @@ def _build_parser():
                    default=None,
                    help="chunked_rs_ag pipeline depth "
                         "(HOROVOD_OVERLAP_CHUNKS)")
+    p.add_argument("--topology", dest="topology", default=None,
+                   help="torus-dims override like 2x4 "
+                        "(HOROVOD_TOPOLOGY); must factor the world size")
     p.add_argument("--sweep-comm", dest="sweep_comm", action="store_true",
                    help="one JSON line per allreduce algorithm "
                         f"({', '.join(SWEEP_ALGS)}) for the selected "

@@ -13,6 +13,7 @@ upstream equivalent.
 from horovod_tpu.core import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, mesh, axis_name, build_info, in_spmd_context,
+    topology, topology_str,
 )
 from horovod_tpu.collective import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,

@@ -320,12 +320,20 @@ class BayesianAutotuner:
     #: RS+AG decomposition puts on the wire per bucket — fp32 (exact),
     #: bf16 cast, or the block-quantized 1-byte formats.
     WIRE_CHOICES = ("fp32", "bf16", "int8", "fp8")
+    #: topology-schedule axis: how the picked algorithm maps onto the
+    #: fabric — the flat 1-D ring, the multi-phase torus decomposition
+    #: ("2d" upgrades rs_ag-family picks to their _2d forms), or the
+    #: distance-halving swing schedule (replaces the pick outright; exact
+    #: wire only). Folded into ``current_algorithm()``'s returned name,
+    #: so the ``AutotunedStep`` consumer surface stays 4-ary.
+    TOPOLOGY_CHOICES = ("ring", "2d", "swing")
 
     def __init__(self, lo_bytes: int = _MB, hi_bytes: int = 256 * _MB,
                  probes: int = 6, samples_per_probe: int = 10,
                  tune_compression: bool = False,
                  tune_algorithm: bool = False,
-                 tune_wire: bool = False):
+                 tune_wire: bool = False,
+                 tune_topology: bool = False):
         import math
         self._lo = math.log2(lo_bytes)
         self._hi = math.log2(hi_bytes)
@@ -334,8 +342,9 @@ class BayesianAutotuner:
         self._tune_comp = tune_compression
         self._tune_alg = tune_algorithm
         self._tune_wire = tune_wire
+        self._tune_topology = tune_topology
         # (normalized threshold coord, compression index, algorithm
-        # index, chunk index, wire index) per probe
+        # index, chunk index, wire index, topology index) per probe
         self._xs: List[tuple] = []
         self._ys: List[float] = []   # median step seconds per probe
         self._pending: List[float] = []
@@ -345,6 +354,7 @@ class BayesianAutotuner:
         self._best_algorithm: Optional[str] = None
         self._best_chunks: Optional[int] = None
         self._best_wire: Optional[str] = None
+        self._best_topology: Optional[str] = None
         #: True whenever a fresh GP proposal is live and has not yet been
         #: agreed across processes (see class docstring). The first point
         #: is fixed, so no sync is needed until a probe completes.
@@ -368,12 +378,38 @@ class BayesianAutotuner:
 
     def current_algorithm(self) -> str:
         """Current allreduce-algorithm pick ("auto" — i.e. the size
-        heuristic — unless ``tune_algorithm``)."""
+        heuristic — unless ``tune_algorithm``). With ``tune_topology``
+        the topology schedule is folded into the name (``rs_ag`` +
+        ``"2d"`` -> ``"rs_ag_2d"``, any pick + ``"swing"`` ->
+        ``"swing"``), so consumers keep passing a single algorithm
+        string."""
         if not self._tune_alg:
             return "auto"
-        if self._best_algorithm is not None:
-            return self._best_algorithm
-        return self.ALGORITHM_CHOICES[self._cur[2]]
+        alg = (self._best_algorithm if self._best_algorithm is not None
+               else self.ALGORITHM_CHOICES[self._cur[2]])
+        return self._compose_topology(alg)
+
+    def current_topology(self) -> str:
+        """Current topology-schedule pick ("ring" unless
+        ``tune_topology``)."""
+        if not self._tune_topology:
+            return "ring"
+        if self._best_topology is not None:
+            return self._best_topology
+        return self.TOPOLOGY_CHOICES[self._cur[5]]
+
+    def _compose_topology(self, alg: str) -> str:
+        """Fold the topology pick into an algorithm name (idempotent —
+        an already-composed name from a peer's broadcast passes
+        through)."""
+        if not self._tune_topology or alg.endswith("_2d") or alg == "swing":
+            return alg
+        topo = self.current_topology()
+        if topo == "swing":
+            return "swing"
+        if topo == "2d" and alg in ("rs_ag", "chunked_rs_ag"):
+            return alg + "_2d"
+        return alg
 
     def current_chunks(self) -> int:
         """Current chunked_rs_ag pipeline depth (the config default when
@@ -418,13 +454,17 @@ class BayesianAutotuner:
                 self._best_chunks = self.CHUNK_CHOICES[self._xs[i][3]]
             if self._tune_wire:
                 self._best_wire = self.WIRE_CHOICES[self._xs[i][4]]
+            if self._tune_topology:
+                self._best_topology = self.TOPOLOGY_CHOICES[self._xs[i][5]]
             gauge("autotune_threshold_bytes").set(self._best)
             event("autotune_converged", mode="bayes",
                   threshold_bytes=self._best,
                   compression=self._best_compression,
                   algorithm=self.current_algorithm(),
                   chunks=self.current_chunks() if self._tune_alg else None,
-                  wire=self.current_wire() if self._tune_wire else None)
+                  wire=self.current_wire() if self._tune_wire else None,
+                  topology=(self._best_topology
+                            if self._tune_topology else None))
         else:
             self._cur = self._next_point()
             # points 2-3 of the initial design are timing-independent and
@@ -437,6 +477,8 @@ class BayesianAutotuner:
                              if self._tune_alg else "auto"),
                   wire=(self.WIRE_CHOICES[self._cur[4]]
                         if self._tune_wire else None),
+                  topology=(self.TOPOLOGY_CHOICES[self._cur[5]]
+                            if self._tune_topology else None),
                   median_step_s=round(med, 6))
 
     def current_point(self) -> tuple:
@@ -446,29 +488,33 @@ class BayesianAutotuner:
 
     def set_current_point(self, point) -> None:
         point = tuple(point)
-        if len(point) < 5:             # legacy shorter points: keep the
+        if len(point) < 6:             # legacy shorter points: keep the
             point = point + self._cur[len(point):]   # local trailing axes
-        x01, comp, alg, chunk, wire = point
+        x01, comp, alg, chunk, wire, topo = point
         self._cur = (float(x01), int(comp), int(alg), int(chunk),
-                     int(wire))
+                     int(wire), int(topo))
         self.pending_sync = False
 
     def summary(self) -> str:
         lines = [f"bayesian autotune: {len(self._xs)} probes"]
-        for (x, c, a, ch, w), y in zip(self._xs, self._ys):
+        for (x, c, a, ch, w, t), y in zip(self._xs, self._ys):
             alg = (f" {self.ALGORITHM_CHOICES[a]}x{self.CHUNK_CHOICES[ch]}"
                    if self._tune_alg else "")
             wire = (f" wire={self.WIRE_CHOICES[w]}"
                     if self._tune_wire else "")
+            topo = (f" topo={self.TOPOLOGY_CHOICES[t]}"
+                    if self._tune_topology else "")
             lines.append(f"  {self._denorm(x) / _MB:8.1f} MB "
-                         f"{self.COMPRESSION_CHOICES[c]:5s}{alg}{wire} -> "
-                         f"{y * 1e3:8.2f} ms/step")
+                         f"{self.COMPRESSION_CHOICES[c]:5s}{alg}{wire}"
+                         f"{topo} -> {y * 1e3:8.2f} ms/step")
         if self._best is not None:
             alg = (f" {self._best_algorithm}x{self._best_chunks}"
                    if self._tune_alg else "")
             wire = (f" wire={self._best_wire}" if self._tune_wire else "")
+            topo = (f" topo={self._best_topology}"
+                    if self._tune_topology else "")
             lines.append(f"best: {self._best / _MB:.1f} MB "
-                         f"{self._best_compression}{alg}{wire}")
+                         f"{self._best_compression}{alg}{wire}{topo}")
         return "\n".join(lines)
 
     # -- GP machinery -----------------------------------------------------
@@ -476,7 +522,7 @@ class BayesianAutotuner:
         return int(round(2 ** (self._lo + x01 * (self._hi - self._lo))))
 
     def _embed(self, x01: float, comp: int, alg: int = 0, chunk: int = 0,
-               wire: int = 0):
+               wire: int = 0, topo: int = 0):
         import math
 
         import numpy as np
@@ -498,6 +544,10 @@ class BayesianAutotuner:
             onehot = [0.0] * len(self.WIRE_CHOICES)
             onehot[wire] = 1.0
             coords += onehot
+        if self._tune_topology:
+            onehot = [0.0] * len(self.TOPOLOGY_CHOICES)
+            onehot[topo] = 1.0
+            coords += onehot
         return np.array(coords)
 
     def _next_point(self) -> tuple:
@@ -508,12 +558,13 @@ class BayesianAutotuner:
         n_alg = len(self.ALGORITHM_CHOICES) if self._tune_alg else 1
         n_chunk = len(self.CHUNK_CHOICES) if self._tune_alg else 1
         n_wire = len(self.WIRE_CHOICES) if self._tune_wire else 1
+        n_topo = len(self.TOPOLOGY_CHOICES) if self._tune_topology else 1
         n = len(self._xs)
         if n < 3:
             # fixed space-filling start: ends + middle of the log range,
             # cycling the categorical choices so every axis gets data
             return ((0.0, 0.5, 1.0)[n], n % n_comp, n % n_alg,
-                    n % n_chunk, n % n_wire)
+                    n % n_chunk, n % n_wire, n % n_topo)
         X = np.stack([self._embed(*p) for p in self._xs])
         y = np.asarray(self._ys)
         y_mu, y_sd = y.mean(), max(y.std(), 1e-12)
@@ -528,11 +579,12 @@ class BayesianAutotuner:
         # candidates: dense threshold grid x every category combination
         # (the grid coarsens as categorical axes multiply so the EI argmax
         # stays a few-thousand-point scan)
-        grid = np.linspace(0.0, 1.0, 65 if n_wire == 1 else 33)
-        cands = [(g, c, a, ch, w)
-                 for w in range(n_wire) for ch in range(n_chunk)
-                 for a in range(n_alg) for c in range(n_comp)
-                 for g in grid]
+        grid = np.linspace(
+            0.0, 1.0, 65 if n_wire == 1 and n_topo == 1 else 33)
+        cands = [(g, c, a, ch, w, t)
+                 for t in range(n_topo) for w in range(n_wire)
+                 for ch in range(n_chunk) for a in range(n_alg)
+                 for c in range(n_comp) for g in grid]
         Xc = np.stack([self._embed(*p) for p in cands])
         Ks = kern(Xc, X)
         sol = np.linalg.solve(K, np.eye(n))

@@ -195,12 +195,19 @@ def _identity_for(op: int, x: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks,
-                wire=None):
-    """Bandwidth-optimal lowering of a Sum/Average fusion bucket:
-    reduce-scatter + all-gather over the full axis (``overlap.py``),
-    optionally as ``chunks`` pipelined pieces. Same masked-subset
-    contract as :func:`_allreduce_leaf` — members contribute their
-    value, non-members zeros, and non-members get their input back.
+                wire=None, base="rs_ag", dims=None):
+    """Decomposed lowering of a Sum/Average fusion bucket: reduce-scatter
+    + all-gather over the full axis (``overlap.py``), optionally as
+    ``chunks`` pipelined pieces. Same masked-subset contract as
+    :func:`_allreduce_leaf` — members contribute their value,
+    non-members zeros, and non-members get their input back.
+
+    ``base`` selects the exchange structure: the 1-D ring pipeline
+    (``rs_ag``/``chunked_rs_ag``), the multi-phase torus decomposition
+    (``rs_ag_2d``/``chunked_rs_ag_2d``, phases along the detected
+    ``dims``), or the distance-halving ``swing`` schedule (exact wire
+    only). All of them reduce zeros for non-members, so the subset
+    contract is unchanged.
 
     ``wire="int8"``/``"fp8"`` runs the quantized-wire pipeline: the
     bucket is reduced in fp32 through the block-scaled two-phase
@@ -217,14 +224,29 @@ def _rs_ag_leaf(x, op, ps: ProcessSet, prescale, postscale, chunks,
     if prescale != 1.0:
         x = x * jnp.asarray(prescale, x.dtype)
     masked = jnp.where(member, x, jnp.zeros_like(x)) if is_subset else x
+    is_2d = base.endswith("_2d")
     if wire is not None:
-        out = _overlap.chunked_rs_ag_psum(
-            masked.astype(jnp.float32), ps.axis, core.size(), chunks=chunks,
-            wire=wire, mean_k=float(k) if op == ReduceOp.Average else None)
+        mk = float(k) if op == ReduceOp.Average else None
+        if is_2d:
+            out = _overlap.chunked_rs_ag_2d_psum(
+                masked.astype(jnp.float32), ps.axis, core.size(),
+                dims=dims or (core.size(),), chunks=chunks, wire=wire,
+                mean_k=mk)
+        else:
+            out = _overlap.chunked_rs_ag_psum(
+                masked.astype(jnp.float32), ps.axis, core.size(),
+                chunks=chunks, wire=wire, mean_k=mk)
         out = out.astype(x.dtype)
     else:
-        out = _overlap.chunked_rs_ag_psum(masked, ps.axis, core.size(),
-                                          chunks=chunks)
+        if base == "swing":
+            out = _overlap.swing_psum(masked, ps.axis, core.size())
+        elif is_2d:
+            out = _overlap.chunked_rs_ag_2d_psum(
+                masked, ps.axis, core.size(),
+                dims=dims or (core.size(),), chunks=chunks)
+        else:
+            out = _overlap.chunked_rs_ag_psum(masked, ps.axis, core.size(),
+                                              chunks=chunks)
         if op == ReduceOp.Average:
             out = out / jnp.asarray(k, out.dtype) if jnp.issubdtype(
                 out.dtype, jnp.floating) else out // k
@@ -381,9 +403,10 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
             wire_cast = c.dtype
             c = c.astype(jnp.bfloat16)
         nbytes = int(c.size) * jnp.dtype(c.dtype).itemsize
+        topo = core.topology() if core.is_initialized() else None
         alg = _overlap.resolve_algorithm(
             algorithm, nbytes, op, core.size(), reducible=reducible,
-            wire=wire if quantizable else None)
+            wire=wire if quantizable else None, topology=topo)
         base, qwire = _overlap.parse_algorithm(alg)
         if qwire is not None and not quantizable:
             # Integer buckets (step counters, masks) and pass-through ops
@@ -391,33 +414,55 @@ def _allreduce_tree(tree, op, ps, prescale, postscale, compression,
             alg, qwire = base, None
         # Per-bucket algorithm + wire-byte telemetry (trace-time: one
         # count per compiled bucket, like the fusion counters). Wire
-        # bytes count the payload actually put on the wire per ring
-        # traversal — 1-byte elements + fp32 block scales for quantized
-        # wires — so the fp32/int8 counter ratio IS the compression.
+        # bytes count the payload actually put on the wire per LEG —
+        # an RS+AG decomposition traverses the bucket twice (quantized
+        # scales ride both legs), a _2d lowering once per torus dim per
+        # direction with shrinking payloads, psum once — each decomposed
+        # leg its own phase-labeled counter, so achieved per-phase bytes
+        # are observable and the fp32/int8 totals ratio IS the
+        # compression (leg structure cancels between wires).
         _metrics.counter("allreduce_algorithm_total", algorithm=alg).inc()
         eff_wire = qwire or _wire_label(c.dtype)
-        wb = _overlap.wire_bytes(int(c.size), eff_wire,
-                                 jnp.dtype(c.dtype).itemsize)
+        elem = jnp.dtype(c.dtype).itemsize
+        phases = _overlap.wire_bytes_by_phase(base, int(c.size), eff_wire,
+                                              core.size(), dims=topo,
+                                              elem_bytes=elem)
+        wb = sum(phases.values())
+        if alg == "psum":
+            _metrics.counter("allreduce_wire_bytes_total",
+                             algorithm=alg, wire=eff_wire).inc(wb)
+        else:
+            for ph, b in phases.items():
+                _metrics.counter("allreduce_wire_bytes_total",
+                                 algorithm=alg, wire=eff_wire,
+                                 phase=ph).inc(b)
         logical = int(buf.size) * jnp.dtype(buf.dtype).itemsize
-        _metrics.counter("allreduce_wire_bytes_total",
-                         algorithm=alg, wire=eff_wire).inc(wb)
-        if logical and wb:
+        # Honest multi-leg ratio: the same legs at the pre-compression
+        # dtype over the legs as shipped (for psum this reduces to
+        # logical/wb, preserving the pre-topology meaning).
+        wb_logical = sum(_overlap.wire_bytes_by_phase(
+            base, int(buf.size), _wire_label(buf.dtype), core.size(),
+            dims=topo,
+            elem_bytes=jnp.dtype(buf.dtype).itemsize).values())
+        if wb_logical and wb:
             _metrics.gauge("allreduce_compression_ratio",
-                           wire=eff_wire).set(logical / wb)
+                           wire=eff_wire).set(wb_logical / wb)
         span = _tracing.current_span()
+        chunked = base in ("chunked_rs_ag", "chunked_rs_ag_2d")
         if span is not None:
             _metrics._timeline_marker(
                 "allreduce_algorithm", category="overlap",
                 op_id=span.op_id, tensor=span.tensor, algorithm=alg,
                 bytes=nbytes, wire=eff_wire, wire_bytes=wb,
-                chunks=overlap_chunks if base == "chunked_rs_ag" else 1)
+                phases=dict(phases),
+                topology="x".join(str(d) for d in (topo or ())),
+                chunks=overlap_chunks if chunked else 1)
         if alg == "psum":
             r = _allreduce_leaf(c, op, ps, prescale, postscale)
         else:
             r = _rs_ag_leaf(c, op, ps, prescale, postscale,
-                            chunks=overlap_chunks
-                            if base == "chunked_rs_ag" else 1,
-                            wire=qwire)
+                            chunks=overlap_chunks if chunked else 1,
+                            wire=qwire, base=base, dims=topo)
         if wire_cast is not None:
             r = r.astype(wire_cast)
         return compression.decompress(r, ctx)
@@ -1093,8 +1138,19 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
       both legs, exact fp32 reduction at the owning shard (wire traffic
       ~1/4 of fp32; pair with ``DistributedOptimizer(error_feedback=
       True)`` for training);
+    * ``"rs_ag_2d"`` / ``"chunked_rs_ag_2d"`` (and their ``_int8`` /
+      ``_fp8`` forms) — multi-phase torus decomposition: reduce-scatter
+      along each detected torus dim in turn, all-gather back in reverse,
+      every leg riding a shorter sub-ring (``HOROVOD_TOPOLOGY`` or TPU
+      device coords supply the dims; degrades to the 1-D base on a flat
+      ring);
+    * ``"swing"`` — distance-halving pairwise schedule: log2(n) exchange
+      steps per direction for latency-bound buckets (exact wire only;
+      power-of-two worlds, else falls back to psum);
     * ``"auto"`` (default via ``HOROVOD_ALLREDUCE_ALGORITHM``) — per
-      bucket by size: small buckets psum, large rs_ag, largest chunked.
+      bucket by size x world x torus dims: small buckets psum, large
+      rs_ag (the ``_2d`` form when the detected torus has >= 2 dims),
+      largest chunked.
 
     ``wire`` (default ``HOROVOD_ALLREDUCE_WIRE``) sets the default wire
     precision: ``"bf16"`` casts each bucket for the collective and back;
@@ -1120,9 +1176,14 @@ def allreduce(tensor, op: int = Average, process_set: Optional[ProcessSet] = Non
         wire = cfg.allreduce_wire
     from horovod_tpu import overlap as _overlap
     if algorithm not in _overlap.ALGORITHMS:
-        raise ValueError(
-            f"unknown allreduce algorithm {algorithm!r}; expected one of "
-            f"{_overlap.ALGORITHMS}")
+        # Name the composed form actually received and the knob that set
+        # it: an explicit algorithm= beats the config default, so the
+        # knob is known here (unlike inside resolve_algorithm).
+        _overlap._reject_algorithm(
+            algorithm,
+            knob=("allreduce(algorithm=...)"
+                  if algorithm != cfg.allreduce_algorithm
+                  else "HOROVOD_ALLREDUCE_ALGORITHM"))
     if wire not in _overlap.WIRES:
         raise ValueError(
             f"unknown allreduce wire {wire!r}; expected one of "

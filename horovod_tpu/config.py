@@ -59,6 +59,13 @@ class Config:
     allreduce_wire: str = "fp32"
     overlap_chunks: int = 4
     xla_latency_hiding: bool = False
+    # Topology override (parallel/mesh.py detect_topology):
+    # HOROVOD_TOPOLOGY="XxY" factors the world into a simulated torus on
+    # CPU/tests (on TPU the dims come from device coords and this is
+    # normally unset). Stored as the normalized spec string; the dims
+    # tuple lives on the init context (core.topology()) because the
+    # product must be validated against the actual world size at init.
+    topology: Optional[str] = None
     # Timeline (timeline.cc): HOROVOD_TIMELINE=<path> starts the Chrome
     # trace at init; HOROVOD_TIMELINE_MARK_CYCLES adds cycle markers.
     timeline_path: Optional[str] = None
@@ -183,6 +190,15 @@ def _env_wire() -> str:
     return v
 
 
+def _env_topology() -> Optional[str]:
+    v = os.environ.get("HOROVOD_TOPOLOGY", "").strip().lower()
+    if not v:
+        return None
+    from horovod_tpu.parallel.mesh import parse_topology
+    dims = parse_topology(v)   # grammar check: a typo'd spec fails here
+    return "x".join(str(d) for d in dims)
+
+
 def _env_chunks() -> int:
     v = os.environ.get("HOROVOD_OVERLAP_CHUNKS")
     if not v:
@@ -240,6 +256,7 @@ def refresh() -> Config:
         allreduce_wire=_env_wire(),
         overlap_chunks=_env_chunks(),
         xla_latency_hiding=_env_bool("HOROVOD_XLA_LATENCY_HIDING"),
+        topology=_env_topology(),
         timeline_path=os.environ.get("HOROVOD_TIMELINE") or None,
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
         trace_jax_profiler=_env_bool("HOROVOD_TRACE_JAX_PROFILER"),

@@ -250,6 +250,12 @@ class AutotunedStep:
                     t._best_algorithm, t._best_chunks = alg, int(chunks)
                 if getattr(t, "_tune_wire", False):
                     t._best_wire = C.broadcast_object(t.current_wire(), 0)
+                if getattr(t, "_tune_topology", False):
+                    # The schedule pick rides current_algorithm()'s
+                    # composed name too, but the reported pick must
+                    # agree for summary()/persisted results.
+                    t._best_topology = C.broadcast_object(
+                        t.current_topology(), 0)
             self._fn = self._build(best)
             self._done = True
         else:

@@ -10,12 +10,18 @@ tensor/sequence parallel last (they need ICI bandwidth).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
+
+log = logging.getLogger("horovod_tpu")
+
+__all__ = ["make_mesh", "parse_topology", "detect_topology",
+           "torus_groups"]
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None,
@@ -52,3 +58,81 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None,
             pass  # fall through to the naive reshape
     arr = np.asarray(devs, dtype=object).reshape(tuple(sizes))
     return Mesh(arr, names)
+
+
+# ---------------------------------------------------------------------------
+# torus topology discovery (the `algorithm=` topology axis)
+# ---------------------------------------------------------------------------
+
+def parse_topology(spec: str) -> Tuple[int, ...]:
+    """Parse a ``HOROVOD_TOPOLOGY`` spec like ``"2x2"`` or ``"4x8x2"``
+    into a dims tuple. Every dim must be a positive integer."""
+    parts = str(spec).strip().lower().split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        dims = ()
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(
+            f"invalid HOROVOD_TOPOLOGY {spec!r}; expected positive torus "
+            f"dims like '2x2' or '4x8'")
+    return dims
+
+
+def detect_topology(world: int, devices: Optional[Sequence] = None,
+                    override: Optional[str] = None) -> Tuple[int, ...]:
+    """Torus/mesh dims of the slice backing a ``world``-device axis.
+
+    Resolution order: an explicit ``override`` spec (``HOROVOD_TOPOLOGY``,
+    e.g. ``"2x2"`` — its product must equal ``world``); else, on TPU, the
+    coordinate spans of ``jax.devices()`` (dims of extent 1 dropped, a
+    trailing cores-per-chip dim appended when chips are multi-core); else
+    a flat 1-D ring ``(world,)``. Detection never raises on unexpected
+    device metadata — anything that does not factor ``world`` cleanly
+    falls back to 1-D, which keeps every pre-topology lowering valid.
+    """
+    if override:
+        dims = parse_topology(override)
+        if int(np.prod(dims)) != world:
+            raise ValueError(
+                f"HOROVOD_TOPOLOGY {override!r} describes "
+                f"{int(np.prod(dims))} devices but the world has {world}")
+        return dims
+    if world <= 1:
+        return (max(world, 1),)
+    devs = list(devices if devices is not None else jax.devices())
+    try:
+        coords = [tuple(d.coords) for d in devs]
+    except Exception:
+        return (world,)
+    try:
+        spans = [len({c[i] for c in coords}) for i in range(len(coords[0]))]
+        cores = len({getattr(d, "core_on_chip", 0) for d in devs})
+        dims = tuple(s for s in spans if s > 1)
+        if cores > 1:
+            dims = dims + (cores,)
+        if dims and int(np.prod(dims)) == world:
+            return dims
+    except Exception:
+        pass
+    log.debug("device coords do not factor a %d-device torus; "
+              "treating the slice as a 1-D ring", world)
+    return (world,)
+
+
+def torus_groups(dims: Sequence[int]) -> List[List[List[int]]]:
+    """Per-dim ``axis_index_groups`` for sub-axis collectives on a flat
+    rank axis laid out row-major over ``dims``.
+
+    Entry ``j`` partitions the ranks into lines along torus dim ``j``
+    (all other coords fixed, dim-``j`` coordinate increasing) — a full
+    equal-size partition of the axis, which is exactly what
+    ``axis_index_groups`` supports under shard_map.
+    """
+    dims = tuple(int(d) for d in dims)
+    ranks = np.arange(int(np.prod(dims))).reshape(dims)
+    out = []
+    for j in range(len(dims)):
+        moved = np.moveaxis(ranks, j, -1).reshape(-1, dims[j])
+        out.append([[int(r) for r in row] for row in moved])
+    return out

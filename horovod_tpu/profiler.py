@@ -1211,6 +1211,59 @@ def _check_wire(snap) -> List[Dict]:
     return []
 
 
+def _check_topology(snap) -> List[Dict]:
+    """Topology/algorithm mismatch: heavy allreduce traffic riding a
+    1-D ring schedule on a slice whose detected torus has >=2 usable
+    dims leaves a whole mesh dimension's bandwidth on the table. Works
+    offline from the exported ``config_topology`` gauges, same as
+    :func:`_check_wire` works from the wire counters."""
+    dims = []
+    for s in _series(snap, "gauges", "config_topology"):
+        try:
+            d = int(s.get("labels", {}).get("dim", -1))
+            v = int(s.get("value", 0))
+        except (TypeError, ValueError):
+            continue
+        if v > 0:
+            dims.append((d, v))
+    torus = tuple(v for _, v in sorted(dims))
+    usable = sum(1 for v in torus if v > 1)
+    if usable < 2:
+        return []
+    from horovod_tpu import overlap as _overlap
+    ring = 0.0
+    multi = 0.0
+    for s in _series(snap, "counters", "allreduce_wire_bytes_total"):
+        alg = s.get("labels", {}).get("algorithm", "")
+        try:
+            base, _ = _overlap.parse_algorithm(alg)
+        except Exception:
+            continue
+        v = float(s.get("value", 0))
+        if base in ("rs_ag", "chunked_rs_ag"):
+            ring += v
+        elif base.endswith("_2d") or base == "swing":
+            multi += v
+    if multi or ring < WIRE_SUGGEST_MIN_BYTES:
+        return []
+    topo = "x".join(str(v) for v in torus)
+    return [_finding(
+        "topology_ring", 0.3,
+        f"1-D ring allreduce on a {topo} torus "
+        f"({ring / 1e6:.0f}MB per compiled pass)",
+        "the slice's detected torus has >=2 dims but every reduce-"
+        "scatter/all-gather bucket is scheduled along a single ring; a "
+        "two-phase torus-native lowering shrinks the second leg by the "
+        "first dim's extent and roughly halves per-hop wire time on "
+        "bandwidth-bound buckets",
+        "set HOROVOD_ALLREDUCE_ALGORITHM=rs_ag_2d (or chunked_rs_ag_2d "
+        "for >=32MB buckets; composes with wire=int8/fp8), or leave "
+        "algorithm='auto' which picks the 2D lowering once the torus "
+        "is detected. See docs/PERFORMANCE.md 'Topology-aware "
+        "algorithms'.",
+        topology=topo, ring_wire_bytes=int(ring))]
+
+
 def _check_recovery(snap) -> List[Dict]:
     """Preemption-tolerance findings (docs/ELASTIC.md): report the
     measured recovery time of the last elastic re-init / relaunch (from
@@ -1349,6 +1402,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_overlap(snap, report)
     findings += _check_fusion(snap)
     findings += _check_wire(snap)
+    findings += _check_topology(snap)
     findings.sort(key=lambda f: (-f["severity"], f["category"], f["title"]))
     for i, f in enumerate(findings):
         f["rank"] = i + 1

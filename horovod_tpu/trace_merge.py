@@ -397,7 +397,47 @@ def overlap_report(shards: List[Dict[str, Any]]) -> Dict[str, Any]:
         "by_rank": per_rank,
         "overlap_efficiency": round(sum(effs) / len(effs), 4) if effs
         else 0.0,
+        "algorithms": _algorithm_summary(shards),
     }
+
+
+def _algorithm_summary(shards: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate the trace-time ``allreduce_algorithm`` markers into a
+    per-algorithm lowering summary: compiled-bucket counts, total wire
+    bytes, per-phase wire bytes (the multi-leg 2D/swing decomposition —
+    each RS/AG leg separately), and the torus the lowering saw. Markers
+    fire identically on every rank during tracing, so the summary reads
+    one representative shard (the lowest rank present) rather than
+    multiplying per-rank copies of the same compiled bucket."""
+    if not shards:
+        return {}
+    rep = min(shards, key=lambda s: s["rank"])
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in rep["events"]:
+        if e.get("name") != "allreduce_algorithm":
+            continue
+        args = e.get("args") or {}
+        alg = args.get("algorithm")
+        if not alg:
+            continue
+        rec = out.setdefault(alg, {"buckets": 0, "wire_bytes": 0,
+                                   "phase_bytes": {}})
+        rec["buckets"] += 1
+        try:
+            rec["wire_bytes"] += int(args.get("wire_bytes", 0))
+        except (TypeError, ValueError):
+            pass
+        for ph, b in (args.get("phases") or {}).items():
+            try:
+                rec["phase_bytes"][ph] = (rec["phase_bytes"].get(ph, 0)
+                                          + int(b))
+            except (TypeError, ValueError):
+                continue
+        if args.get("topology"):
+            rec["topology"] = args["topology"]
+        if args.get("wire"):
+            rec["wire"] = args["wire"]
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -211,9 +211,9 @@ class TestBayesianAutotuner:
             hconfig.refresh()
 
     def test_wire_axis_sync_protocol(self):
-        """5-tuple points (threshold, comp, alg, chunks, wire) must ride
-        the same rank-0 broadcast handshake; legacy 4-tuples from an old
-        coordinator keep the local wire coordinate."""
+        """6-tuple points (threshold, comp, alg, chunks, wire, topo) must
+        ride the same rank-0 broadcast handshake; legacy 4/5-tuples from
+        an old coordinator keep the local trailing coordinates."""
         from horovod_tpu.autotune import BayesianAutotuner
         r0 = BayesianAutotuner(probes=6, samples_per_probe=1,
                                tune_algorithm=True, tune_wire=True)
@@ -224,16 +224,21 @@ class TestBayesianAutotuner:
                 if t.pending_sync:
                     t.set_current_point(r0.current_point())
             assert r0.current_point() == r1.current_point()
-            assert len(r0.current_point()) == 5
+            assert len(r0.current_point()) == 6
             t = self._quadratic(r0.current_threshold())
             r0.record(t)
             r1.record(t)
-        # legacy 4-tuple: wire coordinate is preserved locally
+        # legacy shorter points: trailing coordinates preserved locally
         fresh = BayesianAutotuner(probes=6, samples_per_probe=1,
                                   tune_wire=True)
         wire_before = fresh.current_point()[4]
+        topo_before = fresh.current_point()[5]
         fresh.set_current_point((0.5, 0, 0, 0))
-        assert fresh.current_point() == (0.5, 0, 0, 0, wire_before)
+        assert fresh.current_point() == (0.5, 0, 0, 0, wire_before,
+                                         topo_before)
+        fresh.set_current_point((0.25, 0, 0, 0, wire_before))
+        assert fresh.current_point() == (0.25, 0, 0, 0, wire_before,
+                                         topo_before)
 
     def test_mode_env_selects_bayes(self, clean_env):
         torch = pytest.importorskip("torch")

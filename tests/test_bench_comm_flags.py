@@ -28,6 +28,17 @@ class TestParsing:
         assert args.allreduce_alg == "chunked_rs_ag"
         assert args.overlap_chunks == 8
         assert args.sweep_comm
+        assert args.topology is None
+
+    def test_topology_algorithms_parse(self, bench):
+        for alg in ("rs_ag_2d", "chunked_rs_ag_2d",
+                    "chunked_rs_ag_2d_int8", "swing"):
+            args = bench._build_parser().parse_args(
+                ["--allreduce-alg", alg, "--topology", "2x4"])
+            assert args.allreduce_alg == alg
+            assert args.topology == "2x4"
+        assert all(a in bench.SWEEP_ALGS
+                   for a in ("rs_ag_2d", "chunked_rs_ag_2d", "swing"))
 
     def test_bad_algorithm_rejected(self, bench):
         with pytest.raises(SystemExit):
@@ -55,22 +66,33 @@ class TestParsing:
         monkeypatch.setattr(bench.subprocess, "run", fake_run)
         args = bench._build_parser().parse_args(
             ["--model", "mnist", "--allreduce-alg", "rs_ag",
-             "--overlap-chunks", "2", "--sweep-comm"])
+             "--overlap-chunks", "2", "--topology", "2x2",
+             "--sweep-comm"])
         assert bench._supervise(args) == 0
         cmd = seen["cmd"]
         assert "--allreduce-alg" in cmd and "rs_ag" in cmd
         assert "--overlap-chunks" in cmd and "2" in cmd
+        assert "--topology" in cmd and "2x2" in cmd
         assert "--sweep-comm" in cmd
 
     def test_apply_comm_flags_sets_env(self, bench, monkeypatch):
-        monkeypatch.delenv("HOROVOD_ALLREDUCE_ALGORITHM", raising=False)
-        monkeypatch.delenv("HOROVOD_OVERLAP_CHUNKS", raising=False)
+        # setenv (not delenv) so monkeypatch records the pre-test state
+        # even when the variable is absent: _apply_comm_flags writes
+        # through plain os.environ, and a leaked HOROVOD_TOPOLOGY=2x4
+        # would poison every later hvd.init() whose world it doesn't
+        # factor (2-proc smokes, world-4 re-inits).
+        keys = ("HOROVOD_ALLREDUCE_ALGORITHM", "HOROVOD_OVERLAP_CHUNKS",
+                "HOROVOD_TOPOLOGY")
+        for k in keys:
+            monkeypatch.setenv(k, "pre-test-sentinel")
         args = bench._build_parser().parse_args(
-            ["--allreduce-alg", "chunked_rs_ag", "--overlap-chunks", "3"])
+            ["--allreduce-alg", "chunked_rs_ag", "--overlap-chunks", "3",
+             "--topology", "2x4"])
         bench._apply_comm_flags(args)
         assert os.environ["HOROVOD_ALLREDUCE_ALGORITHM"] == \
             "chunked_rs_ag"
         assert os.environ["HOROVOD_OVERLAP_CHUNKS"] == "3"
+        assert os.environ["HOROVOD_TOPOLOGY"] == "2x4"
 
 
 class TestHeadlineStillEmits:

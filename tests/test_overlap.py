@@ -244,6 +244,35 @@ class TestWireBytesMetrics:
         assert ratios["int8"] > 3.0
         assert ratios["fp32"] == pytest.approx(1.0)
 
+    def test_per_leg_bytes_on_4mb_bucket(self, rng):
+        """Multi-leg exchanges must account payload+scales per phase: the
+        RS and AG legs of a decomposed allreduce each carry the full
+        bucket (ring factor aside), so a single lump-sum counter
+        undercounts the wire by the leg structure and skews
+        allreduce_compression_ratio for 2D/swing lowerings."""
+        from horovod_tpu.ops.quantized import BLOCK
+        hvd.reset_metrics()
+        n = hvd.size()
+        # distinct from the sibling test's bucket so the counters see a
+        # fresh trace (they count per compiled bucket, not per call);
+        # BLOCK-aligned so the fused int8 bucket carries no padding
+        m = (4 * 1024 * 1024) // 4 + 16 * BLOCK
+        x = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+        hvd.allreduce(x, op=hvd.Sum, algorithm="rs_ag")
+        hvd.allreduce(x, op=hvd.Sum, algorithm="rs_ag_int8")
+        snap = hvd.metrics()
+        legs = {}
+        for c in snap["counters"]["allreduce_wire_bytes_total"]:
+            lab = c["labels"]
+            legs[(lab["algorithm"], lab.get("phase"))] = c["value"]
+        # fp32: each leg is the full bucket payload, counted separately
+        assert legs[("rs_ag", "rs")] == 4 * m
+        assert legs[("rs_ag", "ag")] == 4 * m
+        # int8: each leg is payload + one fp32 scale per started block
+        scales = 4 * ((m + BLOCK - 1) // BLOCK)
+        assert legs[("rs_ag_int8", "rs")] == m + scales
+        assert legs[("rs_ag_int8", "ag")] == m + scales
+
     def test_int8_dtype_payload_not_labeled_as_quantized_wire(self, rng):
         """An EXACT exchange of an int8-dtype tensor must label as
         raw-int8: wire="int8" always means the quantized format (else
@@ -361,10 +390,21 @@ class TestAutoSelection:
             hvd.allreduce(jnp.zeros((hvd.size(), 2)), wire="int4")
 
     def test_unknown_algorithm_raises(self):
-        with pytest.raises(ValueError, match="swing"):
-            overlap.resolve_algorithm("swing", 1024, hvd.Sum, 8, True)
+        with pytest.raises(ValueError, match="butterfly"):
+            overlap._reject_algorithm("butterfly")
         with pytest.raises(ValueError, match="algorithm"):
-            hvd.allreduce(jnp.zeros((hvd.size(), 2)), algorithm="swing")
+            hvd.allreduce(jnp.zeros((hvd.size(), 2)), algorithm="butterfly")
+
+    def test_rejection_names_composed_form_and_knob(self):
+        # A known base composed with a wire that has no quantized
+        # lowering must name the composed form it actually received and
+        # the knob that set it — not just dump ALGORITHMS.
+        with pytest.raises(ValueError) as ei:
+            hvd.allreduce(jnp.zeros((hvd.size(), 2)),
+                          algorithm="psum_int8")
+        msg = str(ei.value)
+        assert "psum_int8" in msg and "allreduce(algorithm=...)" in msg
+        assert "exact by construction" in msg
 
     def test_bad_chunks_raises(self):
         with pytest.raises(ValueError, match="overlap_chunks"):

@@ -43,7 +43,9 @@ MODULES = [
     "horovod_tpu.elastic",
     "horovod_tpu.elastic.driver",
     "horovod_tpu.runner.launcher",
+    "horovod_tpu.overlap",
     "horovod_tpu.parallel",
+    "horovod_tpu.parallel.mesh",
     "horovod_tpu.parallel.pipeline",
     "horovod_tpu.parallel.fsdp",
     "horovod_tpu.parallel.conjugate",

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Topology-aware collectives smoke: 4 CPU processes on a simulated
+2x2 torus.
+
+Spawns four real processes that rendezvous over ``jax.distributed``
+with ``HOROVOD_TOPOLOGY=2x2`` and allreduce the same deterministic
+payloads through every topology-aware schedule — the two-phase torus
+lowering (``rs_ag_2d``), its chunked/pipelined form
+(``chunked_rs_ag_2d``), the distance-halving Swing schedule
+(``swing``), and the quantized 2D composition (``rs_ag_2d_int8``) —
+then verifies:
+
+* every rank holds BYTE-IDENTICAL results for each algorithm (object
+  allgather compares actual payload bytes across processes);
+* each exact schedule matches the ``psum`` reference to fp32 roundoff,
+  and the quantized one is within the int8 block error bound;
+* ``build_info()`` publishes the detected torus as ``"2x2"`` and
+  ``allreduce_algorithm_total{algorithm="rs_ag_2d"}`` plus the
+  per-phase ``allreduce_wire_bytes_total`` legs (rs_d0/rs_d1/ag_d1/
+  ag_d0) are observable in ``hvd.metrics()``.
+
+Exit status 0 = all checks pass; nonzero otherwise. Wired as a tier-1
+test (``tests/test_topology.py::TestFourProcessTopoSmoke``) and as
+``make topo-smoke``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, {repo!r})
+    # One device per process: drop an inherited virtual-device flag (the
+    # pytest harness forces 8) before the backend initializes, so the
+    # world is exactly 4 and HOROVOD_TOPOLOGY=2x2 factors it.
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "--xla_force_host_platform_device_count" not in f)
+    os.environ["HOROVOD_TOPOLOGY"] = "2x2"
+    import numpy as np
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+    hvd.init(coordinator_address=f"127.0.0.1:{{port}}", num_processes=4,
+             process_id=pid)
+    assert jax.process_count() == 4
+    n = hvd.size()
+    assert hvd.build_info()["topology"] == "2x2", hvd.build_info()
+
+    # Deterministic mixed-magnitude payload, sized to exercise the
+    # world*BLOCK padding tails of the quantized 2D path.
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((n, 3001)).astype(np.float32)
+    x[:, :100] *= 50.0
+
+    hvd.reset_metrics()
+    ref_j = hvd.allreduce(x, op=hvd.Average, algorithm="psum",
+                          name="topo_smoke_psum")
+    results = {{}}
+    for alg in ("rs_ag_2d", "chunked_rs_ag_2d", "swing", "rs_ag_2d_int8"):
+        results[alg] = hvd.allreduce(x, op=hvd.Average, algorithm=alg,
+                                     overlap_chunks=3,
+                                     name=f"topo_smoke_{{alg}}")
+
+    ref = np.asarray(ref_j[pid])
+    payload = {{alg: np.asarray(r[pid]).tobytes()
+               for alg, r in results.items()}}
+
+    # 1. cross-rank agreement: every process holds the same bytes for
+    # every schedule (the quantized path re-quantizes the reduced
+    # partial once at the owning shard, so even the approximate result
+    # is bit-identical across ranks).
+    peers = hvd.allgather_object(payload)
+    for alg in payload:
+        assert all(p[alg] == peers[0][alg] for p in peers), \\
+            f"ranks diverged on {{alg}}"
+
+    # 2. parity vs the psum reference.
+    for alg in ("rs_ag_2d", "chunked_rs_ag_2d", "swing"):
+        err = float(jnp.max(jnp.abs(results[alg] - ref_j)))
+        assert err < 1e-5, (alg, err)
+    qerr = float(jnp.max(jnp.abs(results["rs_ag_2d_int8"] - ref_j)))
+    bound = 2.5 * np.abs(x).max() / 127
+    assert qerr < bound, (qerr, bound)
+
+    # 3. the lowering and its per-phase legs are observable.
+    snap = hvd.metrics()
+    algs = {{c["labels"]["algorithm"]: c["value"]
+            for c in snap["counters"]["allreduce_algorithm_total"]}}
+    assert algs.get("rs_ag_2d", 0) >= 1, algs
+    assert algs.get("swing", 0) >= 1, algs
+    phases = set()
+    for c in snap["counters"]["allreduce_wire_bytes_total"]:
+        if c["labels"]["algorithm"] == "rs_ag_2d":
+            phases.add(c["labels"]["phase"])
+    assert phases == {{"rs_d0", "rs_d1", "ag_d1", "ag_d0"}}, phases
+    hvd.shutdown()
+    print(f"proc {{pid}} TOPO-OK qerr={{qerr:.4f}}", flush=True)
+""").format(repo=REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_smoke(timeout_s: float = 300.0):
+    """One attempt: returns ``(rc, failure_text)`` — failure text feeds
+    the rendezvous-flake detector in ``smoke_util``."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(4)]
+    outs = [p.communicate(timeout=timeout_s)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 or "TOPO-OK" not in out:
+            print(f"worker failed (rc={p.returncode}):\n{out}",
+                  file=sys.stderr)
+            return 1, "\n".join(outs)
+    print("topo-smoke OK")
+    return 0, ""
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import smoke_util
+    with tempfile.TemporaryDirectory():
+        return smoke_util.main_with_retry(run_smoke, name="topo-smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
