@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
-	preempt-smoke topo-smoke test native
+	preempt-smoke topo-smoke net-smoke test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -55,6 +55,16 @@ quant-smoke:
 # tests/test_checkpoint_sharded.py::TestTwoProcessPreemptSmoke.
 preempt-smoke:
 	$(PY) tools/preempt_smoke.py
+
+# Network-transport serving smoke: 3 socket replicas (JSON-over-TCP,
+# serving/transport.py), one SIGKILLed at its 8th RPC and one partitioned
+# for 2s by HOROVOD_FAULT_PLAN; every request must reach a typed terminal
+# state within its deadline (retries + circuit breakers + failover
+# resubmission route around the faults), identical prompts must decode
+# identically wherever they land, and hvd.doctor() must rank the breaker
+# event. Also runs in tier-1 as tests/test_transport.py::TestNetSmoke.
+net-smoke:
+	$(PY) tools/net_smoke.py
 
 # Topology smoke: 4 CPU processes simulate a 2x2 torus
 # (HOROVOD_TOPOLOGY=2x2) and allreduce the same payload through

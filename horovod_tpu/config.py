@@ -122,6 +122,13 @@ class Config:
     # dispatch (1 = pure token-level interleaving, no second program),
     # HOROVOD_SERVE_KV_QUANT in {"", "int8", "fp8"} for 1-byte KV blocks,
     # HOROVOD_SERVE_HEARTBEAT replica liveness period (replica.py).
+    # Socket transport (serving/transport.py): HOROVOD_SERVE_RPC_TIMEOUT
+    # per-attempt socket timeout, HOROVOD_SERVE_MAX_RETRIES transport-
+    # level retries per RPC (0 = one attempt), HOROVOD_SERVE_HEDGE_MS
+    # tail-latency hedge delay for still-queued requests (0 = off),
+    # HOROVOD_SERVE_BREAKER_FAILURES consecutive connect/timeout
+    # failures that open a replica's circuit, HOROVOD_SERVE_BREAKER_RESET
+    # seconds before a half-open probe.
     serve_slots: int = 8
     serve_max_len: int = 512
     serve_block_size: int = 16
@@ -129,6 +136,11 @@ class Config:
     serve_prefill_chunk: int = 8
     serve_kv_quant: str = ""
     serve_heartbeat_seconds: float = 2.0
+    serve_rpc_timeout_seconds: float = 5.0
+    serve_max_retries: int = 3
+    serve_hedge_ms: float = 0.0
+    serve_breaker_failures: int = 3
+    serve_breaker_reset_seconds: float = 1.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Preemption tolerance (checkpoint_sharded.py / faults.py /
@@ -228,6 +240,33 @@ def _env_posint(name: str, default: int) -> int:
     return n
 
 
+def _env_nonneg_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r}: expected a non-negative integer")
+    if n < 0:
+        raise ValueError(f"{name}={n}: must be >= 0")
+    return n
+
+
+def _env_posfloat(name: str, default: float) -> float:
+    x = _env_float(name, default)
+    if x <= 0:
+        raise ValueError(f"{name}={x:g}: must be > 0")
+    return x
+
+
+def _env_nonneg_float(name: str, default: float) -> float:
+    x = _env_float(name, default)
+    if x < 0:
+        raise ValueError(f"{name}={x:g}: must be >= 0")
+    return x
+
+
 def _env_kv_quant() -> str:
     v = os.environ.get("HOROVOD_SERVE_KV_QUANT", "").strip().lower()
     if v in ("", "none", "off", "0"):
@@ -291,6 +330,15 @@ def refresh() -> Config:
         serve_kv_quant=_env_kv_quant(),
         serve_heartbeat_seconds=max(
             0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
+        serve_rpc_timeout_seconds=_env_posfloat(
+            "HOROVOD_SERVE_RPC_TIMEOUT", 5.0),
+        serve_max_retries=_env_nonneg_int(
+            "HOROVOD_SERVE_MAX_RETRIES", 3),
+        serve_hedge_ms=_env_nonneg_float("HOROVOD_SERVE_HEDGE_MS", 0.0),
+        serve_breaker_failures=_env_posint(
+            "HOROVOD_SERVE_BREAKER_FAILURES", 3),
+        serve_breaker_reset_seconds=_env_posfloat(
+            "HOROVOD_SERVE_BREAKER_RESET", 1.0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         preemption_notice_seconds=max(
             0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),

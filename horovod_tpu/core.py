@@ -390,5 +390,12 @@ def build_info() -> dict:
         "profile_on_stall": cfg.profile_on_stall,
         "profile_dir": cfg.profile_dir,
         "profiler_cost": cfg.profiler_cost,
+        # Serving transport knobs (serving/transport.py): resolved so a
+        # client and a replica can cross-check they agree on timeouts.
+        "serve_rpc_timeout_seconds": cfg.serve_rpc_timeout_seconds,
+        "serve_max_retries": cfg.serve_max_retries,
+        "serve_hedge_ms": cfg.serve_hedge_ms,
+        "serve_breaker_failures": cfg.serve_breaker_failures,
+        "serve_breaker_reset_seconds": cfg.serve_breaker_reset_seconds,
         "inert_env": dict(cfg.inert),
     }

@@ -12,6 +12,7 @@ bounded number of steps.
 Plan grammar (semicolon-separated actions)::
 
     HOROVOD_FAULT_PLAN="kill@rank=1,step=5;stall@rank=0,step=7,seconds=2"
+    HOROVOD_FAULT_PLAN="partition@rank=2,step=4,seconds=2;drop@rank=0,step=9"
 
 Each action is ``kind@key=value,key=value`` with:
 
@@ -19,12 +20,23 @@ Each action is ``kind@key=value,key=value`` with:
   model, no goodbye), ``stall`` (sleep ``seconds``: a degraded peer the
   stall watchdog should name), ``slow_write`` (arm a per-shard-file delay
   of ``seconds`` in the sharded checkpoint writer: a slow durable store
-  must not corrupt the two-phase commit).
+  must not corrupt the two-phase commit), or a **network fault** consumed
+  at the serving transport layer (``serving/transport.py``): ``drop``
+  (serve the RPC but never send the response — the client sees a read
+  timeout), ``delay`` (sleep ``seconds`` before the response — tail
+  latency, hedging fodder), ``partition`` (refuse every inbound
+  connection for ``seconds`` — the one-sided partition of "Highly
+  Available Data Parallel ML training on Mesh Networks").
 * ``rank=R`` — the process index the action targets (required).
-* ``step=S`` — the training step it fires at (required; the training
-  loop, or any instrumented subsystem, reports steps via
-  :func:`fault_point`).
-* ``seconds=X`` — duration for ``stall`` / ``slow_write`` (default 1.0).
+* ``step=S`` — when it fires (required). Training subsystems report
+  steps via :func:`fault_point`; the serving transport reports its
+  per-replica RPC sequence number via :func:`net_fault`, so ``step=4``
+  on a network fault means "at this replica's 4th inbound RPC".
+  :func:`net_fault` fires *any* kind (a ``kill@`` keyed to an RPC
+  sequence SIGKILLs a replica mid-serve); :func:`fault_point` skips the
+  network kinds, whose step space is RPCs, not training steps.
+* ``seconds=X`` — duration for ``stall`` / ``slow_write`` / ``delay`` /
+  ``partition`` (default 1.0).
 * ``restart=N`` — which elastic attempt the action belongs to (default
   ``0``: first launch only, so a relaunched job does not re-kill itself
   forever; ``restart=*`` fires on every attempt).
@@ -46,11 +58,12 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 __all__ = ["FaultAction", "parse_plan", "get_plan", "fault_point",
-           "slow_write_seconds", "reset"]
+           "net_fault", "partitioned", "slow_write_seconds", "reset"]
 
 logger = logging.getLogger("horovod_tpu")
 
-_KINDS = ("kill", "stall", "slow_write")
+_NET_KINDS = ("drop", "delay", "partition")
+_KINDS = ("kill", "stall", "slow_write") + _NET_KINDS
 
 
 @dataclass(frozen=True)
@@ -63,7 +76,7 @@ class FaultAction:
 
     def describe(self) -> str:
         extra = ""
-        if self.kind in ("stall", "slow_write"):
+        if self.kind in ("stall", "slow_write", "delay", "partition"):
             extra = f",seconds={self.seconds:g}"
         r = "*" if self.restart is None else str(self.restart)
         return (f"{self.kind}@rank={self.rank},step={self.step}"
@@ -137,6 +150,8 @@ def parse_plan(text: str) -> List[FaultAction]:
 _LOCK = threading.Lock()
 _FIRED: set = set()            # indices into the active plan
 _SLOW_WRITE: float = 0.0       # armed per-shard-file write delay
+_PARTITION_UNTIL: dict = {}    # rank -> monotonic deadline of a fired
+                               # partition (transport refuses conns)
 _PLAN_CACHE: tuple = ("", [])  # (plan_text, parsed) — fault_point runs
                                # every step; steady state is one compare
 
@@ -181,6 +196,8 @@ def fault_point(step: int, rank: Optional[int] = None) -> None:
     me = _my_rank() if rank is None else rank
     attempt = _restart_count()
     for i, a in enumerate(actions):
+        if a.kind in _NET_KINDS:
+            continue               # RPC-sequence step space (net_fault)
         if a.rank != me or a.step != step:
             continue
         if a.restart is not None and a.restart != attempt:
@@ -191,6 +208,51 @@ def fault_point(step: int, rank: Optional[int] = None) -> None:
                 continue
             _FIRED.add(key)
         _fire(a)
+
+
+def net_fault(step: int, rank: int) -> dict:
+    """Transport-layer fault point: ``step`` is the replica's inbound RPC
+    sequence number, ``rank`` its replica rank. Fires every matching
+    not-yet-fired action of ANY kind (``kill``/``stall`` act inline, so a
+    plan can SIGKILL a replica at its Nth RPC; ``partition`` arms
+    :func:`partitioned` for ``seconds``) and returns the directives the
+    caller must apply to THIS rpc::
+
+        {"drop": bool,       # serve it, but never send the response
+         "delay_s": float}   # sleep this long before responding
+
+    A no-op returning the empty directives when no plan is set."""
+    out = {"drop": False, "delay_s": 0.0}
+    from horovod_tpu.config import get_config
+    plan_text = get_config().fault_plan
+    if not plan_text:
+        return out
+    actions = _cached_plan(plan_text)
+    attempt = _restart_count()
+    for i, a in enumerate(actions):
+        if a.rank != rank or a.step != step:
+            continue
+        if a.restart is not None and a.restart != attempt:
+            continue
+        with _LOCK:
+            key = ("net", i, attempt)
+            if key in _FIRED:
+                continue
+            _FIRED.add(key)
+        _fire(a)                     # a matured kill never returns
+        if a.kind == "drop":
+            out["drop"] = True
+        elif a.kind == "delay":
+            out["delay_s"] = max(out["delay_s"], a.seconds)
+    return out
+
+
+def partitioned(rank: int) -> bool:
+    """Is a fired ``partition@`` still in force for this rank? The
+    transport checks per inbound connection and closes without reading
+    while True — the peer sees connection resets, not slow replies."""
+    with _LOCK:
+        return time.monotonic() < _PARTITION_UNTIL.get(rank, 0.0)
 
 
 def _fire(action: FaultAction) -> None:
@@ -219,6 +281,12 @@ def _fire(action: FaultAction) -> None:
         global _SLOW_WRITE
         with _LOCK:
             _SLOW_WRITE = max(_SLOW_WRITE, action.seconds)
+    elif action.kind == "partition":
+        with _LOCK:
+            _PARTITION_UNTIL[action.rank] = max(
+                _PARTITION_UNTIL.get(action.rank, 0.0),
+                time.monotonic() + action.seconds)
+    # "drop" and "delay" are directives applied by net_fault's caller.
 
 
 def slow_write_seconds() -> float:
@@ -229,8 +297,9 @@ def slow_write_seconds() -> float:
 
 
 def reset() -> None:
-    """Clear fired-action memory and armed delays (tests)."""
+    """Clear fired-action memory and armed delays/partitions (tests)."""
     global _SLOW_WRITE
     with _LOCK:
         _FIRED.clear()
         _SLOW_WRITE = 0.0
+        _PARTITION_UNTIL.clear()

@@ -1358,6 +1358,79 @@ def _check_serving(snap) -> List[Dict]:
     return out
 
 
+def _check_transport(snap) -> List[Dict]:
+    """Serving-transport health: open circuit breakers (a replica being
+    routed around RIGHT NOW), past breaker trips, and a retry rate high
+    enough that the robustness stack is masking a sick network rather
+    than riding out blips. Knob names in the suggestions are the ones
+    ``config.py`` validates: HOROVOD_SERVE_RPC_TIMEOUT,
+    HOROVOD_SERVE_MAX_RETRIES, HOROVOD_SERVE_HEDGE_MS."""
+    out = []
+    open_now = [s.get("labels", {}).get("replica", "?")
+                for s in _series(snap, "gauges", "circuit_state")
+                if float(s.get("value", 0)) >= 1.0]
+    trips = _sum_counter(snap, "circuit_open_total")
+    if open_now:
+        out.append(_finding(
+            "transport_breaker", 0.85,
+            f"circuit open for replica(s): {', '.join(sorted(open_now))}",
+            f"consecutive connect/timeout failures opened the breaker "
+            f"({int(trips)} trip(s) total) — the dispatcher is routing "
+            "around these replicas, so surviving capacity is carrying "
+            "their load",
+            "restart or investigate the dead replica(s); if they are "
+            "merely slow, raise HOROVOD_SERVE_RPC_TIMEOUT or "
+            "HOROVOD_SERVE_BREAKER_FAILURES so transient tail latency "
+            "does not read as death.",
+            open_replicas=sorted(open_now), trips=int(trips)))
+    elif trips > 0:
+        out.append(_finding(
+            "transport_breaker", 0.5,
+            f"{int(trips)} circuit-breaker trip(s) (all recovered)",
+            "replicas went unreachable long enough to open their "
+            "breakers during this run; requests failed over or were "
+            "re-placed on survivors",
+            "check the TRANSPORT timeline markers for which replicas "
+            "tripped and when; correlate with FAULT markers or host "
+            "restarts.",
+            trips=int(trips)))
+    rpcs = 0
+    for s in _series(snap, "histograms", "transport_rpc_seconds"):
+        rpcs += int(s.get("count", 0))
+    retries = _sum_counter(snap, "transport_retries_total")
+    if rpcs >= 20 and retries > 0.1 * rpcs:
+        frac = retries / rpcs
+        out.append(_finding(
+            "transport_retries", 0.35 + min(0.45, frac),
+            f"high transport retry rate: {int(retries)} retries over "
+            f"{int(rpcs)} RPC attempts ({frac:.0%})",
+            "client->replica RPCs are failing at the transport layer "
+            "(connect/timeout) often enough that backoff-and-retry is "
+            "doing load-bearing work — each retry burns deadline budget",
+            "if replicas are healthy but slow, raise "
+            "HOROVOD_SERVE_RPC_TIMEOUT; if the network is lossy, raise "
+            "HOROVOD_SERVE_MAX_RETRIES (and consider hedging queued "
+            "requests with HOROVOD_SERVE_HEDGE_MS) — but a sustained "
+            "rate this high usually means a replica or link is sick.",
+            retries=int(retries), rpc_attempts=int(rpcs)))
+    hedges = _sum_counter(snap, "transport_hedges_total")
+    wins = _sum_counter(snap, "transport_hedge_wins_total")
+    if hedges >= 5 and wins > 0.5 * hedges:
+        out.append(_finding(
+            "transport_hedging", 0.3,
+            f"hedges winning {wins / hedges:.0%} of the time "
+            f"({int(wins)}/{int(hedges)})",
+            "duplicated requests beat their primary replica more often "
+            "than not — the hedge delay fires mostly on genuinely slow "
+            "replicas, i.e. load is imbalanced or a replica is degraded",
+            "find the slow replica (transport_rpc_seconds by replica via "
+            "the timeline, or engine serve_* gauges) rather than "
+            "lowering HOROVOD_SERVE_HEDGE_MS further — hedging spends "
+            "duplicate decode work to hide the problem.",
+            hedges=int(hedges), wins=int(wins)))
+    return out
+
+
 def _check_memory(snap) -> List[Dict]:
     n = _sum_counter(snap, "memory_pressure_total")
     if n <= 0:
@@ -1398,6 +1471,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_memory(snap)
     findings += _check_recovery(snap)
     findings += _check_serving(snap)
+    findings += _check_transport(snap)
     findings += _check_mfu(progs, snap)
     findings += _check_overlap(snap, report)
     findings += _check_fusion(snap)

@@ -22,6 +22,15 @@ ROADMAP's "heavy traffic from millions of users" north star needs:
   replica's claimed requests are reclaimed and drained by survivors
   (the availability playbook of "Highly Available Data Parallel ML
   training on Mesh Networks", PAPERS.md).
+* :mod:`~horovod_tpu.serving.transport` — the network path in front of
+  it all: length-prefixed JSON-RPC over TCP
+  (:class:`~horovod_tpu.serving.transport.SocketReplicaServer` /
+  :class:`~horovod_tpu.serving.transport.RemoteClient` /
+  :class:`~horovod_tpu.serving.transport.RemoteDispatcher`) with
+  per-request deadlines on socket timeouts, bounded jittered retries,
+  per-replica circuit breakers, optional tail-latency hedging, and
+  typed overload shedding. The filesystem spool above stays as the
+  test/CI backend behind the same submit/poll semantics.
 
 Observability is wired through PRs 1–2: TTFT/TPOT/queue-wait histograms,
 slot-occupancy and queue-depth gauges, per-request timeline markers, and
@@ -36,10 +45,17 @@ from horovod_tpu.serving.scheduler import (  # noqa: F401
 from horovod_tpu.serving.replica import (  # noqa: F401
     Dispatcher, ReplicaServer, submit_file_request, wait_file_result,
 )
+from horovod_tpu.serving.transport import (  # noqa: F401
+    CircuitBreaker, RemoteClient, RemoteDispatcher, RemoteHandle,
+    SocketReplicaServer, TransportError, backoff_delays,
+)
 
 __all__ = [
     "InferenceEngine", "PagedKVCache", "BlockManager",
     "Request", "RequestQueue", "RequestStatus", "SlotPool",
     "Dispatcher", "ReplicaServer", "submit_file_request",
     "wait_file_result",
+    "SocketReplicaServer", "RemoteClient", "RemoteDispatcher",
+    "RemoteHandle", "CircuitBreaker", "TransportError",
+    "backoff_delays",
 ]

@@ -49,8 +49,10 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from horovod_tpu import metrics
+from horovod_tpu.faults import fault_point
 from horovod_tpu.serving.engine import InferenceEngine
 from horovod_tpu.serving.scheduler import Request, RequestStatus
+from horovod_tpu.serving.transport import backoff_delays
 
 __all__ = ["Dispatcher", "ReplicaServer", "submit_file_request",
            "wait_file_result", "read_result"]
@@ -210,12 +212,20 @@ def read_result(root: str, request_id: str) -> Optional[Dict[str, Any]]:
 def wait_file_result(root: str, request_id: str,
                      timeout: float = 60.0,
                      poll_s: float = 0.05) -> Dict[str, Any]:
+    """Block until the response lands in ``done/``. Polling backs off
+    with full jitter from ``poll_s`` up to 0.5s (same
+    :func:`~horovod_tpu.serving.transport.backoff_delays` helper the
+    socket transport retries with), clamped so the last sleep ends AT
+    the deadline — many waiting clients don't hammer a shared
+    filesystem in lockstep, and none oversleeps its budget."""
     deadline = time.monotonic() + timeout
+    delays = backoff_delays(base=poll_s, cap=max(poll_s, 0.5),
+                            deadline=deadline)
     while time.monotonic() < deadline:
         res = read_result(root, request_id)
         if res is not None:
             return res
-        time.sleep(poll_s)
+        time.sleep(next(delays))
     raise TimeoutError(f"no result for {request_id} within {timeout}s")
 
 
@@ -252,6 +262,10 @@ class ReplicaServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._claimed: Dict[str, Dict[str, Any]] = {}
+        self._hb_seq = 0               # monotonic, stamped in heartbeats
+        # peer rank -> (last seq value seen, monotonic time it changed)
+        self._peer_seen: Dict[int, Any] = {}
+        self._reclaim_epoch = 0        # sweep counter (a fault_point step)
         self.served = 0
         self.reclaimed = 0
 
@@ -261,14 +275,26 @@ class ReplicaServer:
         return os.path.join(self.dirs["hb"], f"rank{rank}.json")
 
     def _beat(self) -> None:
+        self._hb_seq += 1
         _write_atomic(self._hb_path(self.rank), {
             "rank": self.rank, "unix": time.time(),
+            "seq": self._hb_seq,
             "load": self.engine.load(),
             "alive": self.engine.alive})
 
     def _stale_peers(self) -> List[int]:
+        """Peers whose heartbeat has not ADVANCED for ``stale_after_s``.
+
+        Liveness is the ``seq`` counter inside the payload, not the
+        file's mtime: clock skew on shared storage (or a forged
+        ``os.utime``) can make a dead peer's file look fresh, but it
+        cannot make the sequence number move. Any CHANGE counts as
+        advancing — a restarted peer resets its counter, and ``!=``
+        rather than ``>`` keeps it from reading as stale forever.
+        Payloads without ``seq`` (or torn mid-write) fall back to mtime
+        as the sequence value, which degrades to the old behavior."""
         out = []
-        now = time.time()
+        now = time.monotonic()
         try:
             names = os.listdir(self.dirs["hb"])
         except OSError:
@@ -279,11 +305,23 @@ class ReplicaServer:
             r = int(n[4:-5])
             if r == self.rank:
                 continue
+            path = self._hb_path(r)
+            seq: Any = None
             try:
-                age = now - os.path.getmtime(self._hb_path(r))
-            except OSError:
+                with open(path) as f:
+                    seq = json.load(f).get("seq")
+            except (OSError, ValueError):
+                pass
+            if seq is None:
+                try:
+                    seq = ("mtime", os.path.getmtime(path))
+                except OSError:
+                    continue           # racing removal: peer retired
+            last = self._peer_seen.get(r)
+            if last is None or last[0] != seq:
+                self._peer_seen[r] = (seq, now)
                 continue
-            if age > self.stale_after_s:
+            if now - last[1] > self.stale_after_s:
                 out.append(r)
         return out
 
@@ -380,7 +418,14 @@ class ReplicaServer:
     def _reclaim_stale(self) -> None:
         """Adopt the claims of dead peers: move their claim files back
         to the spool (the normal claim path then picks them up — maybe
-        by us, maybe by another survivor)."""
+        by us, maybe by another survivor).
+
+        Each sweep is a :func:`~horovod_tpu.faults.fault_point` with the
+        sweep index as the step, so a fault plan can stall (or kill) a
+        survivor exactly between noticing a stale peer and winning the
+        rename — the race the two-survivor reclaim tests pin."""
+        self._reclaim_epoch += 1
+        fault_point(self._reclaim_epoch, rank=self.rank)
         for r in self._stale_peers():
             peer_dir = os.path.join(self.dirs["claim"], f"rank{r}")
             try:

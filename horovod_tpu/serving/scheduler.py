@@ -274,6 +274,31 @@ class RequestQueue:
             heapq.heappush(self._heap, (-req.priority, req.seq, req))
         return True
 
+    def shed_lowest(self, below_priority: int) -> Optional[Request]:
+        """Overload shedding (the transport's degradation ladder):
+        remove and return the QUEUED request with the strictly lowest
+        priority under ``below_priority`` — youngest first within that
+        priority, so the request that waited longest keeps its place.
+        Returns ``None`` when nothing outranks the bar; the CALLER
+        finalizes the victim (``REJECTED``, reason ``overloaded: ...``)
+        so the shed policy and its typed reason stay at one layer."""
+        with self._lock:
+            victim: Optional[Request] = None
+            for entry in self._heap:
+                r = entry[2]
+                if r.status != RequestStatus.QUEUED:
+                    continue
+                if r.priority >= below_priority:
+                    continue
+                if victim is None or (r.priority, -r.seq) < \
+                        (victim.priority, -victim.seq):
+                    victim = r
+            if victim is None:
+                return None
+            self._heap = [e for e in self._heap if e[2] is not victim]
+            heapq.heapify(self._heap)
+            return victim
+
     def requeue(self, req: Request) -> None:
         """Put a popped-but-unstarted request back (engine found no
         cache blocks for it). Keyed on the ORIGINAL sequence number, so

@@ -1,0 +1,869 @@
+"""Fault-tolerant serving transport: length-prefixed JSON-RPC over TCP
+behind the same claim/heartbeat semantics as the filesystem spool.
+
+The PR 4 multi-replica layer topped out at a shared filesystem; this is
+the network path in front of it the ROADMAP's "serving at internet
+scale" item asks for. The spool stays (tests, CI, single-host) — this
+module is the same protocol over sockets, wrapped in the robustness
+stack a lossy network needs:
+
+* **Wire format** — one RPC per connection: a 4-byte big-endian length
+  prefix, then a UTF-8 JSON object. ``{"method": ..., "params": {...}}``
+  up, ``{"ok": true, ...}`` / ``{"ok": false, "error", "retryable"}``
+  down. Methods: ``submit`` (idempotent — the server dedupes on the
+  client-generated request id, which is what makes retries and hedging
+  safe), ``poll``, ``status``, ``cancel``.
+* **Deadlines** — a request's remaining deadline rides every RPC and
+  lands on the socket timeout, so a dead peer costs bounded wall clock,
+  never a hang.
+* **Retries** — bounded, jittered exponential backoff
+  (:func:`backoff_delays`, shared with the spool's result poller),
+  only where :class:`~horovod_tpu.serving.scheduler.Request`'s
+  machine-readable ``retryable`` flag (or a transport-level
+  connect/timeout failure) says another attempt can help.
+* **Circuit breakers** — per-replica (:class:`CircuitBreaker`):
+  consecutive connect/timeout failures open the circuit, a cooldown
+  admits one half-open probe, success closes. The dispatcher routes
+  around open circuits instead of burning its deadline re-timing-out.
+* **Hedging** — optional (``HOROVOD_SERVE_HEDGE_MS``): a request still
+  *queued* on its replica past the hedge delay is duplicated onto the
+  next-best replica; first finisher wins, the loser is cancelled.
+  Greedy decode + id-dedup make the duplicate byte-identical and free
+  of double-serve on any single replica.
+* **Degradation ladder** — an overloaded replica sheds the
+  lowest-priority queued request (``REJECTED``, reason
+  ``overloaded: ...``, retryable) before refusing a higher-priority
+  submit; nothing is ever accepted and then silently dropped.
+* **Fault injection** — :func:`horovod_tpu.faults.net_fault` runs at
+  every inbound RPC, so a ``HOROVOD_FAULT_PLAN`` can kill a replica at
+  its Nth RPC, drop/delay single responses, or partition it for a
+  bounded window (``tools/net_smoke.py`` / ``make net-smoke``).
+
+Observability: ``transport_rpc_seconds{method,outcome}``,
+``transport_retries_total{method}``, ``circuit_state{replica}`` (0
+closed / 0.5 half-open / 1 open), ``circuit_open_total``, hedge/shed/
+failover counters, and ``TRANSPORT`` timeline markers; ``hvd.doctor()``
+ranks high retry rates and open breakers with knob suggestions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from horovod_tpu import faults, metrics
+from horovod_tpu.serving.scheduler import Request, RequestStatus
+
+__all__ = ["TransportError", "backoff_delays", "CircuitBreaker",
+           "SocketReplicaServer", "RemoteClient", "RemoteHandle",
+           "RemoteDispatcher"]
+
+_MAX_FRAME = 16 * 1024 * 1024      # sanity bound on one JSON frame
+_TERMINAL = ("done", "rejected", "expired", "cancelled", "failed")
+
+_HANDLE_SEQ = itertools.count(1)
+
+
+# ---------------------------------------------------------------------------
+# shared retry/backoff helper (also used by replica.wait_file_result)
+# ---------------------------------------------------------------------------
+
+def backoff_delays(*, base: float = 0.02, cap: float = 1.0,
+                   factor: float = 2.0, deadline: Optional[float] = None,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """Infinite generator of jittered exponential backoff sleeps.
+
+    Classic full-jitter: each yielded delay is uniform in ``[d/2, d]``
+    where ``d`` doubles from ``base`` up to ``cap`` — retriers spread
+    out instead of thundering in lockstep. With ``deadline`` (absolute
+    ``time.monotonic()``), every yield is additionally clamped to the
+    time remaining, so a retry loop sleeps up to — never past — its
+    budget."""
+    rng = rng if rng is not None else random.Random()
+    d = float(base)
+    while True:
+        j = rng.uniform(d / 2.0, d)
+        if deadline is not None:
+            j = min(j, max(0.0, deadline - time.monotonic()))
+        yield j
+        d = min(float(cap), d * factor)
+
+
+class TransportError(RuntimeError):
+    """A client->replica RPC failed at the transport layer.
+
+    ``kind`` is the typed reason — ``connect``, ``timeout``,
+    ``deadline``, ``circuit_open``, ``protocol``, ``error`` — and
+    ``retryable`` says whether another attempt (here or on another
+    replica) could still succeed. Mirrors ``Request.retryable``:
+    decisions key on the flag, never on the message text."""
+
+    def __init__(self, kind: str, message: str, *, retryable: bool):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.retryable = bool(retryable)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    if len(data) > _MAX_FRAME:
+        raise TransportError("protocol",
+                             f"frame of {len(data)} bytes exceeds "
+                             f"{_MAX_FRAME}", retryable=False)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > _MAX_FRAME:
+        raise TransportError("protocol",
+                             f"peer announced a {n}-byte frame "
+                             f"(cap {_MAX_FRAME})", retryable=False)
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: closed -> open on ``failures``
+    consecutive connect/timeout failures, open -> half-open after
+    ``reset_s`` (one probe in flight at a time), half-open -> closed on
+    probe success / back to open on probe failure.
+
+    State is exported as ``circuit_state{replica}``: 0 closed, 0.5
+    half-open, 1 open — the doctor reads the gauge, the dispatcher
+    reads :meth:`allow`."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _GAUGE = {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}
+
+    def __init__(self, name: str, *, failures: Optional[int] = None,
+                 reset_s: Optional[float] = None):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        self.name = name
+        self.failures = int(failures if failures is not None
+                            else cfg.serve_breaker_failures)
+        self.reset_s = float(reset_s if reset_s is not None
+                             else cfg.serve_breaker_reset_seconds)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+        metrics.gauge("circuit_state", replica=name).set(0.0)
+
+    def _transition(self, new: str) -> None:
+        # under self._lock
+        if new == self._state:
+            return
+        old, self._state = self._state, new
+        metrics.gauge("circuit_state", replica=self.name).set(
+            self._GAUGE[new])
+        if new == self.OPEN:
+            metrics.counter("circuit_open_total", replica=self.name).inc()
+        metrics._timeline_marker("TRANSPORT", category="transport",
+                                 event="circuit", replica=self.name,
+                                 from_state=old, to_state=new)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call go out now? Open circuits refuse instantly (the
+        caller routes around instead of re-timing-out); after the reset
+        window ONE half-open probe is admitted. A half-open probe that
+        never reports back (its caller died, or the token was consumed
+        without an RPC) expires after another ``reset_s`` so the breaker
+        cannot wedge in half-open forever."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = time.monotonic()
+            if self._state == self.OPEN and \
+                    now - self._opened_at >= self.reset_s:
+                self._transition(self.HALF_OPEN)
+                self._probe_at = now
+                return True
+            if self._state == self.HALF_OPEN and \
+                    now - self._probe_at >= self.reset_s:
+                self._probe_at = now    # stale probe: admit a fresh one
+                return True
+            return False        # open (cooling) or half-open (probing)
+
+    def success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._transition(self.CLOSED)
+
+    def failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == self.HALF_OPEN \
+                    or self._consecutive >= self.failures:
+                self._opened_at = time.monotonic()
+                self._transition(self.OPEN)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class SocketReplicaServer:
+    """One replica's RPC front: a listener over an
+    :class:`~horovod_tpu.serving.engine.InferenceEngine`.
+
+    Connection-per-RPC keeps failure atomic (a dead or partitioned
+    replica is a failed *connect*, not a wedged stream) and gives the
+    fault plan a natural injection point: every inbound connection is a
+    ``net_fault`` step for this rank. Results are published exactly like
+    the spool's ``done/`` files — the full terminal request state, typed
+    status + reason + ``retryable`` — but pulled by ``poll`` instead of
+    a directory scan."""
+
+    def __init__(self, engine, rank: int, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self.rank = int(rank)
+        self.name = f"rank{self.rank}"
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self.address = (self.host, self.port)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._requests: Dict[str, Request] = {}
+        self._rpc_seq = itertools.count(1)
+        self.served_rpcs = 0
+
+    # -- request registry -------------------------------------------------
+
+    def _remember(self, req: Request) -> None:
+        with self._lock:
+            self._requests[req.id] = req
+            if len(self._requests) > 4096:
+                # Bounded registry: drop the oldest terminal entries; a
+                # client that polls later gets "unknown id" (permanent).
+                for rid in list(self._requests):
+                    if len(self._requests) <= 2048:
+                        break
+                    if self._requests[rid].status.terminal:
+                        del self._requests[rid]
+
+    def _state(self, req: Request) -> Dict[str, Any]:
+        return {"ok": True, "id": req.id, "status": req.status.value,
+                "reason": req.reason, "retryable": bool(req.retryable),
+                "tokens": [int(t) for t in req.tokens],
+                "served_by": self.name, "ttft": req.ttft,
+                "tpot": req.tpot, "queue_wait": req.queue_wait}
+
+    # -- method handlers --------------------------------------------------
+
+    def _do_submit(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        rid = p.get("request_id")
+        if not rid:
+            return {"ok": False, "error": "submit needs request_id "
+                    "(idempotency key)", "retryable": False}
+        with self._lock:
+            existing = self._requests.get(rid)
+        if existing is not None:
+            # Retry or hedge replay: the id IS the dedup key. Return the
+            # current state instead of double-serving.
+            return self._state(existing)
+        kw: Dict[str, Any] = {"priority": int(p.get("priority", 0)),
+                              "request_id": rid}
+        if p.get("eos_id") is not None:
+            kw["eos_id"] = int(p["eos_id"])
+        if p.get("src") is not None:
+            kw["src"] = list(map(int, p["src"]))
+        if p.get("deadline_s") is not None:
+            kw["deadline_s"] = float(p["deadline_s"])
+        prompt = p.get("prompt") or None
+        mnt = int(p.get("max_new_tokens", 1))
+        req = self.engine.submit(prompt, mnt, **kw)
+        if req.status == RequestStatus.REJECTED and req.retryable \
+                and self.engine.alive:
+            req = self._try_shed_and_resubmit(req, prompt, mnt, kw)
+        self._remember(req)
+        return self._state(req)
+
+    def _try_shed_and_resubmit(self, req: Request, prompt, mnt: int,
+                               kw: Dict[str, Any]) -> Request:
+        """Degradation ladder: a capacity rejection sheds the lowest-
+        priority queued request (typed ``overloaded`` reject, retryable
+        — its client re-routes) and admits the newcomer in its place.
+        Either way the surviving rejection reason is ``overloaded: ...``
+        so clients and the doctor see overload, not a generic bounce."""
+        queue = self.engine.queue
+        full = queue.depth() >= getattr(queue, "maxsize", 0)
+        if not full:
+            return req
+        victim = queue.shed_lowest(kw.get("priority", 0))
+        if victim is not None:
+            victim.retryable = True
+            victim._finish(RequestStatus.REJECTED,
+                           "overloaded: shed for higher-priority "
+                           "admission")
+            metrics.counter("transport_shed_total",
+                            replica=self.name).inc()
+            metrics._timeline_marker("TRANSPORT", category="transport",
+                                     event="shed", replica=self.name,
+                                     victim=victim.id)
+            req = self.engine.submit(prompt, mnt, **kw)
+        if req.status == RequestStatus.REJECTED and req.retryable \
+                and not (req.reason or "").startswith("overloaded"):
+            req.reason = f"overloaded: {req.reason}"
+        return req
+
+    def _do_poll(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            req = self._requests.get(p.get("id", ""))
+        if req is None:
+            return {"ok": False, "error": f"unknown id {p.get('id')!r}",
+                    "retryable": False}
+        return self._state(req)
+
+    def _do_cancel(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            req = self._requests.get(p.get("id", ""))
+        if req is None:
+            return {"ok": False, "error": f"unknown id {p.get('id')!r}",
+                    "retryable": False}
+        req.cancel()
+        return self._state(req)
+
+    def _do_status(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        # The socket analogue of the spool heartbeat file — including
+        # the monotonic sequence number a liveness probe must see
+        # ADVANCE (a forged mtime can't fake progress; neither can a
+        # replayed status response).
+        return {"ok": True, "rank": self.rank, "alive": self.engine.alive,
+                "load": self.engine.load(), "slots": self.engine.slots,
+                "queue_depth": self.engine.queue.depth(),
+                "seq": self.served_rpcs}
+
+    _METHODS = {"submit": _do_submit, "poll": _do_poll,
+                "cancel": _do_cancel, "status": _do_status}
+
+    # -- connection handling ----------------------------------------------
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        seq = next(self._rpc_seq)
+        try:
+            # Fault points first: a partition in force (or fired AT this
+            # rpc) closes the connection unread — the client sees a
+            # reset, exactly what a mesh partition looks like.
+            directives = faults.net_fault(seq, self.rank)
+            if faults.partitioned(self.rank):
+                return
+            conn.settimeout(30.0)
+            msg = _recv_frame(conn)
+            method = msg.get("method", "")
+            handler = self._METHODS.get(method)
+            if handler is None:
+                resp: Dict[str, Any] = {
+                    "ok": False, "error": f"unknown method {method!r}",
+                    "retryable": False}
+            else:
+                try:
+                    resp = handler(self, msg.get("params") or {})
+                except Exception as e:      # noqa: BLE001 — typed reply
+                    resp = {"ok": False,
+                            "error": f"server error: {e!r}",
+                            "retryable": True}
+            if directives["delay_s"] > 0:
+                time.sleep(directives["delay_s"])
+            if directives["drop"]:
+                return                     # served, never answered
+            _send_frame(conn, resp)
+            self.served_rpcs += 1
+        except (OSError, ValueError, ConnectionError, TransportError):
+            pass                           # peer gone mid-rpc; its retry
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def start(self) -> "SocketReplicaServer":
+        self.engine.start()
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except OSError:
+                    return                 # listener closed by stop()
+                threading.Thread(target=self._handle_conn, args=(conn,),
+                                 daemon=True).start()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"hvd-rpc-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RemoteClient:
+    """One replica's client stub: connection-per-RPC with deadline
+    propagation, bounded jittered retries, and a circuit breaker.
+
+    Every attempt's socket timeout is ``min(rpc_timeout, remaining
+    deadline)`` — a request's deadline bounds its worst-case transport
+    wall clock by construction. Retries fire only on transport-level
+    connect/timeout failures (server-side outcomes ride the response's
+    ``retryable`` flag and are the DISPATCHER's re-route decision, not a
+    same-replica retry)."""
+
+    def __init__(self, address: Tuple[str, int], *,
+                 name: Optional[str] = None,
+                 rpc_timeout: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 rng: Optional[random.Random] = None):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        self.address = (address[0], int(address[1]))
+        self.name = name or f"{address[0]}:{address[1]}"
+        self.rpc_timeout = float(rpc_timeout if rpc_timeout is not None
+                                 else cfg.serve_rpc_timeout_seconds)
+        self.max_retries = int(max_retries if max_retries is not None
+                               else cfg.serve_max_retries)
+        self.breaker = breaker or CircuitBreaker(self.name)
+        self._rng = rng or random.Random()
+
+    def _rpc_once(self, method: str, params: Dict[str, Any],
+                  timeout: float) -> Dict[str, Any]:
+        with socket.create_connection(self.address,
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"method": method, "params": params})
+            return _recv_frame(sock)
+
+    def call(self, method: str, params: Optional[Dict[str, Any]] = None,
+             *, deadline: Optional[float] = None,
+             retry: bool = True) -> Dict[str, Any]:
+        """One RPC with the full robustness stack; ``deadline`` is
+        absolute ``time.monotonic()``. Raises :class:`TransportError`
+        (typed, with ``retryable``) instead of ever hanging."""
+        params = params or {}
+        attempts = 0
+        delays = backoff_delays(base=0.02, cap=0.5, deadline=deadline,
+                                rng=self._rng)
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise TransportError(
+                    "deadline", f"{method} to {self.name}: deadline "
+                    "exhausted", retryable=False)
+            if not self.breaker.allow():
+                metrics.histogram("transport_rpc_seconds", method=method,
+                                  outcome="circuit_open").observe(0.0)
+                raise TransportError(
+                    "circuit_open", f"{method} to {self.name}: circuit "
+                    "open", retryable=True)
+            per_try = (self.rpc_timeout if remaining is None
+                       else max(0.05, min(self.rpc_timeout, remaining)))
+            t0 = time.perf_counter()
+            try:
+                resp = self._rpc_once(method, params, per_try)
+            except (OSError, ValueError, ConnectionError) as e:
+                outcome = ("timeout" if isinstance(e, socket.timeout)
+                           else "connect")
+                metrics.histogram("transport_rpc_seconds", method=method,
+                                  outcome=outcome).observe(
+                                      time.perf_counter() - t0)
+                self.breaker.failure()
+                attempts += 1
+                if not retry or attempts > self.max_retries:
+                    raise TransportError(
+                        outcome, f"{method} to {self.name} failed after "
+                        f"{attempts} attempt(s): {e!r}",
+                        retryable=True) from e
+                metrics.counter("transport_retries_total",
+                                method=method).inc()
+                metrics._timeline_marker("TRANSPORT",
+                                         category="transport",
+                                         event="retry", method=method,
+                                         replica=self.name,
+                                         attempt=attempts)
+                time.sleep(next(delays))
+                continue
+            self.breaker.success()
+            if not resp.get("ok"):
+                metrics.histogram("transport_rpc_seconds", method=method,
+                                  outcome="error").observe(
+                                      time.perf_counter() - t0)
+                raise TransportError(
+                    "error", f"{method} to {self.name}: "
+                    f"{resp.get('error')}",
+                    retryable=bool(resp.get("retryable")))
+            metrics.histogram("transport_rpc_seconds", method=method,
+                              outcome="ok").observe(
+                                  time.perf_counter() - t0)
+            return resp
+
+    # -- typed methods ----------------------------------------------------
+
+    def submit(self, spec: Dict[str, Any], *,
+               deadline: Optional[float] = None) -> Dict[str, Any]:
+        params = dict(spec)
+        if deadline is not None:
+            params["deadline_s"] = max(0.0, deadline - time.monotonic())
+        return self.call("submit", params, deadline=deadline)
+
+    def poll(self, request_id: str, *,
+             deadline: Optional[float] = None) -> Dict[str, Any]:
+        return self.call("poll", {"id": request_id}, deadline=deadline)
+
+    def cancel(self, request_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.call("cancel", {"id": request_id},
+                             deadline=time.monotonic() + self.rpc_timeout,
+                             retry=False)
+        except TransportError:
+            return None                    # best-effort by design
+
+    def status(self, *, deadline: Optional[float] = None,
+               retry: bool = False) -> Dict[str, Any]:
+        if deadline is None:
+            deadline = time.monotonic() + min(1.0, self.rpc_timeout)
+        return self.call("status", {}, deadline=deadline, retry=retry)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+class RemoteHandle:
+    """Client-side handle for one remote request: the socket analogue of
+    :class:`~horovod_tpu.serving.scheduler.Request`, updated by
+    :meth:`RemoteDispatcher.wait` from poll responses."""
+
+    def __init__(self, spec: Dict[str, Any],
+                 deadline: Optional[float] = None):
+        self.spec = spec                   # resubmittable: prompt etc.
+        self.id: str = spec["request_id"]
+        self.deadline = deadline           # absolute monotonic, or None
+        self.status: str = "queued"
+        self.tokens: List[int] = []
+        self.reason: Optional[str] = None
+        self.retryable: bool = False
+        self.served_by: Optional[str] = None
+        self.ttft: Optional[float] = None
+        self.tpot: Optional[float] = None
+        self.owners: List[RemoteClient] = []
+        self.resubmits = 0
+        self.hedged = False
+        self.t_submit = time.monotonic()
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in _TERMINAL
+
+    def _apply(self, st: Dict[str, Any],
+               client: "RemoteClient") -> None:
+        self.status = st["status"]
+        self.tokens = list(st.get("tokens") or [])
+        self.reason = st.get("reason")
+        self.retryable = bool(st.get("retryable"))
+        self.served_by = st.get("served_by") or client.name
+        self.ttft = st.get("ttft")
+        self.tpot = st.get("tpot")
+
+    def describe(self) -> Dict[str, Any]:
+        return {"id": self.id, "status": self.status,
+                "reason": self.reason, "served_by": self.served_by,
+                "generated": len(self.tokens), "ttft": self.ttft,
+                "tpot": self.tpot, "resubmits": self.resubmits,
+                "hedged": self.hedged}
+
+    def __repr__(self) -> str:
+        return (f"RemoteHandle({self.id}, {self.status}, "
+                f"gen={len(self.tokens)})")
+
+
+class RemoteDispatcher:
+    """Route requests across socket replicas: least-loaded placement,
+    circuit-aware routing, failover resubmission, optional hedging.
+
+    The network twin of :class:`~horovod_tpu.serving.replica.Dispatcher`
+    — same least-loaded + adoption shape, but distance means the
+    dispatcher can only observe replicas through RPCs, so liveness is
+    the breaker state plus a (briefly cached) ``status`` probe. A lost
+    replica's in-flight requests are resubmitted to survivors; greedy
+    decode and per-server id-dedup make the replay byte-identical."""
+
+    _STATUS_TTL = 0.25
+
+    def __init__(self, addresses: Sequence[Tuple[str, int]], *,
+                 clients: Optional[Sequence[RemoteClient]] = None,
+                 hedge_ms: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None,
+                 max_retries: Optional[int] = None):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        if clients is not None:
+            self.clients = list(clients)
+        else:
+            self.clients = [
+                RemoteClient(a, rpc_timeout=rpc_timeout,
+                             max_retries=max_retries)
+                for a in addresses]
+        if not self.clients:
+            raise ValueError("need at least one replica address")
+        self.hedge_s = (cfg.serve_hedge_ms if hedge_ms is None
+                        else float(hedge_ms)) / 1000.0
+        self._status: Dict[str, Tuple[float, float]] = {}  # name->(ts,load)
+        self._lock = threading.Lock()
+
+    # -- routing ----------------------------------------------------------
+
+    def _load_of(self, client: RemoteClient) -> float:
+        # Deliberately no breaker pre-check here: ``call()`` owns the
+        # single ``allow()`` gate. Consulting ``allow()`` twice would
+        # consume the one half-open probe token before the status RPC
+        # could spend it, wedging the breaker half-open forever. A
+        # cooling breaker makes ``status()`` raise circuit_open without
+        # a connect, so this stays cheap.
+        now = time.monotonic()
+        with self._lock:
+            cached = self._status.get(client.name)
+        if cached is not None and now - cached[0] < self._STATUS_TTL:
+            return cached[1]
+        try:
+            st = client.status()
+            load = (float(st.get("load", 0))
+                    if st.get("alive", True) else float("inf"))
+        except TransportError:
+            load = float("inf")
+        with self._lock:
+            self._status[client.name] = (now, load)
+        return load
+
+    def _ranked(self, exclude: Sequence[RemoteClient] = ()) -> \
+            List[RemoteClient]:
+        scored = [(self._load_of(c), i, c)
+                  for i, c in enumerate(self.clients) if c not in exclude]
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return [c for load, _, c in scored if load != float("inf")]
+
+    # -- submit/wait ------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               eos_id: Optional[int] = None, src=None,
+               request_id: Optional[str] = None) -> RemoteHandle:
+        """Place one request on the least-loaded live replica; returns a
+        handle that is already terminal (typed REJECTED) if no replica
+        accepts. Pass the handle to :meth:`wait` for the result."""
+        rid = request_id or (f"rpc-{os.getpid()}-"
+                             f"{next(_HANDLE_SEQ)}")
+        spec: Dict[str, Any] = {
+            "prompt": None if prompt is None else list(map(int, prompt)),
+            "max_new_tokens": int(max_new_tokens),
+            "priority": int(priority), "request_id": rid}
+        if eos_id is not None:
+            spec["eos_id"] = int(eos_id)
+        if src is not None:
+            spec["src"] = list(map(int, src))
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s is not None else None)
+        handle = RemoteHandle(spec, deadline)
+        self._place(handle)
+        return handle
+
+    def _place(self, handle: RemoteHandle,
+               exclude: Sequence[RemoteClient] = ()) -> bool:
+        """Try each live replica (least-loaded first) until one accepts;
+        retryable rejections (overload, draining) re-route, permanent
+        ones surface. On total failure the handle carries a typed,
+        retryable rejection — wait() keeps re-placing until the
+        deadline, because a partition can heal."""
+        last_reason = "no live replicas"
+        candidates = self._ranked(exclude=exclude)
+        if not candidates:
+            # Nobody LOOKS live (status probes failing, breakers open).
+            # Looking dead is not being dead — a replica mid-compile
+            # answers submits slower than the probe timeout, and a
+            # single-replica deployment must not reject on that. Try
+            # the submit itself as the probe; open breakers still gate
+            # each attempt (instant circuit_open until their half-open
+            # token), so this pass stays cheap.
+            candidates = [c for c in self.clients if c not in exclude]
+        for client in candidates:
+            try:
+                st = client.submit(handle.spec, deadline=handle.deadline)
+            except TransportError as e:
+                last_reason = str(e)
+                if e.retryable:
+                    continue
+                handle.status, handle.reason = "failed", str(e)
+                return False
+            if st["status"] == "rejected" and st.get("retryable"):
+                last_reason = st.get("reason") or last_reason
+                continue                   # overloaded etc: next replica
+            handle._apply(st, client)
+            if not handle.terminal:
+                handle.owners.append(client)
+                if handle.resubmits:
+                    metrics.counter("transport_failover_total").inc()
+                    metrics._timeline_marker(
+                        "TRANSPORT", category="transport",
+                        event="failover", request=handle.id,
+                        target=client.name)
+            return True
+        handle.status = "rejected"
+        handle.reason = last_reason
+        handle.retryable = True
+        return False
+
+    def _maybe_hedge(self, handle: RemoteHandle) -> None:
+        if (self.hedge_s <= 0 or handle.hedged
+                or len(handle.owners) != 1
+                or handle.status != "queued"
+                or time.monotonic() - handle.t_submit < self.hedge_s):
+            return
+        backups = self._ranked(exclude=handle.owners)
+        if not backups:
+            return
+        try:
+            st = backups[0].submit(handle.spec, deadline=handle.deadline)
+        except TransportError:
+            return
+        if st["status"] in _TERMINAL and st["status"] != "done":
+            return
+        handle.owners.append(backups[0])
+        handle.hedged = True
+        metrics.counter("transport_hedges_total").inc()
+        metrics._timeline_marker("TRANSPORT", category="transport",
+                                 event="hedge", request=handle.id,
+                                 target=backups[0].name)
+
+    def wait(self, handle: RemoteHandle,
+             timeout: Optional[float] = None) -> RemoteHandle:
+        """Poll until the request is terminal — NEVER past its deadline.
+        A lost owner triggers failover resubmission; a still-queued
+        request past the hedge delay is duplicated; deadline exhaustion
+        yields a typed local ``expired`` (with best-effort server-side
+        cancels), not a hang."""
+        deadline = handle.deadline
+        if timeout is not None:
+            t = time.monotonic() + float(timeout)
+            deadline = t if deadline is None else min(deadline, t)
+        if deadline is None:
+            deadline = time.monotonic() + 60.0
+        delays = backoff_delays(base=0.005, cap=0.25, deadline=deadline)
+        while True:
+            if handle.terminal:
+                if not (handle.status == "rejected" and handle.retryable
+                        and time.monotonic() < deadline):
+                    return handle
+                # Retryable rejection with budget left: keep re-placing
+                # (an overload drains, a partition heals).
+                if self._place(handle):
+                    handle.resubmits += 1
+            if time.monotonic() >= deadline:
+                return self._expire_locally(handle)
+            winner = None
+            for client in list(handle.owners):
+                poll_by = min(deadline, time.monotonic()
+                              + max(0.2, client.rpc_timeout))
+                try:
+                    st = client.poll(handle.id, deadline=poll_by)
+                except TransportError as e:
+                    if not e.retryable:
+                        handle.status, handle.reason = "failed", str(e)
+                        return handle
+                    handle.owners.remove(client)   # lost: fail over
+                    continue
+                if st["status"] == "done":
+                    winner = (client, st)
+                    break
+                if st["status"] in _TERMINAL:
+                    if st.get("retryable"):
+                        handle.owners.remove(client)
+                        continue           # permanent elsewhere? no: typed
+                    handle._apply(st, client)
+                    self._cancel_others(handle, keep=client)
+                    return handle
+                handle.status = st["status"]
+            if winner is not None:
+                client, st = winner
+                handle._apply(st, client)
+                if handle.hedged and handle.owners \
+                        and client is not handle.owners[0]:
+                    metrics.counter("transport_hedge_wins_total").inc()
+                self._cancel_others(handle, keep=client)
+                return handle
+            if not handle.owners and not handle.terminal:
+                if self._place(handle):
+                    handle.resubmits += 1
+            self._maybe_hedge(handle)
+            time.sleep(next(delays))
+
+    def _expire_locally(self, handle: RemoteHandle) -> RemoteHandle:
+        if not handle.terminal:
+            handle.status = "expired"
+            handle.reason = ("client deadline exceeded waiting for "
+                             "result")
+        for client in handle.owners:
+            client.cancel(handle.id)
+        metrics.counter("transport_deadline_total").inc()
+        return handle
+
+    def _cancel_others(self, handle: RemoteHandle,
+                       keep: RemoteClient) -> None:
+        for client in handle.owners:
+            if client is not keep:
+                client.cancel(handle.id)
+        handle.owners = [keep]
+
+    def wait_all(self, handles: Sequence[RemoteHandle],
+                 timeout: Optional[float] = None) -> List[RemoteHandle]:
+        return [self.wait(h, timeout=timeout) for h in handles]

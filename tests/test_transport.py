@@ -1,0 +1,910 @@
+"""Serving transport: socket RPC, retries/backoff, circuit breakers,
+hedging, overload shedding, network fault injection, heartbeat-seq
+staleness, and the claim/reclaim race.
+
+Acceptance pins (ISSUE 10):
+
+* socket-served tokens are TOKEN-IDENTICAL to offline ``generate()``
+  (parity survives the network hop, retries, and replays);
+* every client-visible outcome is typed and terminal — deadlines
+  produce ``expired``, overload produces ``rejected`` with an
+  ``overloaded`` reason and ``retryable=True``, dead replicas produce
+  transport errors with ``retryable=True`` — never a hang;
+* consecutive connect/timeout failures open a per-replica circuit
+  breaker the dispatcher routes around; half-open probes close it;
+* two survivors racing to reclaim one stale peer's claim: exactly one
+  wins (atomic rename), the loser backs off cleanly;
+* heartbeat liveness keys on the payload's monotonic ``seq``, so a
+  forged mtime cannot resurrect a dead peer.
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import config as hconfig
+from horovod_tpu import faults, metrics
+from horovod_tpu.models.generate import generate
+from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.replica import ReplicaServer, wait_file_result
+from horovod_tpu.serving.scheduler import (
+    Request, RequestQueue, RequestStatus,
+)
+from horovod_tpu.serving.transport import (
+    CircuitBreaker, RemoteClient, RemoteDispatcher, SocketReplicaServer,
+    TransportError, backoff_delays, _recv_frame, _send_frame,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _restore_world():
+    yield
+    faults.reset()
+    os.environ.pop("HOROVOD_FAULT_PLAN", None)
+    for k in ("HOROVOD_SERVE_RPC_TIMEOUT", "HOROVOD_SERVE_MAX_RETRIES",
+              "HOROVOD_SERVE_HEDGE_MS", "HOROVOD_SERVE_BREAKER_FAILURES",
+              "HOROVOD_SERVE_BREAKER_RESET"):
+        os.environ.pop(k, None)
+    hconfig.refresh()
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    return model, params, cfg
+
+
+# ---------------------------------------------------------------------------
+# engine stand-ins: the transport only needs the engine *surface*
+# ---------------------------------------------------------------------------
+
+class ServeNowEngine:
+    """Completes every request instantly: tokens = [0..n)."""
+
+    def __init__(self, name="fake0", slots=4, maxsize=32):
+        self.name = name
+        self.slots = slots
+        self.alive = True
+        self.queue = RequestQueue(maxsize=maxsize)
+        self.submitted = []
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def load(self):
+        return self.queue.depth()
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        kw.pop("deadline_s", None)
+        req = Request(prompt if prompt is not None else [0],
+                      max_new_tokens, **kw)
+        self.submitted.append(req.id)
+        req.tokens = list(range(max_new_tokens))
+        req._finish(RequestStatus.DONE, None)
+        return req
+
+
+class NeverServeEngine(ServeNowEngine):
+    """Accepts into a real bounded queue and never serves — requests
+    stay QUEUED (hedging bait) and the queue genuinely fills
+    (shedding bait)."""
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        kw.pop("deadline_s", None)
+        req = Request(prompt if prompt is not None else [0],
+                      max_new_tokens, **kw)
+        self.submitted.append(req.id)
+        return self.queue.submit(req)
+
+
+def _free_port_addr():
+    """An address that refuses connections: bind, learn the port, close."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()[:2]
+    s.close()
+    return ("127.0.0.1", addr[1])
+
+
+# ---------------------------------------------------------------------------
+# backoff helper (shared by transport retries and wait_file_result)
+# ---------------------------------------------------------------------------
+
+class TestBackoffDelays:
+    def test_doubles_to_cap_with_full_jitter(self):
+        gen = backoff_delays(base=0.1, cap=0.4, rng=random.Random(3))
+        ceilings = [0.1, 0.2, 0.4, 0.4, 0.4]
+        for d, ceil in zip((next(gen) for _ in range(5)), ceilings):
+            assert ceil / 2 <= d <= ceil
+
+    def test_jitter_varies_between_draws(self):
+        gen = backoff_delays(base=1.0, cap=1.0, rng=random.Random(0))
+        xs = {round(next(gen), 9) for _ in range(8)}
+        assert len(xs) > 1
+
+    def test_deadline_clamps_to_remaining_budget(self):
+        deadline = time.monotonic() + 0.05
+        gen = backoff_delays(base=10.0, cap=10.0, deadline=deadline,
+                             rng=random.Random(1))
+        assert next(gen) <= 0.06
+        time.sleep(0.06)
+        assert next(gen) == 0.0       # past deadline: no oversleep
+
+    def test_wait_file_result_bounded_by_timeout(self, tmp_path):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            wait_file_result(str(tmp_path), "nope", timeout=0.3)
+        # jittered polling must not oversleep the budget (cap is 0.5s,
+        # but every sleep is clamped to the remaining deadline)
+        assert time.monotonic() - t0 < 0.3 + 0.25
+
+    def test_wait_file_result_still_finds_result(self, tmp_path):
+        os.makedirs(tmp_path / "done", exist_ok=True)
+        payload = {"id": "r1", "status": "done", "tokens": [1, 2]}
+
+        def land():
+            time.sleep(0.15)
+            with open(tmp_path / "done" / "r1.json", "w") as f:
+                json.dump(payload, f)
+
+        threading.Thread(target=land, daemon=True).start()
+        assert wait_file_result(str(tmp_path), "r1",
+                                timeout=10.0) == payload
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+class TestWireFormat:
+    def test_roundtrip_over_socketpair(self):
+        a, b = socket.socketpair()
+        try:
+            msg = {"method": "poll", "params": {"id": "x", "n": [1, 2]}}
+            _send_frame(a, msg)
+            assert _recv_frame(b) == msg
+        finally:
+            a.close()
+            b.close()
+
+    def test_oversized_announced_frame_is_protocol_error(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 1 << 30))
+            with pytest.raises(TransportError) as ei:
+                _recv_frame(b)
+            assert ei.value.kind == "protocol"
+            assert not ei.value.retryable
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncated_frame_raises_connection_error(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 100) + b"{")
+            a.close()
+            with pytest.raises(ConnectionError):
+                _recv_frame(b)
+        finally:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        br = CircuitBreaker("r0", failures=3, reset_s=60.0)
+        br.failure()
+        br.failure()
+        br.success()                 # streak broken
+        br.failure()
+        br.failure()
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+        br.failure()                 # third consecutive
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+
+    def test_half_open_single_probe_then_close_or_reopen(self):
+        br = CircuitBreaker("r1", failures=1, reset_s=0.05)
+        br.failure()
+        assert not br.allow()
+        time.sleep(0.06)
+        assert br.allow()            # ONE half-open probe
+        assert not br.allow()        # no second probe while in flight
+        br.failure()                 # probe failed -> straight back open
+        assert br.state == CircuitBreaker.OPEN
+        time.sleep(0.06)
+        assert br.allow()
+        br.success()                 # probe succeeded -> closed
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+
+    def test_stale_half_open_probe_expires(self):
+        """A consumed probe token whose caller never reports back must
+        not wedge the breaker half-open forever — after another
+        reset_s a fresh probe is admitted."""
+        br = CircuitBreaker("r2", failures=1, reset_s=0.05)
+        br.failure()
+        time.sleep(0.06)
+        assert br.allow()            # token consumed, never reported
+        assert not br.allow()
+        time.sleep(0.06)
+        assert br.allow()            # stale probe expired: fresh token
+        br.success()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_state_exported_as_gauge(self):
+        metrics.reset_metrics()
+        br = CircuitBreaker("gauged", failures=1, reset_s=60.0)
+        br.failure()
+        snap = metrics.snapshot()
+        vals = {s["labels"]["replica"]: s["value"]
+                for s in snap["gauges"]["circuit_state"]}
+        assert vals["gauged"] == 1.0
+        assert any(s["labels"].get("replica") == "gauged"
+                   for s in snap["counters"]["circuit_open_total"])
+
+
+# ---------------------------------------------------------------------------
+# socket server + client (fake engines: no jax in the loop)
+# ---------------------------------------------------------------------------
+
+class TestSocketRpc:
+    def test_submit_poll_roundtrip_and_status(self):
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            st = client.submit({"prompt": [1, 2, 3], "max_new_tokens": 5,
+                                "request_id": "rt-1"})
+            assert st["status"] == "done"
+            assert st["tokens"] == [0, 1, 2, 3, 4]
+            assert st["served_by"] == "rank0"
+            assert client.poll("rt-1")["status"] == "done"
+            info = client.status()
+            assert info["alive"] and info["rank"] == 0
+            assert info["seq"] >= 1   # liveness counter advances
+        finally:
+            srv.stop()
+
+    def test_submit_is_idempotent_on_request_id(self):
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            a = client.submit({"prompt": [1], "max_new_tokens": 3,
+                               "request_id": "dup"})
+            b = client.submit({"prompt": [1], "max_new_tokens": 3,
+                               "request_id": "dup"})
+            assert a["tokens"] == b["tokens"]
+            # the dedup registry served it ONCE: retries and hedges
+            # are safe because replays return state, not new work
+            assert eng.submitted.count("dup") == 1
+        finally:
+            srv.stop()
+
+    def test_unknown_request_id_is_permanent_error(self):
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            with pytest.raises(TransportError) as ei:
+                client.poll("ghost")
+            assert not ei.value.retryable
+        finally:
+            srv.stop()
+
+    def test_connect_failure_retries_then_raises_typed(self):
+        metrics.reset_metrics()
+        client = RemoteClient(_free_port_addr(), max_retries=2,
+                              rpc_timeout=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(TransportError) as ei:
+            client.call("status", {},
+                        deadline=time.monotonic() + 5.0)
+        assert ei.value.kind in ("connect", "timeout")
+        assert ei.value.retryable
+        assert time.monotonic() - t0 < 5.0
+        snap = metrics.snapshot()
+        retried = sum(s["value"] for s in
+                      snap["counters"].get("transport_retries_total", []))
+        assert retried == 2           # bounded: max_retries, no more
+
+    def test_deadline_bounds_rpc_wall_clock(self):
+        # A listener that accepts and never replies: the classic hang.
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        held = []
+        t = threading.Thread(
+            target=lambda: [held.append(lsock.accept()[0])
+                            for _ in range(10)], daemon=True)
+        t.start()
+        try:
+            client = RemoteClient(lsock.getsockname()[:2],
+                                  max_retries=5, rpc_timeout=10.0)
+            t0 = time.monotonic()
+            with pytest.raises(TransportError) as ei:
+                client.call("status", {},
+                            deadline=time.monotonic() + 0.5)
+            elapsed = time.monotonic() - t0
+            assert ei.value.kind in ("timeout", "deadline")
+            assert elapsed < 2.0      # deadline capped the socket waits
+        finally:
+            lsock.close()
+
+    def test_breaker_open_refuses_instantly(self):
+        br = CircuitBreaker("dead", failures=1, reset_s=60.0)
+        client = RemoteClient(_free_port_addr(), max_retries=0,
+                              breaker=br, rpc_timeout=0.2)
+        with pytest.raises(TransportError):
+            client.call("status", {})
+        assert br.state == CircuitBreaker.OPEN
+        t0 = time.monotonic()
+        with pytest.raises(TransportError) as ei:
+            client.call("status", {})
+        assert ei.value.kind == "circuit_open"
+        assert time.monotonic() - t0 < 0.05   # no connect attempt
+
+
+class TestRemoteDispatcher:
+    def test_routes_around_dead_replica_and_opens_breaker(self):
+        os.environ["HOROVOD_SERVE_BREAKER_FAILURES"] = "1"
+        os.environ["HOROVOD_SERVE_BREAKER_RESET"] = "60"
+        hconfig.refresh()
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            disp = RemoteDispatcher([_free_port_addr(), srv.address],
+                                    rpc_timeout=0.2, max_retries=0)
+            handles = [disp.wait(disp.submit([1, 2], 3, deadline_s=10.0))
+                       for _ in range(4)]
+            assert all(h.status == "done" for h in handles)
+            assert all(h.served_by == "rank0" for h in handles)
+            dead = disp.clients[0]
+            assert dead.breaker.state == CircuitBreaker.OPEN
+        finally:
+            srv.stop()
+
+    def test_no_live_replicas_is_typed_retryable_rejection(self):
+        disp = RemoteDispatcher([_free_port_addr()], rpc_timeout=0.2,
+                                max_retries=0)
+        h = disp.submit([1], 2)       # no deadline: surfaces immediately
+        assert h.terminal and h.status == "rejected"
+        assert h.retryable
+
+    def test_failover_resubmits_when_owner_dies_midflight(self):
+        slow = NeverServeEngine(name="slow")
+        fast = ServeNowEngine(name="fast", maxsize=32)
+        srv_slow = SocketReplicaServer(slow, 1).start()
+        srv_fast = SocketReplicaServer(fast, 2).start()
+        try:
+            disp = RemoteDispatcher([srv_slow.address, srv_fast.address],
+                                    rpc_timeout=0.2, max_retries=0)
+            # Force placement on the never-serving replica, then kill it.
+            h = disp.submit([1, 2], 4, deadline_s=15.0)
+            owners0 = [c.name for c in h.owners]
+            if disp.clients[0].name not in owners0:
+                pytest.skip("placement raced to the fast replica")
+            srv_slow.stop()
+            disp.wait(h)
+            assert h.status == "done"
+            assert h.served_by == "rank2"
+            assert h.resubmits >= 1
+        finally:
+            srv_slow.stop()
+            srv_fast.stop()
+
+    def test_hedge_duplicates_queued_request_and_winner_takes_it(self):
+        metrics.reset_metrics()
+        slow = NeverServeEngine(name="slow")
+        fast = ServeNowEngine(name="fast")
+        srv_slow = SocketReplicaServer(slow, 1).start()
+        srv_fast = SocketReplicaServer(fast, 2).start()
+        try:
+            disp = RemoteDispatcher([srv_slow.address, srv_fast.address],
+                                    rpc_timeout=0.5, max_retries=0,
+                                    hedge_ms=80.0)
+            h = disp.submit([1, 2, 3], 4, deadline_s=15.0)
+            if disp.clients[0].name not in [c.name for c in h.owners]:
+                pytest.skip("placement raced to the fast replica")
+            disp.wait(h)
+            assert h.status == "done" and h.hedged
+            assert h.served_by == "rank2"       # the hedge won
+            snap = metrics.snapshot()
+            assert sum(s["value"] for s in
+                       snap["counters"]["transport_hedges_total"]) >= 1
+            assert sum(s["value"] for s in
+                       snap["counters"]["transport_hedge_wins_total"]) >= 1
+        finally:
+            srv_slow.stop()
+            srv_fast.stop()
+
+    def test_open_breaker_recovers_via_half_open_probe(self):
+        """Regression: routing must not consume the half-open probe
+        token before ``call()`` can spend it. With a double ``allow()``
+        (one in ``_load_of``, one in ``call``) the probe RPC was never
+        sent, so nothing ever reported success/failure and the breaker
+        wedged half-open — a healthy single replica rejected every
+        request forever."""
+        os.environ["HOROVOD_SERVE_BREAKER_FAILURES"] = "1"
+        os.environ["HOROVOD_SERVE_BREAKER_RESET"] = "0.2"
+        hconfig.refresh()
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            disp = RemoteDispatcher([srv.address], rpc_timeout=0.5,
+                                    max_retries=0)
+            client = disp.clients[0]
+            client.breaker.failure()          # forced open (failures=1)
+            assert client.breaker.state == CircuitBreaker.OPEN
+            h = disp.submit([1, 2], 3, deadline_s=10.0)
+            disp.wait(h)
+            assert h.status == "done"
+            assert client.breaker.state == CircuitBreaker.CLOSED
+        finally:
+            srv.stop()
+
+    def test_placement_falls_back_when_no_replica_looks_live(self):
+        """Status probes failing (cold engine mid-compile starving the
+        handler threads) must not hard-reject placement: the submit
+        itself is the probe of last resort."""
+        class ProbeDeafClient:
+            name = "deaf"
+            rpc_timeout = 0.2
+
+            def __init__(self):
+                self.breaker = CircuitBreaker("deaf", failures=3,
+                                              reset_s=60.0)
+                self.submits = 0
+
+            def status(self, **kw):
+                raise TransportError("timeout", "probe starved",
+                                     retryable=True)
+
+            def submit(self, spec, *, deadline=None):
+                self.submits += 1
+                return {"status": "done", "tokens": [1, 2, 3],
+                        "served_by": "rank0", "reason": None}
+
+            def poll(self, rid, **kw):
+                return self.submit(None)
+
+            def cancel(self, rid):
+                pass
+
+        stub = ProbeDeafClient()
+        disp = RemoteDispatcher([("127.0.0.1", 1)], clients=[stub])
+        h = disp.submit([1, 2], 3, deadline_s=5.0)
+        assert h.status == "done" and stub.submits == 1
+
+    def test_client_deadline_yields_typed_expiry_not_hang(self):
+        slow = NeverServeEngine(name="slow")
+        srv = SocketReplicaServer(slow, 0).start()
+        try:
+            disp = RemoteDispatcher([srv.address], rpc_timeout=0.3,
+                                    max_retries=0)
+            h = disp.submit([1, 2], 4, deadline_s=0.5)
+            t0 = time.monotonic()
+            disp.wait(h)
+            assert time.monotonic() - t0 < 3.0
+            assert h.status == "expired"
+            assert "deadline" in h.reason
+        finally:
+            srv.stop()
+
+
+class TestOverloadShedding:
+    def test_high_priority_sheds_lowest_queued(self):
+        eng = NeverServeEngine(name="full", maxsize=2)
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            a = client.submit({"prompt": [1], "max_new_tokens": 2,
+                               "priority": 0, "request_id": "low-a"})
+            b = client.submit({"prompt": [1], "max_new_tokens": 2,
+                               "priority": 1, "request_id": "mid-b"})
+            assert a["status"] == "queued" and b["status"] == "queued"
+            vip = client.submit({"prompt": [1], "max_new_tokens": 2,
+                                 "priority": 5, "request_id": "vip"})
+            # the newcomer was admitted IN PLACE of the lowest-priority
+            # queued request — never accept-then-drop
+            assert vip["status"] == "queued"
+            shed = client.poll("low-a")
+            assert shed["status"] == "rejected"
+            assert shed["retryable"]            # its client re-routes
+            assert shed["reason"].startswith("overloaded")
+            assert client.poll("mid-b")["status"] == "queued"
+        finally:
+            srv.stop()
+
+    def test_equal_priority_cannot_shed_gets_typed_overload(self):
+        eng = NeverServeEngine(name="full", maxsize=1)
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            client.submit({"prompt": [1], "max_new_tokens": 2,
+                           "priority": 0, "request_id": "first"})
+            st = client.submit({"prompt": [1], "max_new_tokens": 2,
+                                "priority": 0, "request_id": "second"})
+            assert st["status"] == "rejected" and st["retryable"]
+            assert st["reason"].startswith("overloaded")
+            # the seated request was NOT evicted for an equal
+            assert client.poll("first")["status"] == "queued"
+        finally:
+            srv.stop()
+
+    def test_shed_lowest_picks_youngest_of_lowest(self):
+        q = RequestQueue(maxsize=8)
+        r1 = q.submit(Request([1], 1, priority=0, request_id="old"))
+        r2 = q.submit(Request([1], 1, priority=0, request_id="young"))
+        r3 = q.submit(Request([1], 1, priority=3, request_id="vip"))
+        victim = q.shed_lowest(below_priority=2)
+        assert victim is r2           # FCFS fairness among equals
+        assert q.depth() == 2
+        assert q.shed_lowest(below_priority=0) is None
+        assert r1.status == RequestStatus.QUEUED    # caller finalizes
+        assert r3.status == RequestStatus.QUEUED
+
+
+# ---------------------------------------------------------------------------
+# network fault plan grammar + injection
+# ---------------------------------------------------------------------------
+
+class TestNetFaults:
+    def test_grammar_accepts_net_kinds(self):
+        plan = faults.parse_plan(
+            "drop@rank=0,step=3;delay@rank=1,step=2,seconds=0.5;"
+            "partition@rank=2,step=4,seconds=2")
+        assert [a.kind for a in plan] == ["drop", "delay", "partition"]
+        assert "seconds=0.5" in plan[1].describe()
+
+    def test_net_fault_returns_directives_once(self):
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "drop@rank=0,step=2;delay@rank=0,step=3,seconds=0.25"
+        hconfig.refresh()
+        faults.reset()
+        assert faults.net_fault(1, 0) == {"drop": False, "delay_s": 0.0}
+        assert faults.net_fault(2, 0)["drop"] is True
+        assert faults.net_fault(2, 0)["drop"] is False   # fired once
+        assert faults.net_fault(3, 0)["delay_s"] == 0.25
+        assert faults.net_fault(2, 1)["drop"] is False   # other rank
+
+    def test_partition_arms_and_expires(self):
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "partition@rank=3,step=1,seconds=0.2"
+        hconfig.refresh()
+        faults.reset()
+        assert not faults.partitioned(3)
+        faults.net_fault(1, 3)
+        assert faults.partitioned(3)
+        assert not faults.partitioned(0)
+        time.sleep(0.25)
+        assert not faults.partitioned(3)     # healed
+
+    def test_fault_point_skips_net_kinds(self):
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "partition@rank=0,step=1,seconds=30"
+        hconfig.refresh()
+        faults.reset()
+        faults.fault_point(1, rank=0)        # training-step space
+        assert not faults.partitioned(0)     # did NOT fire
+        faults.net_fault(1, 0)               # rpc-sequence space
+        assert faults.partitioned(0)
+
+    def test_partitioned_server_refuses_typed(self):
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "partition@rank=0,step=2,seconds=0.6"
+        hconfig.refresh()
+        faults.reset()
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0,
+                                  rpc_timeout=0.3)
+            assert client.status(retry=False)["alive"]   # rpc 1
+            with pytest.raises(TransportError) as ei:    # rpc 2: fires
+                client.call("status", {}, retry=False)
+            assert ei.value.retryable
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:           # heals
+                try:
+                    client.call("status", {}, retry=False)
+                    break
+                except TransportError:
+                    time.sleep(0.1)
+            else:
+                pytest.fail("partition never healed")
+        finally:
+            srv.stop()
+
+    def test_dropped_response_reads_as_timeout(self):
+        os.environ["HOROVOD_FAULT_PLAN"] = "drop@rank=0,step=2"
+        hconfig.refresh()
+        faults.reset()
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0,
+                                  rpc_timeout=0.3)
+            client.submit({"prompt": [1], "max_new_tokens": 2,
+                           "request_id": "d1"})          # rpc 1
+            with pytest.raises(TransportError) as ei:    # rpc 2 dropped
+                client.submit({"prompt": [1], "max_new_tokens": 2,
+                               "request_id": "d2"})
+            assert ei.value.retryable
+            # the drop SERVED the request — the retry dedups, no rerun
+            assert client.poll("d2")["status"] == "done"
+            assert eng.submitted.count("d2") == 1
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat seq + claim/reclaim race (satellites 2 & 3)
+# ---------------------------------------------------------------------------
+
+def _spool_server(root, rank, **kw):
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("stale_after_s", 0.15)
+    return ReplicaServer(str(root), rank, ServeNowEngine(), **kw)
+
+
+def _forge_peer(root, rank, seq=7, with_claim=None):
+    os.makedirs(root / "hb", exist_ok=True)
+    with open(root / "hb" / f"rank{rank}.json", "w") as f:
+        json.dump({"rank": rank, "unix": time.time(), "seq": seq,
+                   "load": 0, "alive": True}, f)
+    if with_claim:
+        d = root / "claim" / f"rank{rank}"
+        os.makedirs(d, exist_ok=True)
+        with open(d / f"{with_claim}.json", "w") as f:
+            json.dump({"id": with_claim, "prompt": [1, 2],
+                       "max_new_tokens": 4}, f)
+
+
+class TestHeartbeatSeq:
+    def test_forged_mtime_cannot_fake_liveness(self, tmp_path):
+        srv = _spool_server(tmp_path, 0)
+        _forge_peer(tmp_path, 1, seq=7)
+        assert srv._stale_peers() == []      # first sighting: benefit
+        time.sleep(0.2)
+        assert srv._stale_peers() == [1]     # seq never advanced
+        # forge freshness the clock-skew way: touch the file
+        os.utime(tmp_path / "hb" / "rank1.json")
+        assert srv._stale_peers() == [1]     # mtime is not liveness
+        # a REAL beat (seq advance) resurrects the peer
+        _forge_peer(tmp_path, 1, seq=8)
+        assert srv._stale_peers() == []
+
+    def test_restarted_peer_with_reset_seq_counts_as_live(self, tmp_path):
+        srv = _spool_server(tmp_path, 0)
+        _forge_peer(tmp_path, 1, seq=500)
+        srv._stale_peers()
+        time.sleep(0.2)
+        assert srv._stale_peers() == [1]
+        _forge_peer(tmp_path, 1, seq=1)      # restart resets the counter
+        assert srv._stale_peers() == []      # any CHANGE is an advance
+
+    def test_own_beat_carries_monotonic_seq(self, tmp_path):
+        srv = _spool_server(tmp_path, 0)
+        srv._beat()
+        srv._beat()
+        with open(tmp_path / "hb" / "rank0.json") as f:
+            assert json.load(f)["seq"] == 2
+
+    def test_legacy_heartbeat_without_seq_falls_back_to_mtime(
+            self, tmp_path):
+        srv = _spool_server(tmp_path, 0)
+        with open(tmp_path / "hb" / "rank1.json", "w") as f:
+            json.dump({"rank": 1, "unix": time.time()}, f)
+        assert srv._stale_peers() == []
+        time.sleep(0.2)
+        assert srv._stale_peers() == [1]
+        os.utime(tmp_path / "hb" / "rank1.json")   # legacy: mtime IS seq
+        assert srv._stale_peers() == []
+
+
+class TestReclaimRace:
+    def test_two_survivors_single_winner(self, tmp_path):
+        """Both survivors see the same stale peer and race
+        _reclaim_stale: the atomic rename admits exactly one winner;
+        the loser's OSError is the normal backoff path."""
+        s0 = _spool_server(tmp_path, 0)
+        s2 = _spool_server(tmp_path, 2)
+        _forge_peer(tmp_path, 1, seq=7, with_claim="orphan")
+        s0._stale_peers(), s2._stale_peers()     # first sighting
+        time.sleep(0.2)                          # now genuinely stale
+        barrier = threading.Barrier(2)
+
+        def race(srv):
+            barrier.wait()
+            srv._reclaim_stale()
+
+        threads = [threading.Thread(target=race, args=(s,))
+                   for s in (s0, s2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert s0.reclaimed + s2.reclaimed == 1
+        assert os.listdir(tmp_path / "spool") == ["orphan.json"]
+        assert not os.listdir(tmp_path / "claim" / "rank1")
+
+    def test_fault_plan_stall_loses_race_deterministically(
+            self, tmp_path):
+        """Fault-plan variant: stall survivor 0 inside its reclaim
+        sweep, so survivor 2 deterministically wins the rename and the
+        stalled one backs off cleanly."""
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "stall@rank=0,step=1,seconds=0.4"
+        hconfig.refresh()
+        faults.reset()
+        metrics.reset_metrics()
+        s0 = _spool_server(tmp_path, 0)
+        s2 = _spool_server(tmp_path, 2)
+        _forge_peer(tmp_path, 1, seq=7, with_claim="orphan")
+        s0._stale_peers(), s2._stale_peers()
+        time.sleep(0.2)
+        threads = [threading.Thread(target=s._reclaim_stale)
+                   for s in (s0, s2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert s2.reclaimed == 1 and s0.reclaimed == 0
+        snap = metrics.snapshot()
+        assert any(s["labels"].get("kind") == "stall"
+                   for s in snap["counters"]["fault_injected_total"])
+
+
+# ---------------------------------------------------------------------------
+# doctor: transport findings
+# ---------------------------------------------------------------------------
+
+class TestDoctorTransport:
+    def test_open_breaker_ranked_with_knob_suggestions(self):
+        from horovod_tpu.profiler import _check_transport
+        snap = {
+            "gauges": {"circuit_state": [
+                {"labels": {"replica": "r1"}, "value": 1.0},
+                {"labels": {"replica": "r2"}, "value": 0.0}]},
+            "counters": {"circuit_open_total": [
+                {"labels": {"replica": "r1"}, "value": 2}]},
+        }
+        fs = _check_transport(snap)
+        assert fs and fs[0]["category"] == "transport_breaker"
+        assert fs[0]["severity"] >= 0.8
+        assert "r1" in fs[0]["title"]
+        assert "HOROVOD_SERVE_RPC_TIMEOUT" in fs[0]["suggestion"]
+
+    def test_high_retry_rate_names_knobs(self):
+        from horovod_tpu.profiler import _check_transport
+        snap = {
+            "gauges": {},
+            "counters": {"transport_retries_total": [
+                {"labels": {"method": "poll"}, "value": 30}]},
+            "histograms": {"transport_rpc_seconds": [
+                {"labels": {"method": "poll", "outcome": "ok"},
+                 "count": 100, "sum": 1.0}]},
+        }
+        fs = _check_transport(snap)
+        cats = [f["category"] for f in fs]
+        assert "transport_retries" in cats
+        f = fs[cats.index("transport_retries")]
+        assert "HOROVOD_SERVE_MAX_RETRIES" in f["suggestion"]
+        assert "HOROVOD_SERVE_HEDGE_MS" in f["suggestion"]
+
+    def test_quiet_transport_no_findings(self):
+        from horovod_tpu.profiler import _check_transport
+        assert _check_transport({"gauges": {}, "counters": {},
+                                 "histograms": {}}) == []
+
+
+# ---------------------------------------------------------------------------
+# config knobs + build_info export
+# ---------------------------------------------------------------------------
+
+class TestTransportConfig:
+    def test_defaults(self):
+        cfg = hconfig.get_config()
+        assert cfg.serve_rpc_timeout_seconds == 5.0
+        assert cfg.serve_max_retries == 3
+        assert cfg.serve_hedge_ms == 0.0
+        assert cfg.serve_breaker_failures == 3
+        assert cfg.serve_breaker_reset_seconds == 1.0
+
+    def test_env_resolves_and_validates(self):
+        os.environ["HOROVOD_SERVE_RPC_TIMEOUT"] = "2.5"
+        os.environ["HOROVOD_SERVE_MAX_RETRIES"] = "0"
+        os.environ["HOROVOD_SERVE_HEDGE_MS"] = "250"
+        try:
+            cfg = hconfig.refresh()
+            assert cfg.serve_rpc_timeout_seconds == 2.5
+            assert cfg.serve_max_retries == 0       # 0 = one attempt
+            assert cfg.serve_hedge_ms == 250.0
+            os.environ["HOROVOD_SERVE_MAX_RETRIES"] = "-1"
+            with pytest.raises(ValueError, match="MAX_RETRIES"):
+                hconfig.refresh()
+            os.environ["HOROVOD_SERVE_MAX_RETRIES"] = "3"
+            os.environ["HOROVOD_SERVE_RPC_TIMEOUT"] = "0"
+            with pytest.raises(ValueError, match="RPC_TIMEOUT"):
+                hconfig.refresh()
+        finally:
+            for k in ("HOROVOD_SERVE_RPC_TIMEOUT",
+                      "HOROVOD_SERVE_MAX_RETRIES",
+                      "HOROVOD_SERVE_HEDGE_MS"):
+                os.environ.pop(k, None)
+            hconfig.refresh()
+
+    def test_build_info_exports_transport_knobs(self):
+        info = hvd.build_info()
+        for k in ("serve_rpc_timeout_seconds", "serve_max_retries",
+                  "serve_hedge_ms", "serve_breaker_failures",
+                  "serve_breaker_reset_seconds"):
+            assert k in info
+
+
+# ---------------------------------------------------------------------------
+# parity: socket-served tokens == offline generate() (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestSocketParity:
+    def test_socket_served_token_identical_to_offline(self, gpt2_setup):
+        model, params, cfg = gpt2_setup
+        prompt = [5, 17, 42, 9, 133]
+        want = np.asarray(generate(
+            model, params, jnp.asarray([prompt], jnp.int32), 8))[0, 5:]
+        eng = InferenceEngine(model, params, slots=2, max_len=32,
+                              block_size=4, prefill_chunk=4,
+                              name="sock-parity")
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            disp = RemoteDispatcher([srv.address])
+            h = disp.wait(disp.submit(prompt, 8, deadline_s=120.0))
+            assert h.status == "done"
+            assert h.tokens == list(want)
+            assert h.ttft is not None and h.tpot is not None
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# three-process fault smoke (make net-smoke)
+# ---------------------------------------------------------------------------
+
+class TestNetSmoke:
+    def test_kill_and_partition_all_requests_typed_terminal(
+            self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import net_smoke
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        # run_smoke returns (rc, failure_text) — the text feeds the
+        # rendezvous-flake retry in tools/smoke_util.py.
+        rc, text = net_smoke.run_smoke(str(tmp_path))
+        assert rc == 0, text

@@ -61,6 +61,7 @@ MODULES = [
     "horovod_tpu.serving.scheduler",
     "horovod_tpu.serving.engine",
     "horovod_tpu.serving.replica",
+    "horovod_tpu.serving.transport",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",

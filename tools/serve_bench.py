@@ -50,8 +50,16 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
               block_size: int = 16, prefill_chunk: int = 8,
               kv_quant=None, num_blocks=None,
               model_size: str = "tiny", seed: int = 0,
+              transport: str = "none",
               metric: str = "serve_tokens_per_sec") -> dict:
-    """Run one load level; returns (and prints) the record."""
+    """Run one load level; returns (and prints) the record.
+
+    ``transport`` selects the path between the load generator and the
+    engine: ``none`` (direct ``engine.submit``, the PR 4 baseline),
+    ``spool`` (the filesystem replica protocol), or ``socket`` (the
+    JSON-over-TCP transport through a ``RemoteDispatcher``) — same
+    Poisson load, so the lines are comparable and the delta IS the
+    transport's latency cost."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -84,44 +92,101 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
     warm = eng.submit([1, 2, 3, 4, 5], 4)
     warm.result(timeout=600)
 
+    srv = None
+    disp = None
+    root = None
+    if transport == "spool":
+        import tempfile
+        from horovod_tpu.serving.replica import ReplicaServer
+        root = tempfile.mkdtemp(prefix="hvd_serve_bench_spool_")
+        srv = ReplicaServer(root, 0, eng, heartbeat_s=0.5).start()
+    elif transport == "socket":
+        from horovod_tpu.serving.transport import (
+            RemoteDispatcher, SocketReplicaServer)
+        srv = SocketReplicaServer(eng, 0).start()
+        disp = RemoteDispatcher([srv.address])
+    elif transport != "none":
+        raise ValueError(f"unknown transport {transport!r}")
+
     gaps = rng.exponential(1.0 / rate, size=requests)
     prompts = [list(rng.integers(1, cfg.vocab_size - 1,
                                  int(rng.integers(4, 17))))
                for _ in range(requests)]
     budgets = [int(rng.integers(8, 33)) for _ in range(requests)]
 
-    reqs = []
+    # outs: one dict per request with the SAME keys whatever the path,
+    # so the percentile summaries below don't care which transport ran.
+    outs = []
     t0 = time.perf_counter()
-    for gap, p, n in zip(gaps, prompts, budgets):
-        time.sleep(float(gap))
-        reqs.append(eng.submit(p, n))
-    for r in reqs:
-        try:
-            r.result(timeout=600)
-        except TimeoutError:
-            pass
+    if transport == "none":
+        reqs = []
+        for gap, p, n in zip(gaps, prompts, budgets):
+            time.sleep(float(gap))
+            reqs.append(eng.submit(p, n))
+        for r in reqs:
+            try:
+                r.result(timeout=600)
+            except TimeoutError:
+                pass
+        outs = [{"status": r.status.value, "tokens": len(r.tokens),
+                 "ttft": r.ttft, "tpot": r.tpot,
+                 "queue_wait": r.queue_wait} for r in reqs]
+    elif transport == "spool":
+        from horovod_tpu.serving.replica import (
+            submit_file_request, wait_file_result)
+        ids = []
+        for i, (gap, p, n) in enumerate(zip(gaps, prompts, budgets)):
+            time.sleep(float(gap))
+            ids.append(submit_file_request(root, p, n,
+                                           request_id=f"bench-{i}"))
+        for rid in ids:
+            try:
+                r = wait_file_result(root, rid, timeout=600)
+            except TimeoutError:
+                outs.append({"status": "timeout", "tokens": 0,
+                             "ttft": None, "tpot": None,
+                             "queue_wait": None})
+                continue
+            outs.append({"status": r["status"],
+                         "tokens": len(r["tokens"]),
+                         "ttft": r.get("ttft"), "tpot": r.get("tpot"),
+                         "queue_wait": r.get("queue_wait")})
+    else:
+        handles = []
+        for gap, p, n in zip(gaps, prompts, budgets):
+            time.sleep(float(gap))
+            handles.append(disp.submit(p, n))
+        for h in handles:
+            disp.wait(h, timeout=600)
+            outs.append({"status": h.status, "tokens": len(h.tokens),
+                         "ttft": h.ttft, "tpot": h.tpot,
+                         "queue_wait": None})
     wall = time.perf_counter() - t0
-    eng.stop()
+    if srv is not None:
+        srv.stop()                      # stops the engine too
+    else:
+        eng.stop()
 
-    done = [r for r in reqs if r.status.value == "done"]
-    tokens = sum(len(r.tokens) for r in reqs)
+    done = [o for o in outs if o["status"] == "done"]
+    tokens = sum(o["tokens"] for o in outs)
     rec = {
         "metric": metric,
         "value": round(tokens / wall, 2),
         "unit": "tokens/sec", "vs_baseline": None,
+        "transport": transport,
         "requests": requests, "completed": len(done),
-        "rejected": sum(1 for r in reqs
-                        if r.status.value == "rejected"),
+        "rejected": sum(1 for o in outs
+                        if o["status"] == "rejected"),
         "arrival_rate_hz": rate, "wall_s": round(wall, 3),
         "slots": slots, "max_len": max_len, "block_size": block_size,
         "prefill_chunk": prefill_chunk, "kv_quant": kv_quant,
         "model": f"gpt2-{model_size}",
-        "ttft_s": _summary([r.ttft for r in done
-                            if r.ttft is not None]),
-        "tpot_s": _summary([r.tpot for r in done
-                            if r.tpot is not None]),
-        "queue_wait_s": _summary([r.queue_wait for r in done
-                                  if r.queue_wait is not None]),
+        "ttft_s": _summary([o["ttft"] for o in done
+                            if o["ttft"] is not None]),
+        "tpot_s": _summary([o["tpot"] for o in done
+                            if o["tpot"] is not None]),
+        "queue_wait_s": _summary([o["queue_wait"] for o in done
+                                  if o["queue_wait"] is not None]),
         "blocks_peak": eng.manager.peak_blocks_in_use,
         "blocks_capacity": eng.manager.capacity,
         "dense_equivalent_blocks": slots * eng.max_blocks_per_slot,
@@ -146,6 +211,10 @@ def _build_parser():
                    help="shared KV pool size (default: dense equivalent)")
     p.add_argument("--model-size", choices=["tiny", "medium"],
                    default="tiny")
+    p.add_argument("--transport", choices=["none", "spool", "socket"],
+                   default="none",
+                   help="path between load generator and engine: direct "
+                   "submit, filesystem spool, or socket RPC")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None,
                    help="append the JSON record to this file")
@@ -159,7 +228,7 @@ def main() -> int:
         max_len=args.max_len, block_size=args.block_size,
         prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant,
         num_blocks=args.num_blocks, model_size=args.model_size,
-        seed=args.seed)
+        transport=args.transport, seed=args.seed)
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(rec) + "\n")
