@@ -32,9 +32,14 @@ Each action is ``kind@key=value,key=value`` with:
   steps via :func:`fault_point`; the serving transport reports its
   per-replica RPC sequence number via :func:`net_fault`, so ``step=4``
   on a network fault means "at this replica's 4th inbound RPC".
-  :func:`net_fault` fires *any* kind (a ``kill@`` keyed to an RPC
-  sequence SIGKILLs a replica mid-serve); :func:`fault_point` skips the
-  network kinds, whose step space is RPCs, not training steps.
+* ``space=step|net`` — which step counter the action is keyed to.
+  Network kinds live in (and default to) ``net``; everything else
+  defaults to ``step``. The two spaces never cross-fire in EITHER
+  direction: :func:`fault_point` only fires ``space=step`` actions,
+  :func:`net_fault` only ``space=net`` — a ``kill@`` written for a
+  training step can never fire at a replica's matching RPC sequence.
+  To SIGKILL/stall a replica at its Nth inbound RPC, opt in
+  explicitly: ``kill@rank=1,step=8,space=net``.
 * ``seconds=X`` — duration for ``stall`` / ``slow_write`` / ``delay`` /
   ``partition`` (default 1.0).
 * ``restart=N`` — which elastic attempt the action belongs to (default
@@ -68,16 +73,20 @@ _KINDS = ("kill", "stall", "slow_write") + _NET_KINDS
 
 @dataclass(frozen=True)
 class FaultAction:
-    kind: str                      # kill | stall | slow_write
+    kind: str                      # one of _KINDS
     rank: int                      # process index the action targets
-    step: int                      # training step it fires at
+    step: int                      # step (in `space`) it fires at
     seconds: float = 1.0           # stall / slow_write duration
     restart: Optional[int] = 0    # elastic attempt (None = every attempt)
+    space: str = "step"           # step counter: training "step" or
+                                  # per-replica inbound-RPC "net"
 
     def describe(self) -> str:
         extra = ""
         if self.kind in ("stall", "slow_write", "delay", "partition"):
             extra = f",seconds={self.seconds:g}"
+        if self.kind not in _NET_KINDS and self.space == "net":
+            extra += ",space=net"      # non-default: explicit opt-in
         r = "*" if self.restart is None else str(self.restart)
         return (f"{self.kind}@rank={self.rank},step={self.step}"
                 f"{extra},restart={r}")
@@ -113,7 +122,8 @@ def parse_plan(text: str) -> List[FaultAction]:
                     f"is not key=value")
             k, _, v = kv.partition("=")
             fields[k.strip().lower()] = v.strip()
-        unknown = set(fields) - {"rank", "step", "seconds", "restart"}
+        unknown = set(fields) - {"rank", "step", "seconds", "restart",
+                                 "space"}
         if unknown:
             raise ValueError(
                 f"HOROVOD_FAULT_PLAN entry {entry!r}: unknown field(s) "
@@ -140,8 +150,19 @@ def parse_plan(text: str) -> List[FaultAction]:
             raise ValueError(
                 f"HOROVOD_FAULT_PLAN entry {entry!r}: rank/step/seconds/"
                 f"restart must be non-negative")
+        default_space = "net" if kind in _NET_KINDS else "step"
+        space = fields.get("space", "").lower() or default_space
+        if space not in ("step", "net"):
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: space must be "
+                f"'step' or 'net', got {space!r}")
+        if kind in _NET_KINDS and space != "net":
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: {kind!r} is a "
+                f"transport directive — it only exists in space=net")
         actions.append(FaultAction(kind=kind, rank=rank, step=step,
-                                   seconds=seconds, restart=restart))
+                                   seconds=seconds, restart=restart,
+                                   space=space))
     return actions
 
 
@@ -196,7 +217,7 @@ def fault_point(step: int, rank: Optional[int] = None) -> None:
     me = _my_rank() if rank is None else rank
     attempt = _restart_count()
     for i, a in enumerate(actions):
-        if a.kind in _NET_KINDS:
+        if a.space != "step":
             continue               # RPC-sequence step space (net_fault)
         if a.rank != me or a.step != step:
             continue
@@ -213,10 +234,12 @@ def fault_point(step: int, rank: Optional[int] = None) -> None:
 def net_fault(step: int, rank: int) -> dict:
     """Transport-layer fault point: ``step`` is the replica's inbound RPC
     sequence number, ``rank`` its replica rank. Fires every matching
-    not-yet-fired action of ANY kind (``kill``/``stall`` act inline, so a
-    plan can SIGKILL a replica at its Nth RPC; ``partition`` arms
-    :func:`partitioned` for ``seconds``) and returns the directives the
-    caller must apply to THIS rpc::
+    not-yet-fired ``space=net`` action — the network kinds live there by
+    default, and ``kill``/``stall`` can opt in (``kill@...,space=net``
+    SIGKILLs a replica at its Nth RPC; ``partition`` arms
+    :func:`partitioned` for ``seconds``). Actions keyed to training
+    steps never fire here. Returns the directives the caller must apply
+    to THIS rpc::
 
         {"drop": bool,       # serve it, but never send the response
          "delay_s": float}   # sleep this long before responding
@@ -230,6 +253,8 @@ def net_fault(step: int, rank: int) -> dict:
     actions = _cached_plan(plan_text)
     attempt = _restart_count()
     for i, a in enumerate(actions):
+        if a.space != "net":
+            continue               # training-step space (fault_point)
         if a.rank != rank or a.step != step:
             continue
         if a.restart is not None and a.restart != attempt:

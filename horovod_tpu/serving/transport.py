@@ -35,9 +35,10 @@ stack a lossy network needs:
   ``overloaded: ...``, retryable) before refusing a higher-priority
   submit; nothing is ever accepted and then silently dropped.
 * **Fault injection** — :func:`horovod_tpu.faults.net_fault` runs at
-  every inbound RPC, so a ``HOROVOD_FAULT_PLAN`` can kill a replica at
-  its Nth RPC, drop/delay single responses, or partition it for a
-  bounded window (``tools/net_smoke.py`` / ``make net-smoke``).
+  every inbound RPC, so a ``HOROVOD_FAULT_PLAN`` can drop/delay single
+  responses, partition a replica for a bounded window, or — with an
+  explicit ``space=net`` tag — kill/stall it at its Nth RPC
+  (``tools/net_smoke.py`` / ``make net-smoke``).
 
 Observability: ``transport_rpc_seconds{method,outcome}``,
 ``transport_retries_total{method}``, ``circuit_state{replica}`` (0
@@ -56,6 +57,7 @@ import socket
 import struct
 import threading
 import time
+import uuid
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from horovod_tpu import faults, metrics
@@ -67,8 +69,6 @@ __all__ = ["TransportError", "backoff_delays", "CircuitBreaker",
 
 _MAX_FRAME = 16 * 1024 * 1024      # sanity bound on one JSON frame
 _TERMINAL = ("done", "rejected", "expired", "cancelled", "failed")
-
-_HANDLE_SEQ = itertools.count(1)
 
 
 # ---------------------------------------------------------------------------
@@ -261,6 +261,7 @@ class SocketReplicaServer:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._requests: Dict[str, Request] = {}
+        self._inflight: Dict[str, threading.Event] = {}
         self._rpc_seq = itertools.count(1)
         self.served_rpcs = 0
 
@@ -287,33 +288,64 @@ class SocketReplicaServer:
 
     # -- method handlers --------------------------------------------------
 
+    @staticmethod
+    def _readmittable(req: Request) -> bool:
+        """A retryable rejection is NOT dedup state: the dispatcher
+        re-places with the SAME id once an overload drains or a
+        partition heals, and that replay must re-run ``engine.submit``
+        instead of echoing the stale bounce forever."""
+        return (req.status == RequestStatus.REJECTED
+                and bool(req.retryable))
+
     def _do_submit(self, p: Dict[str, Any]) -> Dict[str, Any]:
         rid = p.get("request_id")
         if not rid:
             return {"ok": False, "error": "submit needs request_id "
                     "(idempotency key)", "retryable": False}
-        with self._lock:
-            existing = self._requests.get(rid)
-        if existing is not None:
-            # Retry or hedge replay: the id IS the dedup key. Return the
-            # current state instead of double-serving.
-            return self._state(existing)
-        kw: Dict[str, Any] = {"priority": int(p.get("priority", 0)),
-                              "request_id": rid}
-        if p.get("eos_id") is not None:
-            kw["eos_id"] = int(p["eos_id"])
-        if p.get("src") is not None:
-            kw["src"] = list(map(int, p["src"]))
-        if p.get("deadline_s") is not None:
-            kw["deadline_s"] = float(p["deadline_s"])
-        prompt = p.get("prompt") or None
-        mnt = int(p.get("max_new_tokens", 1))
-        req = self.engine.submit(prompt, mnt, **kw)
-        if req.status == RequestStatus.REJECTED and req.retryable \
-                and self.engine.alive:
-            req = self._try_shed_and_resubmit(req, prompt, mnt, kw)
-        self._remember(req)
-        return self._state(req)
+        while True:
+            with self._lock:
+                existing = self._requests.get(rid)
+                if existing is not None \
+                        and not self._readmittable(existing):
+                    # Retry or hedge replay: the id IS the dedup key.
+                    # Return the current state instead of double-serving.
+                    return self._state(existing)
+                mine = self._inflight.get(rid)
+                if mine is None:
+                    # Reserve the id BEFORE engine.submit: a retry racing
+                    # the still-running original (slow submit, e.g.
+                    # cold-engine compile) must block on the reservation,
+                    # not slip past the registry and double-serve.
+                    mine = threading.Event()
+                    self._inflight[rid] = mine
+                    break
+            # Concurrent duplicate: wait for the original handler to
+            # settle, then re-read the registry.
+            if not mine.wait(timeout=30.0):
+                return {"ok": False, "error": f"submit {rid!r} still "
+                        "in flight", "retryable": True}
+        try:
+            kw: Dict[str, Any] = {"priority": int(p.get("priority", 0)),
+                                  "request_id": rid}
+            if p.get("eos_id") is not None:
+                kw["eos_id"] = int(p["eos_id"])
+            if p.get("src") is not None:
+                kw["src"] = list(map(int, p["src"]))
+            if p.get("deadline_s") is not None:
+                kw["deadline_s"] = float(p["deadline_s"])
+            prompt = p.get("prompt") or None
+            mnt = int(p.get("max_new_tokens", 1))
+            req = self.engine.submit(prompt, mnt, **kw)
+            if req.status == RequestStatus.REJECTED and req.retryable \
+                    and self.engine.alive:
+                req = self._try_shed_and_resubmit(req, prompt, mnt, kw)
+            if not self._readmittable(req):
+                self._remember(req)
+            return self._state(req)
+        finally:
+            with self._lock:
+                self._inflight.pop(rid, None)
+            mine.set()
 
     def _try_shed_and_resubmit(self, req: Request, prompt, mnt: int,
                                kw: Dict[str, Any]) -> Request:
@@ -364,11 +396,15 @@ class SocketReplicaServer:
         # The socket analogue of the spool heartbeat file — including
         # the monotonic sequence number a liveness probe must see
         # ADVANCE (a forged mtime can't fake progress; neither can a
-        # replayed status response).
+        # replayed status response). ``seq`` counts *serving* RPCs only
+        # — status probes are excluded, so a prober watching seq
+        # measures request progress, not its own traffic.
+        with self._lock:
+            seq = self.served_rpcs
         return {"ok": True, "rank": self.rank, "alive": self.engine.alive,
                 "load": self.engine.load(), "slots": self.engine.slots,
                 "queue_depth": self.engine.queue.depth(),
-                "seq": self.served_rpcs}
+                "seq": seq}
 
     _METHODS = {"submit": _do_submit, "poll": _do_poll,
                 "cancel": _do_cancel, "status": _do_status}
@@ -404,7 +440,9 @@ class SocketReplicaServer:
             if directives["drop"]:
                 return                     # served, never answered
             _send_frame(conn, resp)
-            self.served_rpcs += 1
+            if method != "status":
+                with self._lock:
+                    self.served_rpcs += 1
         except (OSError, ValueError, ConnectionError, TransportError):
             pass                           # peer gone mid-rpc; its retry
         finally:
@@ -702,8 +740,10 @@ class RemoteDispatcher:
         """Place one request on the least-loaded live replica; returns a
         handle that is already terminal (typed REJECTED) if no replica
         accepts. Pass the handle to :meth:`wait` for the result."""
-        rid = request_id or (f"rpc-{os.getpid()}-"
-                             f"{next(_HANDLE_SEQ)}")
+        # Real entropy, not a per-process counter: two client processes
+        # can share a pid (containers), and the server dedupes on this
+        # id — a collision would hand one client the other's tokens.
+        rid = request_id or f"rpc-{os.getpid()}-{uuid.uuid4().hex}"
         spec: Dict[str, Any] = {
             "prompt": None if prompt is None else list(map(int, prompt)),
             "max_new_tokens": int(max_new_tokens),

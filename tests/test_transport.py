@@ -306,6 +306,68 @@ class TestSocketRpc:
         finally:
             srv.stop()
 
+    def test_concurrent_duplicate_submit_serves_once(self):
+        """Regression: the submit dedup was check-then-act — a client
+        retry racing the still-running original handler (slow
+        engine.submit, e.g. cold-engine compile) slipped past the
+        registry and double-served the id. The in-flight reservation
+        must make the duplicate block, then return the original's
+        state."""
+        class SlowSubmitEngine(ServeNowEngine):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.gate = threading.Event()
+
+            def submit(self, prompt, max_new_tokens, **kw):
+                self.gate.wait(timeout=10.0)
+                return super().submit(prompt, max_new_tokens, **kw)
+
+        eng = SlowSubmitEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            results = []
+
+            def go():
+                client = RemoteClient(srv.address, max_retries=0,
+                                      rpc_timeout=15.0)
+                results.append(client.submit(
+                    {"prompt": [1], "max_new_tokens": 2,
+                     "request_id": "race"}))
+
+            threads = [threading.Thread(target=go) for _ in range(2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)        # both handlers inside _do_submit
+            eng.gate.set()
+            for t in threads:
+                t.join(timeout=15)
+            assert [r["status"] for r in results] == ["done", "done"]
+            assert eng.submitted.count("race") == 1
+        finally:
+            srv.stop()
+
+    def test_status_seq_counts_serving_not_probes(self):
+        """``seq`` witnesses serving progress: status probes must not
+        advance it (a prober watching seq would otherwise only be
+        measuring its own traffic against the listener thread)."""
+        eng = ServeNowEngine()
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            s0 = client.status()["seq"]
+            assert client.status()["seq"] == s0   # probes don't count
+            client.submit({"prompt": [1], "max_new_tokens": 1,
+                           "request_id": "seq-1"})
+            # served_rpcs increments just after the response is framed;
+            # give the handler thread a beat to get there.
+            deadline = time.monotonic() + 5.0
+            while (client.status()["seq"] == s0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert client.status()["seq"] == s0 + 1
+        finally:
+            srv.stop()
+
     def test_unknown_request_id_is_permanent_error(self):
         eng = ServeNowEngine()
         srv = SocketReplicaServer(eng, 0).start()
@@ -501,6 +563,44 @@ class TestRemoteDispatcher:
         h = disp.submit([1, 2], 3, deadline_s=5.0)
         assert h.status == "done" and stub.submits == 1
 
+    def test_default_request_ids_carry_real_entropy(self):
+        """Regression: auto ids were ``rpc-{pid}-{counter}`` with the
+        counter starting at 1 per process — two containers whose
+        entrypoints share a pid generated identical id sequences, and
+        the server-side dedup then handed client B client A's tokens.
+        Default ids must not be predictable from (pid, call count)."""
+        class AcceptAll:
+            name = "accept"
+            rpc_timeout = 0.2
+
+            def __init__(self):
+                self.breaker = CircuitBreaker(
+                    f"accept-{id(self)}", failures=3, reset_s=60.0)
+
+            def status(self, **kw):
+                return {"alive": True, "load": 0}
+
+            def submit(self, spec, *, deadline=None):
+                return {"status": "done", "tokens": [],
+                        "served_by": "accept", "reason": None}
+
+            def poll(self, rid, **kw):
+                return self.submit(None)
+
+            def cancel(self, rid):
+                pass
+
+        ids = set()
+        for _ in range(2):             # two dispatcher "processes"
+            disp = RemoteDispatcher([("127.0.0.1", 1)],
+                                    clients=[AcceptAll()])
+            for _ in range(50):
+                ids.add(disp.submit([1], 1).id)
+        assert len(ids) == 100         # no collisions
+        # and the variable part is not a bare incrementing integer
+        tails = [i.rsplit("-", 1)[-1] for i in ids]
+        assert not all(t.isdigit() for t in tails)
+
     def test_client_deadline_yields_typed_expiry_not_hang(self):
         slow = NeverServeEngine(name="slow")
         srv = SocketReplicaServer(slow, 0).start()
@@ -554,6 +654,31 @@ class TestOverloadShedding:
             assert st["reason"].startswith("overloaded")
             # the seated request was NOT evicted for an equal
             assert client.poll("first")["status"] == "queued"
+        finally:
+            srv.stop()
+
+    def test_retryable_rejection_is_not_sticky_on_replay(self):
+        """Regression: a remembered retryable rejection answered every
+        replay of the id with the stale bounce — wait()'s re-placement
+        (same request_id) could never be admitted even after the queue
+        drained. A replayed id whose remembered state is a retryable
+        rejection must re-run engine.submit."""
+        eng = NeverServeEngine(name="full", maxsize=1)
+        srv = SocketReplicaServer(eng, 0).start()
+        try:
+            client = RemoteClient(srv.address, max_retries=0)
+            client.submit({"prompt": [1], "max_new_tokens": 2,
+                           "request_id": "seat"})
+            st = client.submit({"prompt": [1], "max_new_tokens": 2,
+                                "request_id": "bounced"})
+            assert st["status"] == "rejected" and st["retryable"]
+            # The overload drains (the seated request leaves the queue):
+            # the SAME id re-placed must now be admitted.
+            assert eng.queue.shed_lowest(99) is not None
+            st2 = client.submit({"prompt": [1], "max_new_tokens": 2,
+                                 "request_id": "bounced"})
+            assert st2["status"] == "queued"
+            assert eng.submitted.count("bounced") == 2
         finally:
             srv.stop()
 
@@ -614,6 +739,41 @@ class TestNetFaults:
         assert not faults.partitioned(0)     # did NOT fire
         faults.net_fault(1, 0)               # rpc-sequence space
         assert faults.partitioned(0)
+
+    def test_net_fault_skips_training_step_actions(self):
+        """Regression: net_fault fired actions of ANY kind, so a
+        kill@/stall@ written for a training step could also fire at a
+        replica's matching inbound-RPC sequence. The two spaces must
+        not cross-fire in either direction."""
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "stall@rank=0,step=1,seconds=0.3"
+        hconfig.refresh()
+        faults.reset()
+        t0 = time.monotonic()
+        faults.net_fault(1, 0)               # RPC space: must NOT stall
+        assert time.monotonic() - t0 < 0.25
+        faults.fault_point(1, rank=0)        # its own space still fires
+        assert time.monotonic() - t0 >= 0.3
+
+    def test_kill_stall_opt_into_net_space_explicitly(self):
+        plan = faults.parse_plan("kill@rank=1,step=8,space=net")
+        assert plan[0].space == "net"
+        assert "space=net" in plan[0].describe()
+        os.environ["HOROVOD_FAULT_PLAN"] = \
+            "stall@rank=0,step=1,seconds=0.3,space=net"
+        hconfig.refresh()
+        faults.reset()
+        t0 = time.monotonic()
+        faults.fault_point(1, rank=0)        # training space skips net
+        assert time.monotonic() - t0 < 0.25
+        faults.net_fault(1, 0)               # opted in: fires here
+        assert time.monotonic() - t0 >= 0.3
+
+    def test_net_kind_cannot_claim_step_space(self):
+        with pytest.raises(ValueError, match="space"):
+            faults.parse_plan("drop@rank=0,step=1,space=step")
+        with pytest.raises(ValueError, match="space"):
+            faults.parse_plan("kill@rank=0,step=1,space=rpc")
 
     def test_partitioned_server_refuses_typed(self):
         os.environ["HOROVOD_FAULT_PLAN"] = \

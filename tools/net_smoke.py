@@ -8,8 +8,8 @@ Spawns three real replica processes, each a tiny seeded GPT-2 behind a
 of ``horovod_tpu/serving/transport.py``). All three share one fault
 plan:
 
-* ``kill@rank=1,step=K`` — replica 1 SIGKILLs itself at its Kth inbound
-  RPC (mid-stream, requests claimed and in flight);
+* ``kill@rank=1,step=K,space=net`` — replica 1 SIGKILLs itself at its
+  Kth inbound RPC (mid-stream, requests claimed and in flight);
 * ``partition@rank=2,step=P,seconds=S`` — replica 2 refuses every
   connection for S seconds, then heals.
 
@@ -41,11 +41,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 N_REQUESTS = 20
 MAX_NEW = 24
-# Replica 1 dies at its 8th inbound RPC; replica 2 drops off the network
-# at its 5th for 2 seconds. Steps are per-replica RPC sequence numbers
-# (status probes count), so both fire while the client is actively
+# Replica 1 dies at its 8th inbound RPC (space=net opts the kill into
+# the RPC-sequence step space; without it a kill@ is a training-step
+# action and never fires here); replica 2 drops off the network at its
+# 5th for 2 seconds. Steps are per-replica RPC sequence numbers (status
+# probes count), so both fire while the client is actively
 # submitting/polling.
-FAULT_PLAN = ("kill@rank=1,step=8;"
+FAULT_PLAN = ("kill@rank=1,step=8,space=net;"
               "partition@rank=2,step=5,seconds=2")
 
 WORKER = textwrap.dedent("""
