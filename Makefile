@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
-	preempt-smoke topo-smoke net-smoke test native
+	preempt-smoke topo-smoke net-smoke fleet-smoke bench-sentinel \
+	test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -74,6 +75,25 @@ net-smoke:
 # tests/test_topology.py::TestFourProcessTopoSmoke.
 topo-smoke:
 	$(PY) tools/topo_smoke.py
+
+# Self-healing fleet smoke: 3 socket replicas + 1 warm spare under a
+# FleetSupervisor; HOROVOD_FAULT_PLAN SIGKILLs one replica twice
+# (restart-with-backoff must bring it back), crash-loops another into a
+# typed quarantine (the spare is promoted into its slot), and partitions
+# a third for 2s (tolerated, no spurious restart). Then a rolling
+# drain/restart of every live replica runs mid-load with zero dropped
+# requests. All assertions come from the metrics snapshot, and
+# hvd.doctor() must rank the quarantine. Also runs in tier-1 as
+# tests/test_fleet.py::TestFleetSmoke.
+fleet-smoke:
+	$(PY) tools/fleet_smoke.py
+
+# Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
+# metric's newest line degrades >10% vs the latest prior line at equal
+# settings (same model/metric/variant + settings fields). Comparison
+# logic unit-tested in tests/test_bench_sentinel.py.
+bench-sentinel:
+	$(PY) tools/bench_sentinel.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -844,8 +844,29 @@ _HEADLINE_METRIC = {"resnet50": "resnet50_images_per_sec_per_chip",
 
 # Distinct child exit code for the "relay died between the probe and the
 # child's init, jax fell back to cpu" refusal — the supervisor must blame
-# the relay, not the code.
-_RC_CPU_FALLBACK = 3
+# the relay, not the code. 113 because small codes (1/2/3) are plausible
+# generic crashes (ADVICE r5): any tool exiting 3 would have been
+# misread as a relay death and given up with rc=0. The supervisor ALSO
+# requires the child's cpu-fallback JSON record before blaming the relay
+# — the exit code alone is never proof.
+_RC_CPU_FALLBACK = 113
+
+
+def _cpu_fallback_confirmed(stdout: str) -> bool:
+    """Did the child actually print the cpu-fallback refusal record?
+    Scans the child's stdout for a JSON line whose ``error`` names the
+    cpu fallback — the second factor behind ``_RC_CPU_FALLBACK``."""
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "fell back to cpu" in str(rec.get("error", "")):
+            return True
+    return False
 
 
 def _probe_backend(timeout_s: float) -> str:
@@ -933,15 +954,36 @@ def _supervise(args) -> int:
     if getattr(args, "serve", False):
         cmd += ["--serve"]
     try:
-        r = subprocess.run(cmd, timeout=run_timeout)
+        # Captured (not inherited) stdout: the cpu-fallback exit code is
+        # only believed when the child's refusal record is actually in
+        # the stream. Echoed through below — the driver still records
+        # the last JSON line.
+        r = subprocess.run(cmd, timeout=run_timeout, capture_output=True,
+                           text=True)
     except subprocess.TimeoutExpired:
         return give_up(f"bench run exceeded {run_timeout:.0f}s "
                        f"(relay wedged mid-run)", relay_note)
+    child_out = getattr(r, "stdout", None) or ""
+    child_err = getattr(r, "stderr", None) or ""
+    if child_out:
+        sys.stdout.write(child_out)
+        sys.stdout.flush()
+    if child_err:
+        sys.stderr.write(child_err)
+        sys.stderr.flush()
     if r.returncode == _RC_CPU_FALLBACK:
-        # The child itself diagnosed a mid-window relay death (cpu
-        # fallback) — that's a relay failure, not a code one.
-        return give_up("TPU relay died between the probe and the bench "
-                       "child's init (cpu fallback refused)", relay_note)
+        if _cpu_fallback_confirmed(child_out):
+            # The child itself diagnosed a mid-window relay death (cpu
+            # fallback) — that's a relay failure, not a code one.
+            return give_up("TPU relay died between the probe and the "
+                           "bench child's init (cpu fallback refused)",
+                           relay_note)
+        # The exit code without the record is some OTHER failure that
+        # happened to exit 113 — a code problem, not the relay's.
+        return give_up(f"bench run exited rc={r.returncode} without the "
+                       "cpu-fallback record",
+                       "bench child crashed after a healthy backend probe "
+                       "— likely a code regression, not the relay.", rc=1)
     if r.returncode != 0:
         # The probe just proved the relay reachable, so a crashing child
         # is most likely a CODE regression — say so and keep the nonzero

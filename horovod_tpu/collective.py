@@ -1591,6 +1591,36 @@ def _subset_barrier_wait(ps: ProcessSet, member_procs, timeout_s: float
     _SUBSET_BARRIER_SEQ[ps.process_set_id] = e   # advance ONLY on success
 
 
+def _subset_barrier_teardown(process_set_id: int) -> None:
+    """Best-effort store cleanup when a process set is destroyed.
+
+    A member at local epoch ``e`` (its last SUCCESS) still owns marks at
+    ``e`` (written on entry, deleted only two epochs later) and ``e-1``
+    (deleted only on entering ``e+1``) — destroying the set would leak
+    both for the life of the job, and a LATER set reusing the id would
+    find ghost arrivals from this one. Deletes both and forgets the
+    epoch sequence; called by ``remove_process_set``."""
+    e = _SUBSET_BARRIER_SEQ.pop(process_set_id, 0)
+    if e <= 0:
+        return                       # never completed a barrier: no marks
+    try:
+        from jax._src import distributed
+        client = distributed.global_state.client
+    except Exception:
+        return
+    if client is None:
+        return
+    me = jax.process_index()
+    for epoch in (e, e - 1):
+        if epoch < 1:
+            continue
+        try:
+            client.key_value_delete(
+                f"hvdtpu_ps{process_set_id}_a{epoch}/{me}")
+        except Exception:
+            pass                     # store gone at shutdown: harmless
+
+
 def _barrier_wait(ps: ProcessSet) -> None:
     """The multi-process barrier wait itself (subset sets ride the
     store-backed member rendezvous, the global set a device sync)."""

@@ -141,6 +141,21 @@ class Config:
     serve_hedge_ms: float = 0.0
     serve_breaker_failures: int = 3
     serve_breaker_reset_seconds: float = 1.0
+    # Fleet supervisor (serving/fleet.py): HOROVOD_SERVE_FLEET_RESTART_BUDGET
+    # restarts per replica before quarantine, HOROVOD_SERVE_FLEET_BACKOFF /
+    # HOROVOD_SERVE_FLEET_BACKOFF_CAP jittered-exponential restart backoff
+    # base/cap seconds, HOROVOD_SERVE_FLEET_CRASH_LOOP_K deaths within
+    # HOROVOD_SERVE_FLEET_CRASH_LOOP_WINDOW seconds that quarantine a
+    # crash-looping replica, HOROVOD_SERVE_FLEET_PROBE supervision poll
+    # period, HOROVOD_SERVE_FLEET_SPARES warm spare engines held for
+    # promotion into a dead rank's slot.
+    serve_fleet_restart_budget: int = 5
+    serve_fleet_backoff_seconds: float = 0.5
+    serve_fleet_backoff_cap_seconds: float = 10.0
+    serve_fleet_crash_loop_k: int = 3
+    serve_fleet_crash_loop_window_seconds: float = 30.0
+    serve_fleet_probe_seconds: float = 0.5
+    serve_fleet_spares: int = 0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Preemption tolerance (checkpoint_sharded.py / faults.py /
@@ -339,6 +354,20 @@ def refresh() -> Config:
             "HOROVOD_SERVE_BREAKER_FAILURES", 3),
         serve_breaker_reset_seconds=_env_posfloat(
             "HOROVOD_SERVE_BREAKER_RESET", 1.0),
+        serve_fleet_restart_budget=_env_nonneg_int(
+            "HOROVOD_SERVE_FLEET_RESTART_BUDGET", 5),
+        serve_fleet_backoff_seconds=_env_posfloat(
+            "HOROVOD_SERVE_FLEET_BACKOFF", 0.5),
+        serve_fleet_backoff_cap_seconds=_env_posfloat(
+            "HOROVOD_SERVE_FLEET_BACKOFF_CAP", 10.0),
+        serve_fleet_crash_loop_k=_env_posint(
+            "HOROVOD_SERVE_FLEET_CRASH_LOOP_K", 3),
+        serve_fleet_crash_loop_window_seconds=_env_posfloat(
+            "HOROVOD_SERVE_FLEET_CRASH_LOOP_WINDOW", 30.0),
+        serve_fleet_probe_seconds=_env_posfloat(
+            "HOROVOD_SERVE_FLEET_PROBE", 0.5),
+        serve_fleet_spares=_env_nonneg_int(
+            "HOROVOD_SERVE_FLEET_SPARES", 0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         preemption_notice_seconds=max(
             0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),

@@ -397,5 +397,10 @@ def build_info() -> dict:
         "serve_hedge_ms": cfg.serve_hedge_ms,
         "serve_breaker_failures": cfg.serve_breaker_failures,
         "serve_breaker_reset_seconds": cfg.serve_breaker_reset_seconds,
+        # Fleet supervision knobs (serving/fleet.py): the supervisor and
+        # the operator's runbook must agree on quarantine thresholds.
+        "serve_fleet_restart_budget": cfg.serve_fleet_restart_budget,
+        "serve_fleet_crash_loop_k": cfg.serve_fleet_crash_loop_k,
+        "serve_fleet_spares": cfg.serve_fleet_spares,
         "inert_env": dict(cfg.inert),
     }

@@ -26,7 +26,13 @@ Each action is ``kind@key=value,key=value`` with:
   timeout), ``delay`` (sleep ``seconds`` before the response — tail
   latency, hedging fodder), ``partition`` (refuse every inbound
   connection for ``seconds`` — the one-sided partition of "Highly
-  Available Data Parallel ML training on Mesh Networks").
+  Available Data Parallel ML training on Mesh Networks"),
+  ``crash_loop`` (SIGKILL the replica at its Nth inbound RPC on every
+  fleet restart whose attempt is below ``count`` — the supervisor's
+  crash-loop detector must quarantine, not burn restarts forever), or
+  ``flap`` (alternate partitioned/reachable half-periods of ``period``
+  seconds for ``seconds`` total — a link that bounces instead of
+  cleanly dying).
 * ``rank=R`` — the process index the action targets (required).
 * ``step=S`` — when it fires (required). Training subsystems report
   steps via :func:`fault_point`; the serving transport reports its
@@ -41,10 +47,18 @@ Each action is ``kind@key=value,key=value`` with:
   To SIGKILL/stall a replica at its Nth inbound RPC, opt in
   explicitly: ``kill@rank=1,step=8,space=net``.
 * ``seconds=X`` — duration for ``stall`` / ``slow_write`` / ``delay`` /
-  ``partition`` (default 1.0).
+  ``partition`` / ``flap`` (default 1.0).
+* ``count=N`` — ``crash_loop`` only: SIGKILL while the fleet restart
+  attempt (``HVD_TPU_FLEET_RESTART``) is below ``N``; the attempt at
+  ``N`` survives. ``count`` larger than the supervisor's quarantine
+  threshold forces a quarantine.
+* ``period=X`` — ``flap`` only: half-period of the partition square
+  wave in seconds (default 0.5; the flap starts partitioned).
 * ``restart=N`` — which elastic attempt the action belongs to (default
   ``0``: first launch only, so a relaunched job does not re-kill itself
-  forever; ``restart=*`` fires on every attempt).
+  forever; ``restart=*`` fires on every attempt). ``crash_loop`` and
+  ``flap`` default to ``*`` — a crash loop that stopped firing after
+  the first respawn would not loop.
 
 Every fired action is timeline-marked (``FAULT``, category ``fault``) and
 counted in ``fault_injected_total{kind}`` — on a SIGKILL the marker is
@@ -67,7 +81,7 @@ __all__ = ["FaultAction", "parse_plan", "get_plan", "fault_point",
 
 logger = logging.getLogger("horovod_tpu")
 
-_NET_KINDS = ("drop", "delay", "partition")
+_NET_KINDS = ("drop", "delay", "partition", "crash_loop", "flap")
 _KINDS = ("kill", "stall", "slow_write") + _NET_KINDS
 
 
@@ -80,11 +94,18 @@ class FaultAction:
     restart: Optional[int] = 0    # elastic attempt (None = every attempt)
     space: str = "step"           # step counter: training "step" or
                                   # per-replica inbound-RPC "net"
+    count: int = 3                # crash_loop: die while attempt < count
+    period: float = 0.5           # flap: partition square-wave half-period
 
     def describe(self) -> str:
         extra = ""
-        if self.kind in ("stall", "slow_write", "delay", "partition"):
+        if self.kind in ("stall", "slow_write", "delay", "partition",
+                         "flap"):
             extra = f",seconds={self.seconds:g}"
+        if self.kind == "crash_loop":
+            extra += f",count={self.count}"
+        if self.kind == "flap":
+            extra += f",period={self.period:g}"
         if self.kind not in _NET_KINDS and self.space == "net":
             extra += ",space=net"      # non-default: explicit opt-in
         r = "*" if self.restart is None else str(self.restart)
@@ -123,25 +144,39 @@ def parse_plan(text: str) -> List[FaultAction]:
             k, _, v = kv.partition("=")
             fields[k.strip().lower()] = v.strip()
         unknown = set(fields) - {"rank", "step", "seconds", "restart",
-                                 "space"}
+                                 "space", "count", "period"}
         if unknown:
             raise ValueError(
                 f"HOROVOD_FAULT_PLAN entry {entry!r}: unknown field(s) "
                 f"{sorted(unknown)}")
+        if "count" in fields and kind != "crash_loop":
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: 'count' only "
+                f"applies to crash_loop")
+        if "period" in fields and kind != "flap":
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: 'period' only "
+                f"applies to flap")
         for req in ("rank", "step"):
             if req not in fields:
                 raise ValueError(
                     f"HOROVOD_FAULT_PLAN entry {entry!r}: missing "
                     f"required field {req!r}")
+        # crash_loop/flap must fire on EVERY restart attempt by default
+        # (a crash loop that stops after the first respawn is not a
+        # loop); everything else keys to the first launch.
+        default_restart = "*" if kind in ("crash_loop", "flap") else "0"
         try:
             rank = int(fields["rank"])
             step = int(fields["step"])
             seconds = float(fields.get("seconds", 1.0))
+            count = int(fields.get("count", 3))
+            period = float(fields.get("period", 0.5))
             restart: Optional[int]
-            if fields.get("restart", "0") == "*":
+            if fields.get("restart", default_restart) == "*":
                 restart = None
             else:
-                restart = int(fields.get("restart", "0"))
+                restart = int(fields.get("restart", default_restart))
         except ValueError as e:
             raise ValueError(
                 f"HOROVOD_FAULT_PLAN entry {entry!r}: {e}") from None
@@ -150,6 +185,12 @@ def parse_plan(text: str) -> List[FaultAction]:
             raise ValueError(
                 f"HOROVOD_FAULT_PLAN entry {entry!r}: rank/step/seconds/"
                 f"restart must be non-negative")
+        if count < 1:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: count must be >= 1")
+        if period <= 0:
+            raise ValueError(
+                f"HOROVOD_FAULT_PLAN entry {entry!r}: period must be > 0")
         default_space = "net" if kind in _NET_KINDS else "step"
         space = fields.get("space", "").lower() or default_space
         if space not in ("step", "net"):
@@ -162,7 +203,8 @@ def parse_plan(text: str) -> List[FaultAction]:
                 f"transport directive — it only exists in space=net")
         actions.append(FaultAction(kind=kind, rank=rank, step=step,
                                    seconds=seconds, restart=restart,
-                                   space=space))
+                                   space=space, count=count,
+                                   period=period))
     return actions
 
 
@@ -173,6 +215,8 @@ _FIRED: set = set()            # indices into the active plan
 _SLOW_WRITE: float = 0.0       # armed per-shard-file write delay
 _PARTITION_UNTIL: dict = {}    # rank -> monotonic deadline of a fired
                                # partition (transport refuses conns)
+_FLAP: dict = {}               # rank -> (start, period, until) of a fired
+                               # flap (partition square wave)
 _PLAN_CACHE: tuple = ("", [])  # (plan_text, parsed) — fault_point runs
                                # every step; steady state is one compare
 
@@ -199,7 +243,11 @@ def _my_rank() -> int:
 
 
 def _restart_count() -> int:
-    return int(os.environ.get("HVD_TPU_ELASTIC_RESTART", "0"))
+    # The elastic driver and the serving fleet supervisor each stamp
+    # their respawns; whichever is set is the attempt the plan keys to.
+    return int(os.environ.get("HVD_TPU_FLEET_RESTART",
+                              os.environ.get("HVD_TPU_ELASTIC_RESTART",
+                                             "0")))
 
 
 def fault_point(step: int, rank: Optional[int] = None) -> None:
@@ -273,11 +321,23 @@ def net_fault(step: int, rank: int) -> dict:
 
 
 def partitioned(rank: int) -> bool:
-    """Is a fired ``partition@`` still in force for this rank? The
-    transport checks per inbound connection and closes without reading
-    while True — the peer sees connection resets, not slow replies."""
+    """Is a fired ``partition@`` (or the partitioned half-period of a
+    fired ``flap@``) still in force for this rank? The transport checks
+    per inbound connection and closes without reading while True — the
+    peer sees connection resets, not slow replies."""
+    now = time.monotonic()
     with _LOCK:
-        return time.monotonic() < _PARTITION_UNTIL.get(rank, 0.0)
+        if now < _PARTITION_UNTIL.get(rank, 0.0):
+            return True
+        flap = _FLAP.get(rank)
+    if flap is None:
+        return False
+    start, period, until = flap
+    if now >= until:
+        return False
+    # Square wave starting partitioned: half-periods 0, 2, 4, ... are
+    # dark, odd ones reachable.
+    return int((now - start) / period) % 2 == 0
 
 
 def _fire(action: FaultAction) -> None:
@@ -288,6 +348,20 @@ def _fire(action: FaultAction) -> None:
                               step=action.step,
                               seconds=action.seconds)
     logger.warning("horovod_tpu.faults: injecting %s", action.describe())
+    if action.kind == "crash_loop":
+        # Die only while the fleet restart attempt is below `count`:
+        # the supervisor either out-waits the loop (count < its
+        # quarantine threshold) or must quarantine (count above it).
+        if _restart_count() < action.count:
+            try:
+                from horovod_tpu import timeline as _tl
+                t = _tl.get_timeline()
+                if t is not None:
+                    t.flush()
+            except Exception:
+                pass
+            os.kill(os.getpid(), signal.SIGKILL)
+        return
     if action.kind == "kill":
         # Flush what we can — the timeline shard stays salvageable and the
         # survivors' merge shows where the victim went dark — then die the
@@ -311,6 +385,11 @@ def _fire(action: FaultAction) -> None:
             _PARTITION_UNTIL[action.rank] = max(
                 _PARTITION_UNTIL.get(action.rank, 0.0),
                 time.monotonic() + action.seconds)
+    elif action.kind == "flap":
+        now = time.monotonic()
+        with _LOCK:
+            _FLAP[action.rank] = (now, action.period,
+                                  now + action.seconds)
     # "drop" and "delay" are directives applied by net_fault's caller.
 
 
@@ -328,3 +407,4 @@ def reset() -> None:
         _FIRED.clear()
         _SLOW_WRITE = 0.0
         _PARTITION_UNTIL.clear()
+        _FLAP.clear()

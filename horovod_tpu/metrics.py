@@ -351,6 +351,20 @@ _HELP: Dict[str, str] = {
     "serve_requests_total": "Serving requests by terminal status.",
     "serve_ttft_seconds": "Serving time-to-first-token.",
     "serve_tpot_seconds": "Serving time-per-output-token.",
+    "fleet_replicas":
+        "Fleet supervisor replica counts by lifecycle state "
+        "(live/starting/restarting/quarantined/spare).",
+    "fleet_target_replicas": "Configured serving-fleet target size.",
+    "fleet_restarts_total":
+        "Replica restarts by typed reason (exit/unreachable/rolling).",
+    "fleet_promotion_seconds":
+        "Warm-spare promotion latency (death observed -> spare serving "
+        "in the dead rank's slot).",
+    "rolling_restart_seconds":
+        "Per-replica drain+restart+readmit latency during "
+        "fleet.rolling_restart().",
+    "transport_membership_total":
+        "RemoteDispatcher membership changes (join/readmit/leave).",
 }
 
 

@@ -60,6 +60,23 @@ def gpt2_from_hf(hf_model: Any, dtype=None) -> Tuple[Any, Dict]:
         raise ValueError(
             f"gpt2_from_hf expects the standard 4*d_model MLP width; "
             f"checkpoint has n_inner={hc.n_inner}")
+    if getattr(hc, "scale_attn_by_inverse_layer_idx", False):
+        # Mistral-style 1/(layer_idx+1) attention scaling changes the
+        # logits of every layer past the first; the weights would load
+        # cleanly and attend with the wrong temperature.
+        raise ValueError(
+            "gpt2_from_hf does not implement "
+            "scale_attn_by_inverse_layer_idx; this checkpoint trained "
+            "with per-layer attention scaling and would convert to the "
+            "wrong attention temperature")
+    if getattr(hc, "reorder_and_upcast_attn", False):
+        # Reordered/upcast attention is numerically different in fp16
+        # training AND implies scale_attn_by_inverse_layer_idx-style
+        # checkpoints; reject loudly instead of converting approximately.
+        raise ValueError(
+            "gpt2_from_hf does not implement reorder_and_upcast_attn; "
+            "this checkpoint's attention recipe differs from the "
+            "zoo's GPT-2 and would silently diverge")
     cfg = GPT2Config(vocab_size=hc.vocab_size, max_seq_len=hc.n_positions,
                      num_layers=hc.n_layer, num_heads=hc.n_head,
                      d_model=hc.n_embd,
@@ -196,6 +213,7 @@ def t5_from_hf(hf_model: Any, dtype=None) -> Tuple[Any, Dict]:
         rel_buckets=hc.relative_attention_num_buckets,
         rel_max_distance=getattr(hc, "relative_attention_max_distance",
                                  128),
+        ln_eps=getattr(hc, "layer_norm_epsilon", 1e-6),
         pad_id=hc.pad_token_id,
         dtype=jnp.float32 if dtype is None else dtype)
     sd = hf_model.state_dict()

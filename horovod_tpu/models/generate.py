@@ -249,7 +249,7 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
     x = params["embedding"][tok].astype(dt)               # (B, D)
     for i in range(cfg.num_decoder_layers):
         p = params[f"dec{i}"]
-        h = _rmsnorm(x, p["ln1"], 1e-6)
+        h = _rmsnorm(x, p["ln1"], cfg.ln_eps)
         q = (h @ p["self_attn"]["q"]["kernel"].astype(dt)) \
             .reshape(-1, H, hd)
         k = (h @ p["self_attn"]["k"]["kernel"].astype(dt)) \
@@ -272,7 +272,7 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
         x = x + o.reshape(-1, H * hd) \
             @ p["self_attn"]["o"]["kernel"].astype(dt)
         # Cross-attention over the fixed encoder K/V; no bias, masked.
-        h = _rmsnorm(x, p["ln2"], 1e-6)
+        h = _rmsnorm(x, p["ln2"], cfg.ln_eps)
         q = (h @ p["cross_attn"]["q"]["kernel"].astype(dt)) \
             .reshape(-1, H, hd)
         s = jnp.einsum("bhd,bthd->bht", q, cross[i]["k"]) \
@@ -286,11 +286,11 @@ def _t5_step(cfg, params, cache, cross, src_mask, dec_bias_tbl, tok, idx):
         o = jnp.einsum("bht,bthd->bhd", a, cross[i]["v"])
         x = x + o.reshape(-1, H * hd) \
             @ p["cross_attn"]["o"]["kernel"].astype(dt)
-        h = _rmsnorm(x, p["ln3"], 1e-6)
+        h = _rmsnorm(x, p["ln3"], cfg.ln_eps)
         g = jax.nn.gelu(h @ p["mlp"]["wi_0"]["kernel"].astype(dt))
         u = h @ p["mlp"]["wi_1"]["kernel"].astype(dt)
         x = x + (g * u) @ p["mlp"]["wo"]["kernel"].astype(dt)
-    x = _rmsnorm(x, params["dec_norm"], 1e-6)
+    x = _rmsnorm(x, params["dec_norm"], cfg.ln_eps)
     return (cache.layers if raw else cache), \
         x.astype(jnp.float32) @ params["lm_head"].T
 

@@ -54,6 +54,9 @@ class T5Config:
     num_decoder_layers: int = 6
     rel_buckets: int = 32
     rel_max_distance: int = 128
+    ln_eps: float = 1e-6             # RMSNorm epsilon (HF:
+                                     # layer_norm_epsilon, 1e-6 in every
+                                     # published T5 recipe)
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     remat_policy: str = "full"       # "full" | "dots" (GPT2Config docs)
@@ -181,8 +184,10 @@ class EncoderLayer(nn.Module):
     def __call__(self, x, bias, key_mask):
         cfg = self.cfg
         x = x + T5Attention(cfg, name="attn")(
-            RMSNorm(name="ln1")(x), bias=bias, key_mask=key_mask)
-        return x + GatedGelu(cfg, name="mlp")(RMSNorm(name="ln2")(x))
+            RMSNorm(eps=cfg.ln_eps, name="ln1")(x), bias=bias,
+            key_mask=key_mask)
+        return x + GatedGelu(cfg, name="mlp")(
+            RMSNorm(eps=cfg.ln_eps, name="ln2")(x))
 
 
 class DecoderLayer(nn.Module):
@@ -192,11 +197,14 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, enc, bias, enc_mask):
         cfg = self.cfg
         x = x + T5Attention(cfg, name="self_attn")(
-            RMSNorm(name="ln1")(x), bias=bias, causal=True)
+            RMSNorm(eps=cfg.ln_eps, name="ln1")(x), bias=bias,
+            causal=True)
         # Cross-attention carries NO position bias in T5.
         x = x + T5Attention(cfg, name="cross_attn")(
-            RMSNorm(name="ln2")(x), kv=enc, key_mask=enc_mask)
-        return x + GatedGelu(cfg, name="mlp")(RMSNorm(name="ln3")(x))
+            RMSNorm(eps=cfg.ln_eps, name="ln2")(x), kv=enc,
+            key_mask=enc_mask)
+        return x + GatedGelu(cfg, name="mlp")(
+            RMSNorm(eps=cfg.ln_eps, name="ln3")(x))
 
 
 def _maybe_remat(cfg: T5Config, layer_cls):
@@ -247,7 +255,7 @@ class T5(nn.Module):
                                 name="enc_rel")(x.shape[1], x.shape[1])
         for i in range(cfg.num_encoder_layers):
             x = enc_layer(cfg, name=f"enc{i}")(x, enc_bias, enc_mask)
-        enc_out = RMSNorm(name="enc_norm")(x)
+        enc_out = RMSNorm(eps=cfg.ln_eps, name="enc_norm")(x)
         if dec_tokens is None:
             return enc_out
 
@@ -258,7 +266,7 @@ class T5(nn.Module):
         for i in range(cfg.num_decoder_layers):
             y = dec_layer(cfg, name=f"dec{i}")(y, enc_out, dec_bias,
                                                enc_mask)
-        y = RMSNorm(name="dec_norm")(y)
+        y = RMSNorm(eps=cfg.ln_eps, name="dec_norm")(y)
         # v1.1: untied lm head, fp32 logits.
         wlm = self.param("lm_head", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)

@@ -102,7 +102,14 @@ def remove_process_set(ps: "ProcessSet") -> bool:
     with _LOCK:
         if ps.process_set_id == 0:
             return False
-        return _SETS.pop(ps.process_set_id, None) is not None
+        removed = _SETS.pop(ps.process_set_id, None) is not None
+    if removed:
+        # Drop the set's subset-barrier arrival marks from the
+        # coordinator's KV store (lazy import: collective imports this
+        # module at load time).
+        from horovod_tpu import collective
+        collective._subset_barrier_teardown(ps.process_set_id)
+    return removed
 
 
 def get_process_set_ids_and_ranks() -> Dict[int, Optional[List[int]]]:

@@ -1431,6 +1431,70 @@ def _check_transport(snap) -> List[Dict]:
     return out
 
 
+def _check_fleet(snap) -> List[Dict]:
+    """Fleet-supervisor health: quarantined replicas (a crash loop or a
+    spent restart budget took capacity out ON PURPOSE), live serving
+    capacity below the fleet target, and a restart rate high enough
+    that the supervisor is churning instead of healing. Knob names in
+    the suggestions are the ones ``config.py`` validates:
+    HOROVOD_SERVE_FLEET_CRASH_LOOP_K / _CRASH_LOOP_WINDOW /
+    _RESTART_BUDGET / _SPARES / _BACKOFF."""
+    out = []
+    by_state = {s.get("labels", {}).get("state", "?"):
+                float(s.get("value", 0))
+                for s in _series(snap, "gauges", "fleet_replicas")}
+    target = 0.0
+    for s in _series(snap, "gauges", "fleet_target_replicas"):
+        target = max(target, float(s.get("value", 0)))
+    quarantined = by_state.get("quarantined", 0.0)
+    live = by_state.get("live", 0.0)
+    if quarantined > 0:
+        out.append(_finding(
+            "fleet_quarantine", 0.9,
+            f"{int(quarantined)} replica(s) quarantined",
+            "the fleet supervisor stopped restarting these replicas — "
+            "K deaths inside the crash-loop window or a spent restart "
+            "budget means respawning was burning capacity, not "
+            "restoring it; the crash is deterministic until someone "
+            "fixes the cause",
+            "read the FLEET timeline markers for the typed quarantine "
+            "reason and the replica's exit history; after fixing the "
+            "root cause, restart the fleet (quarantine is sticky by "
+            "design). If the crashes were genuinely transient, raise "
+            "HOROVOD_SERVE_FLEET_CRASH_LOOP_K / "
+            "HOROVOD_SERVE_FLEET_CRASH_LOOP_WINDOW or "
+            "HOROVOD_SERVE_FLEET_RESTART_BUDGET.",
+            quarantined=int(quarantined)))
+    if target > 0 and live < target:
+        out.append(_finding(
+            "fleet_capacity", 0.7,
+            f"serving capacity below target: {int(live)}/{int(target)} "
+            "replicas live",
+            "dead or restarting replicas are not yet back; surviving "
+            "replicas carry the missing share, so queue wait and TTFT "
+            "degrade until the fleet heals",
+            "if this persists, check for quarantines above; provision "
+            "warm spares (HOROVOD_SERVE_FLEET_SPARES) so promotion — a "
+            "membership write — replaces a dead rank instead of a cold "
+            "process spawn.",
+            live=int(live), target=int(target)))
+    restarts = _sum_counter(snap, "fleet_restarts_total")
+    if target > 0 and restarts >= max(5.0, 2.0 * target):
+        out.append(_finding(
+            "fleet_restart_burn", 0.5,
+            f"{int(restarts)} replica restart(s) this run",
+            "the supervisor is healing often enough that restart churn "
+            "is itself a cost — each respawn re-compiles and re-warms "
+            "an engine before the replica serves again",
+            "correlate FLEET death markers (typed reasons: exit / "
+            "unreachable / rolling) with host or network events; raise "
+            "HOROVOD_SERVE_FLEET_BACKOFF to slow the churn if the "
+            "environment is flaky, and keep warm spares so capacity "
+            "holds while replicas rebuild.",
+            restarts=int(restarts)))
+    return out
+
+
 def _check_memory(snap) -> List[Dict]:
     n = _sum_counter(snap, "memory_pressure_total")
     if n <= 0:
@@ -1472,6 +1536,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_recovery(snap)
     findings += _check_serving(snap)
     findings += _check_transport(snap)
+    findings += _check_fleet(snap)
     findings += _check_mfu(progs, snap)
     findings += _check_overlap(snap, report)
     findings += _check_fusion(snap)

@@ -31,6 +31,13 @@ ROADMAP's "heavy traffic from millions of users" north star needs:
   per-replica circuit breakers, optional tail-latency hedging, and
   typed overload shedding. The filesystem spool above stays as the
   test/CI backend behind the same submit/poll semantics.
+* :mod:`~horovod_tpu.serving.fleet` — the self-healing layer above the
+  transport: :class:`~horovod_tpu.serving.fleet.FleetSupervisor`
+  restarts crashed replicas with jittered backoff, quarantines crash
+  loops, promotes warm spares into dead ranks (the inference analogue
+  of ``run_elastic(spares=N)``), and performs zero-drop rolling
+  drain/restarts, publishing membership that
+  :class:`~horovod_tpu.serving.transport.RemoteDispatcher` follows.
 
 Observability is wired through PRs 1–2: TTFT/TPOT/queue-wait histograms,
 slot-occupancy and queue-depth gauges, per-request timeline markers, and
@@ -49,6 +56,9 @@ from horovod_tpu.serving.transport import (  # noqa: F401
     CircuitBreaker, RemoteClient, RemoteDispatcher, RemoteHandle,
     SocketReplicaServer, TransportError, backoff_delays,
 )
+from horovod_tpu.serving.fleet import (  # noqa: F401
+    FleetSupervisor, ProcessLauncher, ProcessReplica, ReplicaSlot,
+)
 
 __all__ = [
     "InferenceEngine", "PagedKVCache", "BlockManager",
@@ -58,4 +68,6 @@ __all__ = [
     "SocketReplicaServer", "RemoteClient", "RemoteDispatcher",
     "RemoteHandle", "CircuitBreaker", "TransportError",
     "backoff_delays",
+    "FleetSupervisor", "ProcessLauncher", "ProcessReplica",
+    "ReplicaSlot",
 ]

@@ -1,0 +1,587 @@
+"""Self-healing serving fleet: replica supervision, hot-spare
+promotion, rolling drain/restart, and crash-loop quarantine.
+
+PR 10's transport makes *requests* survive a dead replica — the
+dispatcher routes around it, breakers open, failover resubmits. Nothing
+makes *capacity* survive: a crashed replica shrinks the fleet forever.
+This module is the keep-the-world-size discipline of "Highly Available
+Data Parallel ML training on Mesh Networks" (PAPERS.md) applied to the
+inference side, mirroring ``run_elastic(spares=N)``:
+
+* :class:`FleetSupervisor` owns replica processes end-to-end: it spawns
+  them through a pluggable *launcher*, watches liveness (process exit +
+  a ``status`` health RPC whose heartbeat ``seq`` the transport already
+  maintains), and **restarts** crashed replicas with jittered
+  exponential backoff under a bounded per-replica restart budget.
+* **Crash loops** are detected — K deaths inside a sliding window, or a
+  spent restart budget — and the replica is **quarantined** with a
+  typed reason instead of burning respawns forever.
+* Optional **warm spares** (engine compiled, programs warmed, idle but
+  unlisted) are *promoted* into a dead rank's slot the moment the death
+  is observed, so serving capacity holds at the target while the dead
+  replica rebuilds in the background as the new spare.
+* :meth:`FleetSupervisor.rolling_restart` drains one replica at a time
+  (the transport's ``drain`` RPC flips the engine to draining: queued
+  and active work finishes, new submits bounce retryable and re-place
+  through the dispatcher), restarts it, waits for readmission (fresh
+  breaker closed, status probe healthy), then moves on — zero dropped
+  requests, at most one replica unavailable at a time.
+* Membership is published to an atomically-rewritten JSON file that
+  :class:`~horovod_tpu.serving.transport.RemoteDispatcher` follows
+  (``membership=`` path): joins/readmissions install fresh clients with
+  fresh CLOSED breakers, so a respawned replica serves again without a
+  dispatcher process restart.
+
+Deterministic failure driving rides :mod:`horovod_tpu.faults`:
+``crash_loop@rank=R,step=S,count=N`` SIGKILLs a replica at its Sth
+inbound RPC on every fleet attempt below N, and
+``flap@rank=R,step=S,period=P,seconds=X`` bounces its link.
+
+Observability: ``fleet_replicas{state}`` /``fleet_target_replicas``
+gauges, ``fleet_restarts_total{replica,reason}``,
+``fleet_promotion_seconds``, ``rolling_restart_seconds``, ``FLEET``
+timeline markers, and a ``hvd.doctor()`` ``_check_fleet`` finding for
+quarantines, capacity below target, and restart burn — each naming the
+``HOROVOD_SERVE_FLEET_*`` knobs validated in ``config.py``. Exercised
+end-to-end by ``tools/fleet_smoke.py`` (``make fleet-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from horovod_tpu import metrics
+from horovod_tpu.serving.transport import (
+    RemoteClient, TransportError, backoff_delays,
+)
+
+__all__ = ["FleetSupervisor", "ReplicaSlot", "ProcessLauncher",
+           "ProcessReplica"]
+
+# Lifecycle states a slot reports (the `state` label of fleet_replicas).
+LIVE = "live"
+STARTING = "starting"
+RESTARTING = "restarting"
+QUARANTINED = "quarantined"
+SPARE = "spare"            # display state: live but held out of serving
+
+
+# ---------------------------------------------------------------------------
+# process launcher (fleet_smoke / production); tests inject their own
+# ---------------------------------------------------------------------------
+
+class ProcessReplica:
+    """Handle for one spawned replica process.
+
+    Address discovery is file-based and attempt-suffixed
+    (``port.rank{R}.a{A}`` under ``root``) so a respawn can never be
+    mistaken for its dead predecessor's stale port file."""
+
+    def __init__(self, proc: subprocess.Popen, root: str, rank: int,
+                 attempt: int):
+        self.proc = proc
+        self.root = root
+        self.rank = int(rank)
+        self.attempt = int(attempt)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def address(self) -> Optional[Tuple[str, int]]:
+        tag = f"rank{self.rank}.a{self.attempt}"
+        ready = os.path.join(self.root, f"ready.{tag}")
+        port = os.path.join(self.root, f"port.{tag}")
+        if not (os.path.exists(ready) and os.path.exists(port)):
+            return None
+        try:
+            with open(port) as f:
+                return ("127.0.0.1", int(f.read().strip()))
+        except (OSError, ValueError):
+            return None
+
+    def stop(self, grace: float = 10.0) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                self.kill()
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+class ProcessLauncher:
+    """Spawn replica worker processes from a ``python -c`` source
+    template taking ``(rank, root)`` argv. Each respawn is stamped with
+    ``HVD_TPU_FLEET_RESTART=<attempt>`` — the fault plan's
+    ``crash_loop`` kind and ``restart=`` field key to it."""
+
+    def __init__(self, worker_src: str, root: str,
+                 env: Optional[Dict[str, str]] = None):
+        self.worker_src = worker_src
+        self.root = root
+        self.env = dict(env if env is not None else os.environ)
+
+    def __call__(self, name: str, rank: int, attempt: int) -> ProcessReplica:
+        env = dict(self.env, HVD_TPU_FLEET_RESTART=str(attempt))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", self.worker_src, str(rank), self.root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        return ProcessReplica(proc, self.root, rank, attempt)
+
+
+# ---------------------------------------------------------------------------
+# slot record
+# ---------------------------------------------------------------------------
+
+class ReplicaSlot:
+    """One supervised replica: identity (name/rank), the live process
+    handle, lifecycle state, and the death/restart bookkeeping the
+    crash-loop detector reads."""
+
+    def __init__(self, name: str, rank: int, role: str):
+        self.name = name
+        self.rank = int(rank)
+        self.role = role               # "serving" | "spare"
+        self.state = STARTING
+        self.handle: Any = None
+        self.attempt = 0
+        self.address: Optional[Tuple[str, int]] = None
+        self.client: Optional[RemoteClient] = None
+        self.restarts = 0
+        self.deaths: Deque[float] = deque()
+        self.probe_failures = 0
+        self.next_restart_at = 0.0
+        self.quarantine_reason: Optional[str] = None
+        self.died_at: Optional[float] = None
+        self.rolling = False           # under rolling_restart control
+
+    def display_state(self) -> str:
+        if self.state == LIVE and self.role == "spare":
+            return SPARE
+        return self.state
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "rank": self.rank, "role": self.role,
+                "state": self.display_state(), "attempt": self.attempt,
+                "restarts": self.restarts,
+                "quarantine_reason": self.quarantine_reason,
+                "address": self.address}
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class FleetSupervisor:
+    """Hold a serving fleet at its target size.
+
+    ``launcher(name, rank, attempt)`` must return a handle with
+    ``alive()``, ``address() -> (host, port) | None``, ``stop()``, and
+    ``kill()`` — :class:`ProcessLauncher` for real processes, anything
+    duck-typed for tests. Knob defaults resolve from the
+    ``HOROVOD_SERVE_FLEET_*`` family in ``config.py``."""
+
+    def __init__(self, launcher: Callable[[str, int, int], Any],
+                 target: int, *, spares: Optional[int] = None,
+                 membership_path: Optional[str] = None,
+                 probe_seconds: Optional[float] = None,
+                 restart_budget: Optional[int] = None,
+                 backoff_seconds: Optional[float] = None,
+                 backoff_cap_seconds: Optional[float] = None,
+                 crash_loop_k: Optional[int] = None,
+                 crash_loop_window_seconds: Optional[float] = None,
+                 unreachable_probes: int = 3,
+                 probe_rpc_timeout: float = 1.0,
+                 rng: Optional[random.Random] = None):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        if target < 1:
+            raise ValueError(f"fleet target must be >= 1, got {target}")
+        self.launcher = launcher
+        self.target = int(target)
+        self.spares = int(cfg.serve_fleet_spares if spares is None
+                          else spares)
+        self.membership_path = membership_path
+        self.probe_s = float(cfg.serve_fleet_probe_seconds
+                             if probe_seconds is None else probe_seconds)
+        self.restart_budget = int(cfg.serve_fleet_restart_budget
+                                  if restart_budget is None
+                                  else restart_budget)
+        self.backoff_s = float(cfg.serve_fleet_backoff_seconds
+                               if backoff_seconds is None
+                               else backoff_seconds)
+        self.backoff_cap_s = float(cfg.serve_fleet_backoff_cap_seconds
+                                   if backoff_cap_seconds is None
+                                   else backoff_cap_seconds)
+        self.crash_loop_k = int(cfg.serve_fleet_crash_loop_k
+                                if crash_loop_k is None else crash_loop_k)
+        self.crash_loop_window_s = float(
+            cfg.serve_fleet_crash_loop_window_seconds
+            if crash_loop_window_seconds is None
+            else crash_loop_window_seconds)
+        self.unreachable_probes = int(unreachable_probes)
+        self.probe_rpc_timeout = float(probe_rpc_timeout)
+        self._rng = rng or random.Random()
+        self._slots: List[ReplicaSlot] = []
+        for i in range(self.target):
+            self._slots.append(ReplicaSlot(f"r{i}", i, "serving"))
+        for i in range(self.spares):
+            self._slots.append(
+                ReplicaSlot(f"s{i}", self.target + i, "spare"))
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._member_version = 0
+        self._members: Dict[str, Dict[str, Any]] = {}
+        metrics.gauge("fleet_target_replicas").set(float(self.target))
+
+    # -- membership file --------------------------------------------------
+
+    def _publish_membership(self) -> None:
+        if self.membership_path is None:
+            return
+        with self._lock:
+            self._member_version += 1
+            doc = {"version": self._member_version,
+                   "replicas": sorted(self._members.values(),
+                                      key=lambda r: r["name"])}
+        tmp = f"{self.membership_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.membership_path)
+
+    def _member_add(self, slot: ReplicaSlot) -> None:
+        if slot.address is None:
+            return
+        with self._lock:
+            self._members[slot.name] = {
+                "name": slot.name, "host": slot.address[0],
+                "port": slot.address[1], "attempt": slot.attempt}
+        self._publish_membership()
+
+    def _member_remove(self, slot: ReplicaSlot) -> None:
+        with self._lock:
+            removed = self._members.pop(slot.name, None)
+        if removed is not None:
+            self._publish_membership()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, wait_live_s: Optional[float] = None) -> \
+            "FleetSupervisor":
+        """Launch every slot (serving + spares) and start the
+        supervision thread. With ``wait_live_s``, block until the
+        serving target is fully live (raises on timeout)."""
+        for slot in self._slots:
+            self._launch(slot)
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="start", target=self.target,
+                                 spares=self.spares)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="hvd-fleet", daemon=True)
+            self._thread.start()
+        if wait_live_s is not None:
+            deadline = time.monotonic() + float(wait_live_s)
+            while time.monotonic() < deadline:
+                if self.live_serving_count() >= self.target:
+                    return self
+                time.sleep(0.05)
+            raise TimeoutError(
+                f"fleet not live after {wait_live_s:g}s: "
+                f"{[s.describe() for s in self._slots]}")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for slot in self._slots:
+            if slot.handle is not None:
+                try:
+                    slot.handle.stop()
+                except Exception:
+                    pass
+        metrics._timeline_marker("FLEET", category="fleet", event="stop")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:   # noqa: BLE001 — supervision must survive
+                pass
+            self._stop.wait(self.probe_s)
+
+    # -- introspection ----------------------------------------------------
+
+    def slot(self, name: str) -> ReplicaSlot:
+        for s in self._slots:
+            if s.name == name:
+                return s
+        raise KeyError(name)
+
+    def slots(self) -> List[ReplicaSlot]:
+        return list(self._slots)
+
+    def live_serving_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots
+                       if s.role == "serving" and s.state == LIVE)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"target": self.target,
+                    "live": self.live_serving_count(),
+                    "slots": [s.describe() for s in self._slots]}
+
+    # -- supervision ------------------------------------------------------
+
+    def _launch(self, slot: ReplicaSlot) -> None:
+        slot.handle = self.launcher(slot.name, slot.rank, slot.attempt)
+        slot.state = STARTING if slot.restarts == 0 else RESTARTING
+        slot.address = None
+        slot.client = None
+        slot.probe_failures = 0
+
+    def _backoff(self, slot: ReplicaSlot) -> float:
+        # Jittered exponential per slot: full-jitter draw at the ceiling
+        # 2^(restarts-1) * base, capped.
+        d = min(self.backoff_cap_s,
+                self.backoff_s * (2.0 ** max(0, slot.restarts - 1)))
+        return self._rng.uniform(d / 2.0, d)
+
+    def poll_once(self) -> None:
+        """One supervision sweep: respawn due slots, detect deaths
+        (process exit or ``unreachable_probes`` consecutive failed
+        health RPCs), admit freshly-ready replicas, refresh gauges.
+        Normally driven by the background thread; tests call it
+        directly."""
+        now = time.monotonic()
+        for slot in self._slots:
+            if slot.rolling or slot.state == QUARANTINED:
+                continue
+            if slot.handle is None:
+                if now >= slot.next_restart_at:
+                    self._launch(slot)
+                continue
+            if not slot.handle.alive():
+                self._on_death(slot, "exit")
+                continue
+            if slot.address is None:
+                addr = slot.handle.address()
+                if addr is None:
+                    continue
+                slot.address = addr
+                slot.client = RemoteClient(
+                    addr, name=slot.name, max_retries=0,
+                    rpc_timeout=self.probe_rpc_timeout)
+            self._probe(slot)
+        self._update_gauges()
+
+    def _probe(self, slot: ReplicaSlot) -> None:
+        try:
+            st = slot.client.status(retry=False)
+        except TransportError:
+            slot.probe_failures += 1
+            if slot.state == LIVE \
+                    and slot.probe_failures >= self.unreachable_probes:
+                # Alive as a process but dark on the network (partition,
+                # wedged listener): indistinguishable from dead for
+                # serving purposes — replace it.
+                self._on_death(slot, "unreachable")
+            return
+        slot.probe_failures = 0
+        if st.get("alive", False) and slot.state != LIVE:
+            self._admit(slot)
+
+    def _admit(self, slot: ReplicaSlot) -> None:
+        was = slot.state
+        slot.state = LIVE
+        if slot.role == "serving":
+            self._member_add(slot)
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="live", replica=slot.name,
+                                 attempt=slot.attempt, was=was)
+
+    def _on_death(self, slot: ReplicaSlot, reason: str) -> None:
+        if slot.rolling:
+            return     # rolling_restart owns this slot's stop/respawn
+        now = time.monotonic()
+        slot.died_at = now
+        if slot.handle is not None:
+            try:
+                slot.handle.kill()
+            except Exception:
+                pass
+        slot.handle = None
+        slot.address = None
+        slot.client = None
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="death", replica=slot.name,
+                                 reason=reason, attempt=slot.attempt)
+        was_serving = slot.role == "serving" and slot.state == LIVE
+        slot.state = RESTARTING
+        self._member_remove(slot)
+        if was_serving:
+            self._promote_spare(slot)
+        slot.deaths.append(now)
+        while slot.deaths and now - slot.deaths[0] > self.crash_loop_window_s:
+            slot.deaths.popleft()
+        if len(slot.deaths) >= self.crash_loop_k:
+            self._quarantine(
+                slot, f"crash_loop: {len(slot.deaths)} deaths in "
+                f"{self.crash_loop_window_s:g}s window")
+            return
+        if slot.restarts >= self.restart_budget:
+            self._quarantine(
+                slot, f"restart budget exhausted "
+                f"({self.restart_budget} restarts)")
+            return
+        slot.restarts += 1
+        slot.attempt += 1
+        slot.next_restart_at = now + self._backoff(slot)
+        metrics.counter("fleet_restarts_total", replica=slot.name,
+                        reason=reason).inc()
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="restart_scheduled",
+                                 replica=slot.name, reason=reason,
+                                 attempt=slot.attempt,
+                                 in_seconds=slot.next_restart_at - now)
+
+    def _promote_spare(self, dead: ReplicaSlot) -> None:
+        """Move a warm spare into the dead rank's serving slot: the
+        spare's engine is already compiled and its server listening, so
+        promotion is a membership write, not a process spawn. The dead
+        slot rebuilds in the background as the new spare."""
+        t0 = time.monotonic()
+        for spare in self._slots:
+            if spare.role == "spare" and spare.state == LIVE:
+                spare.role, dead.role = "serving", "spare"
+                self._member_add(spare)
+                dt = time.monotonic() - t0
+                metrics.histogram("fleet_promotion_seconds").observe(dt)
+                metrics._timeline_marker(
+                    "FLEET", category="fleet", event="promote",
+                    spare=spare.name, into=dead.name, seconds=dt)
+                return
+
+    def _quarantine(self, slot: ReplicaSlot, reason: str) -> None:
+        slot.state = QUARANTINED
+        slot.quarantine_reason = reason
+        slot.next_restart_at = float("inf")
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="quarantine", replica=slot.name,
+                                 reason=reason)
+
+    def _update_gauges(self) -> None:
+        counts = {LIVE: 0, STARTING: 0, RESTARTING: 0, QUARANTINED: 0,
+                  SPARE: 0}
+        with self._lock:
+            for slot in self._slots:
+                counts[slot.display_state()] = \
+                    counts.get(slot.display_state(), 0) + 1
+        for state, n in counts.items():
+            metrics.gauge("fleet_replicas", state=state).set(float(n))
+
+    # -- rolling restart --------------------------------------------------
+
+    def rolling_restart(self, *, drain_timeout: float = 60.0,
+                        ready_timeout: float = 120.0) -> Dict[str, Any]:
+        """Drain + restart every live serving replica, one at a time.
+
+        Per replica: withdraw it from membership (the dispatcher stops
+        placing new work; its in-flight handles keep polling), issue
+        the ``drain`` RPC (queued/active requests finish; new submits
+        bounce retryable and re-place elsewhere), wait for the load to
+        hit zero, stop the process, respawn it at ``attempt+1``, wait
+        for readmission (fresh breaker CLOSED, status healthy), then
+        move to the next. Bounded unavailability: at most one replica
+        out at any moment, zero dropped requests."""
+        t_all = time.monotonic()
+        restarted: List[str] = []
+        with self._lock:
+            todo = [s for s in self._slots
+                    if s.role == "serving" and s.state == LIVE]
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="rolling_restart_begin",
+                                 replicas=len(todo))
+        for slot in todo:
+            t0 = time.monotonic()
+            slot.rolling = True
+            try:
+                self._roll_one(slot, drain_timeout, ready_timeout)
+            finally:
+                slot.rolling = False
+            dt = time.monotonic() - t0
+            metrics.histogram("rolling_restart_seconds").observe(dt)
+            metrics.counter("fleet_restarts_total", replica=slot.name,
+                            reason="rolling").inc()
+            restarted.append(slot.name)
+        metrics._timeline_marker("FLEET", category="fleet",
+                                 event="rolling_restart_done",
+                                 replicas=len(restarted),
+                                 seconds=time.monotonic() - t_all)
+        return {"restarted": restarted,
+                "seconds": time.monotonic() - t_all}
+
+    def _roll_one(self, slot: ReplicaSlot, drain_timeout: float,
+                  ready_timeout: float) -> None:
+        self._member_remove(slot)
+        try:
+            slot.client.drain(timeout=drain_timeout)
+        except TransportError:
+            pass                       # dead already: respawn heals it
+        deadline = time.monotonic() + drain_timeout
+        while time.monotonic() < deadline:
+            try:
+                st = slot.client.status(retry=False)
+                if int(st.get("load", 0)) <= 0:
+                    break
+            except TransportError:
+                break                  # unreachable: nothing to wait on
+            time.sleep(min(0.1, self.probe_s))
+        if slot.handle is not None:
+            try:
+                slot.handle.stop()
+            except Exception:
+                pass
+        slot.attempt += 1
+        self._launch(slot)
+        slot.state = RESTARTING
+        deadline = time.monotonic() + ready_timeout
+        while time.monotonic() < deadline:
+            if slot.address is None:
+                addr = slot.handle.address()
+                if addr is not None:
+                    slot.address = addr
+                    slot.client = RemoteClient(
+                        addr, name=slot.name, max_retries=0,
+                        rpc_timeout=self.probe_rpc_timeout)
+            else:
+                try:
+                    if slot.client.status(retry=False).get("alive"):
+                        self._admit(slot)
+                        return
+                except TransportError:
+                    pass
+            time.sleep(min(0.1, self.probe_s))
+        raise TimeoutError(
+            f"rolling restart: {slot.name} not ready after "
+            f"{ready_timeout:g}s")

@@ -404,10 +404,27 @@ class SocketReplicaServer:
         return {"ok": True, "rank": self.rank, "alive": self.engine.alive,
                 "load": self.engine.load(), "slots": self.engine.slots,
                 "queue_depth": self.engine.queue.depth(),
+                "draining": bool(getattr(self.engine, "_draining", False)),
                 "seq": seq}
 
+    def _do_drain(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        # Rolling-restart entry point: flip the engine to draining NOW
+        # (new submits bounce retryable, queued/active work finishes)
+        # and let the blocking wait-for-idle run off-thread — the RPC
+        # answers immediately, the caller watches ``status.load`` hit 0.
+        drain = getattr(self.engine, "drain", None)
+        if drain is None:
+            return {"ok": False, "error": "engine cannot drain",
+                    "retryable": False}
+        timeout = float(p.get("timeout", 60.0))
+        threading.Thread(target=drain, args=(timeout,),
+                         name=f"hvd-drain-{self.name}",
+                         daemon=True).start()
+        return {"ok": True, "draining": True, "rank": self.rank}
+
     _METHODS = {"submit": _do_submit, "poll": _do_poll,
-                "cancel": _do_cancel, "status": _do_status}
+                "cancel": _do_cancel, "status": _do_status,
+                "drain": _do_drain}
 
     # -- connection handling ----------------------------------------------
 
@@ -456,12 +473,22 @@ class SocketReplicaServer:
         if self._thread is not None:
             return self
 
+        # closing the listener from stop() does NOT interrupt a thread
+        # blocked in accept(2) on Linux — without a timeout every stop()
+        # would burn the full join budget waiting for a connection that
+        # never comes (fleets stop dozens of replicas per rolling
+        # restart, so this is seconds vs minutes).
+        self._sock.settimeout(0.1)
+
         def loop():
             while not self._stop.is_set():
                 try:
                     conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue               # periodic _stop check
                 except OSError:
                     return                 # listener closed by stop()
+                conn.settimeout(None)      # handlers manage their own
                 threading.Thread(target=self._handle_conn, args=(conn,),
                                  daemon=True).start()
 
@@ -613,6 +640,11 @@ class RemoteClient:
             deadline = time.monotonic() + min(1.0, self.rpc_timeout)
         return self.call("status", {}, deadline=deadline, retry=retry)
 
+    def drain(self, timeout: float = 60.0) -> Dict[str, Any]:
+        return self.call("drain", {"timeout": float(timeout)},
+                         deadline=time.monotonic() + self.rpc_timeout,
+                         retry=False)
+
 
 # ---------------------------------------------------------------------------
 # dispatcher
@@ -675,17 +707,32 @@ class RemoteDispatcher:
     dispatcher can only observe replicas through RPCs, so liveness is
     the breaker state plus a (briefly cached) ``status`` probe. A lost
     replica's in-flight requests are resubmitted to survivors; greedy
-    decode and per-server id-dedup make the replay byte-identical."""
+    decode and per-server id-dedup make the replay byte-identical.
+
+    **Dynamic membership** (``membership=`` path): instead of a fixed
+    endpoint list, the dispatcher follows a JSON membership file the
+    fleet supervisor rewrites atomically —
+    ``{"version": N, "replicas": [{"name", "host", "port",
+    "attempt"}, ...]}``. Joins create clients, leaves retire them
+    (in-flight handles keep their owner references, so polls survive
+    the removal), and a respawned replica — same name, new address or
+    a higher ``attempt`` — gets a FRESH client with a fresh CLOSED
+    circuit breaker: readmission re-closes the breaker by
+    construction, without restarting the dispatcher process."""
 
     _STATUS_TTL = 0.25
+    _MEMBER_TTL = 0.25
 
-    def __init__(self, addresses: Sequence[Tuple[str, int]], *,
+    def __init__(self, addresses: Sequence[Tuple[str, int]] = (), *,
                  clients: Optional[Sequence[RemoteClient]] = None,
                  hedge_ms: Optional[float] = None,
                  rpc_timeout: Optional[float] = None,
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None,
+                 membership: Optional[str] = None):
         from horovod_tpu.config import get_config
         cfg = get_config()
+        self._rpc_timeout = rpc_timeout
+        self._max_retries = max_retries
         if clients is not None:
             self.clients = list(clients)
         else:
@@ -693,12 +740,99 @@ class RemoteDispatcher:
                 RemoteClient(a, rpc_timeout=rpc_timeout,
                              max_retries=max_retries)
                 for a in addresses]
-        if not self.clients:
+        self.membership_path = membership
+        self._member_version = -1
+        self._member_checked = 0.0
+        self._attempts: Dict[str, int] = {}
+        if not self.clients and membership is None:
             raise ValueError("need at least one replica address")
         self.hedge_s = (cfg.serve_hedge_ms if hedge_ms is None
                         else float(hedge_ms)) / 1000.0
         self._status: Dict[str, Tuple[float, float]] = {}  # name->(ts,load)
         self._lock = threading.Lock()
+        if membership is not None:
+            self._refresh_membership(force=True)
+
+    # -- dynamic membership ----------------------------------------------
+
+    def _refresh_membership(self, force: bool = False) -> None:
+        if self.membership_path is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._member_checked < self._MEMBER_TTL:
+                return
+            self._member_checked = now
+        try:
+            with open(self.membership_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return                 # file mid-write or not yet published
+        version = int(doc.get("version", 0))
+        with self._lock:
+            if version <= self._member_version:
+                return
+            self._member_version = version
+        for rep in doc.get("replicas", []):
+            name = rep.get("name")
+            if not name:
+                continue
+            self.add_replica(name, (rep.get("host", "127.0.0.1"),
+                                    int(rep.get("port", 0))),
+                             attempt=int(rep.get("attempt", 0)))
+        keep = {rep.get("name") for rep in doc.get("replicas", [])}
+        for client in list(self.clients):
+            if client.name not in keep:
+                self.remove_replica(client.name)
+
+    def add_replica(self, name: str, address: Tuple[str, int], *,
+                    attempt: int = 0) -> None:
+        """Admit (or readmit) a replica. A returning name with a new
+        address or a higher ``attempt`` replaces its client — the fresh
+        :class:`CircuitBreaker` starts CLOSED (and resets the
+        ``circuit_state`` gauge), so a respawned replica serves again
+        without waiting out its dead predecessor's open circuit."""
+        address = (address[0], int(address[1]))
+        with self._lock:
+            for i, client in enumerate(self.clients):
+                if client.name != name:
+                    continue
+                if client.address == address \
+                        and self._attempts.get(name, 0) >= attempt:
+                    return                 # same incarnation: no-op
+                self.clients[i] = RemoteClient(
+                    address, name=name, rpc_timeout=self._rpc_timeout,
+                    max_retries=self._max_retries)
+                self._attempts[name] = attempt
+                self._status.pop(name, None)
+                event = "readmit"
+                break
+            else:
+                self.clients.append(RemoteClient(
+                    address, name=name, rpc_timeout=self._rpc_timeout,
+                    max_retries=self._max_retries))
+                self._attempts[name] = attempt
+                event = "join"
+        metrics.counter("transport_membership_total", event=event).inc()
+        metrics._timeline_marker("TRANSPORT", category="transport",
+                                 event=event, replica=name,
+                                 attempt=attempt)
+
+    def remove_replica(self, name: str) -> None:
+        """Retire a replica from placement. Handles it already owns
+        keep their client reference, so in-flight polls drain normally
+        — removal only stops NEW placements."""
+        with self._lock:
+            before = len(self.clients)
+            self.clients = [c for c in self.clients if c.name != name]
+            self._attempts.pop(name, None)
+            self._status.pop(name, None)
+            removed = len(self.clients) != before
+        if removed:
+            metrics.counter("transport_membership_total",
+                            event="leave").inc()
+            metrics._timeline_marker("TRANSPORT", category="transport",
+                                     event="leave", replica=name)
 
     # -- routing ----------------------------------------------------------
 
@@ -726,8 +860,11 @@ class RemoteDispatcher:
 
     def _ranked(self, exclude: Sequence[RemoteClient] = ()) -> \
             List[RemoteClient]:
+        self._refresh_membership()
+        with self._lock:
+            candidates = list(self.clients)
         scored = [(self._load_of(c), i, c)
-                  for i, c in enumerate(self.clients) if c not in exclude]
+                  for i, c in enumerate(candidates) if c not in exclude]
         scored.sort(key=lambda t: (t[0], t[1]))
         return [c for load, _, c in scored if load != float("inf")]
 
@@ -775,7 +912,9 @@ class RemoteDispatcher:
             # the submit itself as the probe; open breakers still gate
             # each attempt (instant circuit_open until their half-open
             # token), so this pass stays cheap.
-            candidates = [c for c in self.clients if c not in exclude]
+            with self._lock:
+                candidates = [c for c in self.clients
+                              if c not in exclude]
         for client in candidates:
             try:
                 st = client.submit(handle.spec, deadline=handle.deadline)

@@ -103,20 +103,73 @@ def test_inner_refuses_silent_cpu_fallback(bench, monkeypatch, capsys):
 
 def test_supervisor_blames_relay_for_cpu_fallback_rc(bench, monkeypatch,
                                                      capsys):
-    # The child's cpu-fallback refusal (rc=_RC_CPU_FALLBACK) is a relay
-    # death, not a code regression: supervisor must emit the relay note
-    # with rc=0 so gates don't flag the code.
+    # The child's cpu-fallback refusal (rc=_RC_CPU_FALLBACK plus the
+    # refusal JSON record on stdout) is a relay death, not a code
+    # regression: supervisor must emit the relay note with rc=0 so gates
+    # don't flag the code.
     monkeypatch.setenv("HVD_BENCH_PROBE_ATTEMPTS", "1")
     monkeypatch.setattr(bench, "_probe_backend", lambda t: "ok")
+    record = json.dumps({
+        "metric": "gpt2_medium_tokens_per_sec_per_chip", "value": None,
+        "unit": "unavailable", "vs_baseline": None,
+        "error": "backend fell back to cpu (TPU relay init failed "
+                 "mid-window)"})
     monkeypatch.setattr(
         bench.subprocess, "run",
         lambda cmd, timeout=None, **kw: types.SimpleNamespace(
-            returncode=bench._RC_CPU_FALLBACK))
+            returncode=bench._RC_CPU_FALLBACK, stdout=record + "\n",
+            stderr=""))
     rc = bench._supervise(_args())
     assert rc == 0
     rec = _last_json(capsys)
     assert rec["value"] is None
     assert "relay" in rec["error"] and "regression" not in rec["note"]
+
+
+def test_cpu_fallback_rc_is_collision_resistant(bench):
+    # ADVICE r5: 3 was a plausible generic child exit (any sys.exit(3))
+    # — the sentinel must live outside the commonly-used low range.
+    assert bench._RC_CPU_FALLBACK == 113
+
+
+def test_supervisor_distrusts_cpu_fallback_rc_without_record(
+        bench, monkeypatch, capsys):
+    # The SAME exit code without the refusal record on stdout is some
+    # other failure that happened to exit 113: a code problem. The
+    # supervisor must NOT blame the relay, and must keep rc nonzero so
+    # gates notice.
+    monkeypatch.setenv("HVD_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: "ok")
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda cmd, timeout=None, **kw: types.SimpleNamespace(
+            returncode=bench._RC_CPU_FALLBACK,
+            stdout="Traceback (most recent call last): boom\n",
+            stderr=""))
+    rc = bench._supervise(_args())
+    assert rc == 1
+    rec = _last_json(capsys)
+    assert rec["value"] is None
+    assert "without the cpu-fallback record" in rec["error"]
+    assert "regression" in rec["note"]
+    assert "relay died" not in rec["error"]
+
+
+def test_supervisor_echoes_child_output_through(bench, monkeypatch,
+                                                capsys):
+    # capture_output must not eat the child's JSON: the driver records
+    # the LAST json line of the supervisor's stdout.
+    monkeypatch.setenv("HVD_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: "ok")
+    line = json.dumps({"metric": "m", "value": 1.0})
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda cmd, timeout=None, **kw: types.SimpleNamespace(
+            returncode=0, stdout=line + "\n", stderr="warn\n"))
+    assert bench._supervise(_args()) == 0
+    captured = capsys.readouterr()
+    assert line in captured.out
+    assert "warn" in captured.err
 
 
 def test_report_emits_both_hfu_and_mfu(bench, monkeypatch, capsys):

@@ -228,6 +228,70 @@ class TestProcessSets:
             hvd.add_process_set([99])
 
 
+class _FakeKVClient:
+    """Dict-backed stand-in for the jax coordination-service KV client —
+    just the four calls the subset barrier uses."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.store.items()
+                if k.startswith(prefix + "/")]
+
+    def key_value_try_get(self, key):
+        return self.store.get(key)
+
+
+class TestSubsetBarrierTeardown:
+    def test_destroy_deletes_both_standing_epoch_marks(self, monkeypatch):
+        # A member at epoch e still owns marks at e AND e-1 (e-2 is
+        # cleaned on entry); remove_process_set must delete both, or a
+        # later set reusing the id inherits ghost arrivals.
+        from jax._src import distributed
+
+        from horovod_tpu import collective
+
+        fake = _FakeKVClient()
+        monkeypatch.setattr(distributed.global_state, "client", fake)
+        ps = hvd.add_process_set([0, 1])
+        me = jax.process_index()
+        try:
+            for _ in range(3):   # epochs 1..3: e-2 cleanup kicks in at 3
+                collective._subset_barrier_wait(ps, [me], timeout_s=5.0)
+            assert collective._SUBSET_BARRIER_SEQ[ps.process_set_id] == 3
+            standing = [k for k in fake.store
+                        if k.startswith(f"hvdtpu_ps{ps.process_set_id}_")]
+            # Entering epoch 3 deleted epoch 1's mark; 2 and 3 stand.
+            assert sorted(standing) == [
+                f"hvdtpu_ps{ps.process_set_id}_a2/{me}",
+                f"hvdtpu_ps{ps.process_set_id}_a3/{me}"]
+        finally:
+            assert hvd.remove_process_set(ps)
+        leaked = [k for k in fake.store
+                  if k.startswith(f"hvdtpu_ps{ps.process_set_id}_")]
+        assert leaked == [], f"teardown leaked barrier marks: {leaked}"
+        assert ps.process_set_id not in collective._SUBSET_BARRIER_SEQ
+
+    def test_teardown_without_barriers_is_a_noop(self, monkeypatch):
+        from jax._src import distributed
+
+        from horovod_tpu import collective
+
+        fake = _FakeKVClient()
+        monkeypatch.setattr(distributed.global_state, "client", fake)
+        ps = hvd.add_process_set([0, 2])
+        assert hvd.remove_process_set(ps)
+        assert fake.store == {}
+        assert ps.process_set_id not in collective._SUBSET_BARRIER_SEQ
+
+
 # ---------------------------------------------------------------------------
 # in-trace (SPMD) collectives
 # ---------------------------------------------------------------------------

@@ -180,6 +180,60 @@ class TestConversionGuards:
         with pytest.raises(ValueError, match="n_inner"):
             gpt2_from_hf(hf)
 
+    def test_gpt2_inverse_layer_idx_scaling_rejected(self):
+        # Mistral-style per-layer attention scaling loads cleanly but
+        # attends at the wrong temperature — must refuse, not convert.
+        cfg = transformers.GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=32, n_layer=1,
+            n_head=4, scale_attn_by_inverse_layer_idx=True)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        from horovod_tpu.models.convert import gpt2_from_hf
+        with pytest.raises(ValueError,
+                           match="scale_attn_by_inverse_layer_idx"):
+            gpt2_from_hf(hf)
+
+    def test_gpt2_reorder_upcast_attn_rejected(self):
+        cfg = transformers.GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=32, n_layer=1,
+            n_head=4, reorder_and_upcast_attn=True)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        from horovod_tpu.models.convert import gpt2_from_hf
+        with pytest.raises(ValueError, match="reorder_and_upcast_attn"):
+            gpt2_from_hf(hf)
+
+    def test_t5_ln_eps_carried(self):
+        # HF layer_norm_epsilon must ride into T5Config.ln_eps and be
+        # used by every RMSNorm — at eps=1e-2 the difference vs the old
+        # hard-coded 1e-6 is far outside the parity tolerance, so the
+        # logits check fails unless both stacks honor the carried eps.
+        from horovod_tpu.models.convert import t5_from_hf
+        from horovod_tpu.models.t5 import shift_right
+        cfg = transformers.T5Config(
+            vocab_size=128, d_model=32, d_kv=8, d_ff=64, num_layers=1,
+            num_decoder_layers=1, num_heads=4,
+            relative_attention_num_buckets=8,
+            relative_attention_max_distance=32,
+            feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+            layer_norm_epsilon=1e-2, pad_token_id=0,
+            decoder_start_token_id=0)
+        torch.manual_seed(0)
+        hf = transformers.T5ForConditionalGeneration(cfg).eval()
+        model, params = t5_from_hf(hf)
+        assert model.cfg.ln_eps == 1e-2
+        rng = np.random.default_rng(5)
+        src = rng.integers(1, 128, (1, 10))
+        tgt = rng.integers(1, 128, (1, 6))
+        with torch.no_grad():
+            want = hf(input_ids=torch.from_numpy(src),
+                      labels=torch.from_numpy(tgt)).logits.numpy()
+        dec_in = shift_right(jnp.asarray(tgt, jnp.int32), 0)
+        got = model.apply({"params": params},
+                          jnp.asarray(src, jnp.int32), dec_in)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                                   atol=2e-3)
+
     def test_llama_rope_scaling_rejected(self):
         cfg = transformers.LlamaConfig(
             vocab_size=64, hidden_size=32, intermediate_size=64,

@@ -281,6 +281,30 @@ class TestT5Generate:
             upto = int(stop[0]) + 1 if stop.size else row.size
             np.testing.assert_array_equal(got[b, :upto], row[:upto])
 
+    def test_nonstandard_ln_eps_decode_parity(self, rng):
+        # cfg.ln_eps must reach the cached-decode RMSNorms too: at
+        # eps=1e-2 a _t5_step that still hard-coded 1e-6 diverges from
+        # the full forward within a few tokens.
+        from horovod_tpu.models.t5 import T5, T5Config, shift_right
+        from horovod_tpu.models.generate import t5_generate
+        cfg = T5Config.tiny(dtype=jnp.float32, ln_eps=1e-2)
+        assert cfg.ln_eps == 1e-2
+        model = T5(cfg)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)),
+                          jnp.int32)
+        dummy = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 5)),
+                            jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), src,
+                            shift_right(dummy, cfg.pad_id))["params"]
+        dec = jnp.full((2, 1), cfg.pad_id, jnp.int32)
+        for _ in range(6):
+            logits = model.apply({"params": params}, src, dec)
+            nxt = greedy_token(logits[:, -1])[:, None]
+            dec = jnp.concatenate([dec, nxt.astype(dec.dtype)], axis=1)
+        want = dec[:, 1:]
+        got = t5_generate(model, params, src, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_padded_source_ignored(self, rng):
         from horovod_tpu.models.generate import t5_generate
         cfg, model, src, params = self._setup(rng)

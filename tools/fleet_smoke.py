@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Self-healing fleet smoke: 3 serving replicas + 1 warm spare under a
+:class:`~horovod_tpu.serving.fleet.FleetSupervisor`; one replica
+SIGKILLed twice, one crash-looped into quarantine, one partitioned —
+the supervisor must hold the serving target, then rolling-restart the
+whole fleet mid-load with zero dropped requests.
+
+Faults (``HOROVOD_FAULT_PLAN``, fired by each replica's own inbound RPC
+sequence — the supervisor's health probes drive them deterministically):
+
+* ``crash_loop@rank=0,step=4,count=99`` — replica 0 SIGKILLs itself at
+  its 4th RPC on EVERY fleet attempt: a deterministic crash loop. The
+  spare is promoted into its slot at the first death; after K deaths in
+  the window the supervisor must QUARANTINE it with a typed reason
+  instead of burning respawns forever.
+* ``crash_loop@rank=1,step=6,count=2`` — replica 1 dies twice (attempts
+  0 and 1), then survives: the restart-with-backoff path must bring it
+  back to live both times.
+* ``partition@rank=2,step=5,seconds=2`` — replica 2 drops off the
+  network for 2 s, then heals; the supervisor's unreachable threshold
+  must ride it out without a spurious restart.
+
+The client drives a ``RemoteDispatcher`` that follows the supervisor's
+membership file — respawned replicas are readmitted with fresh CLOSED
+breakers, no dispatcher restart. Assertions come from the METRICS
+snapshot, not log scraping:
+
+1. ``fleet_replicas{state=live}`` returns to the target (3) with
+   ``{state=quarantined}`` == 1 and the quarantine reason typed;
+2. ``fleet_restarts_total`` shows the two exit-restarts and the three
+   rolling restarts; ``fleet_promotion_seconds`` recorded the spare
+   promotion;
+3. every request — including those submitted DURING the rolling
+   restart — reaches a typed terminal state and completes;
+4. ``hvd.doctor()`` ranks the quarantine as a ``fleet_quarantine``
+   finding.
+
+Exit status 0 = all checks pass. Wired as ``make fleet-smoke`` and as
+tier-1 ``tests/test_fleet.py::TestFleetSmoke``.
+"""
+
+import os
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_REQUESTS = 12
+N_ROLLING_REQUESTS = 16
+MAX_NEW = 16
+FAULT_PLAN = ("crash_loop@rank=0,step=4,count=99;"
+              "crash_loop@rank=1,step=6,count=2;"
+              "partition@rank=2,step=5,seconds=2")
+
+# Same worker as net_smoke, except port/ready files are suffixed with
+# the fleet attempt (HVD_TPU_FLEET_RESTART, stamped by ProcessLauncher)
+# so a respawn can never be confused with its predecessor's stale files.
+WORKER = textwrap.dedent("""
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, root = int(sys.argv[1]), sys.argv[2]
+    attempt = os.environ.get("HVD_TPU_FLEET_RESTART", "0")
+    sys.path.insert(0, {repo!r})
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.engine import InferenceEngine
+    from horovod_tpu.serving.transport import SocketReplicaServer
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    eng = InferenceEngine(model, params, slots=2, max_len=64,
+                          block_size=8, prefill_chunk=4,
+                          name=f"rank{{rank}}")
+    # Warm both programs before listening: a spare is only warm if its
+    # compile happened before promotion could ever need it.
+    eng.submit([1, 2, 3, 4, 5], 2)
+    eng.run_until_idle()
+    srv = SocketReplicaServer(eng, rank).start()
+    tag = f"rank{{rank}}.a{{attempt}}"
+    with open(os.path.join(root, f"port.{{tag}}"), "w") as f:
+        f.write(str(srv.port))
+    open(os.path.join(root, f"ready.{{tag}}"), "w").close()
+    while True:                       # SIGKILLed or terminated
+        time.sleep(0.1)
+""").format(repo=REPO)
+
+_TYPED = {"done", "rejected", "expired", "cancelled", "failed"}
+
+
+def _gauge(snap, name, **labels):
+    for s in snap.get("gauges", {}).get(name, []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return float(s.get("value", 0))
+    return 0.0
+
+
+def _counter_sum(snap, name, **labels):
+    return sum(float(s.get("value", 0))
+               for s in snap.get("counters", {}).get(name, [])
+               if all(s.get("labels", {}).get(k) == v
+                      for k, v in labels.items()))
+
+
+def run_smoke(workdir: str, timeout_s: float = 420.0):
+    """One attempt: returns ``(rc, failure_text)``; rendezvous-flavored
+    failure text gets the attempt retried by ``smoke_util``."""
+    sys.path.insert(0, REPO)
+    from horovod_tpu import metrics, profiler
+    from horovod_tpu.serving.fleet import FleetSupervisor, ProcessLauncher
+    from horovod_tpu.serving.transport import RemoteDispatcher
+
+    metrics.reset_metrics()
+    root = os.path.join(workdir, "fleet-root")
+    os.makedirs(root, exist_ok=True)
+    membership = os.path.join(root, "membership.json")
+    env = dict(os.environ, HOROVOD_FAULT_PLAN=FAULT_PLAN)
+    fleet = FleetSupervisor(
+        ProcessLauncher(WORKER, root, env=env), target=3, spares=1,
+        membership_path=membership, probe_seconds=0.25,
+        restart_budget=5, backoff_seconds=0.2, backoff_cap_seconds=1.0,
+        crash_loop_k=3, crash_loop_window_seconds=120.0,
+        # A 2 s partition must NOT read as death: the threshold is far
+        # above what 2 s of failed 0.25 s-cadence probes can reach.
+        unreachable_probes=40, probe_rpc_timeout=1.0)
+    deadline = time.monotonic() + timeout_s
+
+    def fail(msg):
+        print(f"fleet-smoke FAIL: {msg}", file=sys.stderr)
+        print(f"fleet status: {fleet.status()}", file=sys.stderr)
+        texts = [msg]
+        for slot in fleet.slots():
+            proc = getattr(slot.handle, "proc", None)
+            if proc is None:
+                continue
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                out = proc.communicate(timeout=10)[0]
+            except Exception:
+                out = "<no output>"
+            print(f"--- {slot.name} (attempt {slot.attempt}) ---\n{out}",
+                  file=sys.stderr)
+            texts.append(out or "")
+        fleet.stop()
+        return 1, "\n".join(texts)
+
+    # 1. fleet up: 3 serving live (spare warms in parallel).
+    try:
+        fleet.start(wait_live_s=timeout_s / 2)
+    except TimeoutError as e:
+        return fail(f"initial fleet never reached target: {e}")
+
+    disp = RemoteDispatcher(membership=membership, rpc_timeout=1.0,
+                            max_retries=2, hedge_ms=400.0)
+
+    # 2. submit while the supervisor's own probes walk each replica's
+    #    RPC sequence into its fault. Generous per-request deadlines:
+    #    the test is zero DROPS, not latency.
+    import numpy as np
+    rng = np.random.default_rng(13)
+    per_request_s = 180.0
+    handles = []
+    for i in range(N_REQUESTS):
+        prompt = list(rng.integers(1, 255, rng.integers(3, 9)))
+        handles.append(disp.submit(prompt, MAX_NEW,
+                                   deadline_s=per_request_s,
+                                   request_id=f"fleet-{i}"))
+        time.sleep(0.05)
+
+    # 3. the fleet must converge: r0 quarantined (crash loop), spare
+    #    promoted in its place, r1 back at attempt 2 after two deaths,
+    #    r2 healed from its partition — 3 live serving replicas.
+    while time.monotonic() < deadline:
+        st = fleet.status()
+        by_name = {s["name"]: s for s in st["slots"]}
+        if (by_name["r0"]["state"] == "quarantined"
+                and by_name["r1"]["state"] == "live"
+                and by_name["r1"]["attempt"] >= 2
+                and st["live"] >= 3):
+            break
+        time.sleep(0.25)
+    else:
+        return fail(f"fleet never converged: {fleet.status()}")
+
+    for h in handles:
+        disp.wait(h)
+    bad = [(h.id, h.status) for h in handles
+           if not h.terminal or h.status not in _TYPED]
+    if bad:
+        return fail(f"phase-1 requests not typed-terminal: {bad}")
+    not_done = [(h.id, h.status, h.reason) for h in handles
+                if h.status != "done"]
+    if not_done:
+        return fail(f"phase-1 requests dropped despite healing: "
+                    f"{not_done}")
+
+    # 4. metrics, not logs: live back at target, quarantine counted,
+    #    restarts typed, promotion observed.
+    snap = metrics.snapshot()
+    live = _gauge(snap, "fleet_replicas", state="live")
+    quar = _gauge(snap, "fleet_replicas", state="quarantined")
+    target = _gauge(snap, "fleet_target_replicas")
+    if (live, quar, target) != (3.0, 1.0, 3.0):
+        return fail(f"gauge mismatch: live={live} quarantined={quar} "
+                    f"target={target}")
+    exit_restarts = _counter_sum(snap, "fleet_restarts_total",
+                                 reason="exit")
+    if exit_restarts < 3:   # r1 twice + r0 at least once before K hit
+        return fail(f"expected >=3 exit restarts, saw {exit_restarts}")
+    promos = sum(int(s.get("count", 0)) for s in
+                 snap.get("histograms", {}).get("fleet_promotion_seconds",
+                                                []))
+    if promos < 1:
+        return fail("spare promotion never observed in "
+                    "fleet_promotion_seconds")
+    reason = fleet.slot("r0").quarantine_reason or ""
+    if "crash_loop" not in reason:
+        return fail(f"r0 quarantine reason not typed: {reason!r}")
+
+    # 5. rolling restart mid-load: a background submitter keeps traffic
+    #    flowing while every live replica is drained and replaced, one
+    #    at a time. Zero dropped requests is the contract.
+    rolling_handles = []
+    stop_submitting = threading.Event()
+
+    def _submit_during_roll():
+        for i in range(N_ROLLING_REQUESTS):
+            if stop_submitting.is_set():
+                return
+            prompt = list(rng.integers(1, 255, rng.integers(3, 9)))
+            rolling_handles.append(
+                disp.submit(prompt, MAX_NEW, deadline_s=per_request_s,
+                            request_id=f"roll-{i}"))
+            time.sleep(0.4)
+
+    submitter = threading.Thread(target=_submit_during_roll, daemon=True)
+    submitter.start()
+    try:
+        result = fleet.rolling_restart(drain_timeout=60.0,
+                                       ready_timeout=120.0)
+    except TimeoutError as e:
+        stop_submitting.set()
+        return fail(f"rolling restart stuck: {e}")
+    stop_submitting.set()
+    submitter.join(timeout=30)
+    if sorted(result["restarted"]) != sorted(
+            s.name for s in fleet.slots()
+            if s.role == "serving" and s.state == "live"):
+        return fail(f"rolling restart did not cover the serving fleet: "
+                    f"{result}")
+    for h in rolling_handles:
+        disp.wait(h)
+    bad = [(h.id, h.status) for h in rolling_handles
+           if not h.terminal or h.status not in _TYPED]
+    if bad:
+        return fail(f"rolling-restart requests not typed-terminal: {bad}")
+    dropped = [(h.id, h.status, h.reason) for h in rolling_handles
+               if h.status != "done"]
+    if dropped:
+        return fail(f"rolling restart dropped requests: {dropped}")
+
+    snap = metrics.snapshot()
+    rolled = _counter_sum(snap, "fleet_restarts_total", reason="rolling")
+    roll_obs = sum(int(s.get("count", 0)) for s in
+                   snap.get("histograms", {}).get("rolling_restart_seconds",
+                                                  []))
+    if rolled != 3 or roll_obs != 3:
+        return fail(f"expected 3 rolling restarts in metrics, saw "
+                    f"counter={rolled} histogram={roll_obs}")
+    if _gauge(snap, "fleet_replicas", state="live") != 3.0:
+        return fail("fleet not back at target after rolling restart")
+
+    # 6. doctor ranks the quarantine.
+    report = profiler.doctor(snapshot=snap, trace=None, programs={})
+    quar_findings = [f for f in report["findings"]
+                     if f["category"] == "fleet_quarantine"]
+    if not quar_findings:
+        return fail("hvd.doctor() did not rank the quarantine; "
+                    f"findings={[f['category'] for f in report['findings']]}")
+
+    n_ok = len(handles) + len(rolling_handles)
+    print(f"fleet-smoke OK: {n_ok} requests terminal+done across two "
+          f"SIGKILLs, a partition, a crash-loop quarantine "
+          f"({reason!r}), 1 spare promotion, and a 3-replica rolling "
+          f"restart in {result['seconds']:.1f}s; doctor finding "
+          f"#{quar_findings[0]['rank']}: {quar_findings[0]['title']}")
+    fleet.stop()
+    return 0, ""
+
+
+def _attempt():
+    # Fresh workdir per attempt: a retry must not reuse the failed
+    # attempt's ports/membership/state files.
+    with tempfile.TemporaryDirectory(prefix="hvd_fleet_smoke_") as td:
+        return run_smoke(td)
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import smoke_util
+    return smoke_util.main_with_retry(_attempt, name="fleet-smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
