@@ -3,8 +3,8 @@
 PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
-	preempt-smoke topo-smoke net-smoke fleet-smoke bench-sentinel \
-	test native
+	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
+	bench-sentinel test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -87,6 +87,17 @@ topo-smoke:
 # tests/test_fleet.py::TestFleetSmoke.
 fleet-smoke:
 	$(PY) tools/fleet_smoke.py
+
+# Shared-prefix + speculative-decode smoke: a high-overlap batch through
+# two GPT-2 engines (prefix cache + spec lane on vs both off); asserts
+# the shared preamble prefills once ever (index hit/reuse counters +
+# per-request prefix_tokens), copy-on-write fires for a capped
+# full-prefix match, token parity with offline greedy for all three
+# families (T5 auto-disables sharing), a leak-free pool after drain, and
+# spec acceptance > 0 with decode_compiles == 1. Also runs in tier-1 as
+# tests/test_prefix.py::TestPrefixSmoke.
+prefix-smoke:
+	$(PY) tools/prefix_smoke.py
 
 # Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
 # metric's newest line degrades >10% vs the latest prior line at equal

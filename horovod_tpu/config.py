@@ -129,12 +129,25 @@ class Config:
     # HOROVOD_SERVE_BREAKER_FAILURES consecutive connect/timeout
     # failures that open a replica's circuit, HOROVOD_SERVE_BREAKER_RESET
     # seconds before a half-open probe.
+    # Prefix caching + speculative decode (serving/cache.py PrefixIndex,
+    # engine verify lane): HOROVOD_SERVE_PREFIX_CACHE=1 turns on the
+    # copy-on-write shared-prefix radix index over the paged pool —
+    # admission matches full prompt blocks against previously served
+    # prompts and attaches them refcounted instead of re-prefilling;
+    # HOROVOD_SERVE_SPEC_K drafts k tokens per decode dispatch through
+    # the proposer and verifies them in the SAME single jitted decode
+    # program (0 = classic one-token decode);
+    # HOROVOD_SERVE_SPEC_PROPOSER picks the drafting strategy ("ngram"
+    # — prompt/history lookup — is the only one today).
     serve_slots: int = 8
     serve_max_len: int = 512
     serve_block_size: int = 16
     serve_queue_limit: int = 128
     serve_prefill_chunk: int = 8
     serve_kv_quant: str = ""
+    serve_prefix_cache: bool = False
+    serve_spec_k: int = 0
+    serve_spec_proposer: str = "ngram"
     serve_heartbeat_seconds: float = 2.0
     serve_rpc_timeout_seconds: float = 5.0
     serve_max_retries: int = 3
@@ -292,6 +305,18 @@ def _env_kv_quant() -> str:
     return v
 
 
+_SPEC_PROPOSERS = ("ngram",)
+
+
+def _env_spec_proposer() -> str:
+    v = (os.environ.get("HOROVOD_SERVE_SPEC_PROPOSER", "ngram")
+         .strip().lower() or "ngram")
+    if v not in _SPEC_PROPOSERS:
+        raise ValueError(f"HOROVOD_SERVE_SPEC_PROPOSER={v!r}: expected "
+                         f"one of {_SPEC_PROPOSERS}")
+    return v
+
+
 def _env_fault_plan() -> str:
     v = os.environ.get("HOROVOD_FAULT_PLAN", "").strip()
     if v:
@@ -343,6 +368,9 @@ def refresh() -> Config:
         serve_queue_limit=_env_posint("HOROVOD_SERVE_QUEUE_LIMIT", 128),
         serve_prefill_chunk=_env_posint("HOROVOD_SERVE_PREFILL_CHUNK", 8),
         serve_kv_quant=_env_kv_quant(),
+        serve_prefix_cache=_env_bool("HOROVOD_SERVE_PREFIX_CACHE"),
+        serve_spec_k=_env_nonneg_int("HOROVOD_SERVE_SPEC_K", 0),
+        serve_spec_proposer=_env_spec_proposer(),
         serve_heartbeat_seconds=max(
             0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
         serve_rpc_timeout_seconds=_env_posfloat(

@@ -351,6 +351,31 @@ _HELP: Dict[str, str] = {
     "serve_requests_total": "Serving requests by terminal status.",
     "serve_ttft_seconds": "Serving time-to-first-token.",
     "serve_tpot_seconds": "Serving time-per-output-token.",
+    "prefix_cache_hit_rate":
+        "Fraction of admissions that attached shared-prefix KV blocks "
+        "from the radix index (per engine, since start).",
+    "prefix_tokens_reused_total":
+        "Prompt tokens served from the shared-prefix KV cache instead "
+        "of being prefilled.",
+    "kv_blocks_shared":
+        "Paged KV blocks currently referenced by more than one holder "
+        "(slot tables + prefix index).",
+    "spec_tokens_proposed_total":
+        "Draft tokens fed to the speculative verify lane by the "
+        "proposer.",
+    "spec_tokens_accepted_total":
+        "Draft tokens accepted by the verify chain (equal to the "
+        "model's own greedy picks).",
+    "spec_acceptance_rate":
+        "spec_tokens_accepted_total / spec_tokens_proposed_total "
+        "(per engine, since start).",
+    "serve_prompt_overlap_rate":
+        "Fraction of admissions whose leading prompt chunk repeats an "
+        "earlier admission — workload shareability, tracked whether or "
+        "not the prefix cache is enabled.",
+    "prefix_cache_evictions":
+        "LRU evictions of index-only prefix blocks under pool "
+        "pressure (per engine, since start).",
     "fleet_replicas":
         "Fleet supervisor replica counts by lifecycle state "
         "(live/starting/restarting/quarantined/spare).",

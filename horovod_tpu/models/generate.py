@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["generate", "t5_generate", "greedy_token",
-           "decode_step", "init_cache", "decode_family", "DecodeFamily",
+           "decode_step", "decode_verify_step", "init_cache",
+           "decode_family", "DecodeFamily",
            "DenseKVCache", "t5_decoder_bias", "t5_encode"]
 
 
@@ -464,6 +465,60 @@ def decode_step(cfg) -> Callable[..., Tuple[Any, jnp.ndarray]]:
         return fam.step(cfg, params, cache, tok, pos, extras)
 
     return step
+
+
+def decode_verify_step(cfg) -> Callable[..., Tuple[Any, jnp.ndarray,
+                                                   jnp.ndarray]]:
+    """K-token verify variant of :func:`decode_step` for speculative
+    decode: ``(params, cache, tok_seq, pos0, counts=None, extras=None,
+    mask_fn=None) -> (cache, first_logits, greedy)``.
+
+    Feeds ``tok_seq`` — ``(K, B)`` token ids, row 0 the committed token
+    and rows 1.. the proposer's drafts — through K chained decode steps
+    of the SAME per-family step function (``lax.scan``, one compiled
+    program for any K), each lane advancing from its own ``pos0``.
+    Returns the step-0 logits (``(B, V)`` fp32 — what a K=1 caller would
+    have gotten, used by sampling paths) and the greedy pick after every
+    step (``(K, B)`` via :func:`greedy_token` — the verify chain:
+    ``greedy[j]`` is the model's token AFTER seeing ``tok_seq[:j+1]``,
+    so a draft ``tok_seq[j+1]`` is accepted iff it equals ``greedy[j]``
+    and everything before it was accepted).
+
+    ``counts`` (``(B,)``) is each lane's number of live steps;
+    ``mask_fn(cache, lane)`` applies the per-step lane mask (the paged
+    cache's ``with_active`` — steps ``j >= counts`` write to the trash
+    block, so rejected drafts never dirty real cache state). Both
+    default to None for the run-all-K dense case. With ``K == 1`` this
+    is exactly the classic one-token decode step, which is how the
+    serving engine keeps ``decode_compiles == 1``: the verify scan IS
+    its only decode program, at every ``spec_k`` including 0.
+    """
+    fam = decode_family(cfg)
+    fam.validate(cfg)
+    vocab = cfg.vocab_size
+
+    def verify(params, cache, tok_seq, pos0, counts=None, extras=None,
+               mask_fn=None):
+        pos0 = jnp.asarray(pos0, jnp.int32)
+        first0 = jnp.zeros((tok_seq.shape[1], vocab), jnp.float32)
+
+        def body(carry, inp):
+            cache, first = carry
+            tok, j = inp
+            if mask_fn is not None and counts is not None:
+                cache = mask_fn(cache, j < counts)
+            cache, logits = fam.step(cfg, params, cache, tok, pos0 + j,
+                                     extras)
+            first = jnp.where(j == 0, logits.astype(jnp.float32), first)
+            return (cache, first), greedy_token(logits).astype(jnp.int32)
+
+        K = tok_seq.shape[0]
+        (cache, first), greedy = jax.lax.scan(
+            body, (cache, first0),
+            (tok_seq, jnp.arange(K, dtype=jnp.int32)))
+        return cache, first, greedy
+
+    return verify
 
 
 def init_cache(cfg, batch: int, total_len: int):

@@ -1358,6 +1358,76 @@ def _check_serving(snap) -> List[Dict]:
     return out
 
 
+def _check_prefix(snap) -> List[Dict]:
+    """Prefix-cache and speculative-decode health: a workload that keeps
+    repeating prompt preambles (serve_prompt_overlap_rate, tracked even
+    with the cache OFF) should be converting those repeats into
+    prefix_cache_hit_rate; and a speculation lane whose drafts mostly
+    get rejected is spending verify steps for nothing. Knob names match
+    ``config.py``: HOROVOD_SERVE_PREFIX_CACHE, HOROVOD_SERVE_SPEC_K."""
+    out = []
+    overlap = {s.get("labels", {}).get("engine", "?"):
+               float(s.get("value", 0))
+               for s in _series(snap, "gauges", "serve_prompt_overlap_rate")}
+    hits = {s.get("labels", {}).get("engine", "?"):
+            float(s.get("value", 0))
+            for s in _series(snap, "gauges", "prefix_cache_hit_rate")}
+    evics = {s.get("labels", {}).get("engine", "?"):
+             float(s.get("value", 0))
+             for s in _series(snap, "gauges", "prefix_cache_evictions")}
+    for eng, ov in sorted(overlap.items()):
+        if ov < 0.3:
+            continue
+        if eng not in hits:
+            out.append(_finding(
+                "prefix_cache", 0.45 + min(0.3, ov - 0.3),
+                f"engine {eng}: {ov:.0%} of admitted prompts repeat a "
+                f"seen preamble but the prefix cache is OFF",
+                "the workload keeps re-sending the same prompt prefixes "
+                "(system preambles, few-shot templates, chat history) "
+                "and every repeat is prefilled from scratch — the "
+                "biggest avoidable prefill cost in this profile",
+                "set HOROVOD_SERVE_PREFIX_CACHE=1 (or prefix_cache=True "
+                "on the engine): repeated preambles are then attached "
+                "from the paged pool's radix index with copy-on-write "
+                "protection instead of being recomputed.",
+                engine=eng, overlap_rate=ov))
+        elif hits[eng] < 0.5 * ov:
+            out.append(_finding(
+                "prefix_cache", 0.45,
+                f"engine {eng}: prompt overlap {ov:.0%} but prefix hit "
+                f"rate only {hits[eng]:.0%}",
+                f"the cache is on but shareable prefixes are not being "
+                f"found at admission — with "
+                f"{int(evics.get(eng, 0))} LRU eviction(s), pool "
+                "pressure is likely reclaiming cached preamble blocks "
+                "before they are re-used (concurrent cold admissions "
+                "also dilute the rate at startup)",
+                "grow the KV pool (num_blocks, or cut its footprint "
+                "with HOROVOD_SERVE_KV_QUANT) so index blocks survive "
+                "between repeats, and check kv_blocks_shared stays > 0 "
+                "under steady load.",
+                engine=eng, overlap_rate=ov, hit_rate=hits[eng],
+                evictions=int(evics.get(eng, 0))))
+    proposed = _sum_counter(snap, "spec_tokens_proposed_total")
+    accepted = _sum_counter(snap, "spec_tokens_accepted_total")
+    if proposed >= 50 and accepted < 0.2 * proposed:
+        rate = accepted / proposed
+        out.append(_finding(
+            "spec_decode", 0.4,
+            f"speculative acceptance {rate:.0%} "
+            f"({int(accepted)}/{int(proposed)} drafts)",
+            "most drafted tokens are rejected by the verify chain — "
+            "every rejected draft bought nothing, and the verify lane "
+            "still paid its attention cost",
+            "lower HOROVOD_SERVE_SPEC_K (shorter drafts abort sooner) "
+            "or set it to 0 for this workload: the n-gram proposer only "
+            "pays off on repetitive continuations (templates, code, "
+            "retrieval-heavy text).",
+            proposed=int(proposed), accepted=int(accepted)))
+    return out
+
+
 def _check_transport(snap) -> List[Dict]:
     """Serving-transport health: open circuit breakers (a replica being
     routed around RIGHT NOW), past breaker trips, and a retry rate high
@@ -1535,6 +1605,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_memory(snap)
     findings += _check_recovery(snap)
     findings += _check_serving(snap)
+    findings += _check_prefix(snap)
     findings += _check_transport(snap)
     findings += _check_fleet(snap)
     findings += _check_mfu(progs, snap)

@@ -27,6 +27,29 @@ jit-stable engine needs:
   dense ``B x T_max`` equivalent whenever requests are shorter than the
   worst case.
 
+**Shared-prefix caching** (``prefix_cache=True``): a radix tree
+(:class:`PrefixIndex`) over full ``block_size``-token prompt chunks maps
+previously prefilled prompt prefixes to their pool blocks. Admission
+matches the new prompt against the index and ATTACHES the matched blocks
+to the slot's table with bumped refcounts — a shared preamble is
+prefilled once, ever; only the divergent tail is computed. The pool
+stays correct under sharing by three rules:
+
+* a write into a block with ``refcount > 1`` **copies on write**
+  (:meth:`BlockManager.ensure_writable` allocates a private copy, swaps
+  the table entry, and decrefs the original — the device copy itself is
+  folded into the jitted step via :meth:`PagedKVCache.copy_blocks`);
+* admission reserves only the UNSHARED tail
+  (``blocks_for(total) - n_matched // block_size`` fresh blocks — the
+  ``// block_size`` rather than an attach count covers the one CoW a
+  capped full-prompt match triggers) and the admission check counts
+  index-only blocks as reclaimable supply, so ``ensure`` still cannot
+  fail mid-flight;
+* eviction is LRU over index leaf nodes whose block nobody else holds
+  (``refcount == 1``): allocation under pressure reclaims the coldest
+  cached prefix block instead of failing, so the index never leaks the
+  pool.
+
 Table VALUES change between steps (host-side admit/evict); table SHAPE
 never does — so the jitted step never recompiles.
 
@@ -41,7 +64,8 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import List, Optional
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -50,7 +74,7 @@ import jax.numpy as jnp
 
 from horovod_tpu.ops.quantized import quantize_blocks, dequantize_blocks
 
-__all__ = ["PagedKVCache", "BlockManager", "TRASH_BLOCK"]
+__all__ = ["PagedKVCache", "BlockManager", "PrefixIndex", "TRASH_BLOCK"]
 
 #: pool block 0 is never allocated: masked-off lanes write here.
 TRASH_BLOCK = 0
@@ -193,6 +217,23 @@ class PagedKVCache:
             cv = dequantize_blocks(cv, vs[..., None], block=hd)
         return ck.astype(self.dtype), cv.astype(self.dtype)
 
+    def copy_blocks(self, src, dst) -> "PagedKVCache":
+        """Pool-level block copies for copy-on-write: row ``dst[i]`` of
+        every layer's K/V pool (and scale pools) becomes a copy of row
+        ``src[i]``. ``src``/``dst`` are FIXED-SHAPE int32 vectors —
+        unused entries point both at the trash block, a self-copy no-op
+        — so the jitted step's signature never changes with the number
+        of CoW events in a dispatch."""
+        src = jnp.asarray(src, jnp.int32)
+        dst = jnp.asarray(dst, jnp.int32)
+        kp = self.kp.at[:, dst].set(self.kp[:, src])
+        vp = self.vp.at[:, dst].set(self.vp[:, src])
+        if self.quant:
+            ks = self.ks.at[:, dst].set(self.ks[:, src])
+            vs = self.vs.at[:, dst].set(self.vs[:, src])
+            return self.replace(kp=kp, vp=vp, ks=ks, vs=vs)
+        return self.replace(kp=kp, vp=vp)
+
     # -- pytree plumbing --------------------------------------------------
 
     def tree_flatten(self):
@@ -211,22 +252,139 @@ class PagedKVCache:
 jax.tree_util.register_pytree_node_class(PagedKVCache)
 
 
+class _PrefixNode:
+    """One full ``block_size``-token prompt chunk in the radix tree,
+    holding the pool block that chunk was prefilled into."""
+
+    __slots__ = ("key", "block", "parent", "children", "last_used")
+
+    def __init__(self, key, block: int, parent: Optional["_PrefixNode"]):
+        self.key = key                  # tuple of token ids (None = root)
+        self.block = int(block)
+        self.parent = parent
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.last_used = 0
+
+
+class PrefixIndex:
+    """Radix tree over full-block prompt chunks -> pool blocks.
+
+    Keys are tuples of ``block_size`` token ids, so two prompts share a
+    path exactly as far as their token-exact common prefix extends in
+    whole blocks. The index holds ONE refcount on every block it maps
+    (accounted by :class:`BlockManager`); a block whose only holder is
+    the index (``refcount == 1``) is *reclaimable* — :meth:`evict_lru`
+    drops the least-recently-matched such LEAF so allocation under
+    pressure trims the coldest cached prefix first. Leaves-only eviction
+    keeps every surviving path rooted; an evictable leaf always exists
+    when any reclaimable block does, because a node whose block is held
+    by some slot implies its ancestors are held by that slot too —
+    index-only nodes form downward-closed subtrees.
+
+    Not thread-safe on its own: :class:`BlockManager` calls every method
+    under its lock.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._root = _PrefixNode(None, TRASH_BLOCK, None)
+        self._by_block: Dict[int, _PrefixNode] = {}
+        self._clock = 0
+        # per-ADMISSION stats, bumped by BlockManager.admit():
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._by_block)
+
+    def blocks(self):
+        """Iterable of pool blocks currently held by the index."""
+        return self._by_block.keys()
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    def match(self, tokens) -> List[int]:
+        """Longest indexed whole-block prefix of ``tokens``; returns the
+        matched chunks' pool blocks in prompt order (LRU-touched)."""
+        bs = self.block_size
+        node, blocks = self._root, []
+        for i in range(len(tokens) // bs):
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            blocks.append(child.block)
+            node = child
+        return blocks
+
+    def insert(self, tokens, blocks) -> List[int]:
+        """Publish a prefilled prompt's whole-block chain. First writer
+        wins: chunks already indexed keep their existing block (the new
+        slot simply never shared those), so a block never gains two
+        index entries. Returns the blocks NEWLY held by the index —
+        the caller owes each one a refcount bump."""
+        bs = self.block_size
+        node, new = self._root, []
+        for i in range(min(len(tokens) // bs, len(blocks))):
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _PrefixNode(key, blocks[i], node)
+                node.children[key] = child
+                self._by_block[child.block] = child
+                new.append(child.block)
+            self._touch(child)
+            node = child
+        return new
+
+    def evict_lru(self, refcount) -> Optional[int]:
+        """Drop the least-recently-used leaf whose block only the index
+        holds (``refcount == 1``) and return that block; ``None`` when
+        nothing is evictable."""
+        best = None
+        for blk, node in self._by_block.items():
+            if node.children or refcount[blk] != 1:
+                continue
+            if best is None or node.last_used < best.last_used:
+                best = node
+        if best is None:
+            return None
+        del best.parent.children[best.key]
+        del self._by_block[best.block]
+        self.evictions += 1
+        return best.block
+
+
 class BlockManager:
     """Host half: free list, refcounts, reservations, the numpy block
     table mirror. All methods are thread-safe; the engine calls them
     between jitted steps.
 
-    Accounting invariants (pinned by ``tests/test_serving.py``):
+    Accounting invariants (pinned by ``tests/test_serving.py`` and the
+    randomized sharing trace in ``tests/test_prefix.py``):
 
-    * every non-trash block is on the free list XOR held by exactly one
-      slot (refcounted — the count is the hook prefix sharing will use);
-    * ``blocks_in_use + len(free) == num_blocks - 1``;
-    * reservations never exceed capacity, so ``ensure()`` cannot fail
-      for an admitted request.
+    * every non-trash block is on the free list XOR in use, and an
+      in-use block's refcount equals the number of slot tables mapping
+      it plus one if the prefix index holds it;
+    * ``blocks_in_use`` counts UNIQUE non-free blocks, so
+      ``blocks_in_use + len(free) == num_blocks - 1`` regardless of how
+      widely a block is shared;
+    * outstanding fresh-block demand (reservations minus blocks already
+      allocated) never exceeds free + index-reclaimable supply, so
+      ``ensure``/``ensure_writable`` cannot fail for an admitted
+      request;
+    * a shared block (refcount > 1) is never written in place —
+      :meth:`ensure_writable` copies first (CoW).
     """
 
     def __init__(self, num_blocks: int, block_size: int, slots: int,
-                 max_blocks_per_slot: int):
+                 max_blocks_per_slot: int, *, prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved trash block)")
@@ -241,6 +399,9 @@ class BlockManager:
         self.table = np.zeros((slots, max_blocks_per_slot), np.int32)
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         self._reserved = np.zeros(slots, np.int64)
+        self._fresh = np.zeros(slots, np.int64)
+        self.prefix = PrefixIndex(block_size) if prefix_cache else None
+        self.cow_copies = 0
         self.blocks_in_use = 0
         self.peak_blocks_in_use = 0
         self._dirty = True
@@ -262,55 +423,200 @@ class BlockManager:
 
     # -- admission --------------------------------------------------------
 
+    def _reclaimable_locked(self, exclude: Sequence[int] = ()) -> int:
+        """Index-held blocks nobody else references — supply the
+        allocator can reclaim via LRU eviction. ``exclude`` names blocks
+        an in-flight admission is about to pin (they stop being supply
+        the moment that admission lands)."""
+        if self.prefix is None:
+            return 0
+        ex = {int(b) for b in exclude}
+        return sum(1 for b in self.prefix.blocks()
+                   if self.refcount[b] == 1 and b not in ex)
+
+    def _can_admit_locked(self, fresh: int,
+                          shared_blocks: Sequence[int]) -> bool:
+        outstanding = int((self._reserved - self._fresh).sum())
+        supply = len(self._free) + self._reclaimable_locked(shared_blocks)
+        return outstanding + fresh <= supply
+
+    def _fresh_for(self, total_tokens: int, n_matched: int) -> int:
+        """Fresh blocks a request needs beyond its attached prefix.
+        ``n_matched // block_size`` (not the attach count) is deliberate:
+        a capped full-prompt match leaves ``n_matched % block_size != 0``
+        and the refeed's first write lands in the LAST attached block —
+        one CoW, whose fresh copy this formula budgets for."""
+        return self.blocks_for(total_tokens) - n_matched // self.block_size
+
+    def match_prefix(self, tokens) -> Tuple[int, List[int]]:
+        """Longest indexed prefix of a prompt, as ``(n_matched,
+        blocks_to_attach)``. ``n_matched`` is capped at ``len(tokens) -
+        1`` — at least one prompt token must be re-fed to produce the
+        first logits — and the attach list covers exactly the matched
+        positions (a zero-token cap attaches nothing). Pure peek: no
+        refcounts move until :meth:`admit`."""
+        if self.prefix is None:
+            return 0, []
+        with self._lock:
+            blocks = self.prefix.match(tokens)
+            n = min(len(blocks) * self.block_size, len(tokens) - 1)
+            attach = blocks[:-(-n // self.block_size)] if n > 0 else []
+            return n, attach
+
     def can_reserve(self, tokens: int) -> bool:
         with self._lock:
-            return (int(self._reserved.sum()) + self.blocks_for(tokens)
-                    <= self.capacity)
+            return self._can_admit_locked(self.blocks_for(tokens), ())
+
+    def can_admit(self, total_tokens: int, n_matched: int = 0,
+                  shared_blocks: Sequence[int] = ()) -> bool:
+        with self._lock:
+            return self._can_admit_locked(
+                self._fresh_for(total_tokens, n_matched), shared_blocks)
 
     def reserve(self, slot: int, tokens: int) -> None:
         """Reserve the worst case for a request entering ``slot``."""
-        need = self.blocks_for(tokens)
+        self.admit(slot, tokens)
+
+    def admit(self, slot: int, total_tokens: int, n_matched: int = 0,
+              shared_blocks: Sequence[int] = ()) -> None:
+        """Reserve ``slot``'s unshared tail and attach its matched
+        prefix blocks (refcount-bumped) from a :meth:`match_prefix`
+        result. With no match this is exactly the old worst-case
+        ``reserve``."""
+        fresh = self._fresh_for(total_tokens, n_matched)
         with self._lock:
             if self._reserved[slot]:
                 raise RuntimeError(f"slot {slot} already holds a "
                                    f"reservation")
-            if int(self._reserved.sum()) + need > self.capacity:
+            if not self._can_admit_locked(fresh, shared_blocks):
                 raise RuntimeError(
-                    f"pool over-reserved: {need} blocks for slot {slot} "
+                    f"pool over-reserved: {fresh} blocks for slot {slot} "
                     f"on top of {int(self._reserved.sum())}/"
                     f"{self.capacity}")
-            self._reserved[slot] = need
+            self._reserved[slot] = fresh
+            self._fresh[slot] = 0
+            for i, blk in enumerate(shared_blocks):
+                blk = int(blk)
+                self.refcount[blk] += 1
+                self.table[slot, i] = blk
+                self._slot_blocks[slot].append(blk)
+            if shared_blocks:
+                self._dirty = True
+            if self.prefix is not None:
+                self.prefix.lookups += 1
+                if n_matched > 0:
+                    self.prefix.hits += 1
+                    self.prefix.tokens_reused += int(n_matched)
+
+    # -- allocation / copy-on-write ---------------------------------------
+
+    def _alloc_block_locked(self, slot: int) -> int:
+        """Pop a fresh block against ``slot``'s reservation, reclaiming
+        the LRU index-only prefix block when the free list is dry."""
+        if self._fresh[slot] >= self._reserved[slot]:
+            raise RuntimeError(
+                f"slot {slot} exceeded its reservation "
+                f"({self._reserved[slot]} blocks)")
+        if not self._free:
+            victim = (self.prefix.evict_lru(self.refcount)
+                      if self.prefix is not None else None)
+            if victim is None:
+                raise RuntimeError("block pool exhausted despite "
+                                   "reservations — accounting bug")
+            self.refcount[victim] -= 1
+            self._free.append(victim)
+            self.blocks_in_use -= 1
+        blk = self._free.pop()
+        self.refcount[blk] += 1
+        self.blocks_in_use += 1
+        self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                      self.blocks_in_use)
+        self._fresh[slot] += 1
+        return blk
 
     def ensure(self, slot: int, pos: int) -> bool:
         """Map logical position ``pos`` of ``slot``; allocate the block
-        on first touch. Returns True when a new block was allocated."""
+        on first touch. Returns True when a new block was allocated.
+        Refuses to hand out a SHARED block for writing — engines running
+        with the prefix cache must use :meth:`ensure_writable`."""
         b = pos // self.block_size
         if b >= self.max_blocks_per_slot:
             raise IndexError(f"position {pos} beyond slot capacity "
                              f"({self.max_blocks_per_slot} blocks)")
         with self._lock:
-            if self.table[slot, b] != TRASH_BLOCK:
+            cur = int(self.table[slot, b])
+            if cur != TRASH_BLOCK:
+                if self.refcount[cur] > 1:
+                    raise RuntimeError(
+                        f"write into shared block {cur} (refcount "
+                        f"{int(self.refcount[cur])}) without CoW — use "
+                        f"ensure_writable()")
                 return False
-            if len(self._slot_blocks[slot]) >= self._reserved[slot]:
-                raise RuntimeError(
-                    f"slot {slot} exceeded its reservation "
-                    f"({self._reserved[slot]} blocks)")
-            if not self._free:
-                raise RuntimeError("block pool exhausted despite "
-                                   "reservations — accounting bug")
-            blk = self._free.pop()
-            self.refcount[blk] += 1
+            blk = self._alloc_block_locked(slot)
             self.table[slot, b] = blk
             self._slot_blocks[slot].append(blk)
-            self.blocks_in_use += 1
-            self.peak_blocks_in_use = max(self.peak_blocks_in_use,
-                                          self.blocks_in_use)
             self._dirty = True
             return True
 
+    def ensure_writable(self, slot: int,
+                        pos: int) -> Optional[Tuple[int, int]]:
+        """Like :meth:`ensure`, but copy-on-write aware: if ``pos`` maps
+        to a block someone else also holds, allocate a private copy,
+        swap the table entry, decref the original, and return ``(src,
+        dst)`` so the caller folds the device copy into its next jitted
+        step (:meth:`PagedKVCache.copy_blocks`). Returns ``None`` when
+        the position was already privately mapped or a plain allocation
+        sufficed."""
+        b = pos // self.block_size
+        if b >= self.max_blocks_per_slot:
+            raise IndexError(f"position {pos} beyond slot capacity "
+                             f"({self.max_blocks_per_slot} blocks)")
+        with self._lock:
+            cur = int(self.table[slot, b])
+            if cur == TRASH_BLOCK:
+                blk = self._alloc_block_locked(slot)
+                self.table[slot, b] = blk
+                self._slot_blocks[slot].append(blk)
+                self._dirty = True
+                return None
+            if self.refcount[cur] <= 1:
+                return None
+            blk = self._alloc_block_locked(slot)
+            self.table[slot, b] = blk
+            sb = self._slot_blocks[slot]
+            sb[sb.index(cur)] = blk
+            self.refcount[cur] -= 1
+            self.cow_copies += 1
+            self._dirty = True
+            return cur, blk
+
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Publish ``slot``'s fully-prefilled prompt into the index so
+        later admissions can attach it. The engine calls this at the
+        request's FIRST generated token — every prompt position has been
+        written by then, and published whole-prompt-chunk blocks are
+        never written again (decode writes land at positions >=
+        ``len(prompt)``). Returns the number of blocks newly indexed."""
+        if self.prefix is None:
+            return 0
+        nfull = len(tokens) // self.block_size
+        if nfull == 0:
+            return 0
+        with self._lock:
+            blocks = [int(self.table[slot, i]) for i in range(nfull)]
+            if TRASH_BLOCK in blocks:
+                raise RuntimeError(
+                    f"register_prefix(slot={slot}) before the prompt was "
+                    f"fully prefilled")
+            new = self.prefix.insert(tokens, blocks)
+            for blk in new:
+                self.refcount[blk] += 1
+            return len(new)
+
     def release(self, slot: int) -> None:
-        """Return a finished slot's blocks (refcount-decremented) and
-        drop its reservation."""
+        """Return a finished slot's blocks (refcount-decremented; a
+        block lives on while other slots or the prefix index still hold
+        it) and drop its reservation."""
         with self._lock:
             for blk in self._slot_blocks[slot]:
                 self.refcount[blk] -= 1
@@ -322,7 +628,32 @@ class BlockManager:
             self._slot_blocks[slot] = []
             self.table[slot, :] = TRASH_BLOCK
             self._reserved[slot] = 0
+            self._fresh[slot] = 0
             self._dirty = True
+
+    # -- sharing stats -----------------------------------------------------
+
+    def shared_block_count(self) -> int:
+        """Blocks referenced by more than one holder (slot tables and/or
+        the prefix index) — the ``kv_blocks_shared`` gauge."""
+        with self._lock:
+            return int((self.refcount[TRASH_BLOCK + 1:] > 1).sum())
+
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Per-admission prefix-cache counters for metrics/doctor."""
+        with self._lock:
+            if self.prefix is None:
+                return {"enabled": False, "lookups": 0, "hits": 0,
+                        "hit_rate": 0.0, "tokens_reused": 0,
+                        "nodes": 0, "evictions": 0, "cow_copies":
+                        self.cow_copies}
+            p = self.prefix
+            return {"enabled": True, "lookups": p.lookups,
+                    "hits": p.hits,
+                    "hit_rate": p.hits / p.lookups if p.lookups else 0.0,
+                    "tokens_reused": p.tokens_reused,
+                    "nodes": p.num_nodes, "evictions": p.evictions,
+                    "cow_copies": self.cow_copies}
 
     # -- device mirror ----------------------------------------------------
 
@@ -349,15 +680,38 @@ class BlockManager:
 
     def check(self) -> None:
         with self._lock:
+            for s, blocks in enumerate(self._slot_blocks):
+                assert len(blocks) == len(set(blocks)), \
+                    f"slot {s} holds a block twice: {sorted(blocks)}"
             held = [b for blocks in self._slot_blocks for b in blocks]
-            assert len(held) == len(set(held)), \
-                f"block double-assigned: {sorted(held)}"
-            assert not (set(held) & set(self._free)), \
-                "block simultaneously free and held"
-            assert TRASH_BLOCK not in held and TRASH_BLOCK not in self._free
-            assert self.blocks_in_use == len(held)
+            index_blocks = (set(self.prefix.blocks())
+                            if self.prefix is not None else set())
+            in_use = set(held) | index_blocks
+            assert not (in_use & set(self._free)), \
+                "block simultaneously free and in use"
+            assert TRASH_BLOCK not in in_use, \
+                "trash block held by a slot or the index"
+            assert TRASH_BLOCK not in self._free
+            assert self.blocks_in_use == len(in_use), \
+                (self.blocks_in_use, sorted(in_use))
             assert self.blocks_in_use + len(self._free) == self.capacity, \
                 (self.blocks_in_use, len(self._free), self.capacity)
-            assert int(self.refcount[1:].sum()) == self.blocks_in_use
+            # sharing invariant: refcount == #slot tables mapping the
+            # block + 1 if the prefix index holds it
+            holders = Counter(held)
+            for blk in in_use:
+                want = holders.get(blk, 0) + (blk in index_blocks)
+                assert int(self.refcount[blk]) == want, \
+                    (blk, int(self.refcount[blk]), want)
+            assert int(self.refcount[TRASH_BLOCK + 1:].sum()) == \
+                len(held) + len(index_blocks)
             mapped = set(int(x) for x in self.table.ravel()) - {TRASH_BLOCK}
             assert mapped == set(held), (mapped, set(held))
+            assert (self._fresh <= self._reserved).all(), \
+                (self._fresh, self._reserved)
+            # admission safety: outstanding fresh demand is always
+            # coverable by free + reclaimable supply
+            outstanding = int((self._reserved - self._fresh).sum())
+            assert outstanding <= len(self._free) + \
+                self._reclaimable_locked(), \
+                (outstanding, len(self._free), self._reclaimable_locked())

@@ -23,6 +23,24 @@ Because both drivers run the SAME registry step functions
 token-identical to offline ``generate()`` — the parity tests in
 ``tests/test_serving.py`` pin all three families.
 
+Two multipliers ride the same single decode program (PR 12):
+
+* **Shared-prefix caching** (``prefix_cache=True`` /
+  ``HOROVOD_SERVE_PREFIX_CACHE=1``): admission matches the prompt
+  against the pool's radix index (``serving/cache.py``) and attaches
+  already-prefilled preamble blocks refcounted — only the divergent
+  tail is prefilled, copy-on-write protects shared blocks, and the
+  admission reservation shrinks to the unshared tail. Disabled for T5
+  (decoder KV depends on the per-request encoder output).
+* **Speculative decode** (``spec_k=k`` / ``HOROVOD_SERVE_SPEC_K=k``):
+  an n-gram proposer drafts up to k tokens from the request's own
+  prompt + history, and the decode program — ALWAYS the
+  ``spec_k + 1``-step verify scan, so ``decode_compiles == 1`` holds —
+  accepts the longest prefix matching the model's own greedy chain.
+  Greedy lanes only; acceptance keeps token-parity with offline
+  ``generate()`` by construction (every accepted token IS the model's
+  greedy pick).
+
 Observability (PRs 1–2): ``serve_ttft_seconds`` / ``serve_tpot_seconds``
 / ``serve_queue_wait_seconds`` histograms, ``serve_slots_active`` /
 ``serve_queue_depth`` / ``serve_blocks_in_use`` gauges, per-request
@@ -45,9 +63,10 @@ import jax.numpy as jnp
 
 from horovod_tpu import metrics, profiler, tracing
 from horovod_tpu.models.generate import (
-    decode_family, decode_step, greedy_token, t5_decoder_bias, t5_encode,
+    decode_family, decode_step, decode_verify_step, greedy_token,
+    t5_decoder_bias, t5_encode,
 )
-from horovod_tpu.serving.cache import BlockManager, PagedKVCache
+from horovod_tpu.serving.cache import BlockManager, PagedKVCache, TRASH_BLOCK
 from horovod_tpu.serving.scheduler import (
     Request, RequestQueue, RequestStatus, SlotPool,
 )
@@ -88,6 +107,9 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  queue_limit: Optional[int] = None,
                  max_src_len: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_proposer: Optional[str] = None,
                  name: str = "engine0"):
         from horovod_tpu.config import get_config
         hcfg = get_config()
@@ -105,6 +127,25 @@ class InferenceEngine:
                                  else hcfg.serve_prefill_chunk)
         self.kv_quant = (hcfg.serve_kv_quant if kv_quant == "__env__"
                          else kv_quant) or None
+        # Prefix sharing is sound only when a prompt's KV depends on the
+        # prompt alone: T5 decoder self-attention K/V are a function of
+        # the per-request encoder output through cross-attention, so two
+        # requests with identical decoder prompts still have different
+        # cache contents — the gate silently disables sharing for T5
+        # (speculative decode stays available: the verify chain replays
+        # the slot's OWN state, nothing is shared).
+        pfx = (hcfg.serve_prefix_cache if prefix_cache is None
+               else prefix_cache)
+        self.prefix_enabled = bool(pfx) and self.family.name != "t5"
+        self.spec_k = int(spec_k if spec_k is not None
+                          else hcfg.serve_spec_k)
+        self.spec_proposer = str(spec_proposer if spec_proposer is not None
+                                 else hcfg.serve_spec_proposer)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_k > 0 and self.spec_proposer != "ngram":
+            raise ValueError(f"unknown spec proposer "
+                             f"{self.spec_proposer!r}; known: ('ngram',)")
         queue_limit = int(queue_limit if queue_limit is not None
                           else hcfg.serve_queue_limit)
         if self.slots < 1 or self.max_len < 2 or self.block_size < 1 \
@@ -124,7 +165,8 @@ class InferenceEngine:
         self.num_blocks = int(num_blocks if num_blocks is not None
                               else dense_blocks + 1)
         self.manager = BlockManager(self.num_blocks, self.block_size,
-                                    self.slots, self.max_blocks_per_slot)
+                                    self.slots, self.max_blocks_per_slot,
+                                    prefix_cache=self.prefix_enabled)
 
         layers = self.family.num_layers(self.cfg)
         self._cache = PagedKVCache.create(
@@ -137,6 +179,7 @@ class InferenceEngine:
 
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
         self._step = decode_step(self.cfg)
+        self._verify = decode_verify_step(self.cfg)
         self._extras = self._init_extras(max_src_len)
 
         self.queue = RequestQueue(queue_limit)
@@ -156,6 +199,17 @@ class InferenceEngine:
         self._last_prefill = False
         self._decode_traces = 0
         self._prefill_traces = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        # Prompt-overlap observer: counts admissions whose leading block
+        # chunk was seen before, whether or not the prefix cache is on —
+        # the doctor compares this against prefix_cache_hit_rate to say
+        # "your workload repeats itself; turn the cache on". Bounded
+        # fingerprint set; the rate saturates once full, which is fine
+        # for a ratio diagnostic.
+        self._overlap_seen: set = set()
+        self._overlap_hits = 0
+        self._overlap_total = 0
         self._span = tracing.mint_span("serve_engine", tensor=name,
                                        traced=True)
 
@@ -167,15 +221,31 @@ class InferenceEngine:
         # it there to keep test logs warning-free.
         donate = (1,) if jax.default_backend() != "cpu" else ()
 
-        def _decode_pure(params, cache, tok, pos, active, extras):
-            cache = cache.with_active(active)
-            cache, logits = self._step(params, cache, tok, pos, extras)
-            return cache, logits, greedy_token(logits).astype(jnp.int32)
+        # The decode program is ALWAYS the K-step verify scan (K =
+        # spec_k + 1; K == 1 is exactly the classic one-token step):
+        # one jitted decode program per engine whatever the speculation
+        # knob says, which is how ``decode_compiles == 1`` survives the
+        # spec lane. ``cow_src``/``cow_dst`` fold the copy-on-write
+        # block copies into the same dispatch — fixed (slots,) vectors
+        # padded with trash->trash no-ops, so CoW traffic never changes
+        # the program signature either.
+        def _decode_pure(params, cache, tok_seq, pos0, counts, active,
+                         cow_src, cow_dst, extras):
+            cache = cache.copy_blocks(cow_src, cow_dst)
+            base = active
 
-        def _decode_raw(params, cache, tok, pos, active, extras):
+            def mask_fn(c, lane):
+                return c.with_active(base & lane)
+
+            return self._verify(params, cache, tok_seq, pos0, counts,
+                                extras, mask_fn)
+
+        def _decode_raw(params, cache, tok_seq, pos0, counts, active,
+                        cow_src, cow_dst, extras):
             self._decode_traces += 1          # host effect: fires per TRACE
             profiler.count_trace(f"serve:{name}:decode")
-            return _decode_pure(params, cache, tok, pos, active, extras)
+            return _decode_pure(params, cache, tok_seq, pos0, counts,
+                                active, cow_src, cow_dst, extras)
 
         self._decode_pure = _decode_pure
         self._decode_jit = jax.jit(_decode_raw, donate_argnums=donate)
@@ -184,7 +254,8 @@ class InferenceEngine:
         view_len = self.view_len
 
         def _prefill_pure(params, cache, tok_seq, pos0, count, active,
-                          extras):
+                          cow_src, cow_dst, extras):
+            cache = cache.copy_blocks(cow_src, cow_dst)
             base = active
 
             def body(carry, j):
@@ -205,11 +276,11 @@ class InferenceEngine:
             return cache, final, greedy_token(final).astype(jnp.int32)
 
         def _prefill_raw(params, cache, tok_seq, pos0, count, active,
-                         extras):
+                         cow_src, cow_dst, extras):
             self._prefill_traces += 1
             profiler.count_trace(f"serve:{name}:prefill")
             return _prefill_pure(params, cache, tok_seq, pos0, count,
-                                 active, extras)
+                                 active, cow_src, cow_dst, extras)
 
         self._prefill_pure = _prefill_pure
         self._prefill_jit = jax.jit(_prefill_raw, donate_argnums=donate)
@@ -486,7 +557,14 @@ class InferenceEngine:
             if req is None:
                 return
             total = len(req.prompt) + req.max_new_tokens
-            if not self.manager.can_reserve(total):
+            # Peek the prefix index BEFORE the admission check: a hit
+            # shrinks the reservation to the unshared tail, so a request
+            # the worst-case check would park can often be admitted
+            # immediately. Safe as a peek-then-admit pair because every
+            # manager mutation runs under the engine lock we hold.
+            n_matched, attach = self.manager.match_prefix(req.prompt) \
+                if self.prefix_enabled else (0, [])
+            if not self.manager.can_admit(total, n_matched, attach):
                 # Head-of-line waits for blocks; FCFS order preserved
                 # (the heap keys on the original sequence number).
                 self.queue.requeue(req)
@@ -494,28 +572,48 @@ class InferenceEngine:
             if not req.start_running():
                 continue    # cancelled in the pop->admit window
             slot = self._slot_pool.acquire()
-            self.manager.reserve(slot, total)
+            self.manager.admit(slot, total, n_matched, attach)
             span = tracing.mint_span("serve_request", tensor=req.id,
                                      traced=True)
             st = _SlotState(req, slot, span)
+            # The matched preamble is already in the pool: the slot
+            # starts with those tokens fed and only the divergent tail
+            # is ever prefilled. (match_prefix caps at prompt_len - 1 —
+            # at least one token must be re-fed to produce logits.)
+            st.n_fed = n_matched
             self._states[slot] = st
             req.t_admit = now
             req.served_by = self.name
+            req.prefix_tokens = n_matched
+            if self.family.name != "t5":
+                key = tuple(int(t) for t in req.prompt[:self.block_size])
+                self._overlap_total += 1
+                if key in self._overlap_seen:
+                    self._overlap_hits += 1
+                elif len(self._overlap_seen) < 8192:
+                    self._overlap_seen.add(key)
             metrics.histogram("serve_queue_wait_seconds",
                               engine=self.name).observe(req.queue_wait)
             self._admit_extras(slot, req)
             metrics.event("serve_admit", engine=self.name, request=req.id,
                           slot=slot, prompt_len=len(req.prompt),
                           op_id=span.op_id)
+            if n_matched > 0:
+                metrics.counter("prefix_tokens_reused_total",
+                                engine=self.name).inc(n_matched)
+                metrics.event("serve_prefix_hit", engine=self.name,
+                              request=req.id, slot=slot,
+                              tokens=n_matched, op_id=span.op_id)
 
     # -- device dispatches ----------------------------------------------
 
     #: dispatch argument names per phase — the recompile detector blames
     #: by name, so a drifting signature reads "tok: int32[8] -> int32[16]"
     _ARGNAMES = {
-        "decode": ("params", "cache", "tok", "pos", "active", "extras"),
+        "decode": ("params", "cache", "tok_seq", "pos0", "counts",
+                   "active", "cow_src", "cow_dst", "extras"),
         "prefill": ("params", "cache", "tok_seq", "pos0", "count",
-                    "active", "extras"),
+                    "active", "cow_src", "cow_dst", "extras"),
     }
 
     def _dispatch(self, phase: str, fn, *args):
@@ -577,33 +675,89 @@ class InferenceEngine:
                                  prog, exc_info=True)
 
     def _run_decode(self, lanes: List[Tuple[int, _SlotState]]) -> None:
-        tok = np.zeros(self.slots, np.int32)
-        pos = np.zeros(self.slots, np.int32)
+        K = self.spec_k + 1
+        tok_seq = np.zeros((K, self.slots), np.int32)
+        pos0 = np.zeros(self.slots, np.int32)
+        counts = np.zeros(self.slots, np.int32)
         act = np.zeros(self.slots, bool)
+        cow_src = np.full(self.slots, TRASH_BLOCK, np.int32)
+        cow_dst = np.full(self.slots, TRASH_BLOCK, np.int32)
+        proposed = 0
         for slot, st in lanes:
-            p = st.request.prompt
+            req = st.request
+            p = req.prompt
             nf = st.n_fed
-            tok[slot] = p[nf] if nf < len(p) else \
-                st.request.tokens[nf - len(p)]
-            pos[slot] = nf
+            tok_seq[0, slot] = p[nf] if nf < len(p) else \
+                req.tokens[nf - len(p)]
+            pos0[slot] = nf
             act[slot] = True
-            self.manager.ensure(slot, nf)
+            c = 1
+            # Draft only once the lane is generating (every fed token
+            # from here on is model output) and only for greedy lanes:
+            # sampled tokens can't be verified against a greedy chain.
+            if K > 1 and req.temperature == 0 and nf >= len(p) - 1:
+                total = len(p) + req.max_new_tokens
+                # Feeding c tokens writes positions nf..nf+c-1 and can
+                # commit through position nf+c — cap so the chain never
+                # runs past the request's last token.
+                drafts = self._propose(req)[:max(0, total - 1 - nf - 1)]
+                for j, d in enumerate(drafts):
+                    tok_seq[1 + j, slot] = d
+                c = 1 + len(drafts)
+                proposed += len(drafts)
+            counts[slot] = c
+            for q in range(nf, nf + c):
+                r = self.manager.ensure_writable(slot, q)
+                if r is not None:
+                    cow_src[slot], cow_dst[slot] = r
         cache = self._cache.replace(table=self.manager.device_table())
-        cache, logits, greedy = self._dispatch(
+        cache, first, greedy = self._dispatch(
             "decode", self._decode_jit, self.params, cache,
-            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(act),
+            jnp.asarray(tok_seq), jnp.asarray(pos0), jnp.asarray(counts),
+            jnp.asarray(act), jnp.asarray(cow_src), jnp.asarray(cow_dst),
             self._extras)
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
-        greedy_np = np.asarray(greedy)
-        logits_np = self._pull_logits_if_sampling(lanes, logits)
+        greedy_np = np.asarray(greedy)                   # (K, slots)
+        logits_np = self._pull_logits_if_sampling(lanes, first)
         metrics.counter("serve_steps_total", engine=self.name,
                         phase="decode").inc()
+        accepted = 0
         for slot, st in lanes:
+            req = st.request
+            p = req.prompt
             nf = st.n_fed
-            st.n_fed += 1
-            if nf >= len(st.request.prompt) - 1:
-                self._commit(st, slot, greedy_np, logits_np)
+            c = int(counts[slot])
+            if req.temperature > 0:
+                st.n_fed += 1
+                if nf >= len(p) - 1:
+                    self._commit(st, slot, greedy_np[0], logits_np)
+                continue
+            # Verify chain: draft tok_seq[j] was fed on the model's
+            # behalf — it stands iff it equals what the model actually
+            # picked after the previous step (greedy[j-1]) and every
+            # draft before it stood. v = length of the valid prefix.
+            v = 1
+            while v < c and tok_seq[v, slot] == greedy_np[v - 1, slot]:
+                v += 1
+            accepted += v - 1
+            advanced = 0
+            for j in range(v):
+                advanced = j + 1
+                if nf + j >= len(p) - 1:
+                    if self._commit_token(st, slot,
+                                          int(greedy_np[j, slot])):
+                        break               # EOS/max mid-chain: stop
+            st.n_fed += advanced
+        if proposed:
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+            metrics.counter("spec_tokens_proposed_total",
+                            engine=self.name).inc(proposed)
+            metrics.counter("spec_tokens_accepted_total",
+                            engine=self.name).inc(accepted)
+            metrics.event("serve_spec_verify", engine=self.name,
+                          proposed=proposed, accepted=accepted)
 
     def _run_prefill(self, lanes: List[Tuple[int, _SlotState]]) -> None:
         C = self.prefill_chunk
@@ -611,6 +765,8 @@ class InferenceEngine:
         pos0 = np.zeros(self.slots, np.int32)
         count = np.zeros(self.slots, np.int32)
         act = np.zeros(self.slots, bool)
+        cow_src = np.full(self.slots, TRASH_BLOCK, np.int32)
+        cow_dst = np.full(self.slots, TRASH_BLOCK, np.int32)
         for slot, st in lanes:
             p = st.request.prompt
             c = min(C, len(p) - st.n_fed)
@@ -619,12 +775,15 @@ class InferenceEngine:
             count[slot] = c
             act[slot] = True
             for q in range(st.n_fed, st.n_fed + c):
-                self.manager.ensure(slot, q)
+                r = self.manager.ensure_writable(slot, q)
+                if r is not None:
+                    cow_src[slot], cow_dst[slot] = r
         cache = self._cache.replace(table=self.manager.device_table())
         cache, final, greedy = self._dispatch(
             "prefill", self._prefill_jit, self.params, cache,
             jnp.asarray(tok_seq), jnp.asarray(pos0), jnp.asarray(count),
-            jnp.asarray(act), self._extras)
+            jnp.asarray(act), jnp.asarray(cow_src), jnp.asarray(cow_dst),
+            self._extras)
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
         greedy_np = np.asarray(greedy)
@@ -653,6 +812,17 @@ class InferenceEngine:
             token = self._host_sample(req, logits_np[slot])
         else:
             token = int(greedy_np[slot])
+        self._commit_token(st, slot, token)
+
+    def _commit_token(self, st: _SlotState, slot: int,
+                      token: int) -> bool:
+        """Append one generated token; returns True when the request
+        went terminal (EOS or max_new_tokens). On the FIRST token the
+        prompt is fully written, so this is also where the slot's
+        prompt chunks are published into the prefix index — published
+        whole-prompt blocks are never written again (all later writes
+        land at positions >= len(prompt))."""
+        req = st.request
         first = req.t_first is None
         req._commit(token)
         if first:
@@ -660,9 +830,35 @@ class InferenceEngine:
                               engine=self.name).observe(req.ttft)
             metrics.event("serve_first_token", engine=self.name,
                           request=req.id, op_id=st.span.op_id)
+            if self.prefix_enabled:
+                self.manager.register_prefix(slot, req.prompt)
         if (req.eos_id is not None and token == req.eos_id) \
                 or len(req.tokens) >= req.max_new_tokens:
             req._finish(RequestStatus.DONE)
+            return True
+        return False
+
+    def _propose(self, req: Request) -> List[int]:
+        """n-gram draft tokens for the speculative lane: find the most
+        recent EARLIER occurrence of the context's current suffix
+        (pattern lengths 3, then 2, then 1) in prompt + generated text
+        and propose the ``spec_k`` tokens that followed it. Pure host
+        lookup — no draft model, no extra device work; repetitive spans
+        (templates, code, loops) verify at high acceptance, novel text
+        simply proposes nothing. O(len(context) * k) per call."""
+        hist = [int(t) for t in req.prompt] + [int(t) for t in req.tokens]
+        n = len(hist)
+        for m in (3, 2, 1):
+            if n < m + 1:
+                continue
+            pat = hist[n - m:]
+            for s in range(n - m - 1, -1, -1):
+                if hist[s:s + m] == pat:
+                    nxt = hist[s + m:s + m + self.spec_k]
+                    if nxt:
+                        return nxt
+                    break
+        return []
 
     @staticmethod
     def _host_sample(req: Request, row: np.ndarray) -> int:
@@ -823,6 +1019,21 @@ class InferenceEngine:
             self.manager.blocks_in_use * bpb)
         metrics.gauge("serve_kv_pool_bytes_capacity",
                       engine=self.name).set(self._cache.pool_bytes)
+        if self._overlap_total:
+            metrics.gauge("serve_prompt_overlap_rate",
+                          engine=self.name).set(
+                self._overlap_hits / self._overlap_total)
+        if self.prefix_enabled:
+            ps = self.manager.prefix_stats()
+            metrics.gauge("prefix_cache_hit_rate", engine=self.name).set(
+                ps["hit_rate"])
+            metrics.gauge("prefix_cache_evictions", engine=self.name).set(
+                ps["evictions"])
+            metrics.gauge("kv_blocks_shared", engine=self.name).set(
+                self.manager.shared_block_count())
+        if self.spec_k > 0 and self._spec_proposed:
+            metrics.gauge("spec_acceptance_rate", engine=self.name).set(
+                self._spec_accepted / self._spec_proposed)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -838,4 +1049,13 @@ class InferenceEngine:
                 "blocks_capacity": self.manager.capacity,
                 "dense_equivalent_tokens": self.slots * self.max_len,
                 "kv_quant": self.kv_quant,
+                "prefix_cache": self.prefix_enabled,
+                "prefix": self.manager.prefix_stats(),
+                "blocks_shared": self.manager.shared_block_count(),
+                "spec_k": self.spec_k,
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "spec_acceptance": (self._spec_accepted /
+                                    self._spec_proposed
+                                    if self._spec_proposed else 0.0),
             }

@@ -93,6 +93,10 @@ class Request:
         #: never on the human-readable reason string.
         self.retryable = False
         self.served_by: Optional[str] = None
+        #: set at admission by a prefix-caching engine: how many leading
+        #: prompt tokens were attached from the shared-prefix index
+        #: instead of being prefilled (0 = miss or caching disabled).
+        self.prefix_tokens = 0
         self.t_submit = time.monotonic()
         self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
@@ -200,7 +204,9 @@ class Request:
                 "generated": len(self.tokens),
                 "priority": self.priority, "served_by": self.served_by,
                 "ttft": self.ttft, "tpot": self.tpot,
-                "queue_wait": self.queue_wait}
+                "queue_wait": self.queue_wait,
+                "prefix_hit": self.prefix_tokens > 0,
+                "prefix_tokens": self.prefix_tokens}
 
     def __repr__(self) -> str:
         return (f"Request({self.id}, {self.status.value}, "

@@ -1,0 +1,254 @@
+"""Shared-prefix KV cache + speculative decode (ISSUE 12).
+
+Pins the sharing contracts the engine relies on:
+
+* radix index semantics — whole-block match, first-writer-wins insert,
+  LRU leaf eviction gated on refcount;
+* ``BlockManager`` sharing invariants under a randomized
+  admit/write/register/release trace (``check()`` after every op);
+* copy-on-write: a capped full-prefix match CoWs exactly the last
+  attached block, and the non-CoW ``ensure()`` refuses shared writes;
+* ``hvd.doctor()`` prefix/spec findings over canned snapshots;
+* the full ``make prefix-smoke`` contract in-process — engine-level
+  token parity for three families with the cache + speculative lane
+  on, the hit/reuse counters and request metadata agreeing, and a
+  leak-free pool after drain.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu.profiler import doctor
+from horovod_tpu.serving.cache import BlockManager, PrefixIndex
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# radix index (pure host structure)
+# ---------------------------------------------------------------------------
+
+class TestPrefixIndex:
+    def test_match_insert_first_writer_wins(self):
+        idx = PrefixIndex(4)
+        assert idx.match([1, 2, 3, 4, 5]) == []
+        assert idx.insert([1, 2, 3, 4, 5, 6, 7, 8], [3, 4]) == [3, 4]
+        # whole-block semantics: partial last chunks never match
+        assert idx.match([1, 2, 3, 4, 5, 6, 7, 8, 9]) == [3, 4]
+        assert idx.match([1, 2, 3, 4, 9]) == [3]
+        assert idx.match([9, 2, 3, 4]) == []
+        # a re-publish of an indexed chunk must NOT steal the entry —
+        # otherwise one block could end up indexed twice
+        assert idx.insert([1, 2, 3, 4, 5, 6, 7, 8], [5, 6]) == []
+        assert idx.match([1, 2, 3, 4, 5, 6, 7, 8]) == [3, 4]
+        assert idx.num_nodes == 2 and set(idx.blocks()) == {3, 4}
+
+    def test_evict_lru_leaf_only_refcount_gated(self):
+        idx = PrefixIndex(2)
+        refc = np.ones(10, np.int64)
+        idx.insert([1, 2, 3, 4], [2, 3])       # chain 2 -> 3
+        idx.insert([5, 6], [4])                # leaf 4, touched later
+        # interior node 2 is never evictable while 3 exists; 3 is the
+        # LRU leaf, then 2 becomes a leaf but 4 is still younger.
+        assert idx.evict_lru(refc) == 3
+        assert idx.evict_lru(refc) == 2
+        refc[4] = 2                            # someone else holds it
+        assert idx.evict_lru(refc) is None
+        refc[4] = 1
+        assert idx.evict_lru(refc) == 4
+        assert idx.num_nodes == 0 and idx.evictions == 3
+
+
+# ---------------------------------------------------------------------------
+# BlockManager sharing invariants
+# ---------------------------------------------------------------------------
+
+class TestCopyOnWrite:
+    def _prefill(self, mgr, slot, tokens, total):
+        mgr.admit(slot, total)
+        for p in range(len(tokens)):
+            mgr.ensure_writable(slot, p)
+        mgr.register_prefix(slot, tokens)
+
+    def test_capped_full_match_single_cow(self):
+        mgr = BlockManager(16, 4, 2, 8, prefix_cache=True)
+        tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+        self._prefill(mgr, 0, tokens, total=10)
+        mgr.release(0)
+        assert mgr.check() is None
+
+        # the prompt IS the indexed chain: match caps at len-1, so the
+        # refeed's first write lands inside the LAST attached block
+        n, attach = mgr.match_prefix(tokens)
+        assert n == 7 and len(attach) == 2
+        assert mgr.can_admit(9, n, attach)
+        mgr.admit(1, 9, n, attach)
+        with pytest.raises(RuntimeError, match="without CoW"):
+            mgr.ensure(1, 7)
+        pair = mgr.ensure_writable(1, 7)
+        assert pair is not None and pair[0] == attach[1]
+        assert mgr.cow_copies == 1
+        # the index keeps the original; the slot now maps the copy
+        assert int(mgr.table[1, 1]) == pair[1] != attach[1]
+        assert mgr.check() is None
+        mgr.release(1)
+        assert mgr.check() is None
+
+    def test_aligned_match_needs_no_cow(self):
+        mgr = BlockManager(16, 4, 2, 8, prefix_cache=True)
+        tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+        self._prefill(mgr, 0, tokens, total=10)
+        mgr.release(0)
+        longer = tokens + [9, 9, 9]
+        n, attach = mgr.match_prefix(longer)
+        assert n == 8 and len(attach) == 2
+        mgr.admit(1, len(longer) + 4, n, attach)
+        for p in range(n, len(longer) + 4):
+            assert mgr.ensure_writable(1, p) is None
+        assert mgr.cow_copies == 0
+        assert mgr.check() is None
+
+    def test_lru_eviction_under_pressure(self):
+        # capacity 5, index ends up holding 4 blocks; a 3-block cold
+        # admission must reclaim via LRU eviction, not fail
+        mgr = BlockManager(6, 2, 1, 4, prefix_cache=True)
+        for base in (1, 2):
+            tokens = [base] * 4
+            self._prefill(mgr, 0, tokens, total=4)
+            mgr.release(0)
+        assert mgr.prefix.num_nodes == 4 and len(mgr._free) == 1
+        assert mgr.can_admit(6)
+        mgr.admit(0, 6)
+        for p in range(6):
+            mgr.ensure_writable(0, p)
+        assert mgr.prefix.evictions >= 2
+        assert mgr.check() is None
+        # evicted chains are really gone from the index
+        n1, _ = mgr.match_prefix([1] * 4)
+        n2, _ = mgr.match_prefix([2] * 4)
+        assert mgr.prefix.num_nodes <= 2 and min(n1, n2) == 0
+
+    def test_randomized_sharing_trace(self, rng):
+        """ISSUE 12 satellite: admit/write/register/release in random
+        order with a colliding-prefix workload; every sharing invariant
+        (refcount == holders, disjoint free list, conservation,
+        reservation solvency) must hold after EVERY op."""
+        bs = 4
+        mgr = BlockManager(20, bs, 4, 8, prefix_cache=True)
+        active = {}
+        for step in range(600):
+            r = rng.random()
+            free_slots = [s for s in range(4) if s not in active]
+            if r < 0.35 and free_slots:
+                plen = int(rng.integers(1, 13))
+                tokens = [int(t) for t in rng.integers(1, 5, plen)]
+                total = plen + int(rng.integers(1, 9))
+                n, attach = mgr.match_prefix(tokens)
+                if mgr.can_admit(total, n, attach):
+                    slot = free_slots[0]
+                    mgr.admit(slot, total, n, attach)
+                    active[slot] = dict(tokens=tokens, total=total,
+                                        pos=n, registered=False)
+            elif r < 0.85 and active:
+                slot = list(active)[int(rng.integers(len(active)))]
+                st = active[slot]
+                if st["pos"] < st["total"]:
+                    mgr.ensure_writable(slot, st["pos"])
+                    st["pos"] += 1
+                    if (st["pos"] >= len(st["tokens"])
+                            and not st["registered"]):
+                        mgr.register_prefix(slot, st["tokens"])
+                        st["registered"] = True
+            elif active:
+                slot = list(active)[int(rng.integers(len(active)))]
+                mgr.release(slot)
+                del active[slot]
+            err = mgr.check()
+            assert err is None, f"step {step}: {err}"
+        for slot in list(active):
+            mgr.release(slot)
+        assert mgr.check() is None
+        # after a full drain only the index holds blocks
+        assert mgr.blocks_in_use == mgr.prefix.num_nodes
+        stats = mgr.prefix_stats()
+        assert stats["enabled"] and stats["lookups"] >= stats["hits"]
+        assert 0.0 <= stats["hit_rate"] <= 1.0
+
+    def test_disabled_prefix_is_the_old_reserve(self):
+        mgr = BlockManager(16, 4, 2, 8)
+        assert mgr.match_prefix([1, 2, 3, 4, 5]) == (0, [])
+        assert mgr.prefix_stats()["enabled"] is False
+        mgr.reserve(0, 8)
+        for p in range(8):
+            mgr.ensure(0, p)
+        assert mgr.shared_block_count() == 0
+        mgr.release(0)
+        assert mgr.check() is None and mgr.blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# doctor findings (canned snapshots)
+# ---------------------------------------------------------------------------
+
+def _g(value, engine):
+    return {"labels": {"engine": engine}, "value": value}
+
+
+class TestDoctorPrefix:
+    def test_overlap_without_cache_suggests_enabling(self):
+        snap = {"counters": {}, "histograms": {}, "gauges": {
+            "serve_prompt_overlap_rate": [_g(0.6, "e0")]}}
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        f = [x for x in rep["findings"]
+             if x["category"] == "prefix_cache"]
+        assert f and "HOROVOD_SERVE_PREFIX_CACHE" in f[0]["suggestion"]
+        assert f[0]["evidence"]["overlap_rate"] == 0.6
+
+    def test_low_hit_rate_suggests_bigger_pool(self):
+        snap = {"counters": {}, "histograms": {}, "gauges": {
+            "serve_prompt_overlap_rate": [_g(0.6, "e0")],
+            "prefix_cache_hit_rate": [_g(0.1, "e0")],
+            "prefix_cache_evictions": [_g(7, "e0")]}}
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        f = [x for x in rep["findings"]
+             if x["category"] == "prefix_cache"]
+        assert f and "num_blocks" in f[0]["suggestion"]
+        assert f[0]["evidence"]["evictions"] == 7
+
+    def test_low_spec_acceptance_suggests_tuning_k(self):
+        snap = {"histograms": {}, "gauges": {}, "counters": {
+            "spec_tokens_proposed_total": [{"labels": {}, "value": 100}],
+            "spec_tokens_accepted_total": [{"labels": {}, "value": 5}]}}
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        f = [x for x in rep["findings"] if x["category"] == "spec_decode"]
+        assert f and "HOROVOD_SERVE_SPEC_K" in f[0]["suggestion"]
+        assert f[0]["evidence"]["proposed"] == 100
+
+    def test_healthy_prefix_profile_is_quiet(self):
+        snap = {"histograms": {}, "counters": {
+            "spec_tokens_proposed_total": [{"labels": {}, "value": 100}],
+            "spec_tokens_accepted_total": [{"labels": {}, "value": 60}]},
+            "gauges": {
+                "serve_prompt_overlap_rate": [_g(0.6, "e0")],
+                "prefix_cache_hit_rate": [_g(0.5, "e0")]}}
+        rep = doctor(snapshot=snap, trace=None, programs={})
+        assert not [x for x in rep["findings"]
+                    if x["category"] in ("prefix_cache", "spec_decode")]
+
+
+# ---------------------------------------------------------------------------
+# the full smoke contract (make prefix-smoke)
+# ---------------------------------------------------------------------------
+
+class TestPrefixSmoke:
+    def test_prefix_smoke_in_process(self):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import prefix_smoke
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        rc, text = prefix_smoke.run_smoke()
+        assert rc == 0, text

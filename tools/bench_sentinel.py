@@ -41,6 +41,7 @@ SETTINGS_KEYS = (
     "kv_quant", "arrival_rate_hz", "requests", "rate",
     "allreduce_alg", "wire", "topology", "overlap_chunks",
     "payload_mb", "world", "batch", "seq_len", "steps",
+    "prefix_overlap", "prefix_cache", "spec_k",
 )
 
 

@@ -51,6 +51,8 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
               kv_quant=None, num_blocks=None,
               model_size: str = "tiny", seed: int = 0,
               transport: str = "none",
+              prefix_overlap: float = 0.0, prefix_cache: bool = False,
+              spec_k: int = 0,
               metric: str = "serve_tokens_per_sec") -> dict:
     """Run one load level; returns (and prints) the record.
 
@@ -59,7 +61,14 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
     ``spool`` (the filesystem replica protocol), or ``socket`` (the
     JSON-over-TCP transport through a ``RemoteDispatcher``) — same
     Poisson load, so the lines are comparable and the delta IS the
-    transport's latency cost."""
+    transport's latency cost.
+
+    ``prefix_overlap=R`` makes fraction R of the requests share one
+    long preamble (4 blocks of tokens) ahead of their individual tails
+    — the chat/system-prompt workload shape prefix caching exists for.
+    Same seeded arrivals and tails whatever ``prefix_cache`` says, so
+    an off/on pair differs ONLY in the cache knob and the TTFT delta is
+    the cache's doing."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -84,6 +93,7 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
                           prefill_chunk=prefill_chunk,
                           kv_quant=kv_quant, num_blocks=num_blocks,
                           queue_limit=max(64, 4 * requests),
+                          prefix_cache=prefix_cache, spec_k=spec_k,
                           name="serve-bench")
     eng.start()
 
@@ -109,9 +119,22 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         raise ValueError(f"unknown transport {transport!r}")
 
     gaps = rng.exponential(1.0 / rate, size=requests)
-    prompts = [list(rng.integers(1, cfg.vocab_size - 1,
-                                 int(rng.integers(4, 17))))
-               for _ in range(requests)]
+    # Shared preamble: 4 whole blocks, shrunk if max_len can't fit
+    # preamble + tail + budget. Only drawn when overlap is requested, so
+    # the prompt stream at overlap 0 is byte-identical to older runs.
+    if prefix_overlap > 0:
+        pre_len = min(4 * block_size,
+                      max(0, (max_len - 16 - 32) // block_size) * block_size)
+        preamble = [int(t) for t in
+                    rng.integers(1, cfg.vocab_size - 1, pre_len)]
+        shared = rng.random(requests) < prefix_overlap
+    else:
+        preamble, shared = [], np.zeros(requests, bool)
+    prompts = []
+    for i in range(requests):
+        tail = [int(t) for t in rng.integers(1, cfg.vocab_size - 1,
+                                             int(rng.integers(4, 17)))]
+        prompts.append(preamble + tail if shared[i] else tail)
     budgets = [int(rng.integers(8, 33)) for _ in range(requests)]
 
     # outs: one dict per request with the SAME keys whatever the path,
@@ -169,6 +192,9 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
 
     done = [o for o in outs if o["status"] == "done"]
     tokens = sum(o["tokens"] for o in outs)
+    pstats = eng.manager.prefix_stats()
+    estats = eng.stats()
+    ttfts = [o["ttft"] for o in done if o["ttft"] is not None]
     rec = {
         "metric": metric,
         "value": round(tokens / wall, 2),
@@ -181,8 +207,15 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "slots": slots, "max_len": max_len, "block_size": block_size,
         "prefill_chunk": prefill_chunk, "kv_quant": kv_quant,
         "model": f"gpt2-{model_size}",
-        "ttft_s": _summary([o["ttft"] for o in done
-                            if o["ttft"] is not None]),
+        "prefix_overlap": prefix_overlap, "prefix_cache": prefix_cache,
+        "spec_k": spec_k,
+        "prefix_hit_rate": round(pstats["hit_rate"], 4),
+        "prefix_tokens_reused": pstats["tokens_reused"],
+        "spec_proposed": estats["spec_proposed"],
+        "spec_accepted": estats["spec_accepted"],
+        "ttft_mean_s": (round(sum(ttfts) / len(ttfts), 6)
+                        if ttfts else None),
+        "ttft_s": _summary(ttfts),
         "tpot_s": _summary([o["tpot"] for o in done
                             if o["tpot"] is not None]),
         "queue_wait_s": _summary([o["queue_wait"] for o in done
@@ -215,6 +248,15 @@ def _build_parser():
                    default="none",
                    help="path between load generator and engine: direct "
                    "submit, filesystem spool, or socket RPC")
+    p.add_argument("--prefix-overlap", type=float, default=0.0,
+                   help="fraction of requests sharing a 4-block preamble")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the shared-prefix KV cache in the engine")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative drafts per decode step (0 = off)")
+    p.add_argument("--prefix-compare", action="store_true",
+                   help="run the same workload with prefix cache off then "
+                   "on and append gated hit-rate / TTFT-speedup lines")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None,
                    help="append the JSON record to this file")
@@ -223,15 +265,42 @@ def _build_parser():
 
 def main() -> int:
     args = _build_parser().parse_args()
-    rec = run_bench(
+    kw = dict(
         requests=args.requests, rate=args.rate, slots=args.slots,
         max_len=args.max_len, block_size=args.block_size,
         prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant,
         num_blocks=args.num_blocks, model_size=args.model_size,
-        transport=args.transport, seed=args.seed)
+        transport=args.transport, seed=args.seed,
+        prefix_overlap=args.prefix_overlap, spec_k=args.spec_k)
+    recs = []
+    if args.prefix_compare:
+        off = run_bench(prefix_cache=False, **kw)
+        on = run_bench(prefix_cache=True, **kw)
+        recs += [off, on]
+        # Gated proxies for the sentinel: both are higher-is-better, so
+        # a regression in either shows up as a drop in "value".
+        common = {k: on[k] for k in
+                  ("transport", "requests", "arrival_rate_hz", "slots",
+                   "max_len", "block_size", "prefill_chunk", "kv_quant",
+                   "model", "prefix_overlap", "prefix_cache", "spec_k")}
+        recs.append(dict(common, metric="serve_prefix_hit_rate",
+                         value=on["prefix_hit_rate"], unit="ratio",
+                         vs_baseline=None, proxy=True))
+        if off["ttft_mean_s"] and on["ttft_mean_s"]:
+            recs.append(dict(
+                common, metric="serve_prefix_ttft_speedup",
+                value=round(off["ttft_mean_s"] / on["ttft_mean_s"], 4),
+                unit="x", vs_baseline=None, proxy=True,
+                ttft_mean_off_s=off["ttft_mean_s"],
+                ttft_mean_on_s=on["ttft_mean_s"]))
+        for r in recs[2:]:
+            print(json.dumps(r), flush=True)
+    else:
+        recs.append(run_bench(prefix_cache=args.prefix_cache, **kw))
     if args.out:
         with open(args.out, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
     return 0
 
 
