@@ -150,6 +150,8 @@ class Config:
     serve_spec_proposer: str = "ngram"
     serve_heartbeat_seconds: float = 2.0
     serve_rpc_timeout_seconds: float = 5.0
+    serve_transport: str = "stream"
+    serve_auth_token: str = ""
     serve_max_retries: int = 3
     serve_hedge_ms: float = 0.0
     serve_breaker_failures: int = 3
@@ -317,6 +319,27 @@ def _env_spec_proposer() -> str:
     return v
 
 
+def _env_serve_transport() -> str:
+    v = (os.environ.get("HOROVOD_SERVE_TRANSPORT", "stream")
+         .strip().lower() or "stream")
+    if v not in ("stream", "legacy"):
+        raise ValueError(f"HOROVOD_SERVE_TRANSPORT={v!r}: expected "
+                         f"'stream' (persistent multiplexed v2 wire) or "
+                         f"'legacy' (one-shot JSON RPC)")
+    return v
+
+
+def _env_auth_token() -> str:
+    # Shared secret for the transport hello handshake. Validated for
+    # plausibility here but NEVER echoed: error messages and build_info
+    # must not leak the value.
+    v = os.environ.get("HOROVOD_SERVE_AUTH_TOKEN", "").strip()
+    if v and len(v) < 8:
+        raise ValueError("HOROVOD_SERVE_AUTH_TOKEN: token too short "
+                         "(need >= 8 characters; value not shown)")
+    return v
+
+
 def _env_fault_plan() -> str:
     v = os.environ.get("HOROVOD_FAULT_PLAN", "").strip()
     if v:
@@ -375,6 +398,8 @@ def refresh() -> Config:
             0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
         serve_rpc_timeout_seconds=_env_posfloat(
             "HOROVOD_SERVE_RPC_TIMEOUT", 5.0),
+        serve_transport=_env_serve_transport(),
+        serve_auth_token=_env_auth_token(),
         serve_max_retries=_env_nonneg_int(
             "HOROVOD_SERVE_MAX_RETRIES", 3),
         serve_hedge_ms=_env_nonneg_float("HOROVOD_SERVE_HEDGE_MS", 0.0),

@@ -393,6 +393,10 @@ def build_info() -> dict:
         # Serving transport knobs (serving/transport.py): resolved so a
         # client and a replica can cross-check they agree on timeouts.
         "serve_rpc_timeout_seconds": cfg.serve_rpc_timeout_seconds,
+        "serve_transport": cfg.serve_transport,
+        # The auth token itself must never appear in logs or build_info
+        # dumps — export only whether the handshake is enforced.
+        "serve_auth_enabled": bool(cfg.serve_auth_token),
         "serve_max_retries": cfg.serve_max_retries,
         "serve_hedge_ms": cfg.serve_hedge_ms,
         "serve_breaker_failures": cfg.serve_breaker_failures,

@@ -281,13 +281,18 @@ def fault_point(step: int, rank: Optional[int] = None) -> None:
 
 def net_fault(step: int, rank: int) -> dict:
     """Transport-layer fault point: ``step`` is the replica's inbound RPC
-    sequence number, ``rank`` its replica rank. Fires every matching
-    not-yet-fired ``space=net`` action — the network kinds live there by
-    default, and ``kill``/``stall`` can opt in (``kill@...,space=net``
-    SIGKILLs a replica at its Nth RPC; ``partition`` arms
-    :func:`partitioned` for ``seconds``). Actions keyed to training
-    steps never fire here. Returns the directives the caller must apply
-    to THIS rpc::
+    sequence number, ``rank`` its replica rank. On the legacy wire one
+    connection is one RPC; on the v2 multiplexed stream the server calls
+    this once per inbound ``request`` FRAME, so the sequence keeps
+    counting logical RPCs — faults inject at frame granularity and a
+    single multiplexed connection can drop/delay one response while its
+    neighbours stream on. Fires every matching not-yet-fired
+    ``space=net`` action — the network kinds live there by default, and
+    ``kill``/``stall`` can opt in (``kill@...,space=net`` SIGKILLs a
+    replica at its Nth RPC; ``partition`` arms :func:`partitioned` for
+    ``seconds``, which also SEVERS established v2 streams at the next
+    frame or idle tick). Actions keyed to training steps never fire
+    here. Returns the directives the caller must apply to THIS rpc::
 
         {"drop": bool,       # serve it, but never send the response
          "delay_s": float}   # sleep this long before responding

@@ -1483,6 +1483,27 @@ def _check_transport(snap) -> List[Dict]:
             "requests with HOROVOD_SERVE_HEDGE_MS) — but a sustained "
             "rate this high usually means a replica or link is sick.",
             retries=int(retries), rpc_attempts=int(rpcs)))
+    polls = 0
+    for s in _series(snap, "histograms", "transport_rpc_seconds"):
+        if s.get("labels", {}).get("method") == "poll":
+            polls += int(s.get("count", 0))
+    pushed = 0.0
+    for s in _series(snap, "counters", "transport_frames_total"):
+        if s.get("labels", {}).get("opcode") == "token":
+            pushed += float(s.get("value", 0))
+    if polls >= 20 and pushed == 0:
+        out.append(_finding(
+            "transport_poll_mode", 0.45,
+            f"{int(polls)} poll RPCs and zero pushed token frames",
+            "clients are waiting for results by polling even though the "
+            "v2 stream transport pushes tokens as they decode — every "
+            "first token pays up to a poll interval of avoidable TTFT "
+            "and every poll is a full RPC of wire overhead",
+            "set HOROVOD_SERVE_TRANSPORT=stream (the default) on the "
+            "client side, or drop transport='legacy' overrides — the "
+            "listener answers both protocols on the same port, so the "
+            "switch needs no server restart.",
+            poll_rpcs=int(polls)))
     hedges = _sum_counter(snap, "transport_hedges_total")
     wins = _sum_counter(snap, "transport_hedge_wins_total")
     if hedges >= 5 and wins > 0.5 * hedges:

@@ -261,6 +261,18 @@ class FleetSupervisor:
             doc = {"version": self._member_version,
                    "replicas": sorted(self._members.values(),
                                       key=lambda r: r["name"])}
+        # The dispatcher state bus gossips per-replica health through a
+        # ``health`` block in this same file — carry it forward so an
+        # atomic membership rewrite never erases what the frontends have
+        # learned about replica liveness.
+        try:
+            with open(self.membership_path) as f:
+                prev = json.load(f)
+            if isinstance(prev, dict) \
+                    and isinstance(prev.get("health"), dict):
+                doc["health"] = prev["health"]
+        except (OSError, ValueError):
+            pass
         tmp = f"{self.membership_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -419,6 +431,12 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="live", replica=slot.name,
                                  attempt=slot.attempt, was=was)
+        # refresh gauges at the transition, not just on the next poll
+        # tick — rolling_restart returns the instant the last replica
+        # is admitted, and callers snapshot right away (the stream
+        # wire's push delivery removed the poll-cycle slack that used
+        # to hide this staleness)
+        self._update_gauges()
 
     def _on_death(self, slot: ReplicaSlot, reason: str) -> None:
         if slot.rolling:
@@ -489,6 +507,7 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="quarantine", replica=slot.name,
                                  reason=reason)
+        self._update_gauges()
 
     def _update_gauges(self) -> None:
         counts = {LIVE: 0, STARTING: 0, RESTARTING: 0, QUARANTINED: 0,

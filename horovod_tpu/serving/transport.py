@@ -35,20 +35,51 @@ stack a lossy network needs:
   ``overloaded: ...``, retryable) before refusing a higher-priority
   submit; nothing is ever accepted and then silently dropped.
 * **Fault injection** — :func:`horovod_tpu.faults.net_fault` runs at
-  every inbound RPC, so a ``HOROVOD_FAULT_PLAN`` can drop/delay single
-  responses, partition a replica for a bounded window, or — with an
-  explicit ``space=net`` tag — kill/stall it at its Nth RPC
+  every inbound RPC (one legacy connection, or one v2 ``request``
+  frame), so a ``HOROVOD_FAULT_PLAN`` can drop/delay single responses,
+  partition a replica for a bounded window — severing established
+  multiplexed connections, not just refusing new ones — or, with an
+  explicit ``space=net`` tag, kill/stall it at its Nth RPC
   (``tools/net_smoke.py`` / ``make net-smoke``).
+
+**Transport v2 (stream)** — the default wire is no longer one
+connection per RPC. Each :class:`RemoteClient` holds ONE long-lived
+connection (lazily opened, lazily reconnected through the same circuit
+breaker) and multiplexes every in-flight request over it with binary
+framing: ``[len u32][stream_id u32][opcode u8][payload]``, compact-JSON
+payloads, a ``0xB2`` magic first byte so the listener can sniff v2
+apart from the legacy 4-byte length prefix (legacy clients and
+fleet-supervisor probes keep working on the same port during a rolling
+restart). The server *pushes* ``token`` frames as the engine's
+``Request.on_token`` callback commits output and a ``terminal`` frame
+when the request finishes — :meth:`RemoteDispatcher.wait` consumes the
+pushes instead of polling, so TTFT stops paying the poll interval and
+``on_token`` streams end to end. An optional shared-secret handshake
+(``HOROVOD_SERVE_AUTH_TOKEN``) challenges every v2 hello with an HMAC
+nonce and refuses unauthenticated legacy connections outright.
+
+**Shared dispatcher state bus** — multiple dispatcher frontends gossip
+replica health (breaker trips with a down-until horizon, load scores,
+the membership version they saw) through a ``health`` block in the
+atomically-replaced membership file, so any dispatcher routes around a
+dead replica the first time ANY dispatcher sees it die — no
+per-frontend rediscovery probe storm.
 
 Observability: ``transport_rpc_seconds{method,outcome}``,
 ``transport_retries_total{method}``, ``circuit_state{replica}`` (0
-closed / 0.5 half-open / 1 open), ``circuit_open_total``, hedge/shed/
-failover counters, and ``TRANSPORT`` timeline markers; ``hvd.doctor()``
-ranks high retry rates and open breakers with knob suggestions.
+closed / 0.5 half-open / 1 open), ``circuit_open_total``,
+``transport_connections{state=open|reconnecting}``,
+``transport_frames_total{opcode,dir}``,
+``transport_stream_push_lag_seconds`` (engine callback -> frame flush),
+hedge/shed/failover/bus counters, and ``TRANSPORT`` timeline markers;
+``hvd.doctor()`` ranks high retry rates, open breakers, and poll-mode
+fallback with knob suggestions.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import itertools
 import json
 import os
@@ -58,7 +89,8 @@ import struct
 import threading
 import time
 import uuid
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Set, Tuple)
 
 from horovod_tpu import faults, metrics
 from horovod_tpu.serving.scheduler import Request, RequestStatus
@@ -141,6 +173,139 @@ def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
                              f"peer announced a {n}-byte frame "
                              f"(cap {_MAX_FRAME})", retryable=False)
     return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# wire format v2: persistent multiplexed stream
+# ---------------------------------------------------------------------------
+#
+# A v2 connection opens with a single 0xB2 magic byte — legacy frames
+# start with the high byte of a <16MiB length prefix (0x00), so one
+# sniffed byte tells the listener which protocol the peer speaks.
+# After that, every frame in both directions is
+#
+#     [len u32][stream_id u32][opcode u8][payload: compact JSON]
+#
+# where ``len`` counts stream_id+opcode+payload (so >= 5). The client
+# picks odd-ball stream ids per request; the server echoes them on the
+# response and on every pushed token/terminal frame, which is what
+# lets many in-flight requests share one socket.
+
+_V2_MAGIC = 0xB2
+
+OP_CHALLENGE = 0x01        # server -> client: {nonce, auth, rank, proto}
+OP_HELLO = 0x02            # client -> server: {client, proto[, auth hmac]}
+OP_HELLO_OK = 0x03         # server -> client: handshake accepted
+OP_HELLO_ERR = 0x04        # server -> client: refused (auth), then close
+OP_REQUEST = 0x10          # client -> server: {method, params}
+OP_RESPONSE = 0x11         # server -> client: the RPC reply
+OP_TOKEN = 0x12            # server -> client push: {id, i, tok}
+OP_TERMINAL = 0x13         # server -> client push: terminal state dict
+
+_OPCODE_NAMES = {OP_CHALLENGE: "challenge", OP_HELLO: "hello",
+                 OP_HELLO_OK: "hello_ok", OP_HELLO_ERR: "hello_err",
+                 OP_REQUEST: "request", OP_RESPONSE: "response",
+                 OP_TOKEN: "token", OP_TERMINAL: "terminal"}
+
+
+def _hmac_hello(token: str, nonce: str, hello: Dict[str, Any]) -> str:
+    """HMAC-SHA256 over nonce + the canonical (sorted, compact) hello —
+    the server recomputes this from the received hello minus ``auth``,
+    so the mac covers every field the client claimed."""
+    body = nonce + json.dumps(hello, sort_keys=True,
+                              separators=(",", ":"))
+    return hmac.new(token.encode("utf-8"), body.encode("utf-8"),
+                    hashlib.sha256).hexdigest()
+
+
+def _send_frame2(sock: socket.socket, lock: threading.Lock,
+                 stream_id: int, opcode: int,
+                 payload: Dict[str, Any]) -> None:
+    data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if len(data) + 5 > _MAX_FRAME:
+        raise TransportError("protocol",
+                             f"v2 frame of {len(data)} bytes exceeds "
+                             f"{_MAX_FRAME}", retryable=False)
+    frame = struct.pack(">IIB", len(data) + 5, int(stream_id),
+                        int(opcode)) + data
+    with lock:
+        sock.sendall(frame)
+    metrics.counter("transport_frames_total",
+                    opcode=_OPCODE_NAMES.get(opcode, str(opcode)),
+                    dir="tx").inc()
+
+
+class _FrameReader:
+    """Buffered v2 frame parser for one socket.
+
+    Bytes accumulate in a bytearray across reads, so a socket timeout
+    mid-frame loses nothing — the next :meth:`read` resumes where the
+    buffer left off. Malformed input (length < header, length > cap,
+    undecodable payload) raises a typed ``TransportError{protocol}``;
+    EOF raises ``ConnectionError``; an idle tick raises
+    ``socket.timeout`` (per the socket's timeout) so callers can poll
+    stop/partition flags without ever hanging."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = bytearray()
+
+    def _parse(self) -> Optional[Tuple[int, int, Dict[str, Any]]]:
+        if len(self.buf) < 4:
+            return None
+        (n,) = struct.unpack(">I", bytes(self.buf[:4]))
+        if n < 5 or n > _MAX_FRAME:
+            raise TransportError("protocol",
+                                 f"bad v2 frame length {n} (need 5.."
+                                 f"{_MAX_FRAME})", retryable=False)
+        if len(self.buf) < 4 + n:
+            return None
+        raw = bytes(self.buf[4:4 + n])
+        del self.buf[:4 + n]
+        sid, op = struct.unpack(">IB", raw[:5])
+        payload: Dict[str, Any] = {}
+        if len(raw) > 5:
+            try:
+                payload = json.loads(raw[5:].decode("utf-8"))
+            except (UnicodeDecodeError, ValueError) as e:
+                raise TransportError("protocol",
+                                     f"undecodable v2 payload: {e!r}",
+                                     retryable=False)
+        if not isinstance(payload, dict):
+            raise TransportError("protocol",
+                                 "v2 payload must be a JSON object",
+                                 retryable=False)
+        metrics.counter("transport_frames_total",
+                        opcode=_OPCODE_NAMES.get(op, str(op)),
+                        dir="rx").inc()
+        return (int(sid), int(op), payload)
+
+    def read(self) -> Tuple[int, int, Dict[str, Any]]:
+        while True:
+            frame = self._parse()
+            if frame is not None:
+                return frame
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed the stream")
+            self.buf += chunk
+
+
+# transport_connections{state}: how many client connections are open vs
+# lost-and-awaiting-lazy-reconnect, fleet-wide in this process.
+_CONN_COUNTS = {"open": 0, "reconnecting": 0}
+_CONN_LOCK = threading.Lock()
+
+
+def _conn_gauge_move(old: Optional[str], new: Optional[str]) -> None:
+    with _CONN_LOCK:
+        if old is not None:
+            _CONN_COUNTS[old] = max(0, _CONN_COUNTS[old] - 1)
+        if new is not None:
+            _CONN_COUNTS[new] += 1
+        for state, n in _CONN_COUNTS.items():
+            metrics.gauge("transport_connections",
+                          state=state).set(float(n))
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +399,119 @@ class CircuitBreaker:
 # server
 # ---------------------------------------------------------------------------
 
+class _PushPump:
+    """Asynchronous writer for one connection's server-push frames.
+
+    Engine ``on_token`` callbacks fire inside the decode loop; writing
+    the frame there would serialize decode with network I/O (one
+    lock + ``sendall`` per committed token, across every concurrent
+    stream on the connection). Instead the callback enqueues the
+    pre-encoded frame and returns; this pump's thread drains the whole
+    backlog into a single ``sendall`` — the paper's tensor-fusion
+    lesson applied to the push lane: amortize per-message overhead by
+    keeping one hot channel busy with fused payloads.
+
+    Ordering per request is preserved (one FIFO per connection, and a
+    request's terminal is enqueued after its last token). ``RESPONSE``
+    frames still go direct under the shared write lock, so they may
+    overtake queued pushes — the client already tolerates that (index
+    dedup, terminal-before-response). A send failure marks the pump
+    dead and every later enqueue raises ``ConnectionError``, which
+    drops the sink exactly like a synchronous send failure did."""
+
+    def __init__(self, conn: socket.socket, wlock: threading.Lock,
+                 name: str):
+        self.conn = conn
+        self.wlock = wlock
+        self._cond = threading.Condition()
+        self._buf: List[Tuple[float, int, bytes]] = []
+        self._dead: Optional[str] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"hvd-push-{name}")
+        self._thread.start()
+
+    def send(self, stream_id: int, opcode: int,
+             payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        if len(data) + 5 > _MAX_FRAME:
+            raise TransportError("protocol",
+                                 f"v2 frame of {len(data)} bytes exceeds "
+                                 f"{_MAX_FRAME}", retryable=False)
+        frame = struct.pack(">IIB", len(data) + 5, int(stream_id),
+                            int(opcode)) + data
+        with self._cond:
+            if self._dead is not None:
+                raise ConnectionError(f"push pump dead: {self._dead}")
+            self._buf.append((time.perf_counter(), opcode, frame))
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._dead is None:
+                self._dead = "closed"
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._buf and self._dead is None:
+                    self._cond.wait()
+                if not self._buf:
+                    return             # closed and drained
+                batch, self._buf = self._buf, []
+            try:
+                with self.wlock:
+                    self.conn.sendall(b"".join(f for _, _, f in batch))
+            except OSError as e:
+                with self._cond:
+                    if self._dead is None:
+                        self._dead = repr(e)
+                return
+            now = time.perf_counter()
+            for t0, opcode, _ in batch:
+                metrics.counter(
+                    "transport_frames_total",
+                    opcode=_OPCODE_NAMES.get(opcode, str(opcode)),
+                    dir="tx").inc()
+                if opcode == OP_TOKEN:
+                    metrics.histogram(
+                        "transport_stream_push_lag_seconds").observe(
+                            now - t0)
+
+
+class _ServerSink:
+    """Server-side push target for one streamed request: the (conn,
+    write-lock, stream id) triple a ``token``/``terminal`` frame rides.
+
+    Sinks live in the server's ``_sinks`` registry keyed by request id —
+    the engine callback looks the sink up at fire time, so a retry or
+    hedge replay re-attaching a NEW sink to the same request just
+    replaces the registry entry and the stream resumes on the new
+    connection. A send into a partition (or a dead conn) raises, which
+    drops the sink: pushes are best-effort, the terminal RPC state is
+    the source of truth."""
+
+    def __init__(self, server: "SocketReplicaServer",
+                 conn: socket.socket, wlock: threading.Lock, sid: int,
+                 pump: _PushPump):
+        self.server = server
+        self.conn = conn
+        self.wlock = wlock
+        self.sid = sid
+        self.pump = pump
+
+    def send_token(self, rid: str, i: int, tok: int) -> None:
+        if faults.partitioned(self.server.rank):
+            raise ConnectionError("partitioned mid-stream")
+        self.pump.send(self.sid, OP_TOKEN,
+                       {"id": rid, "i": int(i), "tok": int(tok)})
+
+    def send_terminal(self, state: Dict[str, Any]) -> None:
+        if faults.partitioned(self.server.rank):
+            raise ConnectionError("partitioned mid-stream")
+        self.pump.send(self.sid, OP_TERMINAL, state)
+
+
 class SocketReplicaServer:
     """One replica's RPC front: a listener over an
     :class:`~horovod_tpu.serving.engine.InferenceEngine`.
@@ -262,6 +540,7 @@ class SocketReplicaServer:
         self._lock = threading.Lock()
         self._requests: Dict[str, Request] = {}
         self._inflight: Dict[str, threading.Event] = {}
+        self._sinks: Dict[str, _ServerSink] = {}
         self._rpc_seq = itertools.count(1)
         self.served_rpcs = 0
 
@@ -297,7 +576,8 @@ class SocketReplicaServer:
         return (req.status == RequestStatus.REJECTED
                 and bool(req.retryable))
 
-    def _do_submit(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    def _do_submit(self, p: Dict[str, Any],
+                   sink: Optional[_ServerSink] = None) -> Dict[str, Any]:
         rid = p.get("request_id")
         if not rid:
             return {"ok": False, "error": "submit needs request_id "
@@ -307,9 +587,8 @@ class SocketReplicaServer:
                 existing = self._requests.get(rid)
                 if existing is not None \
                         and not self._readmittable(existing):
-                    # Retry or hedge replay: the id IS the dedup key.
-                    # Return the current state instead of double-serving.
-                    return self._state(existing)
+                    break
+                existing = None
                 mine = self._inflight.get(rid)
                 if mine is None:
                     # Reserve the id BEFORE engine.submit: a retry racing
@@ -324,6 +603,16 @@ class SocketReplicaServer:
             if not mine.wait(timeout=30.0):
                 return {"ok": False, "error": f"submit {rid!r} still "
                         "in flight", "retryable": True}
+        if existing is not None:
+            # Retry or hedge replay: the id IS the dedup key. Return the
+            # current state instead of double-serving — and if the replay
+            # rides a stream, re-attach its sink (outside the lock: a
+            # terminal push sends frames) so the new connection resumes
+            # the token stream. Tokens committed before the attach ride
+            # the response; the client dedups by index.
+            if sink is not None:
+                self._attach_stream(existing, sink)
+            return self._state(existing)
         try:
             kw: Dict[str, Any] = {"priority": int(p.get("priority", 0)),
                                   "request_id": rid}
@@ -333,6 +622,12 @@ class SocketReplicaServer:
                 kw["src"] = list(map(int, p["src"]))
             if p.get("deadline_s") is not None:
                 kw["deadline_s"] = float(p["deadline_s"])
+            if sink is not None:
+                # Register the sink BEFORE engine.submit so tokens
+                # committed while submit is still returning get pushed.
+                with self._lock:
+                    self._sinks[rid] = sink
+                kw["on_token"] = self._make_on_token(rid)
             prompt = p.get("prompt") or None
             mnt = int(p.get("max_new_tokens", 1))
             req = self.engine.submit(prompt, mnt, **kw)
@@ -341,11 +636,66 @@ class SocketReplicaServer:
                 req = self._try_shed_and_resubmit(req, prompt, mnt, kw)
             if not self._readmittable(req):
                 self._remember(req)
+            if sink is not None:
+                self._attach_stream(req, sink)
             return self._state(req)
         finally:
             with self._lock:
                 self._inflight.pop(rid, None)
             mine.set()
+
+    # -- server push (transport v2) ---------------------------------------
+
+    def _make_on_token(self, rid: str) -> Callable[[Request, int], None]:
+        def on_token(req: Request, tok: int) -> None:
+            with self._lock:
+                sink = self._sinks.get(rid)
+            if sink is None:
+                return
+            try:
+                sink.send_token(rid, len(req.tokens) - 1, tok)
+            except (OSError, ConnectionError, TransportError):
+                with self._lock:
+                    if self._sinks.get(rid) is sink:
+                        del self._sinks[rid]
+        return on_token
+
+    def _attach_stream(self, req: Request, sink: _ServerSink) -> None:
+        """Point the request's push stream at ``sink`` and make sure the
+        terminal chain fires exactly once per attached sink. Caller must
+        NOT hold ``self._lock`` — a terminal push writes to the socket."""
+        with self._lock:
+            self._sinks[req.id] = sink
+        if getattr(req, "on_token", None) is None:
+            # Replay attach to a request originally submitted without a
+            # stream (e.g. legacy first, stream retry).
+            req.on_token = self._make_on_token(req.id)
+        if not getattr(req, "_stream_chained", False):
+            req._stream_chained = True
+            prev = getattr(req, "_on_terminal", None)
+
+            def chained(r: Request) -> None:
+                try:
+                    if prev is not None:
+                        prev(r)
+                finally:
+                    self._push_terminal(r.id)
+            req._on_terminal = chained
+        if req.status.terminal:
+            # Already finished (or finished between submit and attach):
+            # the chain fired before the sink existed — push now.
+            self._push_terminal(req.id)
+
+    def _push_terminal(self, rid: str) -> None:
+        with self._lock:
+            sink = self._sinks.pop(rid, None)
+            req = self._requests.get(rid)
+        if sink is None or req is None:
+            return
+        try:
+            sink.send_terminal(self._state(req))
+        except (OSError, ConnectionError, TransportError):
+            pass                   # peer gone; its retry re-attaches
 
     def _try_shed_and_resubmit(self, req: Request, prompt, mnt: int,
                                kw: Dict[str, Any]) -> Request:
@@ -429,6 +779,26 @@ class SocketReplicaServer:
     # -- connection handling ----------------------------------------------
 
     def _handle_conn(self, conn: socket.socket) -> None:
+        # One sniffed byte routes the connection: 0xB2 opens a v2
+        # multiplexed stream; anything else is the high byte of a legacy
+        # length prefix (< 16 MiB, so always 0x00) — old clients and
+        # fleet-supervisor probes keep working mid-rolling-restart.
+        try:
+            conn.settimeout(30.0)
+            first = _recv_exact(conn, 1)
+        except (OSError, ValueError, ConnectionError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        if first[0] == _V2_MAGIC:
+            self._handle_stream_conn(conn)
+        else:
+            self._handle_legacy_conn(conn, first)
+
+    def _handle_legacy_conn(self, conn: socket.socket,
+                            first: bytes) -> None:
         seq = next(self._rpc_seq)
         try:
             # Fault points first: a partition in force (or fired AT this
@@ -437,8 +807,23 @@ class SocketReplicaServer:
             directives = faults.net_fault(seq, self.rank)
             if faults.partitioned(self.rank):
                 return
-            conn.settimeout(30.0)
-            msg = _recv_frame(conn)
+            (n,) = struct.unpack(">I", first + _recv_exact(conn, 3))
+            if n > _MAX_FRAME:
+                raise TransportError("protocol",
+                                     f"peer announced a {n}-byte frame "
+                                     f"(cap {_MAX_FRAME})",
+                                     retryable=False)
+            msg = json.loads(_recv_exact(conn, n).decode("utf-8"))
+            from horovod_tpu.config import get_config
+            if get_config().serve_auth_token:
+                # Auth knob set: the legacy wire has no handshake to
+                # authenticate, so it is refused outright (typed,
+                # permanent — the client must speak v2).
+                _send_frame(conn, {
+                    "ok": False, "error": "auth required: legacy "
+                    "protocol refused; connect with transport v2 and "
+                    "HOROVOD_SERVE_AUTH_TOKEN", "retryable": False})
+                return
             method = msg.get("method", "")
             handler = self._METHODS.get(method)
             if handler is None:
@@ -467,6 +852,113 @@ class SocketReplicaServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _handle_stream_conn(self, conn: socket.socket) -> None:
+        from horovod_tpu.config import get_config
+        token = get_config().serve_auth_token
+        wlock = threading.Lock()
+        pump: Optional[_PushPump] = None
+        try:
+            # token/terminal frames are tiny; Nagle would batch them
+            # against the delayed ACK and add whole RTTs of push lag
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if faults.partitioned(self.rank):
+                return                     # severed before the handshake
+            nonce = uuid.uuid4().hex
+            _send_frame2(conn, wlock, 0, OP_CHALLENGE,
+                         {"nonce": nonce, "auth": bool(token),
+                          "server": self.name, "rank": self.rank,
+                          "proto": 2})
+            conn.settimeout(5.0)           # handshake must be prompt
+            reader = _FrameReader(conn)
+            _, op, hello = reader.read()
+            if op != OP_HELLO:
+                return
+            if token:
+                mac = hello.pop("auth", None)
+                want = _hmac_hello(token, nonce, hello)
+                if not (isinstance(mac, str)
+                        and hmac.compare_digest(mac, want)):
+                    metrics.counter("transport_auth_total",
+                                    outcome="refused").inc()
+                    _send_frame2(conn, wlock, 0, OP_HELLO_ERR,
+                                 {"error": "auth failed",
+                                  "retryable": False})
+                    return
+            _send_frame2(conn, wlock, 0, OP_HELLO_OK,
+                         {"server": self.name, "rank": self.rank})
+            pump = _PushPump(conn, wlock, self.name)
+            # 0.5s read ticks: each timeout re-checks stop/partition, so
+            # an in-force partition SEVERS the established stream (the
+            # legacy wire only had new connections to refuse).
+            conn.settimeout(0.5)
+            while not self._stop.is_set():
+                try:
+                    sid, op, payload = reader.read()
+                except socket.timeout:
+                    if faults.partitioned(self.rank):
+                        return
+                    continue
+                if faults.partitioned(self.rank):
+                    return
+                if op != OP_REQUEST:
+                    continue               # pushes only flow server->client
+                seq = next(self._rpc_seq)
+                directives = faults.net_fault(seq, self.rank)
+                if faults.partitioned(self.rank):
+                    return                 # partition fired AT this frame
+                threading.Thread(
+                    target=self._serve_stream_request,
+                    args=(conn, wlock, pump, sid, payload, directives),
+                    daemon=True).start()
+        except (OSError, ValueError, ConnectionError, TransportError):
+            pass                           # peer gone; client reconnects
+        finally:
+            if pump is not None:
+                pump.close()
+            with self._lock:
+                dead = [rid for rid, s in self._sinks.items()
+                        if s.conn is conn]
+                for rid in dead:
+                    del self._sinks[rid]
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_stream_request(self, conn: socket.socket,
+                              wlock: threading.Lock, pump: _PushPump,
+                              sid: int, msg: Dict[str, Any],
+                              directives: Dict[str, Any]) -> None:
+        method = msg.get("method", "")
+        params = msg.get("params") or {}
+        handler = self._METHODS.get(method)
+        if handler is None:
+            resp: Dict[str, Any] = {
+                "ok": False, "error": f"unknown method {method!r}",
+                "retryable": False}
+        else:
+            try:
+                if method == "submit" and params.get("stream"):
+                    resp = self._do_submit(
+                        params,
+                        sink=_ServerSink(self, conn, wlock, sid, pump))
+                else:
+                    resp = handler(self, params)
+            except Exception as e:          # noqa: BLE001 — typed reply
+                resp = {"ok": False, "error": f"server error: {e!r}",
+                        "retryable": True}
+        if directives["delay_s"] > 0:
+            time.sleep(directives["delay_s"])
+        if directives["drop"]:
+            return                         # served, never answered
+        try:
+            _send_frame2(conn, wlock, sid, OP_RESPONSE, resp)
+        except (OSError, TransportError):
+            return
+        if method != "status":
+            with self._lock:
+                self.served_rpcs += 1
 
     def start(self) -> "SocketReplicaServer":
         self.engine.start()
@@ -513,23 +1005,205 @@ class SocketReplicaServer:
 # client
 # ---------------------------------------------------------------------------
 
+class _StreamState:
+    """Client-side bookkeeping for one in-flight stream id."""
+
+    __slots__ = ("event", "response", "sink", "error")
+
+    def __init__(self, sink=None):
+        self.event = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+        self.sink = sink
+        self.error: Optional[TransportError] = None
+
+
+class _StreamConn:
+    """One persistent multiplexed v2 connection: a write lock, a reader
+    thread, and per-stream-id state.
+
+    The reader thread owns the socket's receive side. ``response``
+    frames wake the requesting thread; ``token``/``terminal`` pushes are
+    forwarded to the stream's sink (the dispatcher's handle). Any read
+    failure — EOF, reset, protocol garbage — or a request that times out
+    waiting for its response POISONS the whole connection: every
+    in-flight stream errors retryable and sinks learn their owner is
+    lost, so the next RPC lazily reconnects. Conservative, but a mux
+    that might be wedged is worth less than a clean reconnect."""
+
+    def __init__(self, sock: socket.socket, name: str,
+                 auth_token: str = ""):
+        self.sock = sock
+        self.name = name
+        self._wlock = threading.Lock()
+        self._slock = threading.Lock()
+        self._streams: Dict[int, _StreamState] = {}
+        self._sid = itertools.count(1)
+        self._dead: Optional[TransportError] = None
+        self._frames = _FrameReader(sock)
+        self._handshake(auth_token)        # socket keeps its connect timeout
+        self.sock.settimeout(0.5)          # read-loop tick granularity
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"hvd-stream-{name}",
+                                        daemon=True)
+        self._reader.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._dead is None
+
+    def _handshake(self, token: str) -> None:
+        self.sock.sendall(bytes([_V2_MAGIC]))
+        _, op, challenge = self._frames.read()
+        if op != OP_CHALLENGE:
+            raise TransportError("protocol",
+                                 f"{self.name}: expected challenge, got "
+                                 f"opcode {op}", retryable=True)
+        hello: Dict[str, Any] = {"client": self.name, "proto": 2,
+                                 "pid": os.getpid()}
+        if challenge.get("auth"):
+            if not token:
+                raise TransportError(
+                    "auth", f"{self.name} requires an auth token and "
+                    "HOROVOD_SERVE_AUTH_TOKEN is not set",
+                    retryable=False)
+            hello["auth"] = _hmac_hello(token,
+                                        str(challenge.get("nonce", "")),
+                                        {k: v for k, v in hello.items()})
+        _send_frame2(self.sock, self._wlock, 0, OP_HELLO, hello)
+        _, op, ack = self._frames.read()
+        if op == OP_HELLO_ERR:
+            raise TransportError(
+                "auth", f"{self.name}: "
+                f"{ack.get('error', 'handshake refused')}",
+                retryable=False)
+        if op != OP_HELLO_OK:
+            raise TransportError("protocol",
+                                 f"{self.name}: expected hello_ok, got "
+                                 f"opcode {op}", retryable=True)
+
+    def request(self, method: str, params: Dict[str, Any],
+                timeout: float, sink=None) -> Dict[str, Any]:
+        sid = next(self._sid)
+        st = _StreamState(sink)
+        with self._slock:
+            if self._dead is not None:
+                raise ConnectionError(str(self._dead))
+            self._streams[sid] = st
+        try:
+            _send_frame2(self.sock, self._wlock, sid, OP_REQUEST,
+                         {"method": method, "params": params})
+        except OSError as e:
+            self._fail(TransportError("connect",
+                                      f"send to {self.name} failed: "
+                                      f"{e!r}", retryable=True))
+            raise
+        if not st.event.wait(timeout):
+            # No response inside the budget. The stream MIGHT just be
+            # slow — but a response that never comes would wedge every
+            # other stream's liveness signal, so poison the mux and let
+            # retries reconnect. The server dedups replays by id.
+            self._fail(TransportError("timeout",
+                                      f"{method} to {self.name}: no "
+                                      f"response in {timeout:.2f}s",
+                                      retryable=True))
+            raise socket.timeout(f"{method} to {self.name} timed out")
+        if st.error is not None:
+            raise ConnectionError(str(st.error))
+        assert st.response is not None
+        return st.response
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                frame = self._frames.read()
+            except socket.timeout:
+                if self._dead is not None:
+                    return
+                continue
+            except (OSError, ConnectionError, ValueError,
+                    TransportError) as e:
+                self._fail(TransportError("connect",
+                                          f"stream to {self.name} "
+                                          f"lost: {e!r}",
+                                          retryable=True))
+                return
+            self._dispatch(frame)
+
+    def _dispatch(self, frame: Tuple[int, int, Dict[str, Any]]) -> None:
+        sid, op, payload = frame
+        if op == OP_RESPONSE:
+            with self._slock:
+                st = self._streams.get(sid)
+                if st is not None and st.sink is None:
+                    del self._streams[sid]   # plain RPC: stream done
+            if st is not None:
+                st.response = payload
+                st.event.set()
+        elif op == OP_TOKEN:
+            with self._slock:
+                st = self._streams.get(sid)
+            if st is not None and st.sink is not None:
+                st.sink.push_token(int(payload.get("i", -1)),
+                                   int(payload.get("tok", 0)))
+        elif op == OP_TERMINAL:
+            with self._slock:
+                st = self._streams.pop(sid, None)
+            if st is not None:
+                if st.response is None:
+                    # Terminal beat the RPC response through the mux
+                    # (tiny request): the terminal state IS a response.
+                    st.response = payload
+                    st.event.set()
+                if st.sink is not None:
+                    st.sink.push_terminal(payload)
+
+    def _fail(self, err: TransportError) -> None:
+        with self._slock:
+            if self._dead is not None:
+                return
+            self._dead = err
+            streams, self._streams = self._streams, {}
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for st in streams.values():
+            st.error = err
+            st.event.set()
+            if st.sink is not None:
+                try:
+                    st.sink.push_lost()
+                except Exception:           # noqa: BLE001 — best effort
+                    pass
+
+    def close(self) -> None:
+        self._fail(TransportError("connect",
+                                  f"{self.name}: connection closed",
+                                  retryable=True))
+
+
 class RemoteClient:
-    """One replica's client stub: connection-per-RPC with deadline
-    propagation, bounded jittered retries, and a circuit breaker.
+    """One replica's client stub: a persistent multiplexed v2 stream
+    (``transport="stream"``, the default) or connection-per-RPC legacy
+    JSON (``transport="legacy"``), with deadline propagation, bounded
+    jittered retries, and a circuit breaker either way.
 
     Every attempt's socket timeout is ``min(rpc_timeout, remaining
     deadline)`` — a request's deadline bounds its worst-case transport
     wall clock by construction. Retries fire only on transport-level
     connect/timeout failures (server-side outcomes ride the response's
     ``retryable`` flag and are the DISPATCHER's re-route decision, not a
-    same-replica retry)."""
+    same-replica retry). In stream mode the connection is opened — and
+    re-opened after a loss — lazily inside the SAME retry/breaker path,
+    so connect failures count against the breaker exactly like before."""
 
     def __init__(self, address: Tuple[str, int], *,
                  name: Optional[str] = None,
                  rpc_timeout: Optional[float] = None,
                  max_retries: Optional[int] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 transport: Optional[str] = None):
         from horovod_tpu.config import get_config
         cfg = get_config()
         self.address = (address[0], int(address[1]))
@@ -540,9 +1214,46 @@ class RemoteClient:
                                else cfg.serve_max_retries)
         self.breaker = breaker or CircuitBreaker(self.name)
         self._rng = rng or random.Random()
+        self.transport = (transport if transport is not None
+                          else cfg.serve_transport)
+        self._auth_token = cfg.serve_auth_token
+        self._conn: Optional[_StreamConn] = None
+        self._conn_lock = threading.Lock()
+        self._gauge_state: Optional[str] = None
+
+    def _ensure_conn(self, timeout: float) -> _StreamConn:
+        with self._conn_lock:
+            conn = self._conn
+            if conn is not None and conn.alive:
+                return conn
+            if conn is not None:
+                self._conn = None
+                if self._gauge_state != "reconnecting":
+                    _conn_gauge_move(self._gauge_state, "reconnecting")
+                    self._gauge_state = "reconnecting"
+            sock = socket.create_connection(self.address,
+                                            timeout=timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                conn = _StreamConn(sock, self.name, self._auth_token)
+            except BaseException:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            self._conn = conn
+            _conn_gauge_move(self._gauge_state, "open")
+            self._gauge_state = "open"
+            metrics._timeline_marker("TRANSPORT", category="transport",
+                                     event="connect", replica=self.name)
+            return conn
 
     def _rpc_once(self, method: str, params: Dict[str, Any],
-                  timeout: float) -> Dict[str, Any]:
+                  timeout: float, sink=None) -> Dict[str, Any]:
+        if self.transport == "stream":
+            return self._ensure_conn(timeout).request(
+                method, params, timeout, sink=sink)
         with socket.create_connection(self.address,
                                       timeout=timeout) as sock:
             sock.settimeout(timeout)
@@ -551,7 +1262,7 @@ class RemoteClient:
 
     def call(self, method: str, params: Optional[Dict[str, Any]] = None,
              *, deadline: Optional[float] = None,
-             retry: bool = True) -> Dict[str, Any]:
+             retry: bool = True, sink=None) -> Dict[str, Any]:
         """One RPC with the full robustness stack; ``deadline`` is
         absolute ``time.monotonic()``. Raises :class:`TransportError`
         (typed, with ``retryable``) instead of ever hanging."""
@@ -576,7 +1287,8 @@ class RemoteClient:
                        else max(0.05, min(self.rpc_timeout, remaining)))
             t0 = time.perf_counter()
             try:
-                resp = self._rpc_once(method, params, per_try)
+                resp = self._rpc_once(method, params, per_try,
+                                      sink=sink)
             except (OSError, ValueError, ConnectionError) as e:
                 outcome = ("timeout" if isinstance(e, socket.timeout)
                            else "connect")
@@ -622,6 +1334,30 @@ class RemoteClient:
             params["deadline_s"] = max(0.0, deadline - time.monotonic())
         return self.call("submit", params, deadline=deadline)
 
+    def submit_stream(self, spec: Dict[str, Any], *, sink,
+                      deadline: Optional[float] = None) -> Dict[str, Any]:
+        """Streamed submit (v2 only): the server pushes ``token`` and
+        ``terminal`` frames into ``sink`` (``push_token(i, tok)``,
+        ``push_terminal(state)``, ``push_lost()``) as the engine
+        produces them. Retries re-send the same id with the same sink —
+        the server's id-dedup re-attaches instead of double-serving."""
+        params = dict(spec)
+        params["stream"] = True
+        if deadline is not None:
+            params["deadline_s"] = max(0.0, deadline - time.monotonic())
+        return self.call("submit", params, deadline=deadline, sink=sink)
+
+    def close(self) -> None:
+        """Drop the persistent connection (if any). Safe to call twice;
+        the next RPC lazily reconnects."""
+        with self._conn_lock:
+            conn, self._conn = self._conn, None
+            if self._gauge_state is not None:
+                _conn_gauge_move(self._gauge_state, None)
+                self._gauge_state = None
+        if conn is not None:
+            conn.close()
+
     def poll(self, request_id: str, *,
              deadline: Optional[float] = None) -> Dict[str, Any]:
         return self.call("poll", {"id": request_id}, deadline=deadline)
@@ -653,7 +1389,17 @@ class RemoteClient:
 class RemoteHandle:
     """Client-side handle for one remote request: the socket analogue of
     :class:`~horovod_tpu.serving.scheduler.Request`, updated by
-    :meth:`RemoteDispatcher.wait` from poll responses."""
+    :meth:`RemoteDispatcher.wait` — from server pushes in stream mode,
+    from poll responses on the legacy wire.
+
+    Push state: tokens arrive indexed, and the handle appends index
+    ``i`` only when ``i == len(tokens)`` — duplicates from a hedge or a
+    failover replay are dropped by construction (greedy decode makes the
+    prefixes byte-identical), which is what keeps the client-visible
+    stream exactly-once and in order across a mid-stream replica kill.
+    ``on_token(i, tok)`` (optional, set by the caller before wait) fires
+    once per index in order; ``ttft_client`` is the client-OBSERVED
+    first-token latency — the number the poll interval used to tax."""
 
     def __init__(self, spec: Dict[str, Any],
                  deadline: Optional[float] = None):
@@ -671,6 +1417,13 @@ class RemoteHandle:
         self.resubmits = 0
         self.hedged = False
         self.t_submit = time.monotonic()
+        self.on_token: Optional[Callable[[int, int], None]] = None
+        self.ttft_client: Optional[float] = None
+        self._hlock = threading.Lock()
+        self._wake = threading.Event()     # pushes nudge wait() awake
+        self._streamed_upto = 0            # next on_token index to fire
+        self._lost: Set[Any] = set()       # owners whose stream died
+        self._terminal_push: Optional[Tuple[Dict[str, Any], Any]] = None
 
     @property
     def terminal(self) -> bool:
@@ -678,24 +1431,197 @@ class RemoteHandle:
 
     def _apply(self, st: Dict[str, Any],
                client: "RemoteClient") -> None:
-        self.status = st["status"]
-        self.tokens = list(st.get("tokens") or [])
-        self.reason = st.get("reason")
-        self.retryable = bool(st.get("retryable"))
-        self.served_by = st.get("served_by") or client.name
-        self.ttft = st.get("ttft")
-        self.tpot = st.get("tpot")
+        with self._hlock:
+            self.status = st["status"]
+            toks = [int(t) for t in (st.get("tokens") or [])]
+            if len(toks) >= len(self.tokens):
+                # Never shrink: pushed tokens can be AHEAD of a stale
+                # poll/replay response, and greedy decode guarantees the
+                # shorter list is a prefix of the longer one.
+                self.tokens = toks
+            self.reason = st.get("reason")
+            self.retryable = bool(st.get("retryable"))
+            self.served_by = st.get("served_by") or client.name
+            self.ttft = st.get("ttft")
+            self.tpot = st.get("tpot")
+            if self.tokens and self.ttft_client is None:
+                self.ttft_client = time.monotonic() - self.t_submit
+            fire = self._pending_callbacks()
+        self._fire_callbacks(fire)
+
+    # -- push-mode plumbing (called from stream reader threads) -----------
+
+    def _pending_callbacks(self) -> List[Tuple[int, int]]:
+        # under _hlock — returns the (i, tok) pairs on_token still owes.
+        # No callback yet? Hold the cursor: a callback attached just
+        # after submit still sees every token exactly once.
+        if self.on_token is None:
+            return []
+        out = [(i, self.tokens[i])
+               for i in range(self._streamed_upto, len(self.tokens))]
+        self._streamed_upto = len(self.tokens)
+        return out
+
+    def _fire_callbacks(self, fire: List[Tuple[int, int]]) -> None:
+        for i, tok in fire:
+            try:
+                self.on_token(i, tok)
+            except Exception:               # noqa: BLE001 — user callback
+                pass
+
+    def _push_token(self, client, i: int, tok: int) -> None:
+        with self._hlock:
+            if self.terminal:
+                return
+            if i == len(self.tokens):
+                self.tokens.append(int(tok))
+                if self.status == "queued":
+                    self.status = "running"
+                if self.ttft_client is None:
+                    self.ttft_client = time.monotonic() - self.t_submit
+            fire = self._pending_callbacks()
+        self._fire_callbacks(fire)
+        self._wake.set()
+
+    def _push_terminal(self, client, st: Dict[str, Any]) -> None:
+        with self._hlock:
+            if st.get("retryable") and st.get("status") != "done":
+                # Retryable terminal (shed, drain bounce): this owner is
+                # done with us but the request isn't done — wait() drops
+                # the owner and re-places, same as the poll path.
+                self._lost.add(client)
+            elif self._terminal_push is None:
+                self._terminal_push = (st, client)
+        self._wake.set()
+
+    def _owner_lost(self, client) -> None:
+        with self._hlock:
+            self._lost.add(client)
+        self._wake.set()
 
     def describe(self) -> Dict[str, Any]:
         return {"id": self.id, "status": self.status,
                 "reason": self.reason, "served_by": self.served_by,
                 "generated": len(self.tokens), "ttft": self.ttft,
+                "ttft_client": self.ttft_client,
                 "tpot": self.tpot, "resubmits": self.resubmits,
                 "hedged": self.hedged}
 
     def __repr__(self) -> str:
         return (f"RemoteHandle({self.id}, {self.status}, "
                 f"gen={len(self.tokens)})")
+
+
+class _HandleSink:
+    """Adapter wiring one stream's server pushes into a handle — the
+    object :meth:`RemoteClient.submit_stream` hands the connection."""
+
+    def __init__(self, handle: RemoteHandle, client):
+        self.handle = handle
+        self.client = client
+
+    def push_token(self, i: int, tok: int) -> None:
+        self.handle._push_token(self.client, i, tok)
+
+    def push_terminal(self, st: Dict[str, Any]) -> None:
+        self.handle._push_terminal(self.client, st)
+
+    def push_lost(self) -> None:
+        self.handle._owner_lost(self.client)
+
+
+class _StateBus:
+    """Shared dispatcher state bus over the membership file.
+
+    Multiple dispatcher frontends read the same atomically-replaced
+    membership JSON; each also read-modify-writes a ``health`` block
+    keyed by replica name, recording what it observed: a connect/timeout
+    breaker trip as an absolute ``down_until`` horizon, the latest load
+    score, and the membership version it saw. ``version``/``replicas``
+    stay the fleet supervisor's — dispatchers NEVER bump the version —
+    and the supervisor's publisher carries ``health`` forward across
+    rewrites, so gossip survives membership churn.
+
+    A dispatcher honours only OTHER dispatchers' down marks (its own
+    knowledge lives in its circuit breakers) — that is what lets
+    frontend B route around a replica only frontend A watched die,
+    before B's own probe ever burns a timeout on it."""
+
+    _TTL = 0.25
+
+    def __init__(self, path: str, owner: Optional[str] = None):
+        self.path = path
+        self.owner = owner or (
+            f"disp-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+        self._lock = threading.Lock()
+        self._cache: Dict[str, Dict[str, Any]] = {}
+        self._read_at = -1e9
+        self._wrote: Dict[str, float] = {}
+
+    def read(self) -> Dict[str, Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._read_at < self._TTL:
+                return self._cache
+            self._read_at = now
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            health = doc.get("health")
+            health = dict(health) if isinstance(health, dict) else {}
+        except (OSError, ValueError):
+            return self._cache     # mid-write or not yet published
+        with self._lock:
+            self._cache = health
+        return health
+
+    def is_down(self, name: str) -> bool:
+        ent = self.read().get(name)
+        if not ent or ent.get("by") == self.owner:
+            return False
+        try:
+            down_until = float(ent.get("down_until"))
+        except (TypeError, ValueError):
+            return False
+        return time.time() < down_until
+
+    def publish(self, name: str, *, load: Optional[float] = None,
+                down_for: Optional[float] = None,
+                version: Optional[int] = None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._wrote.get(name, -1e9) < 0.2:
+                return             # per-name throttle: gossip, not a log
+            self._wrote[name] = now
+        try:
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+                if not isinstance(doc, dict):
+                    doc = {}
+            except (OSError, ValueError):
+                doc = {}
+            health = doc.get("health")
+            health = dict(health) if isinstance(health, dict) else {}
+            ent: Dict[str, Any] = {"by": self.owner,
+                                   "observed": time.time()}
+            if version is not None and version >= 0:
+                ent["version"] = int(version)
+            if load is not None and load != float("inf"):
+                ent["load"] = float(load)
+            if down_for is not None:
+                ent["down_until"] = time.time() + float(down_for)
+            health[name] = ent
+            doc["health"] = health
+            tmp = f"{self.path}.tmp.{self.owner}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            return                 # fs unavailable: gossip is optional
+        metrics.counter("transport_bus_total", event="publish").inc()
+        with self._lock:
+            self._read_at = -1e9   # our own write invalidates the cache
 
 
 class RemoteDispatcher:
@@ -728,7 +1654,8 @@ class RemoteDispatcher:
                  hedge_ms: Optional[float] = None,
                  rpc_timeout: Optional[float] = None,
                  max_retries: Optional[int] = None,
-                 membership: Optional[str] = None):
+                 membership: Optional[str] = None,
+                 state_bus: Optional[str] = None):
         from horovod_tpu.config import get_config
         cfg = get_config()
         self._rpc_timeout = rpc_timeout
@@ -750,6 +1677,10 @@ class RemoteDispatcher:
                         else float(hedge_ms)) / 1000.0
         self._status: Dict[str, Tuple[float, float]] = {}  # name->(ts,load)
         self._lock = threading.Lock()
+        # State bus rides the membership file unless pointed elsewhere;
+        # with neither there is no peer to gossip with.
+        bus_path = state_bus if state_bus is not None else membership
+        self.bus = _StateBus(bus_path) if bus_path else None
         if membership is not None:
             self._refresh_membership(force=True)
 
@@ -837,6 +1768,14 @@ class RemoteDispatcher:
     # -- routing ----------------------------------------------------------
 
     def _load_of(self, client: RemoteClient) -> float:
+        # Gossip first: if a PEER dispatcher recently watched this
+        # replica die, route around it without spending a probe — that
+        # is the whole point of the bus. Reading the bus never touches
+        # the breaker, so the half-open probe token is safe.
+        if self.bus is not None and self.bus.is_down(client.name):
+            metrics.counter("transport_bus_total",
+                            event="route_around").inc()
+            return float("inf")
         # Deliberately no breaker pre-check here: ``call()`` owns the
         # single ``allow()`` gate. Consulting ``allow()`` twice would
         # consume the one half-open probe token before the status RPC
@@ -852,8 +1791,19 @@ class RemoteDispatcher:
             st = client.status()
             load = (float(st.get("load", 0))
                     if st.get("alive", True) else float("inf"))
-        except TransportError:
+            if self.bus is not None:
+                self.bus.publish(client.name, load=load,
+                                 version=self._member_version)
+        except TransportError as e:
             load = float("inf")
+            if self.bus is not None \
+                    and e.kind in ("connect", "timeout", "circuit_open"):
+                # Tell the other frontends how long WE would cool off:
+                # the breaker reset window is the honest horizon.
+                reset = getattr(getattr(client, "breaker", None),
+                                "reset_s", 1.0)
+                self.bus.publish(client.name, down_for=float(reset),
+                                 version=self._member_version)
         with self._lock:
             self._status[client.name] = (now, load)
         return load
@@ -895,6 +1845,23 @@ class RemoteDispatcher:
         self._place(handle)
         return handle
 
+    @staticmethod
+    def _is_stream(client) -> bool:
+        # getattr-duck-typed: tests (and adapters) drive the dispatcher
+        # with stub clients that predate v2 — those take the poll path.
+        return (getattr(client, "transport", "legacy") == "stream"
+                and hasattr(client, "submit_stream"))
+
+    def _submit_to(self, client, handle: RemoteHandle) -> Dict[str, Any]:
+        """Submit over the client's native wire: stream clients attach a
+        push sink (tokens/terminal arrive without polling); legacy
+        clients and duck-typed stubs take the plain submit."""
+        if self._is_stream(client):
+            return client.submit_stream(
+                handle.spec, sink=_HandleSink(handle, client),
+                deadline=handle.deadline)
+        return client.submit(handle.spec, deadline=handle.deadline)
+
     def _place(self, handle: RemoteHandle,
                exclude: Sequence[RemoteClient] = ()) -> bool:
         """Try each live replica (least-loaded first) until one accepts;
@@ -917,7 +1884,7 @@ class RemoteDispatcher:
                               if c not in exclude]
         for client in candidates:
             try:
-                st = client.submit(handle.spec, deadline=handle.deadline)
+                st = self._submit_to(client, handle)
             except TransportError as e:
                 last_reason = str(e)
                 if e.retryable:
@@ -952,7 +1919,7 @@ class RemoteDispatcher:
         if not backups:
             return
         try:
-            st = backups[0].submit(handle.spec, deadline=handle.deadline)
+            st = self._submit_to(backups[0], handle)
         except TransportError:
             return
         if st["status"] in _TERMINAL and st["status"] != "done":
@@ -964,10 +1931,34 @@ class RemoteDispatcher:
                                  event="hedge", request=handle.id,
                                  target=backups[0].name)
 
+    def _drain_push_state(self, handle: RemoteHandle) -> None:
+        """Fold server pushes into the handle's ownership: owners whose
+        stream died (or bounced retryable-terminal) are dropped so the
+        loop fails over, and a pushed terminal is applied exactly like a
+        winning poll — hedge-win accounting and loser cancels included."""
+        with handle._hlock:
+            lost = list(handle._lost)
+            handle._lost.clear()
+            tp, handle._terminal_push = handle._terminal_push, None
+        for client in lost:
+            if client in handle.owners:
+                handle.owners.remove(client)
+        if tp is not None and not handle.terminal:
+            st, client = tp
+            first = handle.owners[0] if handle.owners else None
+            handle._apply(st, client)
+            if handle.terminal:
+                if handle.status == "done" and handle.hedged \
+                        and first is not None and client is not first:
+                    metrics.counter("transport_hedge_wins_total").inc()
+                self._cancel_others(handle, keep=client)
+
     def wait(self, handle: RemoteHandle,
              timeout: Optional[float] = None) -> RemoteHandle:
-        """Poll until the request is terminal — NEVER past its deadline.
-        A lost owner triggers failover resubmission; a still-queued
+        """Block until the request is terminal — NEVER past its deadline.
+        Stream owners push tokens/terminal and the loop just sleeps on
+        the handle's wake event; legacy owners are polled as before. A
+        lost owner triggers failover resubmission; a still-queued
         request past the hedge delay is duplicated; deadline exhaustion
         yields a typed local ``expired`` (with best-effort server-side
         cancels), not a hang."""
@@ -979,6 +1970,8 @@ class RemoteDispatcher:
             deadline = time.monotonic() + 60.0
         delays = backoff_delays(base=0.005, cap=0.25, deadline=deadline)
         while True:
+            handle._wake.clear()
+            self._drain_push_state(handle)
             if handle.terminal:
                 if not (handle.status == "rejected" and handle.retryable
                         and time.monotonic() < deadline):
@@ -991,6 +1984,8 @@ class RemoteDispatcher:
                 return self._expire_locally(handle)
             winner = None
             for client in list(handle.owners):
+                if self._is_stream(client):
+                    continue               # push-mode owner: no polling
                 poll_by = min(deadline, time.monotonic()
                               + max(0.2, client.rpc_timeout))
                 try:
@@ -1024,7 +2019,10 @@ class RemoteDispatcher:
                 if self._place(handle):
                     handle.resubmits += 1
             self._maybe_hedge(handle)
-            time.sleep(next(delays))
+            # Pushes cut the sleep short — a terminal (or first token)
+            # wakes the loop NOW instead of after the poll interval,
+            # which is exactly the TTFT tax v2 removes.
+            handle._wake.wait(next(delays))
 
     def _expire_locally(self, handle: RemoteHandle) -> RemoteHandle:
         if not handle.terminal:
@@ -1046,3 +2044,17 @@ class RemoteDispatcher:
     def wait_all(self, handles: Sequence[RemoteHandle],
                  timeout: Optional[float] = None) -> List[RemoteHandle]:
         return [self.wait(h, timeout=timeout) for h in handles]
+
+    def close(self) -> None:
+        """Drop every client's persistent connection (no-op for legacy
+        clients and stubs). The dispatcher stays usable — the next RPC
+        reconnects lazily."""
+        with self._lock:
+            clients = list(self.clients)
+        for client in clients:
+            closer = getattr(client, "close", None)
+            if callable(closer):
+                try:
+                    closer()
+                except Exception:           # noqa: BLE001 — best effort
+                    pass

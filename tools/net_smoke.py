@@ -26,15 +26,27 @@ asserts:
 4. the SIGKILLed replica is really dead, its circuit breaker opened,
    and ``hvd.doctor()`` ranks the breaker event as a finding.
 
-Exit status 0 = all checks pass. Wired as ``make net-smoke`` and as
-tier-1 ``tests/test_transport.py::TestNetSmoke``.
+A second scenario (:func:`run_stream_smoke`) exercises the v2 push
+transport: two replicas, one streamed 48-token request whose serving
+replica is SIGKILLed mid-stream (at the 8th pushed token), and the
+client must resume on the survivor with the pushed token stream still
+exactly-once, in-order, and byte-identical to an offline greedy
+``generate()``. The same pair then proves the shared dispatcher state
+bus: dispatcher B — a fresh frontend whose breakers never saw the kill
+— routes its first request around the dead replica purely from
+dispatcher A's gossiped down mark, without spending a probe on it.
+
+Exit status 0 = all checks pass. Wired as ``make net-smoke`` (both
+scenarios) and as tier-1 ``tests/test_transport.py::TestNetSmoke``.
 """
 
 import os
+import signal
 import subprocess
 import sys
 import tempfile
 import textwrap
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -246,6 +258,212 @@ def run_smoke(workdir: str, timeout_s: float = 300.0):
     return 0, ""
 
 
+# ---------------------------------------------------------------------------
+# scenario 2: v2 push stream under a mid-stream kill + dispatcher gossip
+# ---------------------------------------------------------------------------
+
+STREAM_PROMPT = [5, 17, 42, 9]
+STREAM_MAX_NEW = 48            # long enough that token 8 is mid-stream
+
+
+def run_stream_smoke(workdir: str, timeout_s: float = 300.0):
+    """Two replicas, no fault plan — the kill is aimed by the client:
+    the streamed request's 8th PUSHED token SIGKILLs whichever replica
+    is serving it, so the failure always lands mid-stream. Asserts:
+
+    1. the client's ``on_token`` stream stays exactly-once and in-order
+       across the failover (index dedup over the hedge/replay);
+    2. the final tokens are byte-identical to offline greedy
+       ``generate()`` with the same seeded params;
+    3. dispatcher B — fresh breakers, same state-bus file — serves its
+       first request from the survivor WITHOUT probing the dead
+       replica: A's gossiped down mark is its only knowledge.
+    """
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu import metrics
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.transport import (
+        CircuitBreaker, RemoteClient, RemoteDispatcher)
+
+    metrics.reset_metrics()
+    root = os.path.join(workdir, "stream-root")
+    os.makedirs(root, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("HOROVOD_FAULT_PLAN", None)    # this scenario kills by hand
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(rank), root],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+        for rank in (0, 1)]
+    deadline = time.monotonic() + timeout_s
+
+    def fail(msg):
+        print(f"net-smoke-stream FAIL: {msg}", file=sys.stderr)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        texts = [msg]
+        for i, p in enumerate(procs):
+            try:
+                out = p.communicate(timeout=10)[0]
+            except subprocess.TimeoutExpired:
+                out = "<no output>"
+            print(f"--- replica {i} output ---\n{out}", file=sys.stderr)
+            texts.append(out or "")
+        return 1, "\n".join(texts)
+
+    # Offline greedy reference with the SAME seeded params the workers
+    # build (PRNGKey(0), tiny config): the streamed bytes must match.
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    want = [int(t) for t in np.asarray(generate(
+        model, params, jnp.asarray([STREAM_PROMPT], jnp.int32),
+        STREAM_MAX_NEW))[0, len(STREAM_PROMPT):]]
+
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(root, f"ready.rank{r}"))
+               for r in (0, 1)):
+            break
+        if any(p.poll() is not None for p in procs):
+            return fail("a replica exited during startup")
+        time.sleep(0.1)
+    else:
+        return fail("replicas not ready in time")
+
+    addresses = []
+    for r in (0, 1):
+        with open(os.path.join(root, f"port.rank{r}")) as f:
+            addresses.append(("127.0.0.1", int(f.read().strip())))
+
+    bus_path = os.path.join(root, "membership.json")
+
+    def make_clients(tag):
+        # failures=1: the first connect refusal after the kill opens the
+        # breaker; reset_s=30 keeps the down mark honest for the whole
+        # scenario (the gossip horizon is the breaker reset window).
+        return [RemoteClient(addresses[r], name=f"rank{r}",
+                             rpc_timeout=1.0, max_retries=2,
+                             breaker=CircuitBreaker(
+                                 f"{tag}-rank{r}", failures=1,
+                                 reset_s=30.0))
+                for r in (0, 1)]
+
+    disp_a = RemoteDispatcher(clients=make_clients("a"), hedge_ms=0.0,
+                              state_bus=bus_path)
+
+    # 1. streamed request; its 8th pushed token kills the serving
+    #    replica, so the stream is cut mid-flight every run.
+    events = []
+    killed = threading.Event()
+    handle = disp_a.submit(STREAM_PROMPT, STREAM_MAX_NEW,
+                           deadline_s=240.0, request_id="stream-0")
+    if handle.terminal:
+        return fail(f"streamed submit bounced: {handle.status} "
+                    f"({handle.reason})")
+    victim_name = handle.served_by
+    victim = int(victim_name[-1])
+    survivor = 1 - victim
+
+    def on_token(i, tok):
+        events.append((i, int(tok)))
+        if i >= 8 and not killed.is_set():
+            killed.set()
+            os.kill(procs[victim].pid, signal.SIGKILL)
+
+    handle.on_token = on_token
+    disp_a.wait(handle)
+
+    if not killed.is_set():
+        return fail("stream finished before the kill could land "
+                    f"(saw {len(events)} pushed tokens)")
+    try:
+        procs[victim].wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        return fail(f"replica {victim} survived its SIGKILL")
+    if handle.status != "done":
+        return fail(f"streamed request ended {handle.status} "
+                    f"({handle.reason}) instead of done")
+    if handle.tokens != want:
+        return fail(f"streamed tokens diverge from offline generate(): "
+                    f"{handle.tokens[:8]}... vs {want[:8]}...")
+    idx = [i for i, _ in events]
+    if sorted(idx) != list(range(STREAM_MAX_NEW)):
+        dupes = sorted({i for i in idx if idx.count(i) > 1})
+        missing = sorted(set(range(STREAM_MAX_NEW)) - set(idx))
+        return fail(f"on_token stream not exactly-once: dupes={dupes} "
+                    f"missing={missing}")
+    if idx != sorted(idx):
+        return fail("on_token indices fired out of order")
+    if [t for _, t in sorted(events)] != want:
+        return fail("on_token payloads diverge from offline generate()")
+    if handle.resubmits < 1:
+        return fail("kill mid-stream did not force a failover resubmit")
+    if handle.served_by != f"rank{survivor}":
+        return fail(f"final serve credited to {handle.served_by}, "
+                    f"expected rank{survivor}")
+
+    # 2. dispatcher A gossips the death; dispatcher B — fresh breakers,
+    #    fresh clients — must route around the corpse on its FIRST
+    #    request, purely from the bus.
+    disp_b = RemoteDispatcher(clients=make_clients("b"), hedge_ms=0.0,
+                              state_bus=bus_path)
+    gossip_by = time.monotonic() + 15.0
+    while time.monotonic() < gossip_by \
+            and not disp_b.bus.is_down(victim_name):
+        disp_a._ranked()               # drive A's probes -> bus publish
+        time.sleep(0.3)
+    if not disp_b.bus.is_down(victim_name):
+        return fail("dispatcher A never gossiped the dead replica onto "
+                    "the state bus")
+    h2 = disp_b.submit(list(STREAM_PROMPT), 16, deadline_s=120.0,
+                       request_id="stream-b0")
+    disp_b.wait(h2)
+    if h2.status != "done":
+        return fail(f"dispatcher B request ended {h2.status} "
+                    f"({h2.reason})")
+    if h2.served_by != f"rank{survivor}":
+        return fail(f"dispatcher B served by {h2.served_by}, expected "
+                    f"rank{survivor}")
+    b_victim = disp_b.clients[victim]
+    if b_victim.breaker.state != "closed":
+        return fail("dispatcher B's breaker for the dead replica moved "
+                    f"to {b_victim.breaker.state} — it probed instead "
+                    "of trusting the bus")
+    if b_victim._conn is not None:
+        return fail("dispatcher B opened a connection to the dead "
+                    "replica despite the gossiped down mark")
+    snap = metrics.snapshot()
+    routed = sum(s.get("value", 0)
+                 for s in snap.get("counters", {}).get(
+                     "transport_bus_total", [])
+                 if s.get("labels", {}).get("event") == "route_around")
+    if routed < 1:
+        return fail("transport_bus_total{event=route_around} never "
+                    "incremented")
+
+    print(f"net-smoke-stream OK: {STREAM_MAX_NEW} tokens exactly-once "
+          f"across a mid-stream kill of rank{victim} "
+          f"({handle.resubmits} resubmit(s)), byte-identical to offline "
+          f"generate(); dispatcher B routed around rank{victim} via the "
+          f"state bus without a probe ({int(routed)} route-around(s))")
+    disp_a.close()
+    disp_b.close()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return 0, ""
+
+
 def _attempt():
     # Fresh workdir per attempt: a retry must not reuse the failed
     # attempt's ports/state files.
@@ -253,10 +471,19 @@ def _attempt():
         return run_smoke(td)
 
 
+def _attempt_stream():
+    with tempfile.TemporaryDirectory(prefix="hvd_net_smoke_v2_") as td:
+        return run_stream_smoke(td)
+
+
 def main() -> int:
     sys.path.insert(0, os.path.join(REPO, "tools"))
     import smoke_util
-    return smoke_util.main_with_retry(_attempt, name="net-smoke")
+    rc = smoke_util.main_with_retry(_attempt, name="net-smoke")
+    if rc != 0:
+        return rc
+    return smoke_util.main_with_retry(_attempt_stream,
+                                      name="net-smoke-stream")
 
 
 if __name__ == "__main__":

@@ -58,10 +58,14 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
 
     ``transport`` selects the path between the load generator and the
     engine: ``none`` (direct ``engine.submit``, the PR 4 baseline),
-    ``spool`` (the filesystem replica protocol), or ``socket`` (the
-    JSON-over-TCP transport through a ``RemoteDispatcher``) — same
-    Poisson load, so the lines are comparable and the delta IS the
-    transport's latency cost.
+    ``spool`` (the filesystem replica protocol), ``socket`` (legacy
+    one-shot JSON-over-TCP through a ``RemoteDispatcher``), or
+    ``stream`` (the v2 persistent multiplexed wire with server-push
+    tokens) — same Poisson load, so the lines are comparable and the
+    delta IS the transport's latency cost. Socket/stream rows also
+    record ``ttft_client_s``: first-token latency as the CLIENT sees
+    it, which is where the legacy poll interval shows up and the v2
+    push removes it.
 
     ``prefix_overlap=R`` makes fraction R of the requests share one
     long preamble (4 blocks of tokens) ahead of their individual tails
@@ -110,11 +114,16 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         from horovod_tpu.serving.replica import ReplicaServer
         root = tempfile.mkdtemp(prefix="hvd_serve_bench_spool_")
         srv = ReplicaServer(root, 0, eng, heartbeat_s=0.5).start()
-    elif transport == "socket":
+    elif transport in ("socket", "stream"):
         from horovod_tpu.serving.transport import (
-            RemoteDispatcher, SocketReplicaServer)
+            RemoteClient, RemoteDispatcher, SocketReplicaServer)
         srv = SocketReplicaServer(eng, 0).start()
-        disp = RemoteDispatcher([srv.address])
+        # Pin the wire explicitly: "socket" means the legacy one-shot
+        # JSON protocol even when the config default is stream, so the
+        # socket-vs-stream rows measure the wire, not the default knob.
+        wire = "legacy" if transport == "socket" else "stream"
+        disp = RemoteDispatcher(
+            clients=[RemoteClient(srv.address, transport=wire)])
     elif transport != "none":
         raise ValueError(f"unknown transport {transport!r}")
 
@@ -183,7 +192,9 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
             disp.wait(h, timeout=600)
             outs.append({"status": h.status, "tokens": len(h.tokens),
                          "ttft": h.ttft, "tpot": h.tpot,
+                         "ttft_client": h.ttft_client,
                          "queue_wait": None})
+        disp.close()
     wall = time.perf_counter() - t0
     if srv is not None:
         srv.stop()                      # stops the engine too
@@ -199,6 +210,9 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "metric": metric,
         "value": round(tokens / wall, 2),
         "unit": "tokens/sec", "vs_baseline": None,
+        # proxy: bench_sentinel gates this row — a >10% throughput drop
+        # at equal settings (transport included) fails the build
+        "proxy": True,
         "transport": transport,
         "requests": requests, "completed": len(done),
         "rejected": sum(1 for o in outs
@@ -216,6 +230,8 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "ttft_mean_s": (round(sum(ttfts) / len(ttfts), 6)
                         if ttfts else None),
         "ttft_s": _summary(ttfts),
+        "ttft_client_s": _summary([o["ttft_client"] for o in done
+                                   if o.get("ttft_client") is not None]),
         "tpot_s": _summary([o["tpot"] for o in done
                             if o["tpot"] is not None]),
         "queue_wait_s": _summary([o["queue_wait"] for o in done
@@ -244,10 +260,12 @@ def _build_parser():
                    help="shared KV pool size (default: dense equivalent)")
     p.add_argument("--model-size", choices=["tiny", "medium"],
                    default="tiny")
-    p.add_argument("--transport", choices=["none", "spool", "socket"],
+    p.add_argument("--transport",
+                   choices=["none", "spool", "socket", "stream"],
                    default="none",
                    help="path between load generator and engine: direct "
-                   "submit, filesystem spool, or socket RPC")
+                   "submit, filesystem spool, legacy socket RPC, or the "
+                   "v2 multiplexed push stream")
     p.add_argument("--prefix-overlap", type=float, default=0.0,
                    help="fraction of requests sharing a 4-block preamble")
     p.add_argument("--prefix-cache", action="store_true",
