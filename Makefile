@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
 	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
-	bench-sentinel test native
+	mp-smoke bench-sentinel test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -98,6 +98,15 @@ fleet-smoke:
 # tests/test_prefix.py::TestPrefixSmoke.
 prefix-smoke:
 	$(PY) tools/prefix_smoke.py
+
+# dp×mp mesh smoke: 2 CPU processes on a dp=1×mp=2 named mesh
+# (HOROVOD_MESH=dp1xmp2). ZeRO-3 GPT-2 training bit-exact in fp32 vs the
+# 1-proc replicated baseline, tensor-parallel serving token-identical to
+# offline generate() with decode_compiles == 1 (prefix cache + spec lane
+# on) and per-rank param bytes <= 0.55x replicated. Also runs in tier-1
+# as tests/test_mp.py::TestTwoProcessMpSmoke.
+mp-smoke:
+	$(PY) tools/mp_smoke.py
 
 # Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
 # metric's newest line degrades >10% vs the latest prior line at equal

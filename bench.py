@@ -140,9 +140,12 @@ def _collective_counters():
                 or cfg.allreduce_wire)
         topo = (_core.topology_str() if _core.is_initialized()
                 else (cfg.topology or ""))
+        mesh = (_core.mesh_spec() if _core.is_initialized()
+                else (cfg.mesh or ""))
         return {"allreduce_alg": cfg.allreduce_algorithm,
                 "wire": wire,
                 "topology": topo,
+                "mesh": mesh,
                 "overlap_chunks": cfg.overlap_chunks,
                 "allreduce_wire_bytes": int(wire_bytes),
                 "allreduce_wire_bytes_by_phase": wire_bytes_by_phase,
@@ -741,6 +744,8 @@ def _apply_comm_flags(args):
         os.environ["HOROVOD_OVERLAP_CHUNKS"] = str(args.overlap_chunks)
     if getattr(args, "topology", None):
         os.environ["HOROVOD_TOPOLOGY"] = args.topology
+    if getattr(args, "mesh", None):
+        os.environ["HOROVOD_MESH"] = args.mesh
 
 
 #: --sweep-comm measures one line per algorithm (auto is skipped: it
@@ -949,6 +954,8 @@ def _supervise(args) -> int:
         cmd += ["--overlap-chunks", str(args.overlap_chunks)]
     if getattr(args, "topology", None):
         cmd += ["--topology", args.topology]
+    if getattr(args, "mesh", None):
+        cmd += ["--mesh", args.mesh]
     if getattr(args, "sweep_comm", False):
         cmd += ["--sweep-comm"]
     if getattr(args, "serve", False):
@@ -1022,6 +1029,9 @@ def _build_parser():
     p.add_argument("--topology", dest="topology", default=None,
                    help="torus-dims override like 2x4 "
                         "(HOROVOD_TOPOLOGY); must factor the world size")
+    p.add_argument("--mesh", dest="mesh", default=None,
+                   help="dp×mp mesh like dp2xmp4 (HOROVOD_MESH); "
+                        "dp*mp must equal the world size")
     p.add_argument("--sweep-comm", dest="sweep_comm", action="store_true",
                    help="one JSON line per allreduce algorithm "
                         f"({', '.join(SWEEP_ALGS)}) for the selected "

@@ -14,7 +14,12 @@ from horovod_tpu.core import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, mesh, axis_name, build_info, in_spmd_context,
     topology, topology_str,
+    mesh2d, mesh_spec, dp_size, mp_size, dp_rank, mp_rank,
 )
+# dp×mp multi-axis sharding: model-parallel partition rules, ZeRO-2/3
+# training helpers, and tensor-parallel serving splits on the named 2-d
+# mesh (hvd.parallel.mp — docs/PARALLELISM.md).
+from horovod_tpu import parallel  # noqa: F401
 from horovod_tpu.collective import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
     allreduce, allreduce_, allreduce_async, grouped_allreduce,

@@ -159,6 +159,26 @@ class _SaveJob(NamedTuple):
     rank: int
     attempt: int                        # elastic restart count (receipt salt)
     enqueued_at: float
+    mesh: Optional[Tuple[int, int]]     # (dp, mp) axes behind num_shards
+
+
+def _coerce_mesh(mesh, num_shards: int) -> Optional[Tuple[int, int]]:
+    """Normalize a ``mesh=`` argument — ``"dpXxmpY"`` string or
+    ``(dp, mp)`` tuple — against a shard count. ``None`` defaults to the
+    dp-only factoring every pre-mesh checkpoint implicitly used."""
+    if mesh is None:
+        return (int(num_shards), 1) if num_shards else None
+    if isinstance(mesh, str):
+        from horovod_tpu.parallel.mesh import parse_mesh
+        dp, mp = parse_mesh(mesh)
+    else:
+        dp, mp = int(mesh[0]), int(mesh[1])
+    if num_shards and dp * mp != int(num_shards):
+        raise ValueError(
+            f"mesh dp{dp}xmp{mp} describes {dp * mp} shards but the "
+            f"checkpoint has num_shards={num_shards}; the mesh must "
+            f"factor the shard count exactly")
+    return (dp, mp)
 
 
 class ShardedCheckpointManager:
@@ -189,6 +209,7 @@ class ShardedCheckpointManager:
              unpadded: Optional[Dict[str, int]] = None,
              num_shards: Optional[int] = None,
              owned: Optional[List[int]] = None,
+             mesh=None,
              wait: bool = False) -> None:
         """Snapshot ``shards`` (shard-major pytree: every array leaf is
         ``(num_shards, ...)``) and ``replicated`` (any pytree; written by
@@ -197,8 +218,13 @@ class ShardedCheckpointManager:
         ``meta`` is a JSON-able dict published in the manifest (step
         counters, RNG key, data-stream cursor). ``unpadded`` maps a shard
         leaf's key to its true flat length so N→M resharding can strip
-        world-size-dependent padding. ``wait=True`` blocks until the
-        manifest is published (rank 0) / this rank's receipt is written.
+        world-size-dependent padding. ``mesh`` (a ``"dpXxmpY"`` string or
+        ``(dp, mp)`` tuple; defaults to dp-only) records which dp x mp
+        factoring produced the shards — published as ``mesh_axes`` in
+        receipts and manifest, cross-checked at publish so two ranks
+        saving under different meshes fail loudly instead of tearing the
+        step. ``wait=True`` blocks until the manifest is published
+        (rank 0) / this rank's receipt is written.
 
         Donation caveat: the async path snapshots *references* and starts
         the D2H copies immediately, so with an ordinary functional step
@@ -259,7 +285,8 @@ class ShardedCheckpointManager:
                        rank=pid,
                        attempt=int(os.environ.get(
                            "HVD_TPU_ELASTIC_RESTART", "0")),
-                       enqueued_at=time.perf_counter())
+                       enqueued_at=time.perf_counter(),
+                       mesh=_coerce_mesh(mesh, int(num_shards)))
         self._ensure_thread()
         self._q.put(job)
         from horovod_tpu import metrics as _metrics
@@ -370,6 +397,7 @@ class ShardedCheckpointManager:
                     pass
         ok = {"rank": job.rank, "num_ranks": job.num_ranks,
               "attempt": job.attempt,
+              "mesh_axes": list(job.mesh) if job.mesh else None,
               "files": files, "leaves": leaves,
               "wall_time": time.time()}
         ok_tmp = os.path.join(
@@ -424,6 +452,19 @@ class ShardedCheckpointManager:
         files: Dict[str, Dict[str, Any]] = {}
         leaves: Dict[str, Dict[str, Any]] = {}
         for r in sorted(receipts):
+            # Every rank must have sliced the SAME dp x mp factoring:
+            # a mixed-axis step (one rank on the old mesh, one on the
+            # new) would publish shards that silently interleave two
+            # layouts — fail loudly naming the axis instead.
+            rm = receipts[r].get("mesh_axes")
+            if job.mesh is not None and rm is not None \
+                    and tuple(rm) != tuple(job.mesh):
+                axis = "dp" if int(rm[0]) != job.mesh[0] else "mp"
+                raise ValueError(
+                    f"step {job.step}: {axis} axis mismatch — rank {r} "
+                    f"saved under mesh dp{int(rm[0])}xmp{int(rm[1])} "
+                    f"but this job is dp{job.mesh[0]}xmp{job.mesh[1]}; "
+                    f"not publishing a mixed-axis manifest")
             files.update(receipts[r]["files"])
             leaves.update(receipts[r]["leaves"])
         manifest = {
@@ -431,6 +472,7 @@ class ShardedCheckpointManager:
             "step": job.step,
             "num_shards": job.num_shards,
             "num_ranks": job.num_ranks,
+            "mesh_axes": list(job.mesh) if job.mesh else None,
             "dir": _step_dirname(job.step),
             "files": files,
             "leaves": leaves,
@@ -512,13 +554,22 @@ class ShardedCheckpointManager:
 
     def restore(self, step: Optional[int] = None, *,
                 num_shards: Optional[int] = None,
+                mesh=None,
                 shards_template=None, replicated_template=None) -> Restored:
         """Load a published step, resharding to ``num_shards`` when it
         differs from the manifest's world size. Without templates the
         shard/replicated trees come back as ``{keystr: np.ndarray}``;
         with templates they are unflattened into the template structure
         (keys must match exactly — a checkpoint from a different model
-        fails loudly)."""
+        fails loudly).
+
+        ``mesh`` names the TARGET dp x mp factoring (``"dpXxmpY"`` or a
+        ``(dp, mp)`` tuple) and implies ``num_shards = dp * mp`` —
+        cross-axis restores (save on dp1 x mp1, restore on dp2 x mp2)
+        ride the same flat reshard: shard files are rank-major flat
+        chunks, and re-chunking a flat vector is mesh-agnostic and
+        bit-exact. A manifest whose recorded ``mesh_axes`` do not
+        factor its shard count is rejected loudly."""
         from horovod_tpu import metrics as _metrics
         t0 = time.perf_counter()
         if step is None:
@@ -527,6 +578,25 @@ class ShardedCheckpointManager:
                 raise FileNotFoundError(
                     f"no published checkpoint in {self.directory}")
         manifest = self.read_manifest(step)
+        saved_axes = manifest.get("mesh_axes")
+        if saved_axes is not None and int(manifest["num_shards"]) and \
+                int(saved_axes[0]) * int(saved_axes[1]) \
+                != int(manifest["num_shards"]):
+            raise ValueError(
+                f"step {step}: manifest mesh axes "
+                f"dp{int(saved_axes[0])}xmp{int(saved_axes[1])} describe "
+                f"{int(saved_axes[0]) * int(saved_axes[1])} shards but "
+                f"num_shards={int(manifest['num_shards'])} — the dp/mp "
+                f"axes do not factor the shard count; the manifest is "
+                f"mixed-axis or corrupt, refusing to restore")
+        if mesh is not None:
+            tdp, tmp = _coerce_mesh(mesh, 0)
+            if num_shards is not None and int(num_shards) != tdp * tmp:
+                raise ValueError(
+                    f"restore mesh dp{tdp}xmp{tmp} implies "
+                    f"{tdp * tmp} shards but num_shards={num_shards} "
+                    f"was also passed; drop one or make them agree")
+            num_shards = tdp * tmp
         step_dir = os.path.join(self.directory, manifest["dir"])
         missing = [f for f in manifest["files"]
                    if not os.path.exists(os.path.join(step_dir, f))]

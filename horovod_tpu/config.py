@@ -66,6 +66,19 @@ class Config:
     # tuple lives on the init context (core.topology()) because the
     # product must be validated against the actual world size at init.
     topology: Optional[str] = None
+    # Multi-axis mesh (parallel/mesh.py, parallel/mp.py):
+    # HOROVOD_MESH="dpXxmpY" splits the world into a named dp x mp mesh —
+    # data-parallel outer (DCN tolerant), model/tensor-parallel inner
+    # (ICI hungry). Stored as the normalized spec string; the degrees
+    # must factor the actual world and nest with the detected topology,
+    # which is validated at init (core.mesh2d()). Unset = pure dp
+    # (dp=world, mp=1), the pre-mesh behaviour.
+    # HOROVOD_MP_RULES picks the model-parallel rule set mp.partition
+    # helpers use: "auto" (per model family), "megatron" (the explicit
+    # column/row split), or "off" (replicate weights even under mp>1 —
+    # a debugging escape hatch).
+    mesh: Optional[str] = None
+    mp_rules: str = "auto"
     # Timeline (timeline.cc): HOROVOD_TIMELINE=<path> starts the Chrome
     # trace at init; HOROVOD_TIMELINE_MARK_CYCLES adds cycle markers.
     timeline_path: Optional[str] = None
@@ -241,6 +254,28 @@ def _env_topology() -> Optional[str]:
     return "x".join(str(d) for d in dims)
 
 
+def _env_mesh() -> Optional[str]:
+    v = os.environ.get("HOROVOD_MESH", "").strip().lower()
+    if not v:
+        return None
+    from horovod_tpu.parallel.mesh import format_mesh, parse_mesh
+    dp, mp = parse_mesh(v)   # grammar check: a typo'd spec fails here
+    # World/topology fit is validated at init() (needs devices).
+    return format_mesh(dp, mp)
+
+
+_MP_RULE_SETS = ("auto", "megatron", "off")
+
+
+def _env_mp_rules() -> str:
+    v = (os.environ.get("HOROVOD_MP_RULES", "auto").strip().lower()
+         or "auto")
+    if v not in _MP_RULE_SETS:
+        raise ValueError(
+            f"HOROVOD_MP_RULES={v!r}: expected one of {_MP_RULE_SETS}")
+    return v
+
+
 def _env_chunks() -> int:
     v = os.environ.get("HOROVOD_OVERLAP_CHUNKS")
     if not v:
@@ -359,6 +394,8 @@ def refresh() -> Config:
         overlap_chunks=_env_chunks(),
         xla_latency_hiding=_env_bool("HOROVOD_XLA_LATENCY_HIDING"),
         topology=_env_topology(),
+        mesh=_env_mesh(),
+        mp_rules=_env_mp_rules(),
         timeline_path=os.environ.get("HOROVOD_TIMELINE") or None,
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
         trace_jax_profiler=_env_bool("HOROVOD_TRACE_JAX_PROFILER"),

@@ -39,6 +39,12 @@ __all__ = [
     "cross_rank",
     "cross_size",
     "mesh",
+    "mesh2d",
+    "mesh_spec",
+    "dp_size",
+    "mp_size",
+    "dp_rank",
+    "mp_rank",
     "axis_name",
     "build_info",
     "init_epoch",
@@ -66,6 +72,12 @@ class _Context:
     # Detected torus/mesh dims of the slice (parallel/mesh.py
     # detect_topology); (world,) when the fabric is a flat ring.
     topology: tuple = ()
+    # The named 2-D ("dp", "mp") mesh over the SAME devices (HOROVOD_MESH;
+    # dp=world x mp=1 when unset) and its (dp, mp) degrees. The 1-D
+    # communicator mesh above stays the collective/process-set substrate;
+    # the 2-D view is what parallel/mp.py shard_maps over.
+    mesh2d: Optional[Mesh] = None
+    mesh_dims: tuple = (1, 1)
     initialized: bool = True
 
 
@@ -165,8 +177,19 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         from horovod_tpu.parallel import mesh as _mesh_mod
         topo = _mesh_mod.detect_topology(len(devs), devs,
                                          override=cfg.topology)
+        # dp x mp factoring (HOROVOD_MESH): validated against the actual
+        # world and the detected torus HERE — a spec that does not factor
+        # the world or nest with ICI must fail at init, not at first
+        # collective. Explicit devices keep the rank map deterministic:
+        # rank r sits at (dp=r//mp, mp=r%mp).
+        if cfg.mesh:
+            _dp, _mp = _mesh_mod.parse_mesh(cfg.mesh)
+            _mesh_mod.validate_mesh(_dp, _mp, len(devs), topo)
+        else:
+            _dp, _mp = len(devs), 1
+        m2 = _mesh_mod.make_mesh2d(_dp, _mp, devs)
         _CTX = _Context(mesh=m, axis=axis_name, devices=devs,
-                        topology=topo)
+                        topology=topo, mesh2d=m2, mesh_dims=(_dp, _mp))
         # Reset process sets to just the global one and drop compiled
         # collectives bound to a previous mesh.
         from horovod_tpu import collective as _coll
@@ -247,6 +270,10 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
                 topo[_i] if _i < len(topo) else 0)
         _metrics.gauge("config_xla_latency_hiding").set(
             1 if lhs_applied else 0)
+        # Resolved dp x mp degrees — hvd.doctor()'s _check_sharding reads
+        # config_mesh_mp to tell "replicated by choice" from "sharded".
+        _metrics.gauge("config_mesh_dp").set(_dp)
+        _metrics.gauge("config_mesh_mp").set(_mp)
         # Exported so an OFFLINE doctor (perf_doctor over flusher files)
         # can judge checkpoint cadence against the same budget.
         _metrics.gauge("config_preemption_notice_seconds").set(
@@ -281,6 +308,45 @@ def is_initialized() -> bool:
 def mesh() -> Mesh:
     """The global 1-D communicator mesh."""
     return _ctx().mesh
+
+
+def mesh2d() -> Mesh:
+    """The named 2-D ``("dp", "mp")`` mesh over the same devices as
+    :func:`mesh` (``HOROVOD_MESH``; dp=world x mp=1 when unset)."""
+    return _ctx().mesh2d
+
+
+def mesh_spec() -> str:
+    """The active dp x mp factoring as a ``"dpXxmpY"`` spec string."""
+    from horovod_tpu.parallel.mesh import format_mesh
+    dp, mp = _ctx().mesh_dims
+    return format_mesh(dp, mp)
+
+
+def dp_size() -> int:
+    """Data-parallel degree of the active mesh (world when no mesh)."""
+    return _ctx().mesh_dims[0]
+
+
+def mp_size() -> int:
+    """Model/tensor-parallel degree of the active mesh (1 when no mesh)."""
+    return _ctx().mesh_dims[1]
+
+
+def dp_rank() -> int:
+    """This process's first local device's dp coordinate (host-side)."""
+    ctx = _ctx()
+    return _flat_rank() // ctx.mesh_dims[1]
+
+
+def mp_rank() -> int:
+    """This process's first local device's mp coordinate (host-side)."""
+    ctx = _ctx()
+    return _flat_rank() % ctx.mesh_dims[1]
+
+
+def _flat_rank() -> int:
+    return jax.process_index() * jax.local_device_count()
 
 
 def axis_name() -> str:
@@ -384,6 +450,11 @@ def build_info() -> dict:
         # the HOROVOD_TOPOLOGY override if any (detection needs devices).
         "topology": (topology_str() if _CTX is not None
                      else (cfg.topology or None)),
+        # Resolved dp x mp factoring ("dp8xmp1") once init() has run;
+        # before init, the HOROVOD_MESH override if any (the degrees
+        # need the world size to resolve).
+        "mesh": (mesh_spec() if _CTX is not None else (cfg.mesh or None)),
+        "mp_rules": cfg.mp_rules,
         "xla_latency_hiding": cfg.xla_latency_hiding,
         "autotune": cfg.autotune,
         "autotune_mode": cfg.autotune_mode,

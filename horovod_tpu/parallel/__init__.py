@@ -11,7 +11,18 @@ from horovod_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_adamw, fsdp_apply, fsdp_scan_blocks, fsdp_shard_params,
     stack_layer_shards,
 )
-from horovod_tpu.parallel.mesh import make_mesh  # noqa: F401
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    detect_topology, format_mesh, make_mesh, make_mesh2d, parse_mesh,
+    validate_mesh,
+)
+from horovod_tpu.parallel.mp import (  # noqa: F401
+    MP_AXIS, gather_shard, merge_params, mp_broadcast, mp_fetch,
+    mp_partition_rules, mp_stack, param_bytes, split_params, tp_decode_step,
+    tp_decode_verify_step, validate_tp, wrap_spmd,
+    zero2_grad_shard, zero2_update,
+    zero3_adamw, zero3_apply, zero3_scan_blocks, zero3_shard_params,
+    zero3_stack_layer_shards,
+)
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     chunkable_loss, pipeline_1f1b, pipeline_apply,
     pipeline_interleaved_1f1b, pipeline_loss, pipeline_loss_interleaved,

@@ -1,5 +1,6 @@
-"""FSDP / ZeRO-3: parameters sharded across data-parallel ranks, gathered
-just-in-time per block.
+"""FSDP / ZeRO-3 engine room — use the ``zero3_*`` surface in ``parallel/mp.py``.
+
+Parameters sharded across ranks, gathered just-in-time per block.
 
 Extends the weight-update sharding ladder (PAPERS.md "Automatic
 Cross-Replica Sharding of Weight Update"; ZeRO-1 lives in

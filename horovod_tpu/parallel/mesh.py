@@ -21,7 +21,8 @@ from jax.sharding import Mesh
 log = logging.getLogger("horovod_tpu")
 
 __all__ = ["make_mesh", "parse_topology", "detect_topology",
-           "torus_groups"]
+           "torus_groups", "parse_mesh", "format_mesh", "validate_mesh",
+           "make_mesh2d"]
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None,
@@ -58,6 +59,74 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None,
             pass  # fall through to the naive reshape
     arr = np.asarray(devs, dtype=object).reshape(tuple(sizes))
     return Mesh(arr, names)
+
+
+# ---------------------------------------------------------------------------
+# dp x mp mesh specs (the HOROVOD_MESH axis)
+# ---------------------------------------------------------------------------
+
+def parse_mesh(spec: str) -> Tuple[int, int]:
+    """Parse a ``HOROVOD_MESH`` spec like ``"dp2xmp4"`` into ``(dp, mp)``.
+
+    Grammar is fixed to the two named axes — data-parallel first (DCN
+    tolerant), model-parallel last (ICI hungry) — so the string also
+    documents the placement contract.
+    """
+    import re
+    m = re.fullmatch(r"dp(\d+)xmp(\d+)", str(spec).strip().lower())
+    if not m:
+        raise ValueError(
+            f"invalid HOROVOD_MESH {spec!r}; expected 'dpXxmpY' like "
+            f"'dp2xmp4' (data-parallel degree X, model-parallel degree Y)")
+    dp, mp = int(m.group(1)), int(m.group(2))
+    if dp < 1 or mp < 1:
+        raise ValueError(
+            f"invalid HOROVOD_MESH {spec!r}: both degrees must be >= 1")
+    return dp, mp
+
+
+def format_mesh(dp: int, mp: int) -> str:
+    """``(dp, mp)`` -> the canonical ``"dpXxmpY"`` spec string."""
+    return f"dp{int(dp)}xmp{int(mp)}"
+
+
+def validate_mesh(dp: int, mp: int, world: int,
+                  topology: Optional[Sequence[int]] = None
+                  ) -> Tuple[int, int]:
+    """Check a dp x mp request against the world size and the detected
+    torus. ``dp * mp`` must equal ``world`` exactly, and when the fabric
+    has real topology dims the mp degree must nest with the innermost
+    (fastest-wraparound) dim — either filling whole inner rings
+    (``mp % inner == 0``) or subdividing one (``inner % mp == 0``) — so
+    the tensor-parallel collectives stay on contiguous ICI links.
+    """
+    if dp * mp != world:
+        raise ValueError(
+            f"HOROVOD_MESH {format_mesh(dp, mp)} needs {dp * mp} devices "
+            f"but the world has {world}; the mesh must factor the world "
+            f"exactly")
+    dims = tuple(int(d) for d in (topology or ()))
+    if mp > 1 and len(dims) > 1:
+        inner = dims[-1]
+        if mp % inner != 0 and inner % mp != 0:
+            raise ValueError(
+                f"HOROVOD_MESH {format_mesh(dp, mp)}: mp={mp} does not "
+                f"nest with the detected topology {'x'.join(map(str, dims))} "
+                f"(innermost dim {inner}); pick mp dividing {inner} or a "
+                f"multiple of it so tp collectives stay on ICI")
+    return dp, mp
+
+
+def make_mesh2d(dp: int, mp: int,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 2-D ``("dp", "mp")`` mesh for a validated dp x mp spec.
+
+    Device order is row-major over the flat communicator order: global
+    rank ``r`` sits at ``(dp=r // mp, mp=r % mp)``, so each mp group is a
+    contiguous run of ranks — on TPU the same contiguity that
+    :func:`validate_mesh` checked rides the innermost torus dim.
+    """
+    return make_mesh({"dp": int(dp), "mp": int(mp)}, devices)
 
 
 # ---------------------------------------------------------------------------

@@ -181,6 +181,11 @@ class ProgramRecord:
     #: tuning-driven rebuilds (AutotunedStep) recompile BY DESIGN; the
     #: doctor skips expected churn instead of flagging it
     expected_recompiles: bool = False
+    #: tensor-parallel degree the program runs at: cost analysis of a
+    #: shard_map program counts GLOBAL work, so recorded flops/bytes
+    #: were divided by this to stay per-device (what mfu compares
+    #: against one chip's peak)
+    mp_degree: int = 1
     signature: Optional[Dict[str, str]] = None
     #: every signature ever compiled — jax.jit caches all of them, so a
     #: REVISIT of a seen signature executes cached code and must read as
@@ -203,6 +208,7 @@ class ProgramRecord:
             "blame_detail": {k: list(v) for k, v in
                              self.blame_detail.items()},
             "expected_recompiles": self.expected_recompiles,
+            "mp_degree": self.mp_degree,
             "signatures_seen": len(self.seen_signatures),
             "last_step_seconds": self.last_step_seconds,
             "steps": self.steps, "meta": dict(self.meta),
@@ -368,19 +374,28 @@ class ProgramRegistry:
     def record_cost(self, name: str, compiled, *,
                     model_flops: Optional[float] = None,
                     expected_mfu: Optional[float] = None,
-                    kind: str = "step") -> ProgramRecord:
+                    kind: str = "step",
+                    mp_degree: int = 1) -> ProgramRecord:
         """Attach a compiled program's cost/memory analysis to the record
         and publish the static gauges (``program_flops``,
-        ``program_bytes_accessed``, ``program_peak_hbm_bytes``)."""
+        ``program_bytes_accessed``, ``program_peak_hbm_bytes``).
+
+        ``mp_degree`` is the tensor-parallel degree of a shard_map
+        program: its cost analysis counts GLOBAL work (all shards), but
+        each device executes 1/mp of it per step — recorded flops/bytes
+        (and ``model_flops``) are divided down so ``program_mfu``/
+        ``program_hfu`` stay honest against ONE chip's peak."""
         from horovod_tpu import metrics as _metrics
         cost = cost_from(compiled)
+        deg = max(1, int(mp_degree))
         with self._lock:
             rec = self.program(name, kind)
-            rec.flops = cost["flops"]
-            rec.bytes_accessed = cost["bytes_accessed"]
-            rec.peak_hbm_bytes = cost["peak_hbm_bytes"]
+            rec.mp_degree = deg
+            rec.flops = cost["flops"] / deg
+            rec.bytes_accessed = cost["bytes_accessed"] / deg
+            rec.peak_hbm_bytes = cost["peak_hbm_bytes"] / deg
             if model_flops is not None:
-                rec.model_flops = float(model_flops)
+                rec.model_flops = float(model_flops) / deg
             if expected_mfu is not None:
                 rec.expected_mfu = float(expected_mfu)
                 # Exported so an OFFLINE doctor (fresh process, empty
@@ -1603,6 +1618,67 @@ def _check_memory(snap) -> List[Dict]:
         events=int(n))]
 
 
+def _check_sharding(snap) -> List[Dict]:
+    """Params replicated while the workload is memory-bound: every
+    other knob (remat, quant) trades compute or fidelity for memory —
+    once a program peaks near the device limit, or a KV-quantized
+    engine still rejects admissions, the honest fix is a mesh."""
+    mp = _gauge_value(snap, "config_mesh_mp")
+    if mp is not None and mp > 1:
+        return []                       # already model-sharded
+    dp = _gauge_value(snap, "config_mesh_dp") or 0.0
+    world = int(dp * max(1.0, mp or 1.0))
+    mesh = f"dp{world // 2}xmp2" if world >= 2 else "dp1xmp2"
+    out = []
+    limits = [float(s.get("value", 0)) for s in
+              _series(snap, "gauges", "device_hbm_bytes_limit")]
+    limit = max(limits) if limits else 0.0
+    worst_prog, worst_peak = None, 0.0
+    for s in _series(snap, "gauges", "program_peak_hbm_bytes"):
+        v = float(s.get("value", 0))
+        if v > worst_peak:
+            worst_peak = v
+            worst_prog = s.get("labels", {}).get("program", "?")
+    if limit > 0 and worst_peak >= 0.85 * limit:
+        out.append(_finding(
+            "sharding", 0.7,
+            f"params replicated while {worst_prog} peaks at "
+            f"{worst_peak / limit:.0%} of device HBM",
+            f"program_peak_hbm_bytes{{program={worst_prog}}} is within "
+            f"15% of the device limit and the mesh is "
+            f"data-parallel-only (config_mesh_mp <= 1): the next model "
+            f"or batch bump OOMs",
+            f"shard the model over the mesh: HOROVOD_MESH={mesh} "
+            f"splits every attention/MLP weight (and the serving KV "
+            f"pool) to 1/mp per chip with collective matmuls; see "
+            f"docs/PARALLELISM.md",
+            program=worst_prog, peak_hbm_bytes=worst_peak,
+            device_hbm_bytes_limit=limit))
+    for s in _series(snap, "gauges", "serve_kv_quant_enabled"):
+        if float(s.get("value", 0)) < 1:
+            continue
+        eng = s.get("labels", {}).get("engine", "?")
+        rej = _sum_counter(snap, "serve_requests_total", engine=eng,
+                           status="rejected")
+        cap = _gauge_value(snap, "serve_kv_pool_bytes_capacity",
+                           engine=eng)
+        if rej > 0 and cap:
+            out.append(_finding(
+                "sharding", 0.6,
+                f"engine {eng} rejects admissions with KV quant "
+                f"already on",
+                f"{int(rej)} rejection(s) while the KV pool is already "
+                f"quantized — the compression knob is spent, and the "
+                f"mesh is data-parallel-only; only more chips' worth "
+                f"of pool helps",
+                f"split the KV pool over the mesh: HOROVOD_MESH={mesh} "
+                f"gives each engine rank 1/mp of the kv heads (pool "
+                f"bytes drop likewise); see docs/PARALLELISM.md",
+                engine=eng, rejected=int(rej),
+                kv_pool_bytes_capacity=cap))
+    return out
+
+
 def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     """Automated performance diagnosis (``hvd.doctor()``).
 
@@ -1624,6 +1700,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_straggler(report)
     findings += _check_recompiles(snap, progs)
     findings += _check_memory(snap)
+    findings += _check_sharding(snap)
     findings += _check_recovery(snap)
     findings += _check_serving(snap)
     findings += _check_prefix(snap)

@@ -118,6 +118,32 @@ class InferenceEngine:
         self.cfg = model.cfg
         self.family = decode_family(self.cfg)
         self.family.validate(self.cfg)
+        # Tensor-parallel serving rides the runtime dp x mp mesh
+        # (HOROVOD_MESH): mp > 1 means every rank holds 1/mp of each
+        # weight and 1/mp of the KV pool (heads split over mp), and the
+        # decode program runs under shard_map with collective matmuls.
+        # An uninitialized runtime serves replicated, like always.
+        try:
+            from horovod_tpu import core as _core
+            self._mp = _core.mp_size()
+            self._mesh2d = _core.mesh2d() if self._mp > 1 else None
+            self._mesh_spec = _core.mesh_spec()
+        except Exception:
+            self._mp, self._mesh2d, self._mesh_spec = 1, None, None
+        if self._mp > 1:
+            from horovod_tpu import core as _core
+            from horovod_tpu.parallel import mp as _mp
+            if self.family.name == "t5":
+                raise NotImplementedError(
+                    "tensor-parallel serving is implemented for "
+                    "decoder-only families; run T5 engines on a "
+                    "dp-only mesh")
+            if _core.dp_size() != 1:
+                raise NotImplementedError(
+                    f"tensor-parallel serving needs a dp=1 mesh "
+                    f"(every engine rank is one mp shard); got "
+                    f"{self._mesh_spec}")
+            _mp.validate_tp(self.cfg, self._mp)
         self.slots = int(slots if slots is not None else hcfg.serve_slots)
         self.max_len = int(max_len if max_len is not None
                            else hcfg.serve_max_len)
@@ -169,17 +195,41 @@ class InferenceEngine:
                                     prefix_cache=self.prefix_enabled)
 
         layers = self.family.num_layers(self.cfg)
-        self._cache = PagedKVCache.create(
-            layers, self.family.kv_heads(self.cfg),
+        # The LOCAL (per-rank) cache: kv heads split over mp. Pool-byte
+        # accounting is snapshotted here — once the cache is mp-stacked
+        # its leading dim is the mesh axis, not the pool geometry.
+        local_cache = PagedKVCache.create(
+            layers, self.family.kv_heads(self.cfg) // self._mp,
             self.family.head_dim(self.cfg), slots=self.slots,
             num_blocks=self.num_blocks, block_size=self.block_size,
             max_blocks_per_slot=self.max_blocks_per_slot,
             dtype=self.cfg.dtype, quant=self.kv_quant)
-        self.view_len = self._cache.view_len
+        self.view_len = local_cache.view_len
+        self._pool_bytes = local_cache.pool_bytes
+        self._bytes_per_block = local_cache.bytes_per_block
 
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
-        self._step = decode_step(self.cfg)
-        self._verify = decode_verify_step(self.cfg)
+        if self._mp > 1:
+            from horovod_tpu.parallel import mp as _mp
+            self._mpmod = _mp
+            # Every rank's zero-initialized cache is identical, so the
+            # stacked layout is a plain broadcast; params are each
+            # rank's 1/mp Megatron slice.
+            self._cache = _mp.mp_broadcast(local_cache, self._mesh2d)
+            self.params = _mp.mp_stack(
+                lambda r: _mp.split_params(self.cfg, params,
+                                           self._mp, r),
+                self._mesh2d)
+            self._step = _mp.tp_decode_step(self.cfg)
+            self._verify = _mp.tp_decode_verify_step(self.cfg)
+        else:
+            self._mpmod = None
+            self._cache = local_cache
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+            self._step = decode_step(self.cfg)
+            self._verify = decode_verify_step(self.cfg)
+        self._param_bytes = sum(
+            int(l.nbytes) for l in
+            jax.tree_util.tree_leaves(self.params)) // self._mp
         self._extras = self._init_extras(max_src_len)
 
         self.queue = RequestQueue(queue_limit)
@@ -229,7 +279,7 @@ class InferenceEngine:
         # block copies into the same dispatch — fixed (slots,) vectors
         # padded with trash->trash no-ops, so CoW traffic never changes
         # the program signature either.
-        def _decode_pure(params, cache, tok_seq, pos0, counts, active,
+        def _decode_body(params, cache, tok_seq, pos0, counts, active,
                          cow_src, cow_dst, extras):
             cache = cache.copy_blocks(cow_src, cow_dst)
             base = active
@@ -239,6 +289,13 @@ class InferenceEngine:
 
             return self._verify(params, cache, tok_seq, pos0, counts,
                                 extras, mask_fn)
+
+        # mp > 1: the SAME body runs under shard_map over the mesh's mp
+        # axis — the tp steps' psums/all_gathers become collective
+        # matmuls inside the one jitted program, which is how
+        # decode_compiles == 1 survives tensor parallelism.
+        _decode_pure = _decode_body if self._mp == 1 else \
+            self._mpmod.wrap_spmd(_decode_body, self._mesh2d)
 
         def _decode_raw(params, cache, tok_seq, pos0, counts, active,
                         cow_src, cow_dst, extras):
@@ -253,7 +310,7 @@ class InferenceEngine:
         C, V = self.prefill_chunk, self.cfg.vocab_size
         view_len = self.view_len
 
-        def _prefill_pure(params, cache, tok_seq, pos0, count, active,
+        def _prefill_body(params, cache, tok_seq, pos0, count, active,
                           cow_src, cow_dst, extras):
             cache = cache.copy_blocks(cow_src, cow_dst)
             base = active
@@ -274,6 +331,9 @@ class InferenceEngine:
             (cache, final), _ = jax.lax.scan(body, (cache, zeros),
                                              jnp.arange(C))
             return cache, final, greedy_token(final).astype(jnp.int32)
+
+        _prefill_pure = _prefill_body if self._mp == 1 else \
+            self._mpmod.wrap_spmd(_prefill_body, self._mesh2d)
 
         def _prefill_raw(params, cache, tok_seq, pos0, count, active,
                          cow_src, cow_dst, extras):
@@ -669,10 +729,36 @@ class InferenceEngine:
         try:
             compiled = jax.jit(pure, donate_argnums=self._donate).lower(
                 *args).compile()
-            profiler.record_cost(prog, compiled, kind="serving")
+            profiler.record_cost(prog, compiled, kind="serving",
+                                 mp_degree=self._mp)
         except Exception:
             metrics.logger.debug("serve cost capture failed for %s",
                                  prog, exc_info=True)
+
+    def _dev(self, x):
+        """Host step vector -> the dispatch layout: plain device array
+        replicated, or mp-stacked (every row identical — the per-step
+        inputs are computed in host lockstep on every process)."""
+        if self._mp == 1:
+            return jnp.asarray(x)
+        return self._mpmod.mp_broadcast(np.asarray(x), self._mesh2d)
+
+    def _host(self, x) -> np.ndarray:
+        """Device output -> host numpy: one row of the mp stack (the tp
+        steps return replicated-content outputs — gathered logits and
+        greedy picks are identical on every rank)."""
+        if self._mp == 1:
+            return np.asarray(x)
+        return self._mpmod.mp_fetch(x)
+
+    def _device_table(self):
+        """The block table in dispatch layout. A dirty host table comes
+        back 2-D and needs the mp broadcast; a clean one is the adopted
+        jit-output mirror, already stacked."""
+        t = self.manager.device_table()
+        if self._mp > 1 and t.ndim == 2:
+            t = self._mpmod.mp_broadcast(np.asarray(t), self._mesh2d)
+        return t
 
     def _run_decode(self, lanes: List[Tuple[int, _SlotState]]) -> None:
         K = self.spec_k + 1
@@ -710,15 +796,15 @@ class InferenceEngine:
                 r = self.manager.ensure_writable(slot, q)
                 if r is not None:
                     cow_src[slot], cow_dst[slot] = r
-        cache = self._cache.replace(table=self.manager.device_table())
+        cache = self._cache.replace(table=self._device_table())
         cache, first, greedy = self._dispatch(
             "decode", self._decode_jit, self.params, cache,
-            jnp.asarray(tok_seq), jnp.asarray(pos0), jnp.asarray(counts),
-            jnp.asarray(act), jnp.asarray(cow_src), jnp.asarray(cow_dst),
+            self._dev(tok_seq), self._dev(pos0), self._dev(counts),
+            self._dev(act), self._dev(cow_src), self._dev(cow_dst),
             self._extras)
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
-        greedy_np = np.asarray(greedy)                   # (K, slots)
+        greedy_np = self._host(greedy)                   # (K, slots)
         logits_np = self._pull_logits_if_sampling(lanes, first)
         metrics.counter("serve_steps_total", engine=self.name,
                         phase="decode").inc()
@@ -778,15 +864,15 @@ class InferenceEngine:
                 r = self.manager.ensure_writable(slot, q)
                 if r is not None:
                     cow_src[slot], cow_dst[slot] = r
-        cache = self._cache.replace(table=self.manager.device_table())
+        cache = self._cache.replace(table=self._device_table())
         cache, final, greedy = self._dispatch(
             "prefill", self._prefill_jit, self.params, cache,
-            jnp.asarray(tok_seq), jnp.asarray(pos0), jnp.asarray(count),
-            jnp.asarray(act), jnp.asarray(cow_src), jnp.asarray(cow_dst),
+            self._dev(tok_seq), self._dev(pos0), self._dev(count),
+            self._dev(act), self._dev(cow_src), self._dev(cow_dst),
             self._extras)
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
-        greedy_np = np.asarray(greedy)
+        greedy_np = self._host(greedy)
         logits_np = self._pull_logits_if_sampling(lanes, final)
         metrics.counter("serve_steps_total", engine=self.name,
                         phase="prefill").inc()
@@ -795,14 +881,13 @@ class InferenceEngine:
             if st.n_fed >= len(st.request.prompt):
                 self._commit(st, slot, greedy_np, logits_np)
 
-    @staticmethod
-    def _pull_logits_if_sampling(lanes, logits):
+    def _pull_logits_if_sampling(self, lanes, logits):
         """One bulk device->host transfer when ANY lane will host-sample
         this step; greedy-only steps never pay for logits at all, and
         sampling lanes share the single pull instead of one slice
         round-trip each."""
         if any(st.request.temperature > 0 for _, st in lanes):
-            return np.asarray(logits, np.float64)
+            return self._host(logits).astype(np.float64)
         return None
 
     def _commit(self, st: _SlotState, slot: int, greedy_np,
@@ -1014,11 +1099,17 @@ class InferenceEngine:
         # KV-pool occupancy in BYTES: the memory-accounting view the
         # profiler's doctor reads next to program_peak_hbm_bytes —
         # blocks_in_use says "how full", this says "how much HBM that is".
-        bpb = self._cache.bytes_per_block
+        bpb = self._bytes_per_block
         metrics.gauge("serve_kv_pool_bytes_in_use", engine=self.name).set(
             self.manager.blocks_in_use * bpb)
         metrics.gauge("serve_kv_pool_bytes_capacity",
-                      engine=self.name).set(self._cache.pool_bytes)
+                      engine=self.name).set(self._pool_bytes)
+        # The doctor's sharding check reads these two next to the pool
+        # gauges: "rejecting with quant already on" + "replicated
+        # params" together say the fix is a mesh, not a knob.
+        metrics.gauge("serve_kv_quant_enabled", engine=self.name).set(
+            1 if self.kv_quant else 0)
+        metrics.gauge("serve_mp_degree", engine=self.name).set(self._mp)
         if self._overlap_total:
             metrics.gauge("serve_prompt_overlap_rate",
                           engine=self.name).set(
@@ -1058,4 +1149,8 @@ class InferenceEngine:
                 "spec_acceptance": (self._spec_accepted /
                                     self._spec_proposed
                                     if self._spec_proposed else 0.0),
+                "mesh": self._mesh_spec,
+                "mp": self._mp,
+                "param_bytes_per_rank": self._param_bytes,
+                "kv_pool_bytes_per_rank": self._pool_bytes,
             }

@@ -51,6 +51,14 @@ class TestParsing:
         assert args.overlap_chunks is None
         assert not args.sweep_comm
 
+    def test_mesh_flag_parses_and_applies(self, bench, monkeypatch):
+        args = bench._build_parser().parse_args(
+            ["--model", "mnist", "--mesh", "dp2xmp1"])
+        assert args.mesh == "dp2xmp1"
+        monkeypatch.setenv("HOROVOD_MESH", "pre-test-sentinel")
+        bench._apply_comm_flags(args)
+        assert os.environ["HOROVOD_MESH"] == "dp2xmp1"
+
     def test_supervisor_forwards_flags(self, bench, monkeypatch):
         seen = {}
 
@@ -67,12 +75,13 @@ class TestParsing:
         args = bench._build_parser().parse_args(
             ["--model", "mnist", "--allreduce-alg", "rs_ag",
              "--overlap-chunks", "2", "--topology", "2x2",
-             "--sweep-comm"])
+             "--mesh", "dp2xmp2", "--sweep-comm"])
         assert bench._supervise(args) == 0
         cmd = seen["cmd"]
         assert "--allreduce-alg" in cmd and "rs_ag" in cmd
         assert "--overlap-chunks" in cmd and "2" in cmd
         assert "--topology" in cmd and "2x2" in cmd
+        assert "--mesh" in cmd and "dp2xmp2" in cmd
         assert "--sweep-comm" in cmd
 
     def test_apply_comm_flags_sets_env(self, bench, monkeypatch):

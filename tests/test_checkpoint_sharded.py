@@ -417,6 +417,105 @@ class TestDeterministicResume:
         mgr.close()
 
 
+class TestCrossAxisReshard:
+    """Save under one dp x mp factoring, restore under another: shard
+    files are rank-major flat chunks, so re-chunking is mesh-agnostic
+    and bit-exact — and mixed-axis states fail loudly naming the axis."""
+
+    def _save_1x2(self, tmp_path, flat):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        mu = flat.reshape(2, -1)
+        nu = (flat * 3).reshape(2, -1)
+        m.save(1, shards={"mu": jnp.asarray(mu), "nu": jnp.asarray(nu),
+                          "step": jnp.full((2,), 4, jnp.int32)},
+               unpadded={"['mu']": flat.size, "['nu']": flat.size},
+               mesh="dp1xmp2", wait=True)
+        return m
+
+    def test_mesh_axes_published(self, tmp_path):
+        flat = np.arange(20, dtype=np.float32)
+        m = self._save_1x2(tmp_path, flat)
+        assert m.read_manifest(1)["mesh_axes"] == [1, 2]
+        m.close()
+
+    def test_restore_2x1_and_1x1_and_back_bits(self, tmp_path):
+        flat = np.arange(20, dtype=np.float32)
+        m = self._save_1x2(tmp_path, flat)
+        # 1x2 -> 2x1: same shard count, different axes — byte identity
+        r21 = m.restore(step=1, mesh="dp2xmp1")
+        np.testing.assert_array_equal(
+            r21.shards["['mu']"].reshape(-1), flat)
+        np.testing.assert_array_equal(r21.shards["['step']"],
+                                      np.full((2,), 4))
+        # 1x2 -> 1x1: flat reshard to one chunk
+        r11 = m.restore(step=1, mesh="dp1xmp1")
+        assert r11.shards["['mu']"].shape[0] == 1
+        np.testing.assert_array_equal(
+            r11.shards["['mu']"].reshape(-1)[:flat.size], flat)
+        np.testing.assert_array_equal(
+            r11.shards["['nu']"].reshape(-1)[:flat.size], flat * 3)
+        # and back: re-save the 1x1 restore under dp1xmp1, restore 1x2
+        m.save(2, shards={"mu": jnp.asarray(r11.shards["['mu']"]),
+                          "nu": jnp.asarray(r11.shards["['nu']"]),
+                          "step": jnp.asarray(r11.shards["['step']"])},
+               unpadded={"['mu']": flat.size, "['nu']": flat.size},
+               mesh="dp1xmp1", wait=True)
+        r12 = m.restore(step=2, mesh="dp1xmp2")
+        np.testing.assert_array_equal(
+            r12.shards["['mu']"].reshape(-1)[:flat.size], flat)
+        np.testing.assert_array_equal(
+            r12.shards["['nu']"].reshape(-1)[:flat.size], flat * 3)
+        m.close()
+
+    def test_restore_mesh_conflicts_with_num_shards(self, tmp_path):
+        flat = np.arange(20, dtype=np.float32)
+        m = self._save_1x2(tmp_path, flat)
+        with pytest.raises(ValueError, match="make them agree"):
+            m.restore(step=1, mesh="dp2xmp1", num_shards=4)
+        m.close()
+
+    def test_save_mesh_must_factor_num_shards(self, tmp_path):
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        with pytest.raises(ValueError, match="factor"):
+            m.save(1, shards={"v": jnp.ones((2, 3))}, mesh="dp2xmp2",
+                   wait=True)
+        m.close()
+
+    def test_mixed_axis_receipts_fail_naming_axis(self, tmp_path):
+        """_publish refuses a step whose rank receipts disagree on the
+        dp x mp factoring, naming the mismatched axis."""
+        m = cs.ShardedCheckpointManager(str(tmp_path / "c"))
+        step_dir = str(tmp_path / "c" / "step-00000007")
+        os.makedirs(step_dir)
+        job = cs._SaveJob(step=7, parts={}, replicated=None, meta={},
+                          unpadded={}, num_shards=2, num_ranks=2,
+                          rank=0, attempt=0, enqueued_at=0.0,
+                          mesh=(1, 2))
+        for r, axes in ((0, [1, 2]), (1, [2, 1])):
+            with open(os.path.join(
+                    step_dir, m._receipt_name(r, job)), "w") as f:
+                json.dump({"rank": r, "attempt": 0, "mesh_axes": axes,
+                           "files": {}, "leaves": {}}, f)
+        with pytest.raises(ValueError, match="dp axis mismatch"):
+            m._publish(job, step_dir)
+        m.close()
+
+    def test_mixed_axis_manifest_refuses_restore(self, tmp_path):
+        flat = np.arange(20, dtype=np.float32)
+        m = self._save_1x2(tmp_path, flat)
+        path = os.path.join(str(tmp_path / "c"),
+                            [f for f in os.listdir(str(tmp_path / "c"))
+                             if f.endswith(".json")][0])
+        with open(path) as f:
+            manifest = json.load(f)
+        manifest["mesh_axes"] = [2, 2]     # product 4 != num_shards 2
+        with open(path, "w") as f:
+            json.dump(manifest, f)
+        with pytest.raises(ValueError, match="mixed-axis or corrupt"):
+            m.restore(step=1)
+        m.close()
+
+
 class TestFaultPlan:
     def test_grammar_roundtrip(self):
         plan = faults.parse_plan(

@@ -120,3 +120,30 @@ class TestConfig:
         hconfig.refresh()
         assert logging.getLogger("horovod_tpu").level == logging.DEBUG
         clean_env.setenv("HOROVOD_LOG_LEVEL", "warning")
+
+    def test_mesh_env_normalizes(self, clean_env):
+        clean_env.setenv("HOROVOD_MESH", " DP2xMP4 ")
+        cfg = hconfig.refresh()
+        assert cfg.mesh == "dp2xmp4"
+        # build_info reports the live mesh once initialized, the
+        # configured spec before that — either way the key is present.
+        want = hvd.mesh_spec() if hvd.is_initialized() else "dp2xmp4"
+        assert hvd.build_info()["mesh"] == want
+
+    def test_mesh_env_default_unset(self, clean_env):
+        clean_env.delenv("HOROVOD_MESH", raising=False)
+        assert hconfig.refresh().mesh is None
+
+    def test_mesh_env_bad_spec_fails_loud(self, clean_env):
+        clean_env.setenv("HOROVOD_MESH", "2x4")
+        with pytest.raises(ValueError):
+            hconfig.refresh()
+
+    def test_mp_rules_env(self, clean_env):
+        clean_env.setenv("HOROVOD_MP_RULES", "off")
+        cfg = hconfig.refresh()
+        assert cfg.mp_rules == "off"
+        assert hvd.build_info()["mp_rules"] == "off"
+        clean_env.setenv("HOROVOD_MP_RULES", "deepspeed")
+        with pytest.raises(ValueError, match="HOROVOD_MP_RULES"):
+            hconfig.refresh()

@@ -339,6 +339,82 @@ class TestGoldenReport:
             [f["category"] for f in r2["findings"]] != []
 
 
+def _gau(value, **labels):
+    return {"labels": labels, "value": value}
+
+
+class TestShardingCheck:
+    """_check_sharding: replicated params + memory-bound symptoms →
+    suggest HOROVOD_MESH (ISSUE 14 satellite)."""
+
+    def _snap(self, **gauges):
+        base = {"counters": {}, "gauges": {}, "histograms": {},
+                "pending_collectives": []}
+        base["gauges"].update(gauges)
+        return base
+
+    def test_peak_hbm_near_limit_suggests_mesh(self):
+        snap = self._snap(
+            config_mesh_dp=[_gau(8.0)], config_mesh_mp=[_gau(1.0)],
+            device_hbm_bytes_limit=[_gau(100.0, device="0")],
+            program_peak_hbm_bytes=[_gau(90.0, program="train_step")])
+        report = doctor(snapshot=snap, trace=None, programs={})
+        fs = [f for f in report["findings"]
+              if f["category"] == "sharding"]
+        assert fs and "train_step" in fs[0]["title"]
+        assert "HOROVOD_MESH=dp4xmp2" in fs[0]["suggestion"]
+        assert fs[0]["evidence"]["peak_hbm_bytes"] == 90.0
+
+    def test_quiet_when_already_model_sharded(self):
+        snap = self._snap(
+            config_mesh_dp=[_gau(4.0)], config_mesh_mp=[_gau(2.0)],
+            device_hbm_bytes_limit=[_gau(100.0, device="0")],
+            program_peak_hbm_bytes=[_gau(99.0, program="train_step")])
+        report = doctor(snapshot=snap, trace=None, programs={})
+        assert not [f for f in report["findings"]
+                    if f["category"] == "sharding"]
+
+    def test_quiet_when_headroom(self):
+        snap = self._snap(
+            config_mesh_dp=[_gau(8.0)], config_mesh_mp=[_gau(1.0)],
+            device_hbm_bytes_limit=[_gau(100.0, device="0")],
+            program_peak_hbm_bytes=[_gau(50.0, program="train_step")])
+        report = doctor(snapshot=snap, trace=None, programs={})
+        assert not [f for f in report["findings"]
+                    if f["category"] == "sharding"]
+
+    def test_kv_quant_rejections_suggest_mesh(self):
+        snap = self._snap(
+            config_mesh_dp=[_gau(2.0)], config_mesh_mp=[_gau(1.0)],
+            serve_kv_quant_enabled=[_gau(1.0, engine="e0")],
+            serve_kv_pool_bytes_capacity=[_gau(4096.0, engine="e0")])
+        snap["counters"]["serve_requests_total"] = [
+            {"labels": {"engine": "e0", "status": "rejected"},
+             "value": 3}]
+        report = doctor(snapshot=snap, trace=None, programs={})
+        fs = [f for f in report["findings"]
+              if f["category"] == "sharding"]
+        assert fs and fs[0]["evidence"]["rejected"] == 3
+        assert "HOROVOD_MESH=dp1xmp2" in fs[0]["suggestion"]
+
+    def test_no_kv_finding_without_quant(self):
+        snap = self._snap(
+            config_mesh_dp=[_gau(2.0)], config_mesh_mp=[_gau(1.0)],
+            serve_kv_quant_enabled=[_gau(0.0, engine="e0")],
+            serve_kv_pool_bytes_capacity=[_gau(4096.0, engine="e0")])
+        snap["counters"]["serve_requests_total"] = [
+            {"labels": {"engine": "e0", "status": "rejected"},
+             "value": 3}]
+        report = doctor(snapshot=snap, trace=None, programs={})
+        assert not [f for f in report["findings"]
+                    if f["category"] == "sharding"]
+
+    def test_healthy_is_quiet(self):
+        report = doctor(snapshot=self._snap(), trace=None, programs={})
+        assert not [f for f in report["findings"]
+                    if f["category"] == "sharding"]
+
+
 class TestPerfDoctorCLI:
     def _import_tool(self):
         sys.path.insert(0, os.path.join(_REPO, "tools"))

@@ -1,0 +1,253 @@
+"""dp×mp multi-axis sharding (parallel/mp.py + parallel/mesh.py):
+model-parallel weight splits with collective matmuls, ZeRO-2/3 training
+helpers, and the mesh-spec plumbing. Reference role: Megatron-style
+tensor parallelism + DeepSpeed ZeRO, expressed as named-mesh shard_map
+programs."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import generate as gen
+from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+from horovod_tpu.models.llama import Llama, LlamaConfig
+from horovod_tpu.parallel import mesh as meshmod
+from horovod_tpu.parallel import mp
+
+
+def _gpt2_setup():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    params = GPT2(cfg).init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def _llama_setup():
+    cfg = LlamaConfig.tiny(num_kv_heads=2, dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+class TestMeshSpec:
+    def test_parse_and_format(self):
+        assert meshmod.parse_mesh("dp2xmp4") == (2, 4)
+        assert meshmod.parse_mesh(" DP2xMP4 ") == (2, 4)
+        assert meshmod.format_mesh(2, 4) == "dp2xmp4"
+
+    @pytest.mark.parametrize("bad", ["dp2", "mp2", "2x4", "dp0xmp2", "x"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            meshmod.parse_mesh(bad)
+
+    def test_validate_factors_world(self):
+        with pytest.raises(ValueError, match="world"):
+            meshmod.validate_mesh(3, 2, 8)
+
+    def test_validate_respects_topology(self):
+        with pytest.raises(ValueError, match="topology"):
+            meshmod.validate_mesh(2, 3, 6, topology=(3, 2))
+        assert meshmod.validate_mesh(2, 2, 4, topology=(2, 2)) == (2, 2)
+
+    def test_make_mesh2d_row_major(self):
+        m = meshmod.make_mesh2d(2, 4, jax.devices())
+        assert m.shape == {"dp": 2, "mp": 4}
+        flat = list(np.asarray(m.devices).ravel())
+        assert flat == list(jax.devices())
+
+
+class TestValidateTp:
+    def test_accepts_divisible(self):
+        cfg, _ = _gpt2_setup()
+        mp.validate_tp(cfg, 2)
+
+    def test_rejects_head_split(self):
+        cfg, _ = _gpt2_setup()
+        with pytest.raises(ValueError, match="head"):
+            mp.validate_tp(cfg, 3)
+
+    def test_rejects_unknown_family(self):
+        class C:
+            pass
+        with pytest.raises(TypeError, match="no decode family"):
+            mp.validate_tp(C(), 2)
+
+
+class TestSplitMerge:
+    @pytest.mark.parametrize("setup", [_gpt2_setup, _llama_setup])
+    def test_roundtrip_bits(self, setup):
+        cfg, params = setup()
+        parts = [mp.split_params(cfg, params, 2, r) for r in range(2)]
+        merged = mp.merge_params(cfg, parts)
+        want = jax.tree_util.tree_leaves_with_path(params)
+        got = {jax.tree_util.keystr(k): v for k, v in
+               jax.tree_util.tree_leaves_with_path(merged)}
+        for k, v in want:
+            np.testing.assert_array_equal(
+                np.asarray(v), got[jax.tree_util.keystr(k)])
+
+    @pytest.mark.parametrize("setup", [_gpt2_setup, _llama_setup])
+    def test_per_rank_fraction(self, setup):
+        cfg, params = setup()
+        parts = [mp.split_params(cfg, params, 2, r) for r in range(2)]
+        frac = mp.param_bytes(parts[0]) / mp.param_bytes(params)
+        # 1/mp of every split weight + the replicated embeddings/norms
+        assert frac <= 0.55
+
+    def test_mp1_is_identity(self):
+        cfg, params = _gpt2_setup()
+        part = mp.split_params(cfg, params, 1, 0)
+        for (_, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves_with_path(part)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestTpDecodeParity:
+    @pytest.mark.parametrize("setup", [_gpt2_setup, _llama_setup])
+    def test_greedy_matches_replicated(self, setup):
+        """3 decode steps through the collective-matmul step on a real
+        2-device mp mesh produce the same greedy tokens (and close
+        logits) as the dense registry step."""
+        cfg, params = setup()
+        fam = gen.decode_family(cfg)
+        mdev = meshmod.make_mesh2d(1, 2, jax.devices()[:2])
+        B, T = 2, 8
+        kvh, hd = fam.kv_heads(cfg), cfg.d_model // cfg.num_heads
+        rng = np.random.default_rng(5)
+        toks = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(3, B)),
+                           jnp.int32)
+
+        def cache_for(heads):
+            return {i: {"k": jnp.zeros((B, T, heads, hd), jnp.float32),
+                        "v": jnp.zeros((B, T, heads, hd), jnp.float32)}
+                    for i in range(cfg.num_layers)}
+
+        step = gen.decode_step(cfg)
+        c, ref = cache_for(kvh), []
+        for j in range(3):
+            c, lg = step(params, c, toks[j], jnp.int32(j))
+            ref.append(np.asarray(gen.greedy_token(lg)))
+
+        tp_step = mp.tp_decode_step(cfg)
+        prog = jax.jit(mp.wrap_spmd(
+            lambda p, cc, tk, ii: tp_step(p, cc, tk, ii), mdev))
+        pstk = mp.mp_stack(
+            lambda r: mp.split_params(cfg, params, 2, r), mdev)
+        cstk = mp.mp_broadcast(cache_for(kvh // 2), mdev)
+        for j in range(3):
+            cstk, lg = prog(pstk, cstk,
+                            mp.mp_broadcast(np.asarray(toks[j]), mdev),
+                            mp.mp_broadcast(np.int32(j), mdev))
+            got = np.asarray(gen.greedy_token(jnp.asarray(
+                mp.mp_fetch(lg))))
+            np.testing.assert_array_equal(got, ref[j])
+
+
+class TestGatherShard:
+    def _run(self, x, wire):
+        mdev = meshmod.make_mesh2d(1, 2, jax.devices()[:2])
+        prog = jax.jit(mp.wrap_spmd(
+            lambda s: mp.gather_shard(s, "mp", wire), mdev))
+        n = x.shape[0] // 2
+        stk = mp.mp_stack(lambda r: x[r * n:(r + 1) * n], mdev)
+        return mp.mp_fetch(prog(stk))
+
+    def test_fp32_exact(self, rng):
+        x = rng.standard_normal(512).astype(np.float32)
+        np.testing.assert_array_equal(self._run(x, None), x)
+
+    @pytest.mark.parametrize("wire,steps", [("int8", 200), ("fp8", 12)])
+    def test_quantized_within_bound(self, rng, wire, steps):
+        x = rng.standard_normal(512).astype(np.float32)
+        got = self._run(x, wire)
+        assert np.abs(got - x).max() <= np.abs(x).max() / steps
+
+    def test_unknown_wire_rejected(self):
+        with pytest.raises(ValueError, match="wire"):
+            self._run(np.zeros(512, np.float32), "int4")
+
+
+class TestZero2:
+    def test_update_matches_optax_adamw(self, rng):
+        params = {"w": jnp.asarray(rng.standard_normal((8, 8)),
+                                   jnp.float32),
+                  "b": jnp.zeros((8,), jnp.float32)}
+        grads = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(rng.standard_normal(a.shape),
+                                  jnp.float32), params)
+        mdev = meshmod.make_mesh2d(1, 2, jax.devices()[:2])
+        from horovod_tpu.optimizer_sharded import (ShardedAdamWState,
+                                                   _flatten)
+        c = -(-_flatten(params).shape[0] // 2)
+        st0 = {"step": np.zeros((1,), np.int32),
+               "mu": np.zeros((c,), np.float32),
+               "nu": np.zeros((c,), np.float32)}
+
+        def body(p, g, st):
+            gs = mp.zero2_grad_shard(g, "mp")
+            return mp.zero2_update(
+                p, gs, ShardedAdamWState(st["step"], st["mu"], st["nu"]),
+                learning_rate=1e-2, axis_name="mp")
+
+        prog = jax.jit(mp.wrap_spmd(body, mdev))
+        new_p, _ = prog(mp.mp_broadcast(params, mdev),
+                        mp.mp_broadcast(grads, mdev),
+                        mp.mp_stack(lambda r: st0, mdev))
+        opt = optax.adamw(1e-2)
+        upd, _ = opt.update(grads, opt.init(params), params)
+        want = optax.apply_updates(params, upd)
+        for k in params:
+            np.testing.assert_allclose(
+                mp.mp_fetch(new_p[k]), np.asarray(want[k]),
+                rtol=1e-6, atol=1e-7)
+
+
+class TestMpPartitionRules:
+    def test_off_is_empty(self):
+        cfg, _ = _gpt2_setup()
+        assert mp.mp_partition_rules(cfg, "off").rules == []
+
+    def test_auto_shards_weights_over_mp(self):
+        cfg, _ = _gpt2_setup()
+        rules = mp.mp_partition_rules(cfg, "auto")
+        specs = [tuple(spec) for _, spec in rules.rules]
+        assert any("mp" in s for s in specs)
+        assert not any("tp" in s for s in specs)
+
+
+class TestEngineMpStats:
+    def test_replicated_engine_reports_mp1(self):
+        cfg, params = _gpt2_setup()
+        from horovod_tpu.serving.engine import InferenceEngine
+        eng = InferenceEngine(GPT2(cfg), params, slots=2, max_len=32,
+                              block_size=8, name="mp_stats")
+        st = eng.stats()
+        assert st["mp"] == 1
+        assert st["param_bytes_per_rank"] == sum(
+            int(np.asarray(l).nbytes)
+            for l in jax.tree_util.tree_leaves(params))
+
+
+class TestTwoProcessMpSmoke:
+    def test_mp_smoke_two_process(self):
+        """Acceptance drive: 2 real processes on a dp1xmp2 mesh —
+        ZeRO-3 loss curve bit-exact vs the 1-proc baseline, tp serving
+        token-identical to offline generate() with decode_compiles==1
+        and <= 0.55x per-rank param bytes (tools/mp_smoke.py, also
+        `make mp-smoke`)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "mp_smoke.py")],
+            capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, \
+            f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+        assert "mp-smoke OK" in r.stdout

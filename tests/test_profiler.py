@@ -151,8 +151,51 @@ class TestRegistry:
         assert rec.recompiles == 2
 
 
-class TestProfiledStep:
-    def test_forced_recompile_blames_static_arg(self):
+class TestMpDegree:
+    """record_cost(mp_degree=...) divides the analytic per-program
+    numbers by the tensor-parallel degree: shard_map cost analysis
+    counts GLOBAL work, but program_mfu compares against ONE chip's
+    peak (ISSUE 14 satellite)."""
+
+    class _FakeMem:
+        argument_size_in_bytes = 600.0
+        output_size_in_bytes = 200.0
+        temp_size_in_bytes = 200.0
+        alias_size_in_bytes = 0.0
+
+    class _FakeCompiled:
+        def cost_analysis(self):
+            return {"flops": 1000.0, "bytes accessed": 400.0}
+
+        def memory_analysis(self):
+            return TestMpDegree._FakeMem()
+
+    def test_cost_divided_by_degree(self):
+        rec = registry.record_cost("tp_prog", self._FakeCompiled(),
+                                   model_flops=800.0, mp_degree=2)
+        assert rec.mp_degree == 2
+        assert rec.flops == 500.0
+        assert rec.bytes_accessed == 200.0
+        assert rec.peak_hbm_bytes == 500.0
+        assert rec.model_flops == 400.0
+        assert rec.snapshot()["mp_degree"] == 2
+
+    def test_degree_one_unchanged(self):
+        rec = registry.record_cost("dense_prog", self._FakeCompiled())
+        assert rec.mp_degree == 1
+        assert rec.flops == 1000.0
+        assert rec.peak_hbm_bytes == 1000.0
+
+    def test_mfu_honest_under_mp(self, monkeypatch):
+        # 1000 global flops over mp=2 in 1ms = 5e-7 TFLOP/s per chip:
+        # against a 1e-6-TFLOPS "chip" that is hfu 0.5 — without the
+        # division it would read 1.0, 2x truth.
+        monkeypatch.setenv("HOROVOD_PEAK_TFLOPS", "1e-6")
+        registry.record_cost("tp_prog", self._FakeCompiled(),
+                             mp_degree=2)
+        registry.observe_step("tp_prog", 0.001)
+        assert _gauge("program_hfu", program="tp_prog") == \
+            pytest.approx(0.5)
         """The ISSUE acceptance test: change a static arg, assert
         recompiles_total increments and the blamed argument is named."""
         calls = []

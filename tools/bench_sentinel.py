@@ -39,7 +39,7 @@ DEFAULT_LOG = os.path.join(REPO, "BENCH_SELF.jsonl")
 SETTINGS_KEYS = (
     "transport", "slots", "max_len", "block_size", "prefill_chunk",
     "kv_quant", "arrival_rate_hz", "requests", "rate",
-    "allreduce_alg", "wire", "topology", "overlap_chunks",
+    "allreduce_alg", "wire", "topology", "mesh", "overlap_chunks",
     "payload_mb", "world", "batch", "seq_len", "steps",
     "prefix_overlap", "prefix_cache", "spec_k",
 )

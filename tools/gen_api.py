@@ -46,6 +46,7 @@ MODULES = [
     "horovod_tpu.overlap",
     "horovod_tpu.parallel",
     "horovod_tpu.parallel.mesh",
+    "horovod_tpu.parallel.mp",
     "horovod_tpu.parallel.pipeline",
     "horovod_tpu.parallel.fsdp",
     "horovod_tpu.parallel.conjugate",

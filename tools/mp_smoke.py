@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""dp×mp mesh smoke: 2 CPU processes on a dp=1×mp=2 named mesh.
+
+Spawns two real processes that rendezvous over ``jax.distributed`` with
+``HOROVOD_MESH=dp1xmp2`` and drive both halves of the mp subsystem:
+
+* **ZeRO-3 training**: a tiny GPT-2 trains 3 steps with params sharded
+  across the mesh (``zero3_shard_params`` → just-in-time ``zero3_apply``
+  gathers → reduce-scattered grads → shard-domain AdamW). With dp=1 both
+  ranks see the same batch, so the fp32 loss curve must be BIT-EXACT
+  against a dense 1-proc replicated baseline running the same chunked
+  Adam math (``(g+g)/2 == g`` exactly in IEEE — no reduction-order
+  slack to hide behind).
+* **tensor-parallel serving**: the same checkpoint serves through
+  ``InferenceEngine`` with each rank holding 1/mp of every weight and
+  1/mp of the paged KV pool. Greedy completions must be token-identical
+  to offline dense ``generate()``, with ``decode_compiles == 1`` while
+  the prefix cache and the speculative lane are both on, and the
+  measured per-rank param bytes ≤ 0.55× the replicated footprint.
+
+Exit status 0 = all checks pass; nonzero otherwise. Wired as a tier-1
+test (``tests/test_mp.py::TestTwoProcessMpSmoke``) and as
+``make mp-smoke``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    # one CPU device per process: the mesh must be exactly dp1 x mp2
+    # (a parent test runner may have forced 8 virtual devices)
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["HOROVOD_MESH"] = "dp1xmp2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+    hvd.init(coordinator_address=f"127.0.0.1:{{port}}", num_processes=2,
+             process_id=pid)
+    assert jax.process_count() == 2
+    assert hvd.dp_size() == 1 and hvd.mp_size() == 2, (
+        hvd.dp_size(), hvd.mp_size())
+    mesh2d = hvd.mesh2d()
+
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.parallel import mp as mpmod
+    from horovod_tpu.optimizer_sharded import ShardedAdamWState
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 16)),
+                       jnp.int32)
+
+    def block(p, tk):
+        return loss_fn(model.apply({{"params": p}}, tk), tk)
+
+    # --- ZeRO-3: shard -> JIT gather -> RS grads -> shard-domain AdamW
+    n = 2
+    flat0 = np.asarray(mpmod.zero3_shard_params(params, num_shards=n))
+    c = flat0.shape[0] // n
+    LR = 1e-2
+    opt = mpmod.zero3_adamw(LR)
+
+    def train_body(st, tk):
+        shard = st["shard"]
+        l, g = jax.value_and_grad(lambda s: mpmod.zero3_apply(
+            block, params, s, tk, axis_name="mp"))(shard)
+        upd, st2 = opt.update(
+            g, ShardedAdamWState(st["step"], st["mu"], st["nu"]), shard)
+        return {{"shard": shard + upd, "mu": st2.mu, "nu": st2.nu,
+                "step": st2.step, "loss": l}}
+
+    prog = jax.jit(mpmod.wrap_spmd(train_body, mesh2d))
+    st = mpmod.mp_stack(lambda r: {{
+        "shard": flat0[r * c:(r + 1) * c],
+        "mu": np.zeros((c,), np.float32),
+        "nu": np.zeros((c,), np.float32),
+        "step": np.zeros((1,), np.int32)}}, mesh2d)
+    tk_g = mpmod.mp_broadcast(np.asarray(toks), mesh2d)
+    losses = []
+    for _ in range(3):
+        out = prog({{k: st[k] for k in ("shard", "mu", "nu", "step")}},
+                   tk_g)
+        st = out
+        losses.append(np.float32(mpmod.mp_fetch(out["loss"])))
+
+    # replicated 1-proc baseline: the SAME train_body on a mesh of ONE
+    # local device (num_shards=1: the gather/reduce-scatter collectives
+    # are identities), so both curves come from the identical program.
+    from jax.sharding import Mesh
+    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1),
+                 ("dp", "mp"))
+    flat1 = np.asarray(mpmod.zero3_shard_params(params, num_shards=1))
+    c1 = flat1.shape[0]
+    prog1 = jax.jit(mpmod.wrap_spmd(train_body, mesh1))
+    st1 = mpmod.mp_stack(lambda r: {{
+        "shard": flat1,
+        "mu": np.zeros((c1,), np.float32),
+        "nu": np.zeros((c1,), np.float32),
+        "step": np.zeros((1,), np.int32)}}, mesh1)
+    tk1 = mpmod.mp_broadcast(np.asarray(toks), mesh1)
+    ref_losses = []
+    for _ in range(3):
+        st1 = prog1({{k: st1[k] for k in ("shard", "mu", "nu", "step")}},
+                    tk1)
+        ref_losses.append(np.float32(mpmod.mp_fetch(st1["loss"])))
+
+    assert [x.tobytes() for x in losses] == \\
+        [x.tobytes() for x in ref_losses], (losses, ref_losses)
+    assert ref_losses[-1] < ref_losses[0]
+
+    # --- tensor-parallel serving: 1/mp weights, 1/mp KV pool
+    from horovod_tpu.serving.engine import InferenceEngine
+    prompts = [list(map(int, rng.integers(0, cfg.vocab_size, size=m)))
+               for m in (6, 11)]
+    ref = []
+    for p in prompts:
+        seq = generate(model, params, jnp.asarray([p], jnp.int32),
+                       max_new_tokens=8)
+        ref.append([int(t) for t in np.asarray(seq)[0]][len(p):])
+    eng = InferenceEngine(model, params, slots=2, max_len=64,
+                          block_size=8, prefix_cache=True, spec_k=2,
+                          prefill_chunk=4, name="mp_smoke")
+    stats0 = eng.stats()
+    assert stats0["mp"] == 2 and stats0["mesh"] == "dp1xmp2", stats0
+    reqs = [eng.submit(list(p), max_new_tokens=8) for p in prompts]
+    eng.run_until_idle()
+    got = [r.result() for r in reqs]
+    assert got == ref, (got, ref)
+    stats = eng.stats()
+    assert stats["decode_compiles"] == 1, stats["decode_compiles"]
+    full_bytes = sum(np.asarray(l).nbytes for l in
+                     jax.tree_util.tree_leaves(params))
+    frac = stats["param_bytes_per_rank"] / full_bytes
+    assert frac <= 0.55, frac
+
+    # cross-rank agreement: losses and served tokens byte-identical
+    blob = (b"".join(x.tobytes() for x in losses),
+            repr(got).encode())
+    peers = hvd.allgather_object(blob)
+    assert all(p == peers[0] for p in peers), "ranks diverged"
+    hvd.shutdown()
+    print(f"proc {{pid}} MP-OK loss={{losses[-1]:.5f}} "
+          f"frac={{frac:.3f}}", flush=True)
+""").format(repo=REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_smoke(timeout_s: float = 420.0):
+    """One attempt: returns ``(rc, failure_text)`` — failure text feeds
+    the rendezvous-flake detector in ``smoke_util``."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)]
+    outs = [p.communicate(timeout=timeout_s)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 or "MP-OK" not in out:
+            print(f"worker failed (rc={p.returncode}):\n{out}",
+                  file=sys.stderr)
+            return 1, "\n".join(outs)
+    print("mp-smoke OK")
+    return 0, ""
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import smoke_util
+    with tempfile.TemporaryDirectory():
+        return smoke_util.main_with_retry(run_smoke, name="mp-smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,6 +221,9 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "slots": slots, "max_len": max_len, "block_size": block_size,
         "prefill_chunk": prefill_chunk, "kv_quant": kv_quant,
         "model": f"gpt2-{model_size}",
+        "mesh": estats.get("mesh") or "",
+        "mp": estats.get("mp", 1),
+        "param_bytes_per_rank": estats.get("param_bytes_per_rank"),
         "prefix_overlap": prefix_overlap, "prefix_cache": prefix_cache,
         "spec_k": spec_k,
         "prefix_hit_rate": round(pstats["hit_rate"], 4),
