@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
 	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
-	mp-smoke bench-sentinel test native
+	mp-smoke reqtrace-smoke bench-sentinel test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -107,6 +107,17 @@ prefix-smoke:
 # as tests/test_mp.py::TestTwoProcessMpSmoke.
 mp-smoke:
 	$(PY) tools/mp_smoke.py
+
+# Request-tracing smoke: 2 socket replicas + a hedging dispatcher, all
+# writing request-trace shards (HOROVOD_REQUEST_TRACE=1). Replica 0 is
+# rigged slow (busy single lane + a delay@...space=net on the traced
+# submit) so the hedge fires and replica 1 wins; the merged trace must
+# stitch one trace_id across all three processes, the requestReport
+# breakdown must sum to the measured TTFT within 10%, and
+# tools/tail_doctor.py must blame rank0's hedge wait. Also runs in
+# tier-1 as tests/test_reqtrace.py::TestReqtraceSmoke.
+reqtrace-smoke:
+	$(PY) tools/reqtrace_smoke.py
 
 # Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
 # metric's newest line degrades >10% vs the latest prior line at equal

@@ -47,7 +47,7 @@ from horovod_tpu import serving  # noqa: F401
 # (docs/OBSERVABILITY.md "Roofline gauges" / "Doctor").
 from horovod_tpu import profiler  # noqa: F401
 from horovod_tpu.profiler import doctor, profile  # noqa: F401
-from horovod_tpu.metrics import reset_metrics  # noqa: F401
+from horovod_tpu.metrics import metrics_http, reset_metrics  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,
     ErrorFeedbackState, accumulation_has_updated, reset_error_feedback,

@@ -184,6 +184,18 @@ class Config:
     serve_fleet_crash_loop_window_seconds: float = 30.0
     serve_fleet_probe_seconds: float = 0.5
     serve_fleet_spares: int = 0
+    # Request tracing (serving/reqtrace.py): HOROVOD_REQUEST_TRACE=1 turns
+    # on the per-request span layer (trace context minted at dispatcher
+    # submit, spans at every hop); HOROVOD_REQUEST_TRACE_DIR is where each
+    # process flushes its Chrome-trace shard (unset = buffer only, served
+    # via the /trace HTTP endpoint); HOROVOD_REQUEST_TRACE_DECODE_EVERY
+    # samples one DECODE span every N decode steps to bound overhead.
+    # HOROVOD_METRICS_PORT starts hvd.metrics_http() on replica servers
+    # and the fleet supervisor (0 = off; rank r binds port+r).
+    request_trace: bool = False
+    request_trace_dir: Optional[str] = None
+    request_trace_decode_every: int = 16
+    metrics_port: int = 0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Preemption tolerance (checkpoint_sharded.py / faults.py /
@@ -458,6 +470,12 @@ def refresh() -> Config:
             "HOROVOD_SERVE_FLEET_PROBE", 0.5),
         serve_fleet_spares=_env_nonneg_int(
             "HOROVOD_SERVE_FLEET_SPARES", 0),
+        request_trace=_env_bool("HOROVOD_REQUEST_TRACE"),
+        request_trace_dir=os.environ.get("HOROVOD_REQUEST_TRACE_DIR")
+        or None,
+        request_trace_decode_every=_env_posint(
+            "HOROVOD_REQUEST_TRACE_DECODE_EVERY", 16),
+        metrics_port=_env_nonneg_int("HOROVOD_METRICS_PORT", 0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         preemption_notice_seconds=max(
             0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),

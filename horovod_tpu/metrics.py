@@ -62,6 +62,7 @@ __all__ = [
     "StallWatchdog", "start_stall_watchdog", "stop_stall_watchdog",
     "get_stall_watchdog",
     "LATENCY_BUCKETS", "SIZE_BUCKETS", "RATIO_BUCKETS",
+    "SERVE_LATENCY_BUCKETS", "metrics_http",
 ]
 
 # Fixed bucket edges (upper bounds, seconds / bytes / ratio). Fixed — not
@@ -72,6 +73,17 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
 SIZE_BUCKETS: Tuple[float, ...] = tuple(
     float(256 << (2 * i)) for i in range(12))      # 256 B .. 512 MB
 RATIO_BUCKETS: Tuple[float, ...] = tuple(i / 10.0 for i in range(1, 11))
+# Serving latencies (TTFT / TPOT / push lag): the v2 stream wire put
+# client TTFT around 10ms and per-token push lag well under 1ms, which
+# LATENCY_BUCKETS is too coarse to resolve — an explicit set dense from
+# 250µs through the tens-of-ms band. Passed explicitly (buckets=) at
+# every observe site of serve_ttft_seconds / serve_tpot_seconds /
+# transport_stream_push_lag_seconds: the registry freezes a family's
+# layout at first registration, so every site must agree.
+SERVE_LATENCY_BUCKETS: Tuple[float, ...] = (
+    2.5e-4, 5e-4, 7.5e-4, 1e-3, 1.5e-3, 2.5e-3, 4e-3, 6e-3, 1e-2,
+    1.5e-2, 2.5e-2, 4e-2, 6e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0)
 
 
 class Counter:
@@ -390,6 +402,9 @@ _HELP: Dict[str, str] = {
         "fleet.rolling_restart().",
     "transport_membership_total":
         "RemoteDispatcher membership changes (join/readmit/leave).",
+    "transport_stream_push_lag_seconds":
+        "v2 stream wire: engine token callback -> frame on the socket.",
+    "serve_queue_wait_seconds": "Serving submit -> admission wait.",
 }
 
 
@@ -878,6 +893,89 @@ def on_shutdown() -> None:
     registry.counter("shutdown_total").inc()
     stop_stall_watchdog()
     stop_metrics_flusher(final_write=True)
+
+
+# ---------------------------------------------------------------------------
+# live scrape endpoint (hvd.metrics_http)
+# ---------------------------------------------------------------------------
+
+class MetricsHTTPServer:
+    """Tiny stdlib HTTP endpoint for live scraping.
+
+    ``GET /metrics`` returns :func:`to_prometheus` (text exposition
+    0.0.4) — what Prometheus scrapes instead of tailing
+    ``HOROVOD_METRICS_FILE``. ``GET /trace`` returns the live
+    request-trace span buffer as a Chrome-trace JSON document (empty
+    ``traceEvents`` when request tracing is off). Serves on a daemon
+    thread; :meth:`stop` shuts it down."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:           # noqa: N802 — stdlib API
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = to_prometheus().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/trace":
+                    try:
+                        from horovod_tpu.serving import reqtrace
+                        evs = reqtrace.events()
+                    except Exception:
+                        evs = []
+                    body = json.dumps(
+                        {"traceEvents": evs, "displayTimeUnit": "ms"},
+                        default=str).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass                            # scrapes are not stderr news
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"hvd-metrics-http-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+def metrics_http(port: int = 0, host: str = "127.0.0.1", *,
+                 fallback_ports: int = 0) -> MetricsHTTPServer:
+    """Start the live scrape endpoint (``hvd.metrics_http``).
+
+    ``port=0`` binds an ephemeral port (the server object's ``.port``
+    says which). ``fallback_ports=k`` retries ``port+1 .. port+k`` when
+    the requested port is taken — replica servers pass their rank offset
+    here so co-hosted processes under one ``HOROVOD_METRICS_PORT`` don't
+    collide. Raises ``OSError`` when nothing in the range binds."""
+    last: Optional[OSError] = None
+    for p in range(port, port + max(0, int(fallback_ports)) + 1):
+        try:
+            return MetricsHTTPServer(p, host)
+        except OSError as e:
+            last = e
+            if port == 0:
+                break
+    raise last if last is not None else OSError("metrics_http: no port")
 
 
 # ``hvd.metrics`` must be BOTH this submodule (so ``from horovod_tpu.metrics

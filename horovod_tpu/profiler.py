@@ -946,28 +946,41 @@ def _load_snapshot(snapshot) -> Dict[str, Any]:
     return snapshot
 
 
-def _load_report(trace) -> Optional[Dict[str, Any]]:
-    """Normalize the ``trace`` input to a straggler report dict: accepts a
-    merged-trace dict, a bare report dict, a merged-trace JSON path, or a
-    shard base path / glob / directory (merged on the fly)."""
+def _load_reports(trace) -> Tuple[Optional[Dict[str, Any]],
+                                  Optional[Dict[str, Any]]]:
+    """Normalize the ``trace`` input to ``(straggler_report,
+    request_report)``: accepts a merged-trace dict, a bare report dict, a
+    merged-trace JSON path, or a shard base path / glob / directory
+    (merged on the fly). Either element is None when the trace has no
+    collective (resp. request) events."""
     if trace is None:
-        return None
+        return None, None
     if isinstance(trace, dict):
-        if "stragglerReport" in trace:
-            return trace["stragglerReport"]
+        if "stragglerReport" in trace or "requestReport" in trace:
+            return trace.get("stragglerReport"), trace.get("requestReport")
         if "collectives" in trace:
-            return trace
-        return None
+            return trace, None
+        if "requests" in trace:
+            return None, trace
+        return None, None
     if os.path.isfile(trace):
         try:
             with open(trace) as f:
                 doc = json.load(f)
-            if isinstance(doc, dict) and "stragglerReport" in doc:
-                return doc["stragglerReport"]
+            if isinstance(doc, dict) and ("stragglerReport" in doc
+                                          or "requestReport" in doc):
+                return (doc.get("stragglerReport"),
+                        doc.get("requestReport"))
         except ValueError:
             pass
     from horovod_tpu.trace_merge import merge_timelines
-    return merge_timelines(trace, feed_metrics=False)["stragglerReport"]
+    doc = merge_timelines(trace, feed_metrics=False)
+    return doc.get("stragglerReport"), doc.get("requestReport")
+
+
+def _load_report(trace) -> Optional[Dict[str, Any]]:
+    """Straggler-report half of :func:`_load_reports` (back-compat)."""
+    return _load_reports(trace)[0]
 
 
 def _finding(category: str, severity: float, title: str, detail: str,
@@ -1338,19 +1351,94 @@ def _check_recovery(snap) -> List[Dict]:
     return out
 
 
-def _check_serving(snap) -> List[Dict]:
+def _fmt_breakdown(mean: Dict[str, float]) -> str:
+    """``queue 12ms, prefill 3ms, ...`` — non-zero components only."""
+    return ", ".join(f"{k} {v * 1e3:.1f}ms" for k, v in mean.items()
+                     if v > 0) or "no components recorded"
+
+
+def _check_requests(rreport) -> List[Dict]:
+    """Tail-latency triage from the request-trace report
+    (``merge_timelines`` attaches it when the merged trace has request
+    spans): name WHERE the p99 TTFT went and which knob moves it."""
+    if not rreport or not rreport.get("count"):
+        return []
+    mean = {k: float(v)
+            for k, v in (rreport.get("breakdown_mean_s") or {}).items()}
+    total = sum(mean.values())
+    dom = rreport.get("dominant_component")
+    p99 = float(rreport.get("ttft_p99_s") or 0.0)
+    if not dom or total <= 0 or mean.get(dom, 0.0) < 0.3 * total:
+        return []
+    frac = mean[dom] / total
+    n = int(rreport["count"])
+    detail = (f"across {n} traced request(s), p99 TTFT is {p99 * 1e3:.1f}ms "
+              f"and the mean breakdown is {_fmt_breakdown(mean)} — "
+              f"{dom} dominates ({frac:.0%})")
+    sev = 0.35 + min(0.3, frac - 0.3)
+    out: List[Dict] = []
+    if dom == "queue":
+        out.append(_finding(
+            "request_tail", sev,
+            f"TTFT is queue-dominated ({mean[dom] * 1e3:.1f}ms mean wait)",
+            detail,
+            "requests wait for a decode lane before any work starts: add "
+            "lanes (HOROVOD_SERVE_SLOTS) or replicas, or lower admitted "
+            "concurrency so the queue drains.",
+            dominant=dom, fraction=round(frac, 3),
+            breakdown_mean_s=mean))
+    elif dom == "push":
+        out.append(_finding(
+            "request_tail", sev,
+            f"TTFT is push-lag-dominated ({mean[dom] * 1e3:.1f}ms mean)",
+            detail,
+            "tokens are generated but late leaving the server: check "
+            "transport_stream_push_lag_seconds, the push pump's batch "
+            "backlog, and the network path between replica and client.",
+            dominant=dom, fraction=round(frac, 3),
+            breakdown_mean_s=mean))
+    elif dom == "hedge_wait":
+        blame = {k: float(v) for k, v
+                 in (rreport.get("replica_blame_s") or {}).items()}
+        worst = rreport.get("dominant_replica") or (
+            max(blame, key=blame.get) if blame else None)
+        hedged = int(rreport.get("hedged") or 0)
+        out.append(_finding(
+            "request_tail", sev,
+            "TTFT is dominated by retries/hedges waiting out a slow "
+            "replica" + (f" ({worst})" if worst else ""),
+            detail + (f"; {hedged} request(s) hedged; per-replica blame: "
+                      f"{ {k: round(v, 3) for k, v in sorted(blame.items())} }"
+                      if blame else ""),
+            ("inspect replica "
+             f"{worst or '<unknown>'}: its submit path is slow enough "
+             "that hedges fire and win — check its queue depth, breaker "
+             "state, and host; draining or restarting it moves the tail."),
+            dominant=dom, fraction=round(frac, 3),
+            slow_replica=worst, hedged=hedged))
+    return out
+
+
+def _check_serving(snap, rreport=None) -> List[Dict]:
     out = []
     submitted = _sum_counter(snap, "serve_requests_total",
                              status="submitted")
     expired = _sum_counter(snap, "serve_requests_total", status="expired")
     if submitted > 0 and expired > 0:
         frac = expired / submitted
+        burn_detail = ("requests are missing their deadlines (queued "
+                       "expiry or mid-flight EXPIRED)")
+        if rreport and rreport.get("count"):
+            burn_detail += ("; traced-request mean TTFT breakdown: "
+                            + _fmt_breakdown(
+                                {k: float(v) for k, v in
+                                 (rreport.get("breakdown_mean_s")
+                                  or {}).items()}))
         out.append(_finding(
             "serving_slo", 0.4 + min(0.5, frac),
             f"serving SLO burn: {int(expired)}/{int(submitted)} requests "
             f"expired ({frac:.0%})",
-            "requests are missing their deadlines (queued expiry or "
-            "mid-flight EXPIRED)",
+            burn_detail,
             "add decode lanes (HOROVOD_SERVE_SLOTS) or replicas, shrink "
             "HOROVOD_SERVE_PREFILL_CHUNK so long prompts stall decodes "
             "less, check serve_queue_wait_seconds for admission backlog, "
@@ -1692,17 +1780,18 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     suggestion. Returns ``{"findings": [...], "healthy": bool,
     "inputs": {...}}``; render with :func:`format_report`."""
     snap = _load_snapshot(snapshot)
-    report = _load_report(trace)
+    report, rreport = _load_reports(trace)
     progs = programs if programs is not None else registry.snapshot()
 
     findings: List[Dict[str, Any]] = []
     findings += _check_stalls(snap)
     findings += _check_straggler(report)
+    findings += _check_requests(rreport)
     findings += _check_recompiles(snap, progs)
     findings += _check_memory(snap)
     findings += _check_sharding(snap)
     findings += _check_recovery(snap)
-    findings += _check_serving(snap)
+    findings += _check_serving(snap, rreport)
     findings += _check_prefix(snap)
     findings += _check_transport(snap)
     findings += _check_fleet(snap)
@@ -1719,7 +1808,8 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
         "healthy": not any(f["severity"] >= 0.5 for f in findings),
         "inputs": {
             "snapshot": "live" if snapshot is None else "provided",
-            "trace": "none" if report is None else "provided",
+            "trace": ("none" if report is None and rreport is None
+                      else "provided"),
             "programs": sorted(progs or {}),
         },
     }

@@ -41,9 +41,16 @@ ROADMAP's "heavy traffic from millions of users" north star needs:
 
 Observability is wired through PRs 1–2: TTFT/TPOT/queue-wait histograms,
 slot-occupancy and queue-depth gauges, per-request timeline markers, and
-stall-watchdog coverage of stuck decode steps. See docs/SERVING.md.
+stall-watchdog coverage of stuck decode steps. On top of those,
+:mod:`~horovod_tpu.serving.reqtrace` follows ONE request end to end —
+a trace context minted at submit rides the wire into the engine, and
+every hop (submit/retry/hedge, queue, prefill, decode, token push)
+becomes a span ``hvd.merge_timelines`` stitches into per-process tracks
+with a TTFT breakdown report. See docs/SERVING.md and
+docs/OBSERVABILITY.md "Request tracing".
 """
 
+from horovod_tpu.serving import reqtrace  # noqa: F401
 from horovod_tpu.serving.cache import BlockManager, PagedKVCache  # noqa: F401
 from horovod_tpu.serving.engine import InferenceEngine  # noqa: F401
 from horovod_tpu.serving.scheduler import (  # noqa: F401
@@ -70,4 +77,5 @@ __all__ = [
     "backoff_delays",
     "FleetSupervisor", "ProcessLauncher", "ProcessReplica",
     "ReplicaSlot",
+    "reqtrace",
 ]

@@ -66,6 +66,7 @@ from horovod_tpu.models.generate import (
     decode_family, decode_step, decode_verify_step, greedy_token,
     t5_decoder_bias, t5_encode,
 )
+from horovod_tpu.serving import reqtrace
 from horovod_tpu.serving.cache import BlockManager, PagedKVCache, TRASH_BLOCK
 from horovod_tpu.serving.scheduler import (
     Request, RequestQueue, RequestStatus, SlotPool,
@@ -79,13 +80,14 @@ class _SlotState:
     been fed (prompt first, then the request's own output); the next
     input goes to position ``n_fed``."""
 
-    __slots__ = ("request", "slot", "n_fed", "span")
+    __slots__ = ("request", "slot", "n_fed", "span", "decode_steps")
 
     def __init__(self, request: Request, slot: int, span) -> None:
         self.request = request
         self.slot = slot
         self.n_fed = 0
         self.span = span
+        self.decode_steps = 0
 
 
 class InferenceEngine:
@@ -604,6 +606,7 @@ class InferenceEngine:
         req = st.request
         if req.tpot is not None:
             metrics.histogram("serve_tpot_seconds",
+                              buckets=metrics.SERVE_LATENCY_BUCKETS,
                               engine=self.name).observe(req.tpot)
         metrics.counter("serve_tokens_generated_total",
                         engine=self.name).inc(len(req.tokens))
@@ -658,6 +661,13 @@ class InferenceEngine:
             metrics.event("serve_admit", engine=self.name, request=req.id,
                           slot=slot, prompt_len=len(req.prompt),
                           op_id=span.op_id)
+            if req.trace is not None and reqtrace.enabled():
+                qw = max(0.0, float(req.queue_wait or 0.0))
+                reqtrace.emit("QUEUE", req.trace, time.time() - qw, qw,
+                              engine=self.name, request=req.id)
+                reqtrace.instant("ADMIT", req.trace, engine=self.name,
+                                 request=req.id, slot=slot,
+                                 prefix_tokens=n_matched)
             if n_matched > 0:
                 metrics.counter("prefix_tokens_reused_total",
                                 engine=self.name).inc(n_matched)
@@ -760,6 +770,26 @@ class InferenceEngine:
             t = self._mpmod.mp_broadcast(np.asarray(t), self._mesh2d)
         return t
 
+    def _emit_decode_spans(self, lanes: List[Tuple[int, _SlotState]],
+                           t0_wall: float, dur_s: float) -> None:
+        """One DECODE span per traced lane, sampled every
+        ``HOROVOD_REQUEST_TRACE_DECODE_EVERY`` steps (the first step of a
+        lane always emits) so a long generation costs O(tokens/N) spans."""
+        try:
+            from horovod_tpu.config import get_config
+            every = max(1, int(get_config().request_trace_decode_every))
+        except Exception:
+            every = 16
+        for slot, st in lanes:
+            if st.request.trace is None:
+                continue
+            st.decode_steps += 1
+            if (st.decode_steps - 1) % every == 0:
+                reqtrace.emit("DECODE", st.request.trace, t0_wall, dur_s,
+                              engine=self.name, request=st.request.id,
+                              slot=slot, step=st.decode_steps,
+                              sampled_every=every)
+
     def _run_decode(self, lanes: List[Tuple[int, _SlotState]]) -> None:
         K = self.spec_k + 1
         tok_seq = np.zeros((K, self.slots), np.int32)
@@ -796,12 +826,19 @@ class InferenceEngine:
                 r = self.manager.ensure_writable(slot, q)
                 if r is not None:
                     cow_src[slot], cow_dst[slot] = r
+                    if req.trace is not None and reqtrace.enabled():
+                        reqtrace.instant("COW", req.trace,
+                                         engine=self.name, request=req.id,
+                                         slot=slot, pos=q, phase="decode")
         cache = self._cache.replace(table=self._device_table())
+        _rt_t0 = time.time()
         cache, first, greedy = self._dispatch(
             "decode", self._decode_jit, self.params, cache,
             self._dev(tok_seq), self._dev(pos0), self._dev(counts),
             self._dev(act), self._dev(cow_src), self._dev(cow_dst),
             self._extras)
+        if reqtrace.enabled():
+            self._emit_decode_spans(lanes, _rt_t0, time.time() - _rt_t0)
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
         greedy_np = self._host(greedy)                   # (K, slots)
@@ -864,12 +901,26 @@ class InferenceEngine:
                 r = self.manager.ensure_writable(slot, q)
                 if r is not None:
                     cow_src[slot], cow_dst[slot] = r
+                    if st.request.trace is not None and reqtrace.enabled():
+                        reqtrace.instant("COW", st.request.trace,
+                                         engine=self.name,
+                                         request=st.request.id,
+                                         slot=slot, pos=q, phase="prefill")
         cache = self._cache.replace(table=self._device_table())
+        _rt_t0 = time.time()
         cache, final, greedy = self._dispatch(
             "prefill", self._prefill_jit, self.params, cache,
             self._dev(tok_seq), self._dev(pos0), self._dev(count),
             self._dev(act), self._dev(cow_src), self._dev(cow_dst),
             self._extras)
+        if reqtrace.enabled():
+            _rt_dur = time.time() - _rt_t0
+            for slot, st in lanes:
+                if st.request.trace is not None:
+                    reqtrace.emit("PREFILL", st.request.trace, _rt_t0,
+                                  _rt_dur, engine=self.name,
+                                  request=st.request.id, slot=slot,
+                                  tokens=int(count[slot]))
         self._cache = cache
         self.manager.set_device_mirror(cache.table)
         greedy_np = self._host(greedy)
@@ -912,9 +963,14 @@ class InferenceEngine:
         req._commit(token)
         if first:
             metrics.histogram("serve_ttft_seconds",
+                              buckets=metrics.SERVE_LATENCY_BUCKETS,
                               engine=self.name).observe(req.ttft)
             metrics.event("serve_first_token", engine=self.name,
                           request=req.id, op_id=st.span.op_id)
+            if req.trace is not None and reqtrace.enabled():
+                reqtrace.instant("FIRST_TOKEN", req.trace,
+                                 engine=self.name, request=req.id,
+                                 side="server", ttft_s=req.ttft)
             if self.prefix_enabled:
                 self.manager.register_prefix(slot, req.prompt)
         if (req.eos_id is not None and token == req.eos_id) \

@@ -249,6 +249,7 @@ class FleetSupervisor:
         self._thread: Optional[threading.Thread] = None
         self._member_version = 0
         self._members: Dict[str, Dict[str, Any]] = {}
+        self._metrics_srv: Optional[Any] = None
         metrics.gauge("fleet_target_replicas").set(float(self.target))
 
     # -- membership file --------------------------------------------------
@@ -305,6 +306,7 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="start", target=self.target,
                                  spares=self.spares)
+        self._start_metrics_http()
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._run, name="hvd-fleet", daemon=True)
@@ -320,6 +322,24 @@ class FleetSupervisor:
                 f"{[s.describe() for s in self._slots]}")
         return self
 
+    def _start_metrics_http(self) -> None:
+        """Expose the supervisor's registry over HTTP when
+        ``HOROVOD_METRICS_PORT`` is set. Replica servers claim
+        ``base + rank``, so the supervisor scans upward from the base
+        for a free port rather than colliding with rank 0."""
+        from horovod_tpu.config import get_config
+        base = get_config().metrics_port
+        if base <= 0 or self._metrics_srv is not None:
+            return
+        try:
+            self._metrics_srv = metrics.metrics_http(base,
+                                                     fallback_ports=32)
+        except OSError as exc:
+            logger = metrics.logger if hasattr(metrics, "logger") else None
+            if logger is not None:
+                logger.warning("fleet: metrics endpoint unavailable: %s",
+                               exc)
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -331,6 +351,12 @@ class FleetSupervisor:
                     slot.handle.stop()
                 except Exception:
                     pass
+        if self._metrics_srv is not None:
+            try:
+                self._metrics_srv.stop()
+            except Exception:
+                pass
+            self._metrics_srv = None
         metrics._timeline_marker("FLEET", category="fleet", event="stop")
 
     def _run(self) -> None:

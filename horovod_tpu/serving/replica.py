@@ -50,6 +50,7 @@ import numpy as np
 
 from horovod_tpu import metrics
 from horovod_tpu.faults import fault_point
+from horovod_tpu.serving import reqtrace
 from horovod_tpu.serving.engine import InferenceEngine
 from horovod_tpu.serving.scheduler import Request, RequestStatus
 from horovod_tpu.serving.transport import backoff_delays
@@ -88,7 +89,17 @@ class Dispatcher:
     def submit(self, *args, **kw) -> Request:
         """Submit to the least-loaded live engine. With every replica
         gone the request is rejected with a reason, like any other
-        backpressure signal."""
+        backpressure signal.
+
+        This is the in-process trace-mint site: when request tracing is
+        on and the caller did not bring its own context (the socket
+        transport mints at :class:`RemoteDispatcher`), a fresh trace
+        context is minted here and rides the request into the engine."""
+        tr = None
+        if reqtrace.enabled() and "trace" not in kw:
+            tr = reqtrace.mint_context()
+            kw["trace"] = tr.wire()
+        t0 = time.time()
         with self._lock:
             live = self.live_engines()
             if not live:
@@ -103,6 +114,9 @@ class Dispatcher:
                               mnt, **kw)
                 req.retryable = True
                 req._finish(RequestStatus.REJECTED, "no live replicas")
+                if tr is not None:
+                    reqtrace.emit("SUBMIT", tr, t0, time.time() - t0,
+                                  request=req.id, outcome="rejected")
                 return req
             ordered = sorted(live, key=lambda e: e.load())
         req = ordered[0].submit(*args, **kw)
@@ -112,6 +126,9 @@ class Dispatcher:
             if req.status != RequestStatus.REJECTED:
                 break
             req = eng.submit(*args, **kw)
+        if tr is not None:
+            reqtrace.emit("SUBMIT", tr, t0, time.time() - t0,
+                          request=req.id)
         return req
 
     def _adopt(self, source: InferenceEngine,
@@ -194,6 +211,11 @@ def submit_file_request(root: str, prompt, max_new_tokens: int, *,
                "submitted_unix": time.time()}
     if src is not None:
         payload["src"] = list(map(int, src))
+    if reqtrace.enabled():
+        ctx = reqtrace.mint_context()
+        payload["trace"] = ctx.wire()
+        reqtrace.emit("SUBMIT", ctx, time.time(), 0.0, request=rid,
+                      protocol="file")
     _write_atomic(os.path.join(d["spool"], f"{rid}.json"), payload)
     return rid
 
@@ -366,6 +388,7 @@ class ReplicaServer:
             priority=payload.get("priority", 0),
             eos_id=payload.get("eos_id"),
             src=payload.get("src"),
+            trace=payload.get("trace"),
             request_id=rid)
         self._claimed[rid] = {"payload": payload, "request": req,
                               "claim_path": claim_path}

@@ -67,7 +67,8 @@ class Request:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  seed: Optional[int] = None, eos_id: Optional[int] = None,
                  src=None, request_id: Optional[str] = None,
-                 on_token: Optional[Callable[["Request", int], None]] = None):
+                 on_token: Optional[Callable[["Request", int], None]] = None,
+                 trace: Optional[dict] = None):
         self.seq = next(_REQ_SEQ)
         self.id = request_id or f"req-{self.seq}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -80,6 +81,10 @@ class Request:
         self.eos_id = eos_id
         self.src = None if src is None else np.asarray(src, np.int32)
         self.on_token = on_token
+        #: request-trace context (reqtrace wire dict) minted at the
+        #: dispatcher; every engine hop emits spans against it. None when
+        #: tracing is off or the submitter predates it.
+        self.trace = trace
         self._rng = (np.random.default_rng(seed)
                      if temperature > 0 else None)
         self.tokens: List[int] = []

@@ -93,6 +93,7 @@ from typing import (Any, Callable, Dict, Iterator, List, Optional,
                     Sequence, Set, Tuple)
 
 from horovod_tpu import faults, metrics
+from horovod_tpu.serving import reqtrace
 from horovod_tpu.serving.scheduler import Request, RequestStatus
 
 __all__ = ["TransportError", "backoff_delays", "CircuitBreaker",
@@ -424,14 +425,14 @@ class _PushPump:
         self.conn = conn
         self.wlock = wlock
         self._cond = threading.Condition()
-        self._buf: List[Tuple[float, int, bytes]] = []
+        self._buf: List[Tuple[float, float, int, bytes, Any]] = []
         self._dead: Optional[str] = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"hvd-push-{name}")
         self._thread.start()
 
     def send(self, stream_id: int, opcode: int,
-             payload: Dict[str, Any]) -> None:
+             payload: Dict[str, Any], trace: Any = None) -> None:
         data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
         if len(data) + 5 > _MAX_FRAME:
             raise TransportError("protocol",
@@ -442,7 +443,8 @@ class _PushPump:
         with self._cond:
             if self._dead is not None:
                 raise ConnectionError(f"push pump dead: {self._dead}")
-            self._buf.append((time.perf_counter(), opcode, frame))
+            self._buf.append((time.perf_counter(), time.time(), opcode,
+                              frame, trace))
             self._cond.notify()
 
     def close(self) -> None:
@@ -461,22 +463,25 @@ class _PushPump:
                 batch, self._buf = self._buf, []
             try:
                 with self.wlock:
-                    self.conn.sendall(b"".join(f for _, _, f in batch))
+                    self.conn.sendall(b"".join(f for _, _, _, f, _ in batch))
             except OSError as e:
                 with self._cond:
                     if self._dead is None:
                         self._dead = repr(e)
                 return
             now = time.perf_counter()
-            for t0, opcode, _ in batch:
+            for t0, wall0, opcode, _, trace in batch:
                 metrics.counter(
                     "transport_frames_total",
                     opcode=_OPCODE_NAMES.get(opcode, str(opcode)),
                     dir="tx").inc()
                 if opcode == OP_TOKEN:
+                    lag = now - t0
                     metrics.histogram(
-                        "transport_stream_push_lag_seconds").observe(
-                            now - t0)
+                        "transport_stream_push_lag_seconds",
+                        buckets=metrics.SERVE_LATENCY_BUCKETS).observe(lag)
+                    if trace is not None and reqtrace.enabled():
+                        reqtrace.emit("PUSH_DELIVERY", trace, wall0, lag)
 
 
 class _ServerSink:
@@ -500,11 +505,13 @@ class _ServerSink:
         self.sid = sid
         self.pump = pump
 
-    def send_token(self, rid: str, i: int, tok: int) -> None:
+    def send_token(self, rid: str, i: int, tok: int,
+                   trace: Any = None) -> None:
         if faults.partitioned(self.server.rank):
             raise ConnectionError("partitioned mid-stream")
         self.pump.send(self.sid, OP_TOKEN,
-                       {"id": rid, "i": int(i), "tok": int(tok)})
+                       {"id": rid, "i": int(i), "tok": int(tok)},
+                       trace=trace)
 
     def send_terminal(self, state: Dict[str, Any]) -> None:
         if faults.partitioned(self.server.rank):
@@ -543,6 +550,7 @@ class SocketReplicaServer:
         self._sinks: Dict[str, _ServerSink] = {}
         self._rpc_seq = itertools.count(1)
         self.served_rpcs = 0
+        self._metrics_srv: Optional[Any] = None
 
     # -- request registry -------------------------------------------------
 
@@ -622,6 +630,8 @@ class SocketReplicaServer:
                 kw["src"] = list(map(int, p["src"]))
             if p.get("deadline_s") is not None:
                 kw["deadline_s"] = float(p["deadline_s"])
+            if isinstance(p.get("trace"), dict):
+                kw["trace"] = p["trace"]
             if sink is not None:
                 # Register the sink BEFORE engine.submit so tokens
                 # committed while submit is still returning get pushed.
@@ -653,7 +663,8 @@ class SocketReplicaServer:
             if sink is None:
                 return
             try:
-                sink.send_token(rid, len(req.tokens) - 1, tok)
+                sink.send_token(rid, len(req.tokens) - 1, tok,
+                                trace=req.trace)
             except (OSError, ConnectionError, TransportError):
                 with self._lock:
                     if self._sinks.get(rid) is sink:
@@ -987,7 +998,30 @@ class SocketReplicaServer:
         self._thread = threading.Thread(
             target=loop, name=f"hvd-rpc-{self.name}", daemon=True)
         self._thread.start()
+        self._start_metrics_http()
         return self
+
+    def _start_metrics_http(self) -> None:
+        """Under HOROVOD_METRICS_PORT, expose this replica's registry at
+        port+rank (rank 0 gets the bare port; the fallback scan covers
+        co-hosted processes racing for the same offset)."""
+        if getattr(self, "_metrics_srv", None) is not None:
+            return
+        try:
+            from horovod_tpu.config import get_config
+            base = int(get_config().metrics_port)
+        except Exception:
+            base = 0
+        if base <= 0:
+            return
+        try:
+            self._metrics_srv = metrics.metrics_http(
+                base + self.rank, fallback_ports=16)
+        except OSError:
+            metrics.logger.warning(
+                "replica %s: no free metrics port near %d",
+                self.name, base + self.rank)
+            self._metrics_srv = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -998,6 +1032,10 @@ class SocketReplicaServer:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        srv = getattr(self, "_metrics_srv", None)
+        if srv is not None:
+            srv.stop()
+            self._metrics_srv = None
         self.engine.stop()
 
 
@@ -1280,6 +1318,10 @@ class RemoteClient:
             if not self.breaker.allow():
                 metrics.histogram("transport_rpc_seconds", method=method,
                                   outcome="circuit_open").observe(0.0)
+                tr = params.get("trace")
+                if tr is not None and reqtrace.enabled():
+                    reqtrace.instant("BREAKER_WAIT", tr, method=method,
+                                     replica=self.name)
                 raise TransportError(
                     "circuit_open", f"{method} to {self.name}: circuit "
                     "open", retryable=True)
@@ -1309,6 +1351,10 @@ class RemoteClient:
                                          event="retry", method=method,
                                          replica=self.name,
                                          attempt=attempts)
+                tr = params.get("trace")
+                if tr is not None and reqtrace.enabled():
+                    reqtrace.instant("RETRY", tr, method=method,
+                                     replica=self.name, attempt=attempts)
                 time.sleep(next(delays))
                 continue
             self.breaker.success()
@@ -1446,8 +1492,17 @@ class RemoteHandle:
             self.tpot = st.get("tpot")
             if self.tokens and self.ttft_client is None:
                 self.ttft_client = time.monotonic() - self.t_submit
+                self._trace_first_token(client)
             fire = self._pending_callbacks()
         self._fire_callbacks(fire)
+
+    def _trace_first_token(self, client) -> None:
+        tr = self.spec.get("trace")
+        if tr is not None and reqtrace.enabled():
+            reqtrace.instant("CLIENT_FIRST_TOKEN", tr,
+                             request=self.id, side="client",
+                             replica=getattr(client, "name", None),
+                             ttft_s=self.ttft_client)
 
     # -- push-mode plumbing (called from stream reader threads) -----------
 
@@ -1479,6 +1534,7 @@ class RemoteHandle:
                     self.status = "running"
                 if self.ttft_client is None:
                     self.ttft_client = time.monotonic() - self.t_submit
+                    self._trace_first_token(client)
             fire = self._pending_callbacks()
         self._fire_callbacks(fire)
         self._wake.set()
@@ -1841,6 +1897,17 @@ class RemoteDispatcher:
             spec["src"] = list(map(int, src))
         deadline = (time.monotonic() + float(deadline_s)
                     if deadline_s is not None else None)
+        if reqtrace.enabled():
+            # Mint the trace context HERE — the submit boundary — so the
+            # "trace" key rides the RPC params over either wire and every
+            # downstream hop (server queue, engine, push pump) emits
+            # spans under one trace_id.
+            ctx = reqtrace.mint_context()
+            spec["trace"] = ctx.wire()
+            handle = RemoteHandle(spec, deadline)
+            with reqtrace.span("SUBMIT", ctx, request=rid):
+                self._place(handle)
+            return handle
         handle = RemoteHandle(spec, deadline)
         self._place(handle)
         return handle
@@ -1856,11 +1923,31 @@ class RemoteDispatcher:
         """Submit over the client's native wire: stream clients attach a
         push sink (tokens/terminal arrive without polling); legacy
         clients and duck-typed stubs take the plain submit."""
-        if self._is_stream(client):
-            return client.submit_stream(
-                handle.spec, sink=_HandleSink(handle, client),
-                deadline=handle.deadline)
-        return client.submit(handle.spec, deadline=handle.deadline)
+        tr = handle.spec.get("trace")
+        if tr is None or not reqtrace.enabled():
+            if self._is_stream(client):
+                return client.submit_stream(
+                    handle.spec, sink=_HandleSink(handle, client),
+                    deadline=handle.deadline)
+            return client.submit(handle.spec, deadline=handle.deadline)
+        # Traced: each placement target is one ATTEMPT child span — a
+        # hedge produces a second ATTEMPT under the same trace_id, and
+        # the first-terminal-wins HEDGE_WIN instant names the winner.
+        t0 = time.time()
+        outcome = "error"
+        try:
+            if self._is_stream(client):
+                st = client.submit_stream(
+                    handle.spec, sink=_HandleSink(handle, client),
+                    deadline=handle.deadline)
+            else:
+                st = client.submit(handle.spec, deadline=handle.deadline)
+            outcome = st.get("status", "ok")
+            return st
+        finally:
+            reqtrace.emit("ATTEMPT", tr, t0, time.time() - t0,
+                          request=handle.id, target=client.name,
+                          outcome=outcome)
 
     def _place(self, handle: RemoteHandle,
                exclude: Sequence[RemoteClient] = ()) -> bool:
@@ -1918,6 +2005,11 @@ class RemoteDispatcher:
         backups = self._ranked(exclude=handle.owners)
         if not backups:
             return
+        tr = handle.spec.get("trace")
+        if tr is not None and reqtrace.enabled():
+            reqtrace.instant("HEDGE", tr, request=handle.id,
+                             target=backups[0].name,
+                             hedge_s=self.hedge_s)
         try:
             st = self._submit_to(backups[0], handle)
         except TransportError:
@@ -1951,6 +2043,7 @@ class RemoteDispatcher:
                 if handle.status == "done" and handle.hedged \
                         and first is not None and client is not first:
                     metrics.counter("transport_hedge_wins_total").inc()
+                    self._trace_hedge_win(handle, client)
                 self._cancel_others(handle, keep=client)
 
     def wait(self, handle: RemoteHandle,
@@ -2013,6 +2106,7 @@ class RemoteDispatcher:
                 if handle.hedged and handle.owners \
                         and client is not handle.owners[0]:
                     metrics.counter("transport_hedge_wins_total").inc()
+                    self._trace_hedge_win(handle, client)
                 self._cancel_others(handle, keep=client)
                 return handle
             if not handle.owners and not handle.terminal:
@@ -2023,6 +2117,17 @@ class RemoteDispatcher:
             # wakes the loop NOW instead of after the poll interval,
             # which is exactly the TTFT tax v2 removes.
             handle._wake.wait(next(delays))
+
+    @staticmethod
+    def _trace_hedge_win(handle: RemoteHandle, client) -> None:
+        """Mark first-terminal-wins on the winning hedge attempt: the
+        HEDGE_WIN instant names the winner so the request report (and a
+        human in the trace viewer) can tell the winning ATTEMPT span
+        from the losing one."""
+        tr = handle.spec.get("trace")
+        if tr is not None and reqtrace.enabled():
+            reqtrace.instant("HEDGE_WIN", tr, request=handle.id,
+                             winner=getattr(client, "name", None))
 
     def _expire_locally(self, handle: RemoteHandle) -> RemoteHandle:
         if not handle.terminal:

@@ -24,6 +24,12 @@ reconstructed after the fact:
   latency, is what determines step time on mesh/ring topologies (see
   PAPERS: arxiv 2011.03605, 2401.09356); this report measures it.
 
+* **Request tracks** — request-trace shards (``serving/reqtrace``, one per
+  dispatcher/replica process) merge onto dedicated ``pid >= 1000`` tracks,
+  wall-clock aligned through any anchored rank shard, and feed a
+  ``requestReport`` with per-request TTFT breakdowns (see
+  :func:`request_report`).
+
 A truncated or corrupt shard degrades to a warning (its parseable prefix is
 salvaged when possible); the merge never crashes on one bad rank.
 """
@@ -33,6 +39,7 @@ from __future__ import annotations
 import glob
 import json
 import logging
+import math
 import os
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -40,7 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 logger = logging.getLogger("horovod_tpu")
 
 __all__ = ["merge_timelines", "discover_shards", "load_shard",
-           "straggler_report", "overlap_report"]
+           "straggler_report", "overlap_report", "request_report"]
 
 #: phase-event names (tracing.phase) that mark a collective's host phases
 PHASE_NAMES = ("NEGOTIATE", "QUEUE", "EXEC")
@@ -139,6 +146,25 @@ def _shard_rank(path: str, events: List[dict], ordinal: int) -> int:
             except (KeyError, TypeError, ValueError):
                 break
     return _shard_rank_from_name(path, ordinal)
+
+
+def _request_shard_meta(events: List[dict]) -> Optional[Dict[str, Any]]:
+    """The ``shard_meta`` args of a request-trace shard (``serving/
+    reqtrace.flush``), identified by ``role == "request"`` — else None.
+    Request shards are NOT rank shards: they have no collective op-ids,
+    no clock anchor, and their own pid track space in the merge."""
+    for e in events:
+        if e.get("name") != "shard_meta":
+            continue
+        args = e.get("args") or {}
+        if args.get("role") == "request":
+            return args
+        return None
+    # A salvaged (truncated) reqtrace shard can lose its shard_meta
+    # header order — fall back to the event category.
+    if any(e.get("cat") == "request" for e in events):
+        return {"role": "request"}
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -441,6 +467,161 @@ def _algorithm_summary(shards: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# request report
+# ---------------------------------------------------------------------------
+
+#: TTFT breakdown component names, in pipeline order.
+REQUEST_COMPONENTS = ("hedge_wait", "queue", "prefill", "decode", "push",
+                      "other")
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (empty → 0)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+def request_report(events_or_doc: Union[Dict[str, Any], Sequence[dict]]
+                   ) -> Dict[str, Any]:
+    """Per-request TTFT breakdown over ``cat == "request"`` span events.
+
+    Groups request spans (``serving/reqtrace``) by ``args.trace_id`` and,
+    for each traced request, decomposes its time-to-first-token into
+    components — ``hedge_wait`` (submit until the winning attempt reached a
+    replica), ``queue``/``prefill``/``decode`` (server-side engine spans),
+    ``push`` (first token's transport delivery lag) and ``other`` (the
+    unattributed remainder). Component durations are same-process ts
+    deltas or server-recorded span durations, so the math survives clock
+    skew between dispatcher and replica shards. Serving spans are
+    attributed to the engine that produced the first token (``FIRST_TOKEN``
+    ``args.engine``) so a hedged loser's partial work is not double
+    counted.
+
+    Returns aggregate p50/p99 TTFT, the p99 request's full breakdown (with
+    its component sum, for sanity-checking against measured TTFT), mean
+    breakdown across requests, the dominant component, and per-replica
+    blame (``hedge_wait`` charged to the first-attempt target, serving
+    time to the serving engine).
+    """
+    events: Sequence[dict]
+    if isinstance(events_or_doc, dict):
+        events = events_or_doc.get("traceEvents") or []
+    else:
+        events = events_or_doc
+
+    traces: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("cat") != "request":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            traces.setdefault(str(tid), []).append(e)
+
+    requests: List[Dict[str, Any]] = []
+    blame: Dict[str, float] = {}
+    for tid, evs in sorted(traces.items()):
+        by_name: Dict[str, List[dict]] = {}
+        for e in sorted(evs, key=lambda e: float(e.get("ts", 0.0))):
+            by_name.setdefault(e.get("name") or "", []).append(e)
+
+        def _args(e: Optional[dict]) -> Dict[str, Any]:
+            return (e.get("args") or {}) if e else {}
+
+        submit = (by_name.get("SUBMIT") or [None])[0]
+        attempts = by_name.get("ATTEMPT") or []
+        hedge_win = (by_name.get("HEDGE_WIN") or [None])[0]
+        winner = _args(hedge_win).get("winner")
+        win_attempt = next(
+            (a for a in attempts if _args(a).get("target") == winner),
+            attempts[0] if attempts else None) if winner else \
+            (attempts[0] if attempts else None)
+
+        first_tok = (by_name.get("FIRST_TOKEN") or [None])[0]
+        client_tok = (by_name.get("CLIENT_FIRST_TOKEN") or [None])[0]
+        ttft = _args(client_tok).get("ttft_s",
+                                     _args(first_tok).get("ttft_s"))
+        engine = _args(first_tok).get("engine")
+
+        def _serving(name: str) -> List[dict]:
+            evs = by_name.get(name) or []
+            if engine is not None:
+                evs = [e for e in evs if _args(e).get("engine") == engine]
+            return evs
+
+        comp = {k: 0.0 for k in REQUEST_COMPONENTS}
+        if submit is not None and win_attempt is not None:
+            comp["hedge_wait"] = max(
+                0.0, (float(win_attempt.get("ts", 0.0))
+                      - float(submit.get("ts", 0.0))) / 1e6)
+        comp["queue"] = sum(float(e.get("dur", 0.0))
+                            for e in _serving("QUEUE")) / 1e6
+        comp["prefill"] = sum(float(e.get("dur", 0.0))
+                              for e in _serving("PREFILL")) / 1e6
+        decodes = _serving("DECODE")
+        if first_tok is not None:
+            # Only decode work that started before the first token counts
+            # toward TTFT; the rest is TPOT territory.
+            ft_ts = float(first_tok.get("ts", 0.0))
+            decodes = [e for e in decodes
+                       if float(e.get("ts", 0.0)) <= ft_ts]
+        comp["decode"] = sum(float(e.get("dur", 0.0))
+                             for e in decodes) / 1e6
+        pushes = by_name.get("PUSH_DELIVERY") or []
+        if pushes:
+            comp["push"] = float(pushes[0].get("dur", 0.0)) / 1e6
+        known = sum(v for k, v in comp.items() if k != "other")
+        if ttft is not None:
+            comp["other"] = max(0.0, float(ttft) - known)
+
+        first_attempt = attempts[0] if attempts else None
+        target0 = _args(first_attempt).get("target")
+        if target0:
+            blame[str(target0)] = (blame.get(str(target0), 0.0)
+                                   + comp["hedge_wait"])
+        if engine:
+            blame[str(engine)] = (blame.get(str(engine), 0.0)
+                                  + comp["queue"] + comp["prefill"]
+                                  + comp["decode"] + comp["push"])
+
+        requests.append({
+            "trace_id": tid,
+            "request": _args(submit).get("request",
+                                         _args(first_tok).get("request")),
+            "ttft_s": float(ttft) if ttft is not None else None,
+            "hedged": bool(by_name.get("HEDGE")),
+            "winner": winner,
+            "engine": engine,
+            "breakdown_s": comp,
+            "breakdown_sum_s": sum(comp.values()),
+        })
+
+    with_ttft = sorted((r for r in requests if r["ttft_s"] is not None),
+                       key=lambda r: r["ttft_s"])
+    ttfts = [r["ttft_s"] for r in with_ttft]
+    p99_req = with_ttft[max(0, min(len(with_ttft) - 1,
+                                   int(math.ceil(0.99 * len(with_ttft)))
+                                   - 1))] if with_ttft else None
+    mean = {k: (sum(r["breakdown_s"][k] for r in requests) / len(requests)
+                if requests else 0.0) for k in REQUEST_COMPONENTS}
+    dominant = max(mean, key=mean.get) if requests else None
+    return {
+        "requests": requests,
+        "count": len(requests),
+        "hedged": sum(1 for r in requests if r["hedged"]),
+        "ttft_p50_s": _pctl(ttfts, 0.50),
+        "ttft_p99_s": _pctl(ttfts, 0.99),
+        "p99_request": p99_req,
+        "breakdown_mean_s": mean,
+        "dominant_component": dominant,
+        "replica_blame_s": {k: v for k, v in sorted(blame.items())},
+        "dominant_replica": (max(blame, key=blame.get) if blame else None),
+    }
+
+
+# ---------------------------------------------------------------------------
 # merge
 # ---------------------------------------------------------------------------
 
@@ -466,12 +647,23 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
         raise FileNotFoundError(f"no timeline shards found for {inputs!r}")
     warnings: List[str] = []
     shards: List[Dict[str, Any]] = []
+    req_shards: List[Dict[str, Any]] = []
     for i, path in enumerate(paths):
         events, w = load_shard(path)
         warnings.extend(w)
         for msg in w:
             logger.warning("trace_merge: %s", msg)
         if not events:
+            continue
+        rmeta = _request_shard_meta(events)
+        if rmeta is not None:
+            # Request-trace shard: its own track, wall-clock aligned —
+            # it never competes for a rank id and never feeds the
+            # op-id straggler/overlap analysis.
+            req_shards.append({
+                "path": path, "events": events,
+                "proc": str(rmeta.get("proc") or f"shard{i}"),
+                "wall0": float(rmeta.get("wall0") or 0.0)})
             continue
         rank = _shard_rank(path, events, i)
         if any(s["rank"] == rank for s in shards):
@@ -481,7 +673,7 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
             continue
         shards.append({"path": path, "events": events, "rank": rank,
                        "anchors": _find_anchors(events)})
-    if not shards:
+    if not shards and not req_shards:
         raise ValueError(
             f"no events salvageable from any shard of {inputs!r}: "
             + "; ".join(warnings))
@@ -507,6 +699,41 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
             if "ts" in out:
                 out["ts"] = float(out["ts"]) + off
             merged.append(out)
+
+    if req_shards:
+        # Request shards carry no clock_anchor (they live in dispatcher /
+        # replica processes, outside the collective barrier). Each records
+        # the wall time of its ts origin (``wall0``), so map wall time onto
+        # the merged axis through any anchored rank shard whose anchor also
+        # recorded ``wall_time``; with no rank shards at all, the earliest
+        # request shard defines t=0.
+        anchored, _ = _select_anchor_epoch(shards)
+        ref: Optional[Tuple[float, float]] = None
+        for r, a in sorted(anchored.items()):
+            wall = (a.get("args") or {}).get("wall_time")
+            if wall is not None:
+                ref = (float(wall), float(a.get("ts", 0.0))
+                       + offsets.get(r, 0.0))
+                break
+        if ref is None:
+            ref = (min(s["wall0"] for s in req_shards), 0.0)
+        for seq, s in enumerate(sorted(req_shards,
+                                       key=lambda s: s["wall0"])):
+            pid = 1000 + seq
+            delta = (s["wall0"] - ref[0]) * 1e6 + ref[1]
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": f"request {s['proc']}"}})
+            merged.append({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "args": {"sort_index": pid}})
+            for e in s["events"]:
+                if e.get("ph") == "M":
+                    continue
+                out = dict(e)
+                out["pid"] = pid
+                if "ts" in out:
+                    out["ts"] = float(out["ts"]) + delta
+                merged.append(out)
+
     merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
 
     report = straggler_report(shards, offsets, skew)
@@ -529,6 +756,11 @@ def merge_timelines(inputs: Union[str, Sequence[str]],
 
     doc = {"traceEvents": merged, "displayTimeUnit": "ms",
            "stragglerReport": report}
+    if any(e.get("cat") == "request" for e in merged):
+        try:
+            doc["requestReport"] = request_report(merged)
+        except Exception:
+            logger.exception("trace_merge: request_report failed")
     if output:
         tmp = f"{output}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:

@@ -473,3 +473,73 @@ class TestTwoProcessSmoke:
             capture_output=True, text=True, timeout=500)
         assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
         assert "doctor-smoke OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# request-tail triage from the request-trace report (PR 15)
+# ---------------------------------------------------------------------------
+
+def _rreport(dominant, mean, *, blame=None, hedged=0, worst=None):
+    return {
+        "count": 4, "hedged": hedged,
+        "ttft_p50_s": sum(mean.values()), "ttft_p99_s": sum(mean.values()),
+        "breakdown_mean_s": mean, "dominant_component": dominant,
+        "replica_blame_s": blame or {}, "dominant_replica": worst,
+        "requests": [],
+    }
+
+
+_EMPTY_SNAP = {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+class TestRequestTailFindings:
+    def test_queue_dominated_names_slots_knob(self):
+        rep = doctor(snapshot=_EMPTY_SNAP, programs={}, trace={
+            "requestReport": _rreport("queue", {
+                "queue": 0.08, "prefill": 0.01, "decode": 0.005,
+                "push": 0.0, "hedge_wait": 0.0, "other": 0.005})})
+        tail = [f for f in rep["findings"]
+                if f["category"] == "request_tail"]
+        assert tail and tail[0]["evidence"]["dominant"] == "queue"
+        assert "HOROVOD_SERVE_SLOTS" in tail[0]["suggestion"]
+        assert tail[0]["evidence"]["fraction"] == pytest.approx(0.8)
+
+    def test_hedge_wait_dominated_blames_replica(self):
+        rep = doctor(snapshot=_EMPTY_SNAP, programs={}, trace={
+            "requestReport": _rreport(
+                "hedge_wait",
+                {"queue": 0.005, "prefill": 0.01, "decode": 0.005,
+                 "push": 0.0, "hedge_wait": 0.09, "other": 0.0},
+                blame={"r0": 0.36, "r1": 0.02}, hedged=3, worst="r0")})
+        tail = [f for f in rep["findings"]
+                if f["category"] == "request_tail"]
+        assert tail and tail[0]["evidence"]["slow_replica"] == "r0"
+        assert "r0" in tail[0]["title"]
+        assert tail[0]["evidence"]["hedged"] == 3
+
+    def test_prefill_dominated_stays_quiet(self):
+        # prefill/decode dominance is the model doing work — the triage
+        # only fires for queue / push / hedge_wait (actionable waits).
+        rep = doctor(snapshot=_EMPTY_SNAP, programs={}, trace={
+            "requestReport": _rreport("prefill", {
+                "queue": 0.001, "prefill": 0.2, "decode": 0.05,
+                "push": 0.001, "hedge_wait": 0.0, "other": 0.002})})
+        assert not [f for f in rep["findings"]
+                    if f["category"] == "request_tail"]
+
+    def test_slo_burn_cites_traced_breakdown(self):
+        snap = {
+            "counters": {
+                "serve_requests_total": [
+                    _ctr("serve_requests_total", 100, status="submitted"),
+                    _ctr("serve_requests_total", 30, status="expired"),
+                ],
+            },
+            "gauges": {}, "histograms": {},
+        }
+        rep = doctor(snapshot=snap, programs={}, trace={
+            "requestReport": _rreport("queue", {
+                "queue": 0.08, "prefill": 0.01, "decode": 0.005,
+                "push": 0.0, "hedge_wait": 0.0, "other": 0.005})})
+        slo = [f for f in rep["findings"] if f["category"] == "serving_slo"]
+        assert slo and "queue 80.0ms" in slo[0]["detail"]

@@ -608,3 +608,59 @@ class TestBenchWiring:
         rec = bench._report("m", "u", 1.0, 0.5, 2e12)
         assert rec["negotiation"] == {"full": 0, "fast": 0}  # single process
         assert rec["collectives"]["allreduce"]["calls"] >= 1
+
+
+class TestServeLatencyBuckets:
+    """ISSUE 15 satellite: sub-ms histogram resolution for the serving
+    latency families, and the live scrape endpoint round-tripping
+    through the same exposition grammar."""
+
+    def test_sub_ms_buckets_roundtrip_exposition(self):
+        from horovod_tpu.metrics import SERVE_LATENCY_BUCKETS
+        assert SERVE_LATENCY_BUCKETS[0] == pytest.approx(2.5e-4)
+        h = registry.histogram("serve_ttft_seconds", engine="e0",
+                               buckets=SERVE_LATENCY_BUCKETS)
+        for v in (2e-4, 3e-4, 8e-4, 2e-3, 0.05):
+            h.observe(v)
+        text = to_prometheus()
+        for line in text.strip().splitlines():
+            if not line.startswith("# "):
+                assert _PROM_LINE.match(line), line
+        buckets = dict(re.findall(
+            r'horovod_tpu_serve_ttft_seconds_bucket\{[^}]*le="([^"]+)"\}'
+            r" (\d+)", text))
+        # the 250us boundary is exposed and resolves the two sub-ms obs
+        assert buckets["0.00025"] == "1"
+        assert buckets["0.0005"] == "2"
+        assert buckets["0.001"] == "3"
+        assert buckets["+Inf"] == "5"
+
+    def test_metrics_http_endpoint_roundtrip(self):
+        import urllib.request
+        from horovod_tpu.metrics import SERVE_LATENCY_BUCKETS
+        registry.histogram("serve_tpot_seconds", engine="e9",
+                           buckets=SERVE_LATENCY_BUCKETS).observe(3e-4)
+        registry.counter("scrape_probe_total").inc(2)
+        srv = hvd.metrics_http(0)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/metrics",
+                                        timeout=5) as r:
+                assert "version=0.0.4" in r.headers["Content-Type"]
+                text = r.read().decode("utf-8")
+            for line in text.strip().splitlines():
+                if not line.startswith("# "):
+                    assert _PROM_LINE.match(line), line
+            assert "horovod_tpu_scrape_probe_total 2" in text
+            assert 'le="0.00025"' in text
+            # /trace serves the live request-span buffer (empty when
+            # request tracing is off) as a Chrome-trace doc
+            with urllib.request.urlopen(f"{srv.url}/trace",
+                                        timeout=5) as r:
+                doc = json.loads(r.read().decode("utf-8"))
+            assert doc["traceEvents"] == []
+            # unknown paths 404 instead of crashing the thread
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{srv.url}/nope", timeout=5)
+        finally:
+            srv.stop()

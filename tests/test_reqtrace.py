@@ -1,0 +1,281 @@
+"""Request-scoped distributed tracing (ISSUE 15 tentpole): the span
+buffer and wire context, ``request_report`` critical-path math, traced
+in-process serving holding the ``decode_compiles == 1`` pin with offline
+token parity, and the 3-process hedged smoke (``make reqtrace-smoke``).
+"""
+
+import json
+import os
+import sys
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import config as hconfig
+from horovod_tpu.models.generate import generate
+from horovod_tpu.serving import reqtrace
+from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.replica import Dispatcher
+from horovod_tpu.trace_merge import REQUEST_COMPONENTS, request_report
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture
+def tracing(monkeypatch):
+    """Request tracing on, no shard dir (spans stay in the buffer)."""
+    monkeypatch.setenv("HOROVOD_REQUEST_TRACE", "1")
+    monkeypatch.delenv("HOROVOD_REQUEST_TRACE_DIR", raising=False)
+    hconfig.refresh()
+    reqtrace.reset()
+    yield
+    reqtrace.reset()
+    monkeypatch.delenv("HOROVOD_REQUEST_TRACE", raising=False)
+    hconfig.refresh()
+
+
+# ---------------------------------------------------------------------------
+# span buffer and wire context
+# ---------------------------------------------------------------------------
+
+class TestSpanBuffer:
+    def test_off_by_default(self):
+        assert reqtrace.enabled() is False
+
+    def test_garbage_context_records_nothing(self, tracing):
+        reqtrace.emit("SUBMIT", None, time.time(), 0.0)
+        reqtrace.emit("SUBMIT", {"no": "tid"}, time.time(), 0.0)
+        reqtrace.emit("SUBMIT", {"tid": "t", "sid": "NaN?"},
+                      time.time(), 0.0)
+        assert reqtrace.events() == []
+
+    def test_wire_roundtrip_chains_parent(self, tracing):
+        ctx = reqtrace.mint_context()
+        w = ctx.wire()
+        assert set(w) == {"tid", "sid"} and w["tid"] == ctx.tid
+        # The wire dict is what rides the submit RPC params; spans
+        # emitted against it chain to the minting hop's span id.
+        reqtrace.emit("QUEUE", w, time.time(), 0.001, engine="e0")
+        (ev,) = reqtrace.events()
+        assert ev["cat"] == "request" and ev["ph"] == "X"
+        assert ev["args"]["trace_id"] == ctx.tid
+        assert ev["args"]["parent_id"] == ctx.sid
+        assert ev["args"]["engine"] == "e0"
+        assert ev["dur"] == pytest.approx(1000.0)      # seconds -> us
+
+    def test_span_and_instant_shapes(self, tracing):
+        ctx = reqtrace.mint_context()
+        with reqtrace.span("PREFILL", ctx, chunk=0):
+            time.sleep(0.002)
+        reqtrace.instant("HEDGE", ctx, target="e1")
+        prefill, hedge = reqtrace.events()
+        assert prefill["name"] == "PREFILL" and prefill["ph"] == "X"
+        assert prefill["dur"] >= 1000.0
+        assert hedge["ph"] == "i" and hedge["s"] == "g"
+        assert hedge["args"]["target"] == "e1"
+        # ts is microseconds since this process's trace origin (minted
+        # at the FIRST record — which is this span's exit, so its own
+        # ts backs up by its duration)
+        assert prefill["ts"] == pytest.approx(-prefill["dur"], rel=0.5)
+        assert hedge["ts"] >= prefill["ts"]
+
+    def test_buffer_bounded_drops_oldest(self, tracing, monkeypatch):
+        monkeypatch.setattr(reqtrace, "_BUF", deque(maxlen=4))
+        ctx = reqtrace.mint_context()
+        for i in range(6):
+            reqtrace.emit("DECODE", ctx, time.time(), 0.0, step=i)
+        evs = reqtrace.events()
+        assert len(evs) == 4
+        assert [e["args"]["step"] for e in evs] == [2, 3, 4, 5]
+
+    def test_flush_shard_format(self, tracing, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_REQTRACE_LABEL", "unit")
+        ctx = reqtrace.mint_context()
+        reqtrace.emit("SUBMIT", ctx, time.time(), 0.0, request="r-1")
+        path = reqtrace.flush(str(tmp_path / "shard.json"))
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        assert evs[0]["ph"] == "M"
+        assert evs[0]["args"]["name"] == "request unit"
+        meta = evs[1]
+        assert meta["name"] == "shard_meta"
+        assert meta["args"]["role"] == "request"
+        assert meta["args"]["proc"] == "unit"
+        assert meta["args"]["wall0"] > 0 and meta["args"]["dropped"] == 0
+        assert evs[2]["name"] == "SUBMIT"
+
+    def test_flush_empty_buffer_returns_none(self, tracing, tmp_path):
+        assert reqtrace.flush(str(tmp_path / "never.json")) is None
+        assert not (tmp_path / "never.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# request_report critical-path math (synthetic spans, no jax)
+# ---------------------------------------------------------------------------
+
+def _ev(name, tid, ts, dur=0.0, **args):
+    a = {"trace_id": tid, "span_id": 1, "parent_id": 0}
+    a.update(args)
+    return {"name": name, "cat": "request", "ph": "X", "ts": ts,
+            "dur": dur, "pid": 1, "tid": 0, "args": a}
+
+
+class TestRequestReportMath:
+    def test_hedged_breakdown_and_blame(self):
+        evs = [
+            _ev("SUBMIT", "t1", 0.0, request="r1"),
+            _ev("ATTEMPT", "t1", 1_000.0, target="e0"),
+            _ev("HEDGE", "t1", 50_000.0),
+            _ev("ATTEMPT", "t1", 100_000.0, target="e1"),
+            # loser e0's partial work must NOT be charged to this TTFT
+            _ev("QUEUE", "t1", 2_000.0, dur=50_000.0, engine="e0"),
+            _ev("QUEUE", "t1", 110_000.0, dur=5_000.0, engine="e1"),
+            _ev("PREFILL", "t1", 120_000.0, dur=20_000.0, engine="e1"),
+            _ev("DECODE", "t1", 140_000.0, dur=8_000.0, engine="e1"),
+            _ev("HEDGE_WIN", "t1", 150_000.0, winner="e1"),
+            _ev("FIRST_TOKEN", "t1", 150_000.0, engine="e1",
+                ttft_s=0.16, request="r1"),
+            # decode work after the first token is TPOT, not TTFT
+            _ev("DECODE", "t1", 200_000.0, dur=8_000.0, engine="e1"),
+            _ev("PUSH_DELIVERY", "t1", 155_000.0, dur=2_000.0),
+            _ev("CLIENT_FIRST_TOKEN", "t1", 160_000.0, ttft_s=0.16),
+        ]
+        rep = request_report(evs)
+        assert rep["count"] == 1 and rep["hedged"] == 1
+        (rec,) = rep["requests"]
+        assert rec["request"] == "r1"
+        assert rec["hedged"] is True and rec["winner"] == "e1"
+        assert rec["engine"] == "e1"
+        bd = rec["breakdown_s"]
+        # hedge_wait: SUBMIT until the WINNING attempt (ts 100000), not
+        # the first one.
+        assert bd["hedge_wait"] == pytest.approx(0.1)
+        assert bd["queue"] == pytest.approx(0.005)       # e1's only
+        assert bd["prefill"] == pytest.approx(0.02)
+        assert bd["decode"] == pytest.approx(0.008)      # pre-first-token
+        assert bd["push"] == pytest.approx(0.002)
+        assert bd["other"] == pytest.approx(0.16 - 0.135)
+        assert rec["breakdown_sum_s"] == pytest.approx(0.16)
+        assert rec["ttft_s"] == pytest.approx(0.16)
+        # blame: the hedge wait goes to the replica that was slow to
+        # accept (first attempt's target), serving time to the winner.
+        assert rep["replica_blame_s"]["e0"] == pytest.approx(0.1)
+        assert rep["replica_blame_s"]["e1"] == pytest.approx(0.035)
+        assert rep["dominant_replica"] == "e0"
+        assert rep["dominant_component"] == "hedge_wait"
+        assert rep["ttft_p50_s"] == pytest.approx(0.16)
+        assert rep["p99_request"]["trace_id"] == "t1"
+
+    def test_unhedged_fallback_ttft_from_server(self):
+        evs = [
+            _ev("SUBMIT", "t2", 0.0, request="r2"),
+            _ev("QUEUE", "t2", 100.0, dur=1_000.0, engine="e0"),
+            _ev("FIRST_TOKEN", "t2", 5_000.0, engine="e0", ttft_s=0.005),
+        ]
+        rep = request_report(evs)
+        (rec,) = rep["requests"]
+        assert rec["hedged"] is False and rec["winner"] is None
+        assert rec["ttft_s"] == pytest.approx(0.005)     # server-side
+        assert rec["breakdown_s"]["hedge_wait"] == 0.0
+        assert rec["breakdown_s"]["queue"] == pytest.approx(0.001)
+        assert set(rec["breakdown_s"]) == set(REQUEST_COMPONENTS)
+
+    def test_empty_input(self):
+        rep = request_report([])
+        assert rep["count"] == 0 and rep["requests"] == []
+        assert rep["dominant_component"] is None
+        assert rep["dominant_replica"] is None
+
+
+# ---------------------------------------------------------------------------
+# traced serving: compile pin + parity + span coverage
+# ---------------------------------------------------------------------------
+
+class TestTracedServing:
+    def test_tracing_off_emits_nothing(self, gpt2_setup):
+        model, params, cfg = gpt2_setup
+        reqtrace.reset()
+        eng = InferenceEngine(model, params, slots=1, max_len=16,
+                              block_size=4, prefill_chunk=1, name="off0")
+        disp = Dispatcher([eng])
+        req = disp.submit([1, 2, 3], 3)
+        eng.run_until_idle()
+        assert req.result(1)
+        assert reqtrace.events() == []
+
+    def test_traced_parity_single_decode_compile(self, gpt2_setup,
+                                                 tracing, rng):
+        """Acceptance pin: tracing ON does not perturb the jit story —
+        decode compiles exactly once, outputs stay token-identical to
+        offline generate() — while every request's spans land in the
+        buffer with the engine attributed."""
+        model, params, cfg = gpt2_setup
+        eng = InferenceEngine(model, params, slots=3, max_len=32,
+                              block_size=4, prefill_chunk=4, name="tr0")
+        disp = Dispatcher([eng])
+        lengths = [(6, 5), (3, 8), (9, 4)]
+        prompts = [list(rng.integers(1, cfg.vocab_size, p))
+                   for p, _ in lengths]
+        reqs = [disp.submit(p, n) for p, (_, n) in zip(prompts, lengths)]
+        eng.run_until_idle()
+
+        for p, (plen, n), req in zip(prompts, lengths, reqs):
+            want = np.asarray(generate(
+                model, params, jnp.asarray([p], jnp.int32), n))[0, plen:]
+            assert req.result(1) == list(want), req.id
+        assert eng.decode_compiles == 1, \
+            f"tracing perturbed the decode jit: {eng.decode_compiles}"
+
+        evs = reqtrace.events()
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        assert len(by_name["SUBMIT"]) == 3
+        assert {e["args"]["request"] for e in by_name["SUBMIT"]} == \
+            {r.id for r in reqs}
+        for name in ("QUEUE", "PREFILL", "FIRST_TOKEN"):
+            assert len(by_name.get(name, [])) >= 3, name
+        assert all(e["args"]["engine"] == "tr0"
+                   for e in by_name["FIRST_TOKEN"])
+
+        rep = request_report(evs)
+        assert rep["count"] == 3
+        for rec in rep["requests"]:
+            assert rec["engine"] == "tr0"
+            assert rec["ttft_s"] is not None and rec["ttft_s"] > 0
+            assert all(v >= 0.0 for v in rec["breakdown_s"].values())
+            # components must account for TTFT (loose bound: host-side
+            # wall clocks on shared CI hardware)
+            assert rec["breakdown_sum_s"] <= rec["ttft_s"] * 1.5 + 0.05
+
+
+# ---------------------------------------------------------------------------
+# three-process hedged smoke (make reqtrace-smoke)
+# ---------------------------------------------------------------------------
+
+class TestReqtraceSmoke:
+    def test_hedged_request_traced_end_to_end(self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import reqtrace_smoke
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        # run_smoke returns (rc, failure_text) — the text feeds the
+        # rendezvous-flake retry in tools/smoke_util.py.
+        rc, text = reqtrace_smoke.run_smoke(str(tmp_path))
+        assert rc == 0, text

@@ -377,3 +377,149 @@ class TestTwoProcessSmoke:
             capture_output=True, text=True, timeout=500)
         assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
         assert "trace-smoke OK" in r.stdout
+
+
+class TestRequestShards:
+    """Request-trace shards (``serving/reqtrace.flush``) threading through
+    the collective merge (ISSUE 15): separate pid tracks, wall-clock
+    alignment against anchored rank shards, truncated-shard salvage, and
+    the attached ``requestReport``."""
+
+    @staticmethod
+    def _req_shard(path, proc, wall0, events, pid=4000):
+        """Write a shard in the exact format reqtrace.flush produces."""
+        evs = [
+            {"name": "process_name", "cat": "__metadata", "ph": "M",
+             "ts": 0.0, "pid": pid, "tid": 0,
+             "args": {"name": f"request {proc}"}},
+            {"name": "shard_meta", "cat": "trace", "ph": "i", "ts": 0.0,
+             "pid": pid, "tid": 0, "s": "g",
+             "args": {"role": "request", "proc": proc, "pid": pid,
+                      "wall0": wall0, "dropped": 0}},
+        ] + events
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+        return path
+
+    @staticmethod
+    def _rspan(name, tid, ts, dur=100.0, pid=4000, **extra):
+        args = {"trace_id": tid, "span_id": 1, "parent_id": 0}
+        args.update(extra)
+        return {"name": name, "cat": "request", "ph": "X", "ts": ts,
+                "dur": dur, "pid": pid, "tid": 0, "args": args}
+
+    def _rank_shards(self, tmp_path):
+        # Same geometry as TestMergeSynthetic._two_shards: both anchors
+        # land at merged ts 5000 (rank 0 shifted +4000), and rank 0's
+        # anchor recorded wall_time 100.0 — so wall time W maps onto the
+        # merged axis as (W - 100.0) * 1e6 + 5000.
+        _shard(str(tmp_path / "trace.rank0.json"), 0, 1000.0, 100.0,
+               [_phase("NEGOTIATE", 1, 2000.0), _phase("QUEUE", 1, 2050.0),
+                _phase("EXEC", 1, 2100.0, dur=400.0),
+                _phase("QUEUE", 2, 4000.0), _phase("EXEC", 2, 4050.0)])
+        _shard(str(tmp_path / "trace.rank1.json"), 1, 5000.0, 100.002,
+               [_phase("NEGOTIATE", 1, 6300.0), _phase("QUEUE", 1, 6350.0),
+                _phase("EXEC", 1, 6400.0, dur=200.0),
+                _phase("QUEUE", 2, 8000.0), _phase("EXEC", 2, 8050.0)])
+
+    def test_mixed_collective_and_request_shards(self, tmp_path, caplog):
+        import logging
+        self._rank_shards(tmp_path)
+        self._req_shard(
+            str(tmp_path / "reqtrace.dispatcher.101.json"), "dispatcher",
+            100.001,
+            [self._rspan("SUBMIT", "t1", 500.0, dur=200.0, request="r-0"),
+             self._rspan("ATTEMPT", "t1", 800.0, dur=100.0,
+                         target="rank0"),
+             self._rspan("CLIENT_FIRST_TOKEN", "t1", 9000.0, dur=0.0,
+                         ttft_s=0.009)])
+        self._req_shard(
+            str(tmp_path / "reqtrace.rank0.102.json"), "rank0", 100.003,
+            # The QUEUE span deliberately carries an op_id: request spans
+            # must never leak into the collective straggler analysis.
+            [self._rspan("QUEUE", "t1", 100.0, dur=1000.0, request="r-0",
+                         engine="rank0", op_id=9),
+             self._rspan("PREFILL", "t1", 1200.0, dur=2000.0,
+                         engine="rank0"),
+             self._rspan("DECODE", "t1", 3500.0, dur=300.0,
+                         engine="rank0"),
+             self._rspan("FIRST_TOKEN", "t1", 4000.0, dur=0.0,
+                         engine="rank0", ttft_s=0.009, request="r-0"),
+             self._rspan("PUSH_DELIVERY", "t1", 4200.0, dur=500.0)])
+        # A push-pump shard truncated mid-write, as a crash would leave it.
+        p = tmp_path / "reqtrace.pump.103.json"
+        self._req_shard(str(p), "pump", 100.005,
+                        [self._rspan("PUSH_DELIVERY", "t2", float(ts),
+                                     dur=50.0)
+                         for ts in range(0, 1200, 200)])
+        text = p.read_text()
+        p.write_text(text[: int(len(text) * 0.55)])
+
+        out = str(tmp_path / "mix.merged.json")
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            doc = tm.merge_timelines(str(tmp_path), out, feed_metrics=False)
+        assert any("truncated/corrupt" in r.getMessage()
+                   for r in caplog.records)
+
+        # Tracks: rank pids 0/1 untouched, request shards on pid 1000+seq
+        # in wall0 order, each with its own process_name metadata row.
+        evs = doc["traceEvents"]
+        pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+        assert pids == {0, 1, 1000, 1001, 1002}
+        names = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert {"request dispatcher", "request rank0",
+                "request pump"} <= names
+
+        # Wall-clock alignment: the dispatcher's origin sits 1ms after
+        # rank 0's anchor wall_time -> SUBMIT at 500 + 1000 + 5000.
+        submit = next(e for e in evs if e.get("name") == "SUBMIT")
+        assert submit["pid"] == 1000
+        assert submit["ts"] == pytest.approx(6500.0)
+        queue = next(e for e in evs if e.get("pid") == 1001
+                     and e.get("name") == "QUEUE")
+        assert queue["ts"] == pytest.approx(100.0 + 3000.0 + 5000.0)
+
+        # The collective analysis is exactly what the rank shards alone
+        # produce — the request QUEUE span's op_id never reached it.
+        rep = doc["stragglerReport"]
+        assert rep["ranks"] == [0, 1]
+        ops = {c["op_id"]: c for c in rep["collectives"]}
+        assert set(ops) == {1, 2}
+        assert ops[1]["spread_seconds"] == pytest.approx(300e-6)
+
+        # requestReport rides the merged doc and the file on disk.
+        rr = doc["requestReport"]
+        rec = next(r for r in rr["requests"] if r["trace_id"] == "t1")
+        assert rec["request"] == "r-0"
+        assert rec["engine"] == "rank0"
+        assert rec["ttft_s"] == pytest.approx(0.009)
+        assert rec["breakdown_s"]["queue"] == pytest.approx(1e-3)
+        assert rec["breakdown_s"]["prefill"] == pytest.approx(2e-3)
+        assert rec["breakdown_s"]["decode"] == pytest.approx(3e-4)
+        assert rec["breakdown_s"]["push"] == pytest.approx(5e-4)
+        # hedge_wait is a merged-axis ts delta: ATTEMPT 6800 - SUBMIT 6500.
+        assert rec["breakdown_s"]["hedge_wait"] == pytest.approx(3e-4)
+        disk = json.loads(open(out).read())
+        assert disk["requestReport"]["count"] == rr["count"]
+
+    def test_request_only_merge_needs_no_anchor(self, tmp_path):
+        # No rank shards at all: the earliest request shard's wall0
+        # defines t=0 and the merge must not demand a clock_anchor.
+        self._req_shard(
+            str(tmp_path / "reqtrace.dispatcher.201.json"), "dispatcher",
+            200.0, [self._rspan("SUBMIT", "t9", 100.0, dur=50.0,
+                                request="r-9")])
+        self._req_shard(
+            str(tmp_path / "reqtrace.rank0.202.json"), "rank0", 200.005,
+            [self._rspan("QUEUE", "t9", 200.0, dur=50.0)])
+        doc = tm.merge_timelines(str(tmp_path), feed_metrics=False)
+        evs = doc["traceEvents"]
+        submit = next(e for e in evs if e.get("name") == "SUBMIT")
+        queue = next(e for e in evs if e.get("name") == "QUEUE")
+        assert submit["pid"] == 1000
+        assert submit["ts"] == pytest.approx(100.0)
+        assert queue["pid"] == 1001
+        assert queue["ts"] == pytest.approx(200.0 + 5000.0)
+        assert doc["stragglerReport"]["collectives"] == []
+        assert doc["requestReport"]["count"] == 1

@@ -41,7 +41,7 @@ SETTINGS_KEYS = (
     "kv_quant", "arrival_rate_hz", "requests", "rate",
     "allreduce_alg", "wire", "topology", "mesh", "overlap_chunks",
     "payload_mb", "world", "batch", "seq_len", "steps",
-    "prefix_overlap", "prefix_cache", "spec_k",
+    "prefix_overlap", "prefix_cache", "spec_k", "request_trace",
 )
 
 

@@ -64,6 +64,7 @@ MODULES = [
     "horovod_tpu.serving.replica",
     "horovod_tpu.serving.transport",
     "horovod_tpu.serving.fleet",
+    "horovod_tpu.serving.reqtrace",
     "horovod_tpu.ops.attention",
     "horovod_tpu.ops.flash_attention",
     "horovod_tpu.ops.ring_attention",

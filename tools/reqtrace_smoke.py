@@ -1,0 +1,385 @@
+#!/usr/bin/env python
+"""Request-tracing smoke: follow one hedged request end to end.
+
+Two socket replicas behind a ``RemoteDispatcher`` with hedging on, all
+three processes writing request-trace shards
+(``HOROVOD_REQUEST_TRACE=1``). Replica 0 is rigged to be the slow one:
+
+* a single decode lane (``slots=1``) already occupied by a long filler
+  request when the traced request arrives, on an engine whose dispatch
+  is slowed ~50ms/step — so the traced request sits ``queued`` there;
+* ``delay@rank=0,step=3,seconds=1.5,space=net`` holds the traced
+  request's submit RESPONSE for 1.5s (its 3rd inbound RPC: status
+  probe, filler submit, traced submit), so by the time ``submit``
+  returns, the hedge timer (300ms) has already expired and the first
+  ``wait()`` poll hedges onto replica 1 — which serves it immediately.
+
+Asserts:
+
+1. the traced request hedges, replica 1 wins, and its tokens are
+   byte-identical to offline greedy ``generate()``;
+2. both replicas served with exactly ONE decode compile each — the
+   ``decode_compiles == 1`` contract survives tracing being on;
+3. the merged trace stitches ONE trace_id across the dispatcher and
+   replica processes: SUBMIT + HEDGE + both ATTEMPTs (loser and
+   winner) client-side, QUEUE/PREFILL/DECODE/FIRST_TOKEN server-side,
+   PUSH_DELIVERY for the token push;
+4. the ``requestReport`` breakdown for the traced request sums to its
+   measured TTFT within 10%;
+5. ``tools/tail_doctor.py`` ranks hedge_wait as the dominant component
+   and the delayed replica (rank0) as the dominant replica;
+6. each replica's ``HOROVOD_METRICS_PORT`` HTTP endpoint serves a
+   parseable Prometheus exposition (with the sub-ms serving buckets)
+   and a ``/trace`` JSON span buffer.
+
+Exit status 0 = all checks pass. Wired as ``make reqtrace-smoke`` and
+as tier-1 ``tests/test_reqtrace.py::TestReqtraceSmoke``.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRACED_PROMPT = [5, 17, 42, 9]
+TRACED_MAX_NEW = 12
+FILLER_MAX_NEW = 64
+HEDGE_MS = 300.0
+# Replica 0's 3rd inbound RPC is the traced submit (status probe,
+# filler submit, traced submit — the dispatcher's 0.25s status cache
+# keeps the second submit from re-probing). 1.5s >> the 300ms hedge.
+FAULT_PLAN = "delay@rank=0,step=3,seconds=1.5,space=net"
+
+# One Prometheus exposition line: name{labels} value (same shape the
+# parser round-trip test in tests/test_metrics.py accepts).
+_PROM_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$")
+
+WORKER = textwrap.dedent("""
+    import os, signal, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, root = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, {repo!r})
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.engine import InferenceEngine
+    from horovod_tpu.serving.transport import SocketReplicaServer
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    # Replica 0: ONE lane, so the filler request occupies the whole
+    # engine and the traced request queues behind it.
+    eng = InferenceEngine(model, params, slots=(1 if rank == 0 else 2),
+                          max_len=96, block_size=8, prefill_chunk=4,
+                          name=f"rank{{rank}}")
+    # Warm both programs before listening (and before slowing the
+    # dispatch): compiles must not eat the client's RPC deadlines, and
+    # the decode_compiles==1 check below must see steady state.
+    eng.submit([1, 2, 3, 4, 5], 2)
+    eng.run_until_idle()
+    if rank == 0:
+        # ~50ms per dispatched step keeps the filler busy for seconds
+        # without touching the jitted program (no recompile).
+        _orig = eng._dispatch
+        def _slow(*a, **kw):
+            time.sleep(0.05)
+            return _orig(*a, **kw)
+        eng._dispatch = _slow
+    srv = SocketReplicaServer(eng, rank).start()
+    with open(os.path.join(root, f"port.rank{{rank}}"), "w") as f:
+        f.write(str(srv.port))
+    if srv._metrics_srv is not None:
+        with open(os.path.join(root, f"mport.rank{{rank}}"), "w") as f:
+            f.write(str(srv._metrics_srv.port))
+
+    def _term(*_a):
+        # Stats for the client's decode_compiles assertion, then a
+        # normal exit so atexit flushes the reqtrace shard.
+        with open(os.path.join(root, f"stats.rank{{rank}}"), "w") as f:
+            f.write(str(eng.decode_compiles))
+        sys.exit(0)
+    signal.signal(signal.SIGTERM, _term)
+    open(os.path.join(root, f"ready.rank{{rank}}"), "w").close()
+    while True:
+        time.sleep(0.1)
+""").format(repo=REPO)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fetch(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def run_smoke(workdir: str, timeout_s: float = 300.0):
+    """One attempt: returns ``(rc, failure_text)``."""
+    sys.path.insert(0, REPO)
+    root = os.path.join(workdir, "reqtrace-root")
+    trace_dir = os.path.join(workdir, "traces")
+    os.makedirs(root, exist_ok=True)
+    os.makedirs(trace_dir, exist_ok=True)
+
+    # The client process traces too: dispatcher-side spans (SUBMIT /
+    # HEDGE / ATTEMPT / ...) land in its own shard.
+    os.environ["HOROVOD_REQUEST_TRACE"] = "1"
+    os.environ["HOROVOD_REQUEST_TRACE_DIR"] = trace_dir
+    os.environ["HOROVOD_REQTRACE_LABEL"] = "dispatcher"
+    os.environ.pop("HOROVOD_FAULT_PLAN", None)
+    from horovod_tpu.config import refresh
+    refresh()
+    from horovod_tpu import metrics
+    from horovod_tpu.serving import reqtrace
+    from horovod_tpu.serving.transport import (RemoteClient,
+                                               RemoteDispatcher)
+
+    metrics.reset_metrics()
+    reqtrace.reset()
+    mport_base = _free_port()
+    env = dict(os.environ,
+               HOROVOD_FAULT_PLAN=FAULT_PLAN,
+               HOROVOD_METRICS_PORT=str(mport_base))
+    procs = []
+    for rank in (0, 1):
+        wenv = dict(env, HOROVOD_REQTRACE_LABEL=f"rank{rank}")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(rank), root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=wenv))
+    deadline = time.monotonic() + timeout_s
+
+    def fail(msg):
+        print(f"reqtrace-smoke FAIL: {msg}", file=sys.stderr)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        texts = [msg]
+        for i, p in enumerate(procs):
+            try:
+                out = p.communicate(timeout=10)[0]
+            except subprocess.TimeoutExpired:
+                out = "<no output>"
+            print(f"--- replica {i} output ---\n{out}", file=sys.stderr)
+            texts.append(out or "")
+        return 1, "\n".join(texts)
+
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(root, f"ready.rank{r}"))
+               for r in (0, 1)):
+            break
+        if any(p.poll() is not None for p in procs):
+            return fail("a replica exited during startup")
+        time.sleep(0.1)
+    else:
+        return fail("replicas not ready in time")
+
+    addresses = []
+    for r in (0, 1):
+        with open(os.path.join(root, f"port.rank{r}")) as f:
+            addresses.append(("127.0.0.1", int(f.read().strip())))
+
+    # Offline greedy reference with the same seeded params the workers
+    # build: the hedged request's tokens must match byte-for-byte.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    want = [int(t) for t in np.asarray(generate(
+        model, params, jnp.asarray([TRACED_PROMPT], jnp.int32),
+        TRACED_MAX_NEW))[0, len(TRACED_PROMPT):]]
+
+    # Clients named after the replica engines, so client-side attempt
+    # spans and server-side serving spans attribute to the same name.
+    disp = RemoteDispatcher(
+        clients=[RemoteClient(addresses[r], name=f"rank{r}",
+                              rpc_timeout=5.0, max_retries=1)
+                 for r in (0, 1)],
+        hedge_ms=HEDGE_MS)
+
+    # Filler first: both replicas idle, the load tie breaks by index,
+    # so it lands on (and fills) replica 0's single slow lane. The
+    # traced submit follows inside the status-cache window, routes to
+    # replica 0 too, and its submit response eats the 1.5s delay fault.
+    filler = disp.submit([2, 3, 4], FILLER_MAX_NEW, deadline_s=240.0,
+                         request_id="filler-0")
+    if filler.terminal:
+        return fail(f"filler bounced: {filler.status} ({filler.reason})")
+    traced = disp.submit(list(TRACED_PROMPT), TRACED_MAX_NEW,
+                         deadline_s=240.0, request_id="traced-0")
+    disp.wait(traced)
+
+    if traced.status != "done":
+        return fail(f"traced request ended {traced.status} "
+                    f"({traced.reason})")
+    if not traced.hedged:
+        return fail("traced request never hedged — the delay fault or "
+                    "the hedge timer misfired")
+    if traced.served_by != "rank1":
+        return fail(f"hedge winner was {traced.served_by}, expected "
+                    "rank1 (rank0 is the rigged-slow replica)")
+    if traced.tokens != want:
+        return fail(f"traced tokens diverge from offline generate(): "
+                    f"{traced.tokens[:6]}... vs {want[:6]}...")
+    disp.wait(filler)
+    if filler.status != "done":
+        return fail(f"filler ended {filler.status} ({filler.reason})")
+
+    # Metrics endpoints: Prometheus exposition parses line-by-line and
+    # includes the sub-ms serving buckets; /trace returns the live span
+    # buffer.
+    for r in (0, 1):
+        mport_path = os.path.join(root, f"mport.rank{r}")
+        if not os.path.exists(mport_path):
+            return fail(f"replica {r} did not start a metrics endpoint")
+        with open(mport_path) as f:
+            mport = int(f.read().strip())
+        try:
+            text = _fetch(f"http://127.0.0.1:{mport}/metrics")
+        except OSError as e:
+            return fail(f"GET /metrics on replica {r} failed: {e}")
+        bad = [ln for ln in text.splitlines()
+               if ln and not ln.startswith("#")
+               and not _PROM_RE.match(ln)]
+        if bad:
+            return fail(f"unparseable exposition lines from replica "
+                        f"{r}: {bad[:3]}")
+        if "serve_ttft_seconds_bucket" not in text:
+            return fail(f"replica {r} exposition lacks serve_ttft "
+                        "buckets")
+        if "0.00025" not in text:
+            return fail(f"replica {r} exposition lacks the 250us "
+                        "bucket boundary")
+        try:
+            tdoc = json.loads(_fetch(f"http://127.0.0.1:{mport}/trace"))
+        except (OSError, ValueError) as e:
+            return fail(f"GET /trace on replica {r} failed: {e}")
+        if not isinstance(tdoc.get("traceEvents"), list):
+            return fail(f"replica {r} /trace is not a span buffer")
+
+    # Stop the workers via SIGTERM: the handler records
+    # decode_compiles and exits normally so atexit flushes the shards.
+    for p in procs:
+        p.terminate()
+    for i, p in enumerate(procs):
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            return fail(f"replica {i} did not exit on SIGTERM")
+    for r in (0, 1):
+        spath = os.path.join(root, f"stats.rank{r}")
+        if not os.path.exists(spath):
+            return fail(f"replica {r} wrote no stats file")
+        with open(spath) as f:
+            compiles = int(f.read().strip())
+        if compiles != 1:
+            return fail(f"replica {r} decode_compiles == {compiles} "
+                        "with tracing on (expected exactly 1)")
+
+    disp.close()
+    reqtrace.flush()
+
+    shard_names = sorted(os.listdir(trace_dir))
+    if len([n for n in shard_names if n.startswith("reqtrace.")]) != 3:
+        return fail(f"expected 3 reqtrace shards, got {shard_names}")
+
+    from horovod_tpu.trace_merge import merge_timelines
+    merged_path = os.path.join(workdir, "merged.json")
+    doc = merge_timelines(trace_dir, merged_path, feed_metrics=False)
+    evs = [e for e in doc["traceEvents"] if e.get("cat") == "request"]
+
+    submit = next((e for e in evs if e["name"] == "SUBMIT"
+                   and e["args"].get("request") == "traced-0"), None)
+    if submit is None:
+        return fail("merged trace has no SUBMIT span for traced-0")
+    tid = submit["args"]["trace_id"]
+    chain = [e for e in evs if e["args"].get("trace_id") == tid]
+    names = {e["name"] for e in chain}
+    need = {"SUBMIT", "ATTEMPT", "HEDGE", "HEDGE_WIN", "QUEUE",
+            "PREFILL", "DECODE", "FIRST_TOKEN", "PUSH_DELIVERY",
+            "CLIENT_FIRST_TOKEN"}
+    if not need <= names:
+        return fail(f"trace {tid} is missing spans: {sorted(need - names)}"
+                    f" (has {sorted(names)})")
+    attempts = [e for e in chain if e["name"] == "ATTEMPT"]
+    targets = sorted(a["args"].get("target") for a in attempts)
+    if targets != ["rank0", "rank1"]:
+        return fail(f"expected losing (rank0) and winning (rank1) "
+                    f"attempt spans, got targets {targets}")
+    win = next(e for e in chain if e["name"] == "HEDGE_WIN")
+    if win["args"].get("winner") != "rank1":
+        return fail(f"HEDGE_WIN names {win['args'].get('winner')}, "
+                    "expected rank1")
+    if len({e.get("pid") for e in chain}) < 2:
+        return fail("trace spans all landed in one process — cross-"
+                    "process propagation broke")
+
+    rep = doc.get("requestReport")
+    if not rep:
+        return fail("merged trace has no requestReport")
+    entry = next((r for r in rep["requests"]
+                  if r.get("request") == "traced-0"), None)
+    if entry is None:
+        return fail("requestReport has no entry for traced-0")
+    if not entry["hedged"] or entry.get("winner") != "rank1":
+        return fail(f"report entry wrong: {entry}")
+    ttft, total = entry["ttft_s"], entry["breakdown_sum_s"]
+    if ttft is None or abs(total - ttft) > 0.10 * ttft:
+        return fail(f"breakdown sum {total:.3f}s vs measured TTFT "
+                    f"{ttft}s — outside the 10% budget: "
+                    f"{entry['breakdown_s']}")
+
+    # tail_doctor must pin the tail on the hedge wait for rank0.
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import tail_doctor
+    drep = tail_doctor.load_report(merged_path)
+    if drep.get("dominant_component") != "hedge_wait":
+        return fail(f"tail_doctor dominant component "
+                    f"{drep.get('dominant_component')}, expected "
+                    f"hedge_wait ({drep.get('breakdown_mean_s')})")
+    if drep.get("dominant_replica") != "rank0":
+        return fail(f"tail_doctor blames {drep.get('dominant_replica')}"
+                    f", expected rank0 ({drep.get('replica_blame_s')})")
+    print(tail_doctor.format_report(drep))
+
+    print(f"reqtrace-smoke OK: traced-0 hedged rank0->rank1 under a "
+          f"{FAULT_PLAN!r} fault, {len(chain)} spans across "
+          f"{len({e.get('pid') for e in chain})} processes share trace "
+          f"{tid}; breakdown {total:.3f}s vs TTFT {ttft:.3f}s; "
+          f"decode_compiles==1 on both replicas with tracing on")
+    return 0, ""
+
+
+def _attempt():
+    with tempfile.TemporaryDirectory(prefix="hvd_reqtrace_smoke_") as td:
+        return run_smoke(td)
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import smoke_util
+    return smoke_util.main_with_retry(_attempt, name="reqtrace-smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

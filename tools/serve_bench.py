@@ -77,7 +77,16 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
     import jax
     import jax.numpy as jnp
     from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving import reqtrace
     from horovod_tpu.serving.engine import InferenceEngine
+
+    # With HOROVOD_REQUEST_TRACE=1 every benched request is span-traced
+    # and the record carries the mean TTFT component breakdown; the
+    # request_trace flag is part of the sentinel identity, so traced
+    # rows never gate against untraced ones.
+    trace_on = reqtrace.enabled()
+    if trace_on:
+        reqtrace.reset()
 
     if model_size == "tiny":
         cfg = GPT2Config.tiny(dtype=jnp.float32)
@@ -154,7 +163,9 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         reqs = []
         for gap, p, n in zip(gaps, prompts, budgets):
             time.sleep(float(gap))
-            reqs.append(eng.submit(p, n))
+            tr = ({"trace": reqtrace.mint_context().wire()}
+                  if trace_on else {})
+            reqs.append(eng.submit(p, n, **tr))
         for r in reqs:
             try:
                 r.result(timeout=600)
@@ -244,7 +255,14 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "dense_equivalent_blocks": slots * eng.max_blocks_per_slot,
         "decode_compiles": eng.decode_compiles,
         "prefill_compiles": eng.prefill_compiles,
+        "request_trace": trace_on,
     }
+    if trace_on:
+        from horovod_tpu.trace_merge import request_report
+        mean = request_report(
+            reqtrace.events()).get("breakdown_mean_s") or {}
+        for comp in ("queue", "prefill", "decode", "push"):
+            rec[f"breakdown_{comp}_s"] = round(mean.get(comp, 0.0), 6)
     print(json.dumps(rec), flush=True)
     return rec
 

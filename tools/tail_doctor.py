@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Where did the p99 TTFT go? Per-request critical paths from a merged
+request trace.
+
+Input is anything ``hvd.merge_timelines`` accepts — a merged trace JSON
+(with its ``requestReport``), a shard directory, or a glob — as long as
+request-trace shards (``HOROVOD_REQUEST_TRACE=1`` +
+``HOROVOD_REQUEST_TRACE_DIR``) are in the set. For every traced request
+the report decomposes TTFT into ``hedge_wait`` (submit until the winning
+attempt reached a replica), ``queue``, ``prefill``, ``decode`` (up to
+the first token), ``push`` (transport delivery lag), and ``other``; the
+rollup ranks components by mean contribution and charges per-replica
+blame (hedge waits to the replica that was slow to accept, serving time
+to the engine that produced the tokens).
+
+    python tools/tail_doctor.py /tmp/traces/            # human summary
+    python tools/tail_doctor.py merged.json --json      # full report
+    python tools/tail_doctor.py merged.json --top 5     # worst requests
+
+Exit status: 0 with traced requests found, 2 when the input has no
+request spans (nothing to diagnose is not an error in scripts, but you
+probably forgot HOROVOD_REQUEST_TRACE=1).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_report(path: str) -> dict:
+    """The ``requestReport`` for ``path``: pre-merged JSON when present,
+    else a fresh merge of the shard set."""
+    from horovod_tpu.trace_merge import merge_timelines, request_report
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict):
+                if "requestReport" in doc:
+                    return doc["requestReport"]
+                if "traceEvents" in doc:
+                    return request_report(doc)
+        except ValueError:
+            pass
+    doc = merge_timelines(path, feed_metrics=False)
+    return doc.get("requestReport") or {"count": 0, "requests": []}
+
+
+def _ms(v) -> str:
+    return f"{float(v or 0.0) * 1e3:8.1f}ms"
+
+
+def format_report(rep: dict, top: int = 3) -> str:
+    lines = []
+    n = int(rep.get("count") or 0)
+    lines.append(f"tail_doctor: {n} traced request(s), "
+                 f"{int(rep.get('hedged') or 0)} hedged")
+    lines.append(f"  TTFT p50 {_ms(rep.get('ttft_p50_s'))}   "
+                 f"p99 {_ms(rep.get('ttft_p99_s'))}")
+    mean = rep.get("breakdown_mean_s") or {}
+    if mean:
+        lines.append("  mean breakdown: "
+                     + "  ".join(f"{k}={float(v) * 1e3:.1f}ms"
+                                 for k, v in mean.items() if v))
+        lines.append(f"  dominant component: "
+                     f"{rep.get('dominant_component')}")
+    blame = rep.get("replica_blame_s") or {}
+    if blame:
+        ranked = sorted(blame.items(), key=lambda kv: -float(kv[1]))
+        lines.append("  replica blame: "
+                     + "  ".join(f"{k}={float(v) * 1e3:.1f}ms"
+                                 for k, v in ranked))
+        lines.append(f"  dominant replica: {rep.get('dominant_replica')}")
+    p99 = rep.get("p99_request")
+    if p99:
+        lines.append(f"  p99 request {p99.get('request')} "
+                     f"(trace {p99.get('trace_id')}): "
+                     f"ttft {_ms(p99.get('ttft_s'))}, components sum "
+                     f"{_ms(p99.get('breakdown_sum_s'))}")
+    worst = sorted((r for r in rep.get("requests", [])
+                    if r.get("ttft_s") is not None),
+                   key=lambda r: -r["ttft_s"])[:max(0, top)]
+    for r in worst:
+        bd = r.get("breakdown_s") or {}
+        path = " + ".join(f"{k} {float(v) * 1e3:.1f}ms"
+                          for k, v in bd.items() if v > 1e-6)
+        hedge = " [hedged"
+        hedge += f" -> {r['winner']}]" if r.get("winner") else "]"
+        lines.append(f"    {r.get('request')}: ttft {_ms(r['ttft_s'])} = "
+                     f"{path or 'no spans'}"
+                     + (hedge if r.get("hedged") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-request TTFT breakdowns from a merged request "
+                    "trace")
+    ap.add_argument("trace", help="merged trace JSON, shard dir, or glob")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full requestReport as JSON")
+    ap.add_argument("--top", type=int, default=3,
+                    help="slowest requests to itemize (default 3)")
+    args = ap.parse_args(argv)
+    rep = load_report(args.trace)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(format_report(rep, top=args.top))
+    return 0 if int(rep.get("count") or 0) > 0 else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
