@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
 	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
-	mp-smoke reqtrace-smoke bench-sentinel test native
+	mp-smoke reqtrace-smoke fleet-top bench-sentinel test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -118,6 +118,15 @@ mp-smoke:
 # tier-1 as tests/test_reqtrace.py::TestReqtraceSmoke.
 reqtrace-smoke:
 	$(PY) tools/reqtrace_smoke.py
+
+# One frame of the fleet health dashboard (hvd.top): per-replica
+# UP/QPS/TTFT_P99/SLOTS/BLOCKS/BREAKER from scraped /metrics.json
+# windows, plus active alerts. Pass MEMBERS=/path/to/members.json to
+# follow a live fleet's membership file; without it the local process
+# registry is sampled. Drop --once (run the tool directly) for a live
+# refreshing dashboard.
+fleet-top:
+	$(PY) tools/fleet_top.py --once $(if $(MEMBERS),--membership $(MEMBERS))
 
 # Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
 # metric's newest line degrades >10% vs the latest prior line at equal

@@ -48,6 +48,14 @@ from horovod_tpu import serving  # noqa: F401
 from horovod_tpu import profiler  # noqa: F401
 from horovod_tpu.profiler import doctor, profile  # noqa: F401
 from horovod_tpu.metrics import metrics_http, reset_metrics  # noqa: F401
+# Fleet health plane (docs/OBSERVABILITY.md "Fleet health plane"):
+# windowed time-series over registry snapshots (hvd.timeseries), the
+# continuous doctor with fire/clear hysteresis + SLO burn-rate alerts
+# and the per-replica scrape collector (hvd.health), and the hvd.top()
+# terminal dashboard (CLI: tools/fleet_top.py).
+from horovod_tpu import timeseries  # noqa: F401
+from horovod_tpu import health  # noqa: F401
+from horovod_tpu.health import top  # noqa: F401
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,
     ErrorFeedbackState, accumulation_has_updated, reset_error_feedback,

@@ -191,11 +191,34 @@ class Config:
     # via the /trace HTTP endpoint); HOROVOD_REQUEST_TRACE_DECODE_EVERY
     # samples one DECODE span every N decode steps to bound overhead.
     # HOROVOD_METRICS_PORT starts hvd.metrics_http() on replica servers
-    # and the fleet supervisor (0 = off; rank r binds port+r).
+    # and the fleet supervisor (0 = off; rank r binds port+r; "auto" —
+    # stored as -1 — binds an ephemeral port that the status RPC and
+    # membership file advertise, so co-hosted fleets never collide).
     request_trace: bool = False
     request_trace_dir: Optional[str] = None
     request_trace_decode_every: int = 16
     metrics_port: int = 0
+    # Fleet health plane (timeseries.py / health.py, docs/OBSERVABILITY.md
+    # "Fleet health plane"): HOROVOD_HEALTH_INTERVAL is the continuous
+    # doctor's evaluation/sampling tick, HOROVOD_HEALTH_WINDOW the
+    # sliding window its checks see, HOROVOD_HEALTH_FIRE_N /
+    # HOROVOD_HEALTH_CLEAR_M the fire/clear hysteresis (N consecutive
+    # bad windows to fire an alert, M good ones to clear it),
+    # HOROVOD_HEALTH_ALERTS_FILE the append-only alerts.jsonl path,
+    # HOROVOD_FLEET_SCRAPE_INTERVAL the FleetCollector's per-member
+    # scrape period. Declared SLOs: HOROVOD_SLO_TTFT_P99_MS (0 = no TTFT
+    # SLO) and HOROVOD_SLO_ERROR_RATE (allowed error fraction, 0 = no
+    # error SLO), both evaluated as multi-window burn rates that must
+    # exceed HOROVOD_SLO_BURN_THRESHOLD in the short AND long window.
+    health_interval_seconds: float = 2.0
+    health_window_seconds: float = 30.0
+    health_fire_n: int = 2
+    health_clear_m: int = 2
+    health_alerts_file: Optional[str] = None
+    fleet_scrape_interval_seconds: float = 1.0
+    slo_ttft_p99_ms: float = 0.0
+    slo_error_rate: float = 0.0
+    slo_burn_threshold: float = 2.0
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Preemption tolerance (checkpoint_sharded.py / faults.py /
@@ -387,6 +410,23 @@ def _env_auth_token() -> str:
     return v
 
 
+def _env_metrics_port() -> int:
+    v = os.environ.get("HOROVOD_METRICS_PORT", "").strip().lower()
+    if not v:
+        return 0
+    if v == "auto":
+        return -1          # ephemeral bind; status RPC advertises the port
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"HOROVOD_METRICS_PORT={v!r}: expected a port "
+                         f"number, 'auto', or unset")
+    if n < 0:
+        raise ValueError(f"HOROVOD_METRICS_PORT={n}: must be >= 0 "
+                         f"(or 'auto')")
+    return n
+
+
 def _env_fault_plan() -> str:
     v = os.environ.get("HOROVOD_FAULT_PLAN", "").strip()
     if v:
@@ -475,7 +515,19 @@ def refresh() -> Config:
         or None,
         request_trace_decode_every=_env_posint(
             "HOROVOD_REQUEST_TRACE_DECODE_EVERY", 16),
-        metrics_port=_env_nonneg_int("HOROVOD_METRICS_PORT", 0),
+        metrics_port=_env_metrics_port(),
+        health_interval_seconds=max(
+            0.05, _env_float("HOROVOD_HEALTH_INTERVAL", 2.0)),
+        health_window_seconds=_env_posfloat("HOROVOD_HEALTH_WINDOW", 30.0),
+        health_fire_n=_env_posint("HOROVOD_HEALTH_FIRE_N", 2),
+        health_clear_m=_env_posint("HOROVOD_HEALTH_CLEAR_M", 2),
+        health_alerts_file=os.environ.get("HOROVOD_HEALTH_ALERTS_FILE")
+        or None,
+        fleet_scrape_interval_seconds=_env_posfloat(
+            "HOROVOD_FLEET_SCRAPE_INTERVAL", 1.0),
+        slo_ttft_p99_ms=_env_nonneg_float("HOROVOD_SLO_TTFT_P99_MS", 0.0),
+        slo_error_rate=_env_nonneg_float("HOROVOD_SLO_ERROR_RATE", 0.0),
+        slo_burn_threshold=_env_posfloat("HOROVOD_SLO_BURN_THRESHOLD", 2.0),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         preemption_notice_seconds=max(
             0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),

@@ -1,0 +1,680 @@
+"""Continuous fleet health plane: peer scraping, windowed doctor with
+alert hysteresis, SLO burn rates, and the ``hvd.top`` dashboard.
+
+``hvd.doctor()`` (profiler.py) is a one-shot diagnosis over one
+process's cumulative registry. This module makes it *continuous* and
+*fleet-wide* — the sensing half the ROADMAP's closed-loop item needs
+before any actuator can judge a knob change:
+
+* :class:`FleetCollector` — a scrape thread following the fleet
+  supervisor's membership file (PR 11/13): every listed replica's
+  ``/metrics.json`` endpoint lands in one
+  :class:`~horovod_tpu.timeseries.TimeSeriesStore` under
+  ``{replica, attempt}`` labels. A restarted replica (membership
+  ``readmit`` with a bumped attempt) mints *new* series, so windowed
+  rates never see its counter reset as a negative spike, and the dead
+  attempt's series age out of the store.
+* :class:`ContinuousDoctor` — re-runs the existing doctor checks over
+  sliding windows (``profiler.doctor_window``), adds a windowed fleet
+  availability check and declared-SLO burn rates
+  (``HOROVOD_SLO_TTFT_P99_MS`` / ``HOROVOD_SLO_ERROR_RATE``, evaluated
+  over a short and a long window like SRE multi-window burn alerts),
+  and drives a full alert lifecycle with fire/clear **hysteresis**
+  (``HOROVOD_HEALTH_FIRE_N`` consecutive bad windows to fire,
+  ``HOROVOD_HEALTH_CLEAR_M`` good ones to clear):
+  ``alerts_total{finding,severity}``, ``alert_active{finding}``,
+  ``ALERT`` timeline markers, and an append-only ``alerts.jsonl``.
+* Surfaces — ``hvd.metrics_http()`` serves ``/doctor`` (ranked findings
+  from :func:`last_report`) and ``/healthz`` (200/503 from the
+  ``alert_active`` gauges); :func:`top` / ``tools/fleet_top.py`` render
+  the live per-replica terminal dashboard.
+
+Sticky findings (``fleet_quarantine`` stays true as long as the replica
+is parked — by design) are reported by ``/doctor`` but excluded from the
+alert lifecycle: an alert that can never clear is a page that never
+stops, so the *availability* consequence (capacity below target, or a
+fresh quarantine event inside the window) is what alerts, and it clears
+once spare promotion restores capacity and the event ages out.
+
+Background threads register with the same atexit drain the metrics
+flusher uses (``metrics.register_atexit_drain``): a short-lived process
+stops them cleanly and its final ``alerts.jsonl`` entries are on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from horovod_tpu import metrics
+from horovod_tpu.timeseries import LocalSampler, TimeSeriesStore
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = ["FleetCollector", "ContinuousDoctor", "active_alerts",
+           "last_report", "healthz", "top", "render_top", "stop_all"]
+
+#: doctor categories that are true for as long as their cause persists
+#: (quarantine is sticky by design) — shown in ``/doctor``, never alerted:
+#: the windowed ``fleet_availability`` finding carries their alert.
+STICKY_CATEGORIES = frozenset({"fleet_quarantine"})
+
+#: terminal request statuses that count against HOROVOD_SLO_ERROR_RATE.
+ERROR_STATUSES = ("rejected", "expired", "failed")
+#: statuses that complete the denominator (client cancels are excluded —
+#: a cancel is the client's choice, not the fleet's failure).
+TERMINAL_STATUSES = ERROR_STATUSES + ("done",)
+
+#: the long SLO window is this multiple of the short (health) window —
+#: the classic two-window burn alert: the short window says "happening
+#: now", the long one says "not just one bad scrape".
+SLO_LONG_WINDOW_FACTOR = 4.0
+
+metrics.set_help("alerts_total",
+                 "Continuous-doctor alert fires by finding and severity.")
+metrics.set_help("alert_active",
+                 "1-per-active-alert gauge (value = finding severity); "
+                 "/healthz turns 503 while any is >= 0.5.")
+metrics.set_help("fleet_quarantines_total",
+                 "Quarantine events by replica (the windowed availability "
+                 "check alerts on these, then clears — unlike the sticky "
+                 "quarantined-replicas gauge).")
+
+_LIVE_LOCK = threading.Lock()
+_LIVE: List[Any] = []          # started collectors/doctors, for the drain
+_LAST_DOCTOR: Optional["ContinuousDoctor"] = None
+
+
+def _drain_health_at_exit() -> None:
+    """Interpreter-exit drain shared with the metrics flusher: stop every
+    started collector/doctor so final ``alerts.jsonl`` entries land and
+    no scrape thread outlives the process teardown."""
+    stop_all()
+
+
+def stop_all() -> None:
+    """Stop every started :class:`FleetCollector` / :class:`ContinuousDoctor`
+    in this process (idempotent; also the atexit drain)."""
+    with _LIVE_LOCK:
+        live = list(_LIVE)
+    for obj in live:
+        try:
+            obj.stop()
+        except Exception:
+            pass
+
+
+def _register_live(obj: Any) -> None:
+    with _LIVE_LOCK:
+        if obj not in _LIVE:
+            _LIVE.append(obj)
+    metrics.register_atexit_drain(_drain_health_at_exit)
+
+
+def _unregister_live(obj: Any) -> None:
+    with _LIVE_LOCK:
+        if obj in _LIVE:
+            _LIVE.remove(obj)
+
+
+# ---------------------------------------------------------------------------
+# fleet scraping
+# ---------------------------------------------------------------------------
+
+class FleetCollector:
+    """Scrape every fleet member's metrics endpoint into one store.
+
+    Addresses come from the supervisor's membership file (each entry now
+    carries the replica's ``metrics_port``, discovered via the status
+    RPC); each scrape lands as one snapshot append labeled
+    ``{replica, attempt}``. Unreachable members are skipped quietly — a
+    dying replica's scrape failing is the *expected* signal, not an
+    error — and series that stop updating expire from the store."""
+
+    def __init__(self, membership_path: str,
+                 store: Optional[TimeSeriesStore] = None,
+                 interval_s: Optional[float] = None,
+                 scrape_timeout_s: float = 0.5):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        self.membership_path = membership_path
+        self.store = store if store is not None else TimeSeriesStore()
+        self.interval_s = max(0.05, float(
+            cfg.fleet_scrape_interval_seconds
+            if interval_s is None else interval_s))
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scrapes = 0
+        self.scrape_errors = 0
+
+    def members(self) -> List[Dict[str, Any]]:
+        try:
+            with open(self.membership_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return []
+        out = []
+        for rep in (doc.get("replicas") or []):
+            if isinstance(rep, dict) and rep.get("name") \
+                    and int(rep.get("metrics_port") or 0) > 0:
+                out.append(rep)
+        return out
+
+    def scrape_once(self, now: Optional[float] = None) -> int:
+        """One sweep over the current membership; returns the number of
+        members scraped successfully. Callable directly from tests and
+        ``--once`` dashboards — no thread required."""
+        ok = 0
+        for rep in self.members():
+            url = (f"http://{rep.get('host', '127.0.0.1')}:"
+                   f"{int(rep['metrics_port'])}/metrics.json")
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.scrape_timeout_s) as resp:
+                    snap = json.loads(resp.read().decode("utf-8"))
+            except Exception:
+                self.scrape_errors += 1
+                continue
+            ts = snap.pop("timestamp", None) if now is None else now
+            self.store.append_snapshot(
+                snap, ts=ts,
+                labels={"replica": rep["name"],
+                        "attempt": rep.get("attempt", 0)})
+            ok += 1
+        self.scrapes += 1
+        self.store.expire()
+        return ok
+
+    def start(self) -> "FleetCollector":
+        if self._thread is not None:
+            logger.warning("FleetCollector already running for %s — "
+                           "double start refused", self.membership_path)
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-fleet-collector", daemon=True)
+        self._thread.start()
+        _register_live(self)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        _unregister_live(self)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:     # a bad scrape must not kill the plane
+                pass
+
+
+# ---------------------------------------------------------------------------
+# windowed checks the one-shot doctor does not have
+# ---------------------------------------------------------------------------
+
+def check_fleet_availability(store: TimeSeriesStore, window_s: float, *,
+                             now: Optional[float] = None) -> List[Dict]:
+    """Windowed availability: live capacity below target *now*, or a
+    quarantine event *inside the window*. Unlike the sticky
+    ``fleet_quarantine`` gauge finding this clears — once spare
+    promotion restores capacity and the event ages past the window, the
+    fleet is healthy again and the alert must say so."""
+    target = store.latest("fleet_target_replicas", agg="max")
+    if not target:
+        return []
+    live = store.latest("fleet_replicas",
+                        labels={"state": "live"}, agg="max") or 0.0
+    q_events = store.delta("fleet_quarantines_total", window_s, now=now)
+    if live >= target and q_events <= 0:
+        return []
+    if live < target:
+        sev, what = 0.9, (f"{int(live)}/{int(target)} replicas live")
+    else:
+        sev, what = 0.6, (f"{int(q_events)} quarantine event(s) in the "
+                          f"last {window_s:g}s (capacity restored)")
+    return [{
+        "category": "fleet_availability", "severity": sev,
+        "title": f"fleet availability degraded: {what}",
+        "detail": "a replica left the serving set inside this window "
+                  "(crash-loop quarantine or unhealed death); windowed "
+                  "rates on the restarted attempt's fresh series stay "
+                  "reset-safe, but capacity was at risk",
+        "suggestion": "check FLEET quarantine markers for the typed "
+                      "reason; keep HOROVOD_SERVE_FLEET_SPARES >= 1 so "
+                      "promotion restores capacity inside one probe tick.",
+        "evidence": {"live": int(live), "target": int(target),
+                     "quarantine_events_in_window": int(q_events)},
+    }]
+
+
+def check_slo_burn(store: TimeSeriesStore, window_s: float, *,
+                   now: Optional[float] = None,
+                   ttft_p99_ms: Optional[float] = None,
+                   error_rate: Optional[float] = None,
+                   burn_threshold: Optional[float] = None) -> List[Dict]:
+    """Declared-SLO multi-window burn rates.
+
+    A burn rate is the window's violation fraction over the SLO's
+    allowed fraction (p99 allows 1%; the error SLO allows its declared
+    rate). An alert needs the burn past threshold in BOTH the short
+    (``window_s``) and long (``SLO_LONG_WINDOW_FACTOR *  window_s``)
+    windows — the short window proves it is happening *now*, the long
+    one that it is not a single bad scrape."""
+    from horovod_tpu.config import get_config
+    cfg = get_config()
+    ttft_p99_ms = (cfg.slo_ttft_p99_ms if ttft_p99_ms is None
+                   else ttft_p99_ms)
+    error_rate = (cfg.slo_error_rate if error_rate is None else error_rate)
+    burn_threshold = (cfg.slo_burn_threshold if burn_threshold is None
+                      else burn_threshold)
+    now = time.time() if now is None else float(now)
+    long_s = SLO_LONG_WINDOW_FACTOR * float(window_s)
+    out: List[Dict] = []
+
+    if ttft_p99_ms and ttft_p99_ms > 0:
+        allowed = 0.01                       # p99: 1% may exceed the target
+        t_s = ttft_p99_ms / 1000.0
+        frac_short = store.fraction_over(
+            "serve_ttft_seconds", t_s, window_s, now=now)
+        frac_long = store.fraction_over(
+            "serve_ttft_seconds", t_s, long_s, now=now)
+        if frac_short is not None and frac_long is not None:
+            burn_short = frac_short / allowed
+            burn_long = frac_long / allowed
+            if burn_short >= burn_threshold and burn_long >= burn_threshold:
+                out.append({
+                    "category": "slo_ttft_burn",
+                    "severity": min(1.0, 0.6 + 0.1 * burn_long),
+                    "title": f"TTFT p99 SLO burning {burn_long:.1f}x "
+                             f"allowed ({ttft_p99_ms:g}ms target)",
+                    "detail": f"{frac_short:.1%} of requests in the last "
+                              f"{window_s:g}s (and {frac_long:.1%} over "
+                              f"{long_s:g}s) exceeded the declared p99 "
+                              f"target — past the {burn_threshold:g}x "
+                              f"burn threshold in both windows",
+                    "suggestion": "hvd.doctor()'s request_tail / serving "
+                                  "findings say where the time goes; add "
+                                  "replicas or HOROVOD_SERVE_SLOTS before "
+                                  "relaxing HOROVOD_SLO_TTFT_P99_MS.",
+                    "evidence": {"burn_short": round(burn_short, 2),
+                                 "burn_long": round(burn_long, 2),
+                                 "target_ms": ttft_p99_ms},
+                })
+
+    if error_rate and error_rate > 0:
+        for w, tag in ((float(window_s), "short"), (long_s, "long")):
+            errs = sum(store.delta("serve_requests_total", w,
+                                   labels={"status": s}, now=now)
+                       for s in ERROR_STATUSES)
+            total = sum(store.delta("serve_requests_total", w,
+                                    labels={"status": s}, now=now)
+                        for s in TERMINAL_STATUSES)
+            frac = (errs / total) if total > 0 else 0.0
+            if tag == "short":
+                burn_short, err_short = frac / error_rate, frac
+            else:
+                burn_long, err_long = frac / error_rate, frac
+        if burn_short >= burn_threshold and burn_long >= burn_threshold:
+            out.append({
+                "category": "slo_error_burn",
+                "severity": min(1.0, 0.6 + 0.1 * burn_long),
+                "title": f"error-rate SLO burning {burn_long:.1f}x "
+                         f"allowed ({error_rate:.2%} target)",
+                "detail": f"{err_short:.1%} of terminal requests errored "
+                          f"(rejected/expired/failed) in the last "
+                          f"{window_s:g}s and {err_long:.1%} over "
+                          f"{long_s:g}s — past the {burn_threshold:g}x "
+                          f"burn threshold in both windows",
+                "suggestion": "rejected = backpressure (queue limit, KV "
+                              "pool), expired = deadline pressure, failed "
+                              "= crashes; the doctor's serving findings "
+                              "name the knob per cause.",
+                "evidence": {"burn_short": round(burn_short, 2),
+                             "burn_long": round(burn_long, 2),
+                             "target_rate": error_rate},
+            })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# continuous doctor with alert lifecycle
+# ---------------------------------------------------------------------------
+
+class ContinuousDoctor:
+    """Re-run the doctor over sliding windows with an alert lifecycle.
+
+    Each tick: sample the local registry into the store (peers arrive
+    via a :class:`FleetCollector` sharing the same store), run
+    ``profiler.doctor_window`` plus the windowed availability and SLO
+    burn checks, then walk finding categories through fire/clear
+    hysteresis — ``fire_n`` consecutive bad ticks (severity >= 0.5)
+    fire, ``clear_m`` consecutive good ticks clear. Transitions bump
+    ``alerts_total{finding,severity}``, set ``alert_active{finding}``,
+    drop ``ALERT`` timeline markers, and append to ``alerts.jsonl``."""
+
+    def __init__(self, store: Optional[TimeSeriesStore] = None, *,
+                 interval_s: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 fire_n: Optional[int] = None,
+                 clear_m: Optional[int] = None,
+                 alerts_path: Optional[str] = None,
+                 sample_local: bool = True,
+                 categories: Optional[Any] = None):
+        from horovod_tpu.config import get_config
+        cfg = get_config()
+        self.store = store if store is not None else TimeSeriesStore()
+        self.interval_s = max(0.05, float(
+            cfg.health_interval_seconds if interval_s is None
+            else interval_s))
+        self.window_s = float(cfg.health_window_seconds
+                              if window_s is None else window_s)
+        self.fire_n = max(1, int(cfg.health_fire_n
+                                 if fire_n is None else fire_n))
+        self.clear_m = max(1, int(cfg.health_clear_m
+                                  if clear_m is None else clear_m))
+        self.alerts_path = (cfg.health_alerts_file
+                            if alerts_path is None else alerts_path)
+        #: optional alert ROUTING allowlist: findings of other categories
+        #: still appear ranked in every report (/doctor), but only these
+        #: walk the fire/clear lifecycle — a paging policy, not a filter.
+        self.categories = frozenset(categories) if categories else None
+        self._sampler = (LocalSampler(self.store, self.interval_s)
+                         if sample_local else None)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._bad: Dict[str, int] = {}
+        self._good: Dict[str, int] = {}
+        self._active: Dict[str, Dict[str, Any]] = {}
+        self._report: Optional[Dict[str, Any]] = None
+        self.ticks = 0
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One tick: sample, diagnose over the window, advance hysteresis.
+        Returns the windowed report (also served at ``/doctor``). Tests
+        drive this directly with canned stores and explicit ``now``."""
+        from horovod_tpu import profiler
+        ts = time.time() if now is None else float(now)
+        if self._sampler is not None:
+            try:
+                self._sampler.sample_once(ts=ts)
+            except Exception:
+                pass
+        report = profiler.doctor_window(self.store, self.window_s, now=ts)
+        findings = report["findings"]
+        findings += check_fleet_availability(self.store, self.window_s,
+                                             now=ts)
+        findings += check_slo_burn(self.store, self.window_s, now=ts)
+        findings.sort(key=lambda f: (-f["severity"], f["category"],
+                                     f["title"]))
+        for i, f in enumerate(findings):
+            f["rank"] = i + 1
+        report["healthy"] = not any(f["severity"] >= 0.5 for f in findings)
+        report["window_seconds"] = self.window_s
+        report["alerts"] = self._advance(findings, ts)
+        with self._lock:
+            self._report = report
+            self.ticks += 1
+        return report
+
+    def _advance(self, findings: List[Dict], ts: float) -> List[Dict]:
+        """One hysteresis step over alertable finding categories."""
+        bad_now: Dict[str, Dict] = {}
+        for f in findings:
+            if f["severity"] >= 0.5 and f["category"] not in STICKY_CATEGORIES \
+                    and (self.categories is None
+                         or f["category"] in self.categories):
+                prev = bad_now.get(f["category"])
+                if prev is None or f["severity"] > prev["severity"]:
+                    bad_now[f["category"]] = f
+        with self._lock:
+            for cat, f in bad_now.items():
+                self._good[cat] = 0
+                self._bad[cat] = self._bad.get(cat, 0) + 1
+                if cat not in self._active and self._bad[cat] >= self.fire_n:
+                    self._fire(cat, f, ts)
+            for cat in list(self._bad):
+                if cat not in bad_now:
+                    self._bad[cat] = 0
+                    self._good[cat] = self._good.get(cat, 0) + 1
+                    if cat in self._active \
+                            and self._good[cat] >= self.clear_m:
+                        self._clear(cat, ts)
+            return list(self._active.values())
+
+    def _fire(self, cat: str, finding: Dict, ts: float) -> None:
+        sev = float(finding["severity"])
+        self._active[cat] = {"finding": cat, "severity": sev,
+                             "title": finding["title"], "since": ts}
+        metrics.counter("alerts_total", finding=cat,
+                        severity=f"{sev:.1f}").inc()
+        metrics.gauge("alert_active", finding=cat).set(sev)
+        metrics._timeline_marker("ALERT", category="health", event="fire",
+                                 finding=cat, severity=sev,
+                                 title=finding["title"])
+        logger.warning("health: ALERT fired: %s [%.2f] %s",
+                       cat, sev, finding["title"])
+        self._append_alert({"ts": ts, "event": "fire", "finding": cat,
+                            "severity": sev, "title": finding["title"],
+                            "detail": finding.get("detail", ""),
+                            "suggestion": finding.get("suggestion", "")})
+
+    def _clear(self, cat: str, ts: float) -> None:
+        rec = self._active.pop(cat)
+        metrics.gauge("alert_active", finding=cat).set(0.0)
+        metrics._timeline_marker("ALERT", category="health", event="clear",
+                                 finding=cat,
+                                 active_s=round(ts - rec["since"], 3))
+        logger.warning("health: alert cleared: %s (active %.1fs)",
+                       cat, ts - rec["since"])
+        self._append_alert({"ts": ts, "event": "clear", "finding": cat,
+                            "severity": rec["severity"],
+                            "active_seconds": round(ts - rec["since"], 3)})
+
+    def _append_alert(self, rec: Dict[str, Any]) -> None:
+        if not self.alerts_path:
+            return
+        try:
+            with open(self.alerts_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+        except OSError:
+            logger.exception("health: cannot append %s", self.alerts_path)
+
+    # -- state -------------------------------------------------------------
+
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(a) for a in self._active.values()]
+
+    def last_report(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._report
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ContinuousDoctor":
+        global _LAST_DOCTOR
+        if self._thread is not None:
+            logger.warning("ContinuousDoctor already running — double "
+                           "start refused")
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-continuous-doctor", daemon=True)
+        self._thread.start()
+        _register_live(self)
+        _LAST_DOCTOR = self
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        _unregister_live(self)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:     # diagnosis must never kill the plane
+                logger.exception("health: doctor tick failed")
+
+
+# ---------------------------------------------------------------------------
+# process-global views (the HTTP endpoints read these)
+# ---------------------------------------------------------------------------
+
+def active_alerts() -> List[Dict[str, Any]]:
+    """Active alerts of the most recently started :class:`ContinuousDoctor`
+    in this process (empty when none runs — ``/healthz`` then also folds
+    in the ``alert_active`` gauges, which survive a stopped doctor)."""
+    d = _LAST_DOCTOR
+    return d.active_alerts() if d is not None else []
+
+
+def last_report() -> Optional[Dict[str, Any]]:
+    """The most recent windowed doctor report, or ``None`` when no
+    :class:`ContinuousDoctor` has evaluated yet (``/doctor`` then falls
+    back to a one-shot ``hvd.doctor()``)."""
+    d = _LAST_DOCTOR
+    return d.last_report() if d is not None else None
+
+
+def healthz() -> Dict[str, Any]:
+    """Liveness verdict for ``/healthz``: every ``alert_active`` gauge
+    series > 0 (fired, not yet cleared) plus the running doctor's view.
+    ``ok`` is False — HTTP 503 — while any active alert is >= 0.5."""
+    alerts: Dict[str, Dict[str, Any]] = {}
+    for s in metrics.snapshot()["gauges"].get("alert_active", []):
+        if float(s.get("value", 0)) > 0:
+            cat = s.get("labels", {}).get("finding", "?")
+            alerts[cat] = {"finding": cat,
+                           "severity": float(s["value"])}
+    for a in active_alerts():
+        alerts[a["finding"]] = a
+    acts = sorted(alerts.values(), key=lambda a: -a["severity"])
+    ok = not any(a["severity"] >= 0.5 for a in acts)
+    return {"status": "ok" if ok else "alerting", "ok": ok, "alerts": acts}
+
+
+# ---------------------------------------------------------------------------
+# hvd.top — live per-replica terminal dashboard
+# ---------------------------------------------------------------------------
+
+def _fmt(v, spec: str = "{:.1f}", dash: str = "-") -> str:
+    return dash if v is None else spec.format(v)
+
+
+def render_top(store: TimeSeriesStore, *, window_s: float = 10.0,
+               now: Optional[float] = None,
+               local_snap: Optional[Dict[str, Any]] = None,
+               stale_s: float = 5.0) -> str:
+    """Render one dashboard frame as text (``hvd.top --once`` prints
+    exactly this; tests assert on it). Per replica (from the store's
+    scraped ``{replica, attempt}`` series): liveness (scrape freshness),
+    QPS (reset-aware windowed request rate), TTFT p99 from windowed
+    bucket deltas, slots/blocks gauges, breaker state (supervisor-side
+    ``circuit_state`` gauges), then the active-alert lines."""
+    now = time.time() if now is None else float(now)
+    local_snap = local_snap if local_snap is not None else metrics.snapshot()
+    breaker_by_rep: Dict[str, float] = {
+        s.get("labels", {}).get("replica", "?"): float(s.get("value", 0))
+        for s in local_snap.get("gauges", {}).get("circuit_state", [])}
+
+    by_rep: Dict[str, List[str]] = {}
+    for labels in store.label_sets(keys=("replica", "attempt")):
+        rep = labels.get("replica")
+        if rep is None:
+            continue
+        by_rep.setdefault(rep, []).append(labels.get("attempt", "0"))
+
+    header = (f"{'REPLICA':<10}{'ATT':>4}{'UP':>6}{'QPS':>8}"
+              f"{'TTFT_P99_MS':>13}{'SLOTS':>7}{'BLOCKS':>8}{'BREAKER':>9}")
+    lines = [f"hvd.top — fleet health plane "
+             f"(window {window_s:g}s, {len(by_rep)} replica(s))",
+             header]
+    for rep in sorted(by_rep):
+        sel = {"replica": rep}
+        attempt = max(by_rep[rep], key=lambda a: (len(a), a))
+        age = store.last_update(sel)
+        up = "up" if age is not None and now - age <= stale_s else "stale"
+        qps = store.rate("serve_requests_total", window_s,
+                         labels=sel, now=now)
+        p99 = store.quantile("serve_ttft_seconds", 0.99, window_s,
+                             labels=sel, now=now)
+        slots = store.latest("serve_slots_active", labels=sel)
+        blocks = store.latest("serve_blocks_in_use", labels=sel)
+        brk = breaker_by_rep.get(rep)
+        brk_s = {0.0: "closed", 0.5: "half", 1.0: "open"}.get(brk, "-") \
+            if brk is not None else "-"
+        lines.append(
+            f"{rep:<10}{attempt:>4}{up:>6}{qps:>8.2f}"
+            f"{_fmt(None if p99 is None else p99 * 1e3):>13}"
+            f"{_fmt(slots, '{:.0f}'):>7}{_fmt(blocks, '{:.0f}'):>8}"
+            f"{brk_s:>9}")
+
+    acts = healthz()["alerts"]
+    if acts:
+        lines.append("")
+        for a in acts:
+            since = a.get("since")
+            age_s = f" for {now - since:.0f}s" if since else ""
+            lines.append(f"ALERT [{a['severity']:.2f}] "
+                         f"{a['finding']}{age_s}"
+                         + (f": {a['title']}" if a.get("title") else ""))
+    else:
+        lines.append("")
+        lines.append("no active alerts")
+    return "\n".join(lines)
+
+
+def top(membership: Optional[str] = None, *, once: bool = False,
+        interval_s: float = 2.0, window_s: float = 10.0,
+        store: Optional[TimeSeriesStore] = None,
+        iterations: Optional[int] = None) -> str:
+    """Live per-replica terminal dashboard (``hvd.top()``; CLI:
+    ``tools/fleet_top.py``). With ``membership`` a scrape of every fleet
+    member feeds each frame; without it the local registry is sampled.
+    ``once=True`` renders a single frame, prints it, and returns it —
+    the CI/test mode. Returns the last rendered frame."""
+    own_store = store is None
+    store = store if store is not None else TimeSeriesStore()
+    collector = (FleetCollector(membership, store=store,
+                                interval_s=interval_s)
+                 if membership else None)
+    sampler = (LocalSampler(store, interval_s)
+               if collector is None and own_store else None)
+    frame = ""
+    try:
+        n = 1 if once else iterations
+        i = 0
+        while n is None or i < n:
+            if collector is not None:
+                collector.scrape_once()
+            if sampler is not None:
+                sampler.sample_once()
+            frame = render_top(store, window_s=window_s,
+                               stale_s=max(5.0, 3 * interval_s))
+            if not once:
+                print("\033[2J\033[H", end="")
+            print(frame)
+            i += 1
+            if n is None or i < n:
+                time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return frame

@@ -58,6 +58,7 @@ __all__ = [
     "snapshot", "reset_metrics", "to_prometheus", "to_json", "set_help",
     "collective_summary",
     "start_metrics_flusher", "stop_metrics_flusher",
+    "register_atexit_drain",
     "collective_begin", "collective_end", "pending_collectives",
     "StallWatchdog", "start_stall_watchdog", "stop_stall_watchdog",
     "get_stall_watchdog",
@@ -529,6 +530,35 @@ def collective_summary() -> Dict[str, Dict[str, Any]]:
 _FLUSHER_LOCK = threading.Lock()
 _FLUSHER: Optional["_Flusher"] = None
 _ATEXIT_REGISTERED = False
+_ATEXIT_DRAINS: List[Callable[[], None]] = []
+
+
+def register_atexit_drain(fn: Callable[[], None]) -> None:
+    """Register ``fn`` with the shared interpreter-exit drain (one
+    ``atexit`` hook for the whole metrics plane). The flusher's final
+    write registers here; the health plane's collector/doctor threads
+    (``horovod_tpu.health``) register the same way so a short-lived
+    process stops them cleanly and lands its final ``alerts.jsonl``
+    entries. Idempotent per function; drains run in registration order
+    and an exception in one never skips the rest."""
+    global _ATEXIT_REGISTERED
+    with _FLUSHER_LOCK:
+        if fn not in _ATEXIT_DRAINS:
+            _ATEXIT_DRAINS.append(fn)
+        if not _ATEXIT_REGISTERED:
+            import atexit
+            atexit.register(_run_atexit_drains)
+            _ATEXIT_REGISTERED = True
+
+
+def _run_atexit_drains() -> None:
+    with _FLUSHER_LOCK:
+        drains = list(_ATEXIT_DRAINS)
+    for fn in drains:
+        try:
+            fn()
+        except Exception:
+            logger.exception("atexit drain %r failed", fn)
 
 
 def _drain_flusher_at_exit() -> None:
@@ -602,7 +632,6 @@ def start_metrics_flusher(path: Optional[str] = None,
             path = f"{root}.r{jax.process_index()}{ext}"
     except Exception:
         pass
-    global _ATEXIT_REGISTERED
     with _FLUSHER_LOCK:
         if _FLUSHER is not None:
             if (_FLUSHER.path == path
@@ -610,10 +639,7 @@ def start_metrics_flusher(path: Optional[str] = None,
                 return
             _FLUSHER.stop(final_write=False)
         _FLUSHER = _Flusher(path, interval_s)
-        if not _ATEXIT_REGISTERED:
-            import atexit
-            atexit.register(_drain_flusher_at_exit)
-            _ATEXIT_REGISTERED = True
+    register_atexit_drain(_drain_flusher_at_exit)
 
 
 def stop_metrics_flusher(final_write: bool = True) -> None:
@@ -904,10 +930,17 @@ class MetricsHTTPServer:
 
     ``GET /metrics`` returns :func:`to_prometheus` (text exposition
     0.0.4) — what Prometheus scrapes instead of tailing
-    ``HOROVOD_METRICS_FILE``. ``GET /trace`` returns the live
-    request-trace span buffer as a Chrome-trace JSON document (empty
-    ``traceEvents`` when request tracing is off). Serves on a daemon
-    thread; :meth:`stop` shuts it down."""
+    ``HOROVOD_METRICS_FILE``. ``GET /metrics.json`` is the same snapshot
+    as :func:`to_json` — the lossless form ``health.FleetCollector``
+    ingests (bucket layouts and label sets survive the wire exactly).
+    ``GET /trace`` returns the live request-trace span buffer as a
+    Chrome-trace JSON document (empty ``traceEvents`` when request
+    tracing is off). ``GET /doctor`` serves the continuous doctor's last
+    windowed report (falling back to a one-shot ``hvd.doctor()`` when
+    none runs); ``GET /healthz`` answers 200/503 from the
+    ``alert_active`` severities — the load-balancer / probe view of the
+    alert lifecycle. Unknown paths 404. Serves on a daemon thread;
+    :meth:`stop` shuts it down."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         import http.server
@@ -915,9 +948,13 @@ class MetricsHTTPServer:
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:           # noqa: N802 — stdlib API
                 path = self.path.split("?", 1)[0]
+                code = 200
                 if path in ("/metrics", "/"):
                     body = to_prometheus().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = to_json().encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/trace":
                     try:
                         from horovod_tpu.serving import reqtrace
@@ -928,10 +965,30 @@ class MetricsHTTPServer:
                         {"traceEvents": evs, "displayTimeUnit": "ms"},
                         default=str).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/doctor":
+                    try:
+                        from horovod_tpu import health as _health
+                        rep = _health.last_report()
+                    except Exception:
+                        rep = None
+                    if rep is None:
+                        from horovod_tpu import profiler as _profiler
+                        rep = _profiler.doctor()
+                    body = json.dumps(rep, default=str).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    try:
+                        from horovod_tpu import health as _health
+                        verdict = _health.healthz()
+                    except Exception:
+                        verdict = {"status": "ok", "ok": True, "alerts": []}
+                    code = 200 if verdict.get("ok", True) else 503
+                    body = json.dumps(verdict, default=str).encode("utf-8")
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -939,6 +996,11 @@ class MetricsHTTPServer:
 
             def log_message(self, *args) -> None:
                 pass                            # scrapes are not stderr news
+
+            def log_error(self, *args) -> None:
+                pass                            # 404s included — the fleet
+                                                # collector probing a replica
+                                                # mid-restart is routine
 
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)

@@ -70,7 +70,7 @@ __all__ = [
     "note_trace", "observe_step", "record_cost", "count_trace",
     "live_buffer_census", "check_memory_pressure",
     "profile", "trigger_profile", "profile_capture_count",
-    "doctor", "format_report",
+    "doctor", "doctor_window", "format_report",
     "PEAK_TFLOPS_BF16", "HBM_GBPS",
 ]
 
@@ -1813,6 +1813,26 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
             "programs": sorted(progs or {}),
         },
     }
+
+
+def doctor_window(store, window_s: float, *,
+                  now: Optional[float] = None) -> Dict[str, Any]:
+    """Windowed entry point: run every :func:`doctor` check over the last
+    ``window_s`` seconds of a :class:`~horovod_tpu.timeseries
+    .TimeSeriesStore` instead of the cumulative live registry.
+
+    The store's :meth:`window_snapshot` synthesizes a registry-shaped
+    snapshot whose counters/histograms are reset-aware window deltas and
+    whose gauges are the latest values, so the checks themselves run
+    unchanged — a finding from here means "true *in this window*", which
+    is what ``health.ContinuousDoctor`` feeds through fire/clear
+    hysteresis. The program registry is deliberately excluded
+    (``programs={}``): compile-time cost records are cumulative
+    process-local state, not windowed fleet state."""
+    snap = store.window_snapshot(window_s, now=now)
+    report = doctor(snapshot=snap, trace=None, programs={})
+    report["inputs"]["snapshot"] = f"window:{float(window_s):g}s"
+    return report
 
 
 def format_report(report: Dict[str, Any]) -> str:

@@ -164,6 +164,7 @@ class ReplicaSlot:
         self.address: Optional[Tuple[str, int]] = None
         self.client: Optional[RemoteClient] = None
         self.restarts = 0
+        self.metrics_port = 0          # from the status RPC, per attempt
         self.deaths: Deque[float] = deque()
         self.probe_failures = 0
         self.next_restart_at = 0.0
@@ -283,9 +284,14 @@ class FleetSupervisor:
         if slot.address is None:
             return
         with self._lock:
+            # metrics_port rides the membership entry so the health
+            # plane's FleetCollector can scrape every member without a
+            # second discovery channel; attempt re-keys the scraped
+            # series, keeping windowed rates reset-safe across respawns.
             self._members[slot.name] = {
                 "name": slot.name, "host": slot.address[0],
-                "port": slot.address[1], "attempt": slot.attempt}
+                "port": slot.address[1], "attempt": slot.attempt,
+                "metrics_port": slot.metrics_port}
         self._publish_membership()
 
     def _member_remove(self, slot: ReplicaSlot) -> None:
@@ -303,6 +309,12 @@ class FleetSupervisor:
         serving target is fully live (raises on timeout)."""
         for slot in self._slots:
             self._launch(slot)
+            # Pre-register the per-slot quarantine event counter at zero:
+            # a counter series born by its FIRST inc has no baseline
+            # sample, so a windowed reset-aware delta over it reads 0 —
+            # the zero point makes the first quarantine visible to the
+            # health plane's availability window.
+            metrics.counter("fleet_quarantines_total", replica=slot.name)
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="start", target=self.target,
                                  spares=self.spares)
@@ -329,11 +341,14 @@ class FleetSupervisor:
         for a free port rather than colliding with rank 0."""
         from horovod_tpu.config import get_config
         base = get_config().metrics_port
-        if base <= 0 or self._metrics_srv is not None:
+        if base == 0 or self._metrics_srv is not None:
             return
         try:
-            self._metrics_srv = metrics.metrics_http(base,
-                                                     fallback_ports=32)
+            if base < 0:                  # =auto: ephemeral bind
+                self._metrics_srv = metrics.metrics_http(0)
+            else:
+                self._metrics_srv = metrics.metrics_http(base,
+                                                         fallback_ports=32)
         except OSError as exc:
             logger = metrics.logger if hasattr(metrics, "logger") else None
             if logger is not None:
@@ -446,6 +461,10 @@ class FleetSupervisor:
                 self._on_death(slot, "unreachable")
             return
         slot.probe_failures = 0
+        try:
+            slot.metrics_port = int(st.get("metrics_port", 0) or 0)
+        except (TypeError, ValueError):
+            slot.metrics_port = 0
         if st.get("alive", False) and slot.state != LIVE:
             self._admit(slot)
 
@@ -530,6 +549,12 @@ class FleetSupervisor:
         slot.state = QUARANTINED
         slot.quarantine_reason = reason
         slot.next_restart_at = float("inf")
+        # Event counter next to the sticky state gauge: the continuous
+        # doctor's windowed availability check alerts on the *event*
+        # (which ages out of the window and clears) rather than the
+        # quarantined-replicas gauge (which stays up by design).
+        metrics.counter("fleet_quarantines_total",
+                        replica=slot.name).inc()
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="quarantine", replica=slot.name,
                                  reason=reason)

@@ -762,10 +762,15 @@ class SocketReplicaServer:
         # measures request progress, not its own traffic.
         with self._lock:
             seq = self.served_rpcs
+        srv = getattr(self, "_metrics_srv", None)
         return {"ok": True, "rank": self.rank, "alive": self.engine.alive,
                 "load": self.engine.load(), "slots": self.engine.slots,
                 "queue_depth": self.engine.queue.depth(),
                 "draining": bool(getattr(self.engine, "_draining", False)),
+                # scrape discovery: the fleet supervisor copies this into
+                # the membership file so health.FleetCollector knows where
+                # this replica's /metrics.json lives (0 = not exposed)
+                "metrics_port": int(srv.port) if srv is not None else 0,
                 "seq": seq}
 
     def _do_drain(self, p: Dict[str, Any]) -> Dict[str, Any]:
@@ -1004,7 +1009,10 @@ class SocketReplicaServer:
     def _start_metrics_http(self) -> None:
         """Under HOROVOD_METRICS_PORT, expose this replica's registry at
         port+rank (rank 0 gets the bare port; the fallback scan covers
-        co-hosted processes racing for the same offset)."""
+        co-hosted processes racing for the same offset).
+        ``HOROVOD_METRICS_PORT=auto`` binds an ephemeral port instead —
+        the status RPC advertises the actual port, so fleets of
+        co-hosted test replicas never collide on a base."""
         if getattr(self, "_metrics_srv", None) is not None:
             return
         try:
@@ -1012,15 +1020,18 @@ class SocketReplicaServer:
             base = int(get_config().metrics_port)
         except Exception:
             base = 0
-        if base <= 0:
+        if base == 0:
             return
         try:
-            self._metrics_srv = metrics.metrics_http(
-                base + self.rank, fallback_ports=16)
+            if base < 0:                      # auto: ephemeral bind
+                self._metrics_srv = metrics.metrics_http(0)
+            else:
+                self._metrics_srv = metrics.metrics_http(
+                    base + self.rank, fallback_ports=16)
         except OSError:
             metrics.logger.warning(
                 "replica %s: no free metrics port near %d",
-                self.name, base + self.rank)
+                self.name, max(0, base) + self.rank)
             self._metrics_srv = None
 
     def stop(self) -> None:
@@ -1816,6 +1827,11 @@ class RemoteDispatcher:
             self._status.pop(name, None)
             removed = len(self.clients) != before
         if removed:
+            # A retired replica has no circuit to be open: zero its
+            # breaker gauge so the doctor's transport_breaker finding
+            # (and the health plane's /healthz) track only members that
+            # can still be routed to.
+            metrics.gauge("circuit_state", replica=name).set(0.0)
             metrics.counter("transport_membership_total",
                             event="leave").inc()
             metrics._timeline_marker("TRANSPORT", category="transport",

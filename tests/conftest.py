@@ -11,11 +11,27 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache, shared by this process AND every
+# smoke-tool subprocess (workers inherit the env): the suite compiles
+# the same tiny programs dozens of times — every fleet respawn, every
+# golden-then-faulted rerun, every restarted elastic worker. Entries
+# are keyed on the HLO + jax version, so staleness is impossible by
+# construction; only compiles slower than the threshold are written.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "hvd_tpu_jit_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 # The image's sitecustomize imports jax at interpreter startup with
 # JAX_PLATFORMS=axon baked in, so env vars alone are too late here.
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,599 @@
+"""Fleet health plane (ISSUE 16 tentpole): windowed time-series store
+(reset-aware rates, bucket-delta quantiles, EWMA), the continuous doctor
+with fire/clear hysteresis + SLO burn rates, the hardened metrics HTTP
+surfaces (/healthz, /doctor), thread lifecycle via the shared atexit
+drain, and the hvd.top renderer. Every window test drives canned
+timestamps — no sleeps, no wall-clock dependence."""
+
+import json
+import logging
+import math
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import health, metrics, profiler
+from horovod_tpu.health import (
+    ContinuousDoctor, FleetCollector, check_fleet_availability,
+    check_slo_burn, render_top,
+)
+from horovod_tpu.timeseries import LocalSampler, TimeSeriesStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T0 = 1000.0   # canned epoch for every windowed test
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset_metrics()
+    yield
+    health.stop_all()
+    metrics.reset_metrics()
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    """Registry-snapshot-shaped dict from terse {name: [(labels, value)]}
+    maps (histogram values are (count, sum, [[le, cum], ...]))."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for name, series in (counters or {}).items():
+        out["counters"][name] = [{"labels": lb, "value": v}
+                                 for lb, v in series]
+    for name, series in (gauges or {}).items():
+        out["gauges"][name] = [{"labels": lb, "value": v}
+                               for lb, v in series]
+    for name, series in (histograms or {}).items():
+        out["histograms"][name] = [
+            {"labels": lb, "count": c, "sum": s, "buckets": b}
+            for lb, (c, s, b) in series]
+    return out
+
+
+def _fleet_snap(live, target=3, quarantined=0):
+    return _snap(gauges={
+        "fleet_replicas": [({"state": "live"}, float(live)),
+                           ({"state": "quarantined"}, float(quarantined))],
+        "fleet_target_replicas": [({}, float(target))]})
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore: reset-aware counter math
+# ---------------------------------------------------------------------------
+
+class TestCounterResets:
+    def test_delta_clamps_at_mid_stream_reset(self):
+        st = TimeSeriesStore()
+        # 0 -> 10 -> 20 -> RESTART(5) -> 15: increase is 10+10+5+10 = 35,
+        # never the naive 15 - 0 nor a negative spike.
+        for dt, v in [(0, 0), (10, 10), (20, 20), (30, 5), (40, 15)]:
+            st.append_snapshot(_snap(counters={"req_total": [({}, v)]}),
+                               ts=T0 + dt)
+        assert st.delta("req_total", 100, now=T0 + 40) == 35.0
+        assert st.rate("req_total", 100, now=T0 + 40) == pytest.approx(0.35)
+
+    def test_window_spanning_only_the_reset_stays_nonnegative(self):
+        st = TimeSeriesStore()
+        for dt, v in [(0, 0), (20, 20), (30, 5)]:
+            st.append_snapshot(_snap(counters={"req_total": [({}, v)]}),
+                               ts=T0 + dt)
+        # window [1025, 1035]: baseline is the last pre-window point (20),
+        # in-window value 5 < 20 -> reset, contribution = 5
+        assert st.delta("req_total", 10, now=T0 + 35) == 5.0
+
+    def test_scrape_sequence_with_attempt_rekeying(self):
+        """A restarted replica scrapes as a NEW {replica, attempt} series
+        (health.FleetCollector re-keys it), so the fleet-wide rate across
+        the restart seam equals the reset-clamped single-series answer
+        and is never negative."""
+        rekeyed = TimeSeriesStore()
+        naive = TimeSeriesStore()
+        seq = [(0, 0, 0), (10, 5, 0), (20, 9, 0),    # attempt 0 dies
+               (30, 0, 1), (40, 3, 1), (50, 7, 1)]   # attempt 1 from zero
+        for dt, v, att in seq:
+            rekeyed.append_snapshot(
+                _snap(counters={"req_total": [({}, v)]}),
+                ts=T0 + dt, labels={"replica": "r1", "attempt": att})
+            naive.append_snapshot(
+                _snap(counters={"req_total": [({}, v)]}),
+                ts=T0 + dt, labels={"replica": "r1"})
+        d_rekeyed = rekeyed.delta("req_total", 100, now=T0 + 50,
+                                  labels={"replica": "r1"})
+        d_naive = naive.delta("req_total", 100, now=T0 + 50,
+                              labels={"replica": "r1"})
+        assert d_rekeyed == d_naive == 16.0
+        assert rekeyed.rate("req_total", 100, now=T0 + 50) >= 0
+        atts = {ls["attempt"] for ls in rekeyed.label_sets()
+                if ls.get("replica") == "r1"}
+        assert atts == {"0", "1"}
+
+    def test_old_attempt_expires(self):
+        st = TimeSeriesStore()
+        st.append_snapshot(_snap(counters={"req_total": [({}, 9)]}),
+                           ts=T0, labels={"replica": "r1", "attempt": 0})
+        st.append_snapshot(_snap(counters={"req_total": [({}, 4)]}),
+                           ts=T0 + 30, labels={"replica": "r1",
+                                               "attempt": 1})
+        assert st.expire(max_age_s=20, now=T0 + 40) == 1
+        atts = {ls["attempt"] for ls in st.label_sets()}
+        assert atts == {"1"}
+
+    def test_single_point_window_contributes_nothing(self):
+        st = TimeSeriesStore()
+        st.append_snapshot(_snap(counters={"req_total": [({}, 7)]}), ts=T0)
+        assert st.delta("req_total", 10, now=T0 + 1) == 0.0
+        assert st.rate("req_total", 10, now=T0 + 1) == 0.0
+
+    def test_empty_store(self):
+        st = TimeSeriesStore()
+        assert st.delta("req_total", 10, now=T0) == 0.0
+        assert st.latest("req_total", kind="counter") is None
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore: histogram quantiles, fraction_over, EWMA, latest
+# ---------------------------------------------------------------------------
+
+def _hist_points(st, points, name="lat", labels=None):
+    """points: [(dt, count, sum, [cum...])] against edges (1, 2, 4, inf)."""
+    edges = [1.0, 2.0, 4.0, float("inf")]
+    for dt, c, s, cums in points:
+        st.append_snapshot(_snap(histograms={
+            name: [(dict(labels or {}),
+                    (c, s, [[e, cum] for e, cum in zip(edges, cums)]))]}),
+            ts=T0 + dt)
+
+
+class TestHistogramWindows:
+    def test_quantile_matches_exact_within_bucket_width(self):
+        st = TimeSeriesStore()
+        # 50 obs <= 1, 30 in (1, 2], 10 in (2, 4], 10 above 4
+        _hist_points(st, [(0, 0, 0.0, [0, 0, 0, 0]),
+                          (10, 100, 150.0, [50, 80, 90, 100])])
+        exact = sorted([0.5] * 50 + [1.5] * 30 + [3.0] * 10 + [8.0] * 10)
+        for q, width in ((0.5, 1.0), (0.8, 1.0), (0.9, 2.0)):
+            est = st.quantile("lat", q, 20, now=T0 + 10)
+            ex = exact[int(q * len(exact)) - 1]
+            assert abs(est - ex) <= width, (q, est, ex)
+        # the +Inf bucket cannot interpolate: it answers its lower edge
+        assert st.quantile("lat", 0.99, 20, now=T0 + 10) == 4.0
+
+    def test_quantile_uses_window_deltas_not_cumulative(self):
+        st = TimeSeriesStore()
+        # first 100 obs are all fast; the NEXT 100 (only ones in the
+        # short window) are all slow -> the window p50 must be slow.
+        _hist_points(st, [(0, 100, 50.0, [100, 100, 100, 100]),
+                          (50, 200, 650.0, [100, 100, 200, 200])])
+        assert st.quantile("lat", 0.5, 60, now=T0 + 50) > 2.0
+
+    def test_histogram_reset_zeroes_the_baseline(self):
+        st = TimeSeriesStore()
+        _hist_points(st, [(0, 100, 150.0, [50, 80, 90, 100]),
+                          (10, 10, 5.0, [10, 10, 10, 10])])   # restart
+        q = st.quantile("lat", 0.5, 20, now=T0 + 10)
+        assert q is not None and q <= 1.0     # 10 fresh fast obs, not -90
+
+    def test_empty_window_is_none(self):
+        st = TimeSeriesStore()
+        assert st.quantile("lat", 0.5, 10, now=T0) is None
+        _hist_points(st, [(0, 100, 150.0, [50, 80, 90, 100])])
+        # one point -> no delta -> no observations in the window
+        assert st.quantile("lat", 0.5, 10, now=T0 + 1) is None
+        assert st.fraction_over("lat", 1.0, 10, now=T0 + 1) is None
+
+    def test_fraction_over(self):
+        st = TimeSeriesStore()
+        _hist_points(st, [(0, 0, 0.0, [0, 0, 0, 0]),
+                          (10, 100, 150.0, [50, 80, 90, 100])])
+        assert st.fraction_over("lat", 1.0, 20, now=T0 + 10) == \
+            pytest.approx(0.5)
+        assert st.fraction_over("lat", 4.0, 20, now=T0 + 10) == \
+            pytest.approx(0.1)
+
+    def test_ewma_time_aware(self):
+        st = TimeSeriesStore()
+        st.append_snapshot(_snap(gauges={"g": [({}, 0.0)]}), ts=T0)
+        st.append_snapshot(_snap(gauges={"g": [({}, 10.0)]}), ts=T0 + 10)
+        # weights: 0.5 (one half-life old), 1.0 -> 10/1.5
+        assert st.ewma("g", half_life_s=10, now=T0 + 10) == \
+            pytest.approx(10.0 / 1.5)
+
+    def test_ewma_single_and_empty(self):
+        st = TimeSeriesStore()
+        assert st.ewma("g") is None
+        st.append_snapshot(_snap(gauges={"g": [({}, 4.0)]}), ts=T0)
+        assert st.ewma("g", half_life_s=10, now=T0) == 4.0
+
+    def test_latest_absent_vs_zero(self):
+        st = TimeSeriesStore()
+        assert st.latest("g") is None
+        st.append_snapshot(_snap(gauges={"g": [({}, 0.0)]}), ts=T0)
+        assert st.latest("g") == 0.0
+
+    def test_window_snapshot_is_doctor_shaped(self):
+        st = TimeSeriesStore()
+        for dt, v in [(0, 0), (10, 30)]:
+            st.append_snapshot(
+                _snap(counters={"c": [({}, v)]},
+                      gauges={"g": [({}, 2.0)]}),
+                ts=T0 + dt, labels={"replica": "r0"})
+        snap = st.window_snapshot(20, now=T0 + 10)
+        assert snap["window_seconds"] == 20.0
+        assert snap["counters"]["c"][0]["value"] == 30.0
+        assert snap["counters"]["c"][0]["labels"]["replica"] == "r0"
+        assert snap["gauges"]["g"][0]["value"] == 2.0
+        assert snap["pending_collectives"] == []
+
+
+# ---------------------------------------------------------------------------
+# windowed checks + hysteresis lifecycle
+# ---------------------------------------------------------------------------
+
+class TestHysteresis:
+    def _doctor(self, store, tmp_path, **kw):
+        kw.setdefault("interval_s", 1.0)
+        kw.setdefault("window_s", 30.0)
+        kw.setdefault("fire_n", 2)
+        kw.setdefault("clear_m", 2)
+        kw.setdefault("sample_local", False)
+        kw.setdefault("alerts_path", str(tmp_path / "alerts.jsonl"))
+        # route pages to the windowed availability category; the
+        # profiler's own fleet_capacity finding rides the same gauges
+        # and would double-page these canned fleets
+        kw.setdefault("categories", {"fleet_availability"})
+        return ContinuousDoctor(store, **kw)
+
+    def test_fire_then_clear(self, tmp_path):
+        st = TimeSeriesStore()
+        doc = self._doctor(st, tmp_path)
+        st.append_snapshot(_fleet_snap(live=2), ts=T0)
+
+        r1 = doc.evaluate_once(now=T0)        # 1st bad tick: armed, silent
+        assert any(f["category"] == "fleet_availability"
+                   for f in r1["findings"])
+        assert not doc.active_alerts()
+
+        doc.evaluate_once(now=T0 + 1)         # 2nd bad tick: FIRE
+        acts = doc.active_alerts()
+        assert [a["finding"] for a in acts] == ["fleet_availability"]
+        assert acts[0]["severity"] == pytest.approx(0.9)
+        snap = metrics.snapshot()
+        tot = [s for s in snap["counters"]["alerts_total"]
+               if s["labels"]["finding"] == "fleet_availability"]
+        assert tot and tot[0]["value"] == 1
+        assert not health.healthz()["ok"]
+
+        st.append_snapshot(_fleet_snap(live=3), ts=T0 + 2)   # healed
+        doc.evaluate_once(now=T0 + 2)         # 1st good tick: still active
+        assert doc.active_alerts()
+        doc.evaluate_once(now=T0 + 3)         # 2nd good tick: CLEAR
+        assert not doc.active_alerts()
+        assert health.healthz()["ok"]
+        act = [s for s in metrics.snapshot()["gauges"]["alert_active"]
+               if s["labels"]["finding"] == "fleet_availability"]
+        assert act[0]["value"] == 0.0
+
+        events = [json.loads(line) for line
+                  in (tmp_path / "alerts.jsonl").read_text().splitlines()]
+        assert [e["event"] for e in events] == ["fire", "clear"]
+        assert events[0]["finding"] == "fleet_availability"
+        assert events[1]["active_seconds"] == pytest.approx(2.0)
+
+    def test_flapping_below_fire_n_never_fires(self, tmp_path):
+        st = TimeSeriesStore()
+        doc = self._doctor(st, tmp_path, fire_n=3)
+        for i in range(4):                    # bad, good, bad, good
+            st.append_snapshot(_fleet_snap(live=2 if i % 2 == 0 else 3),
+                               ts=T0 + i)
+            doc.evaluate_once(now=T0 + i)
+        assert not doc.active_alerts()
+        assert not (tmp_path / "alerts.jsonl").exists()
+
+    def test_sticky_quarantine_reported_not_alerted(self, tmp_path):
+        st = TimeSeriesStore()
+        doc = self._doctor(st, tmp_path)
+        st.append_snapshot(_fleet_snap(live=3, quarantined=1), ts=T0)
+        for i in range(3):
+            report = doc.evaluate_once(now=T0 + i)
+        cats = [f["category"] for f in report["findings"]]
+        assert "fleet_quarantine" in cats       # ranked in /doctor ...
+        assert not doc.active_alerts()          # ... but never paged
+
+    def test_category_allowlist_routes_alerts(self, tmp_path):
+        st = TimeSeriesStore()
+        doc = self._doctor(st, tmp_path, categories={"slo_ttft_burn"})
+        st.append_snapshot(_fleet_snap(live=1), ts=T0)
+        for i in range(3):
+            report = doc.evaluate_once(now=T0 + i)
+        assert any(f["category"] == "fleet_availability"
+                   for f in report["findings"])
+        assert not doc.active_alerts()
+
+    def test_quarantine_event_alerts_then_ages_out(self, tmp_path):
+        """Capacity already restored (live == target) but a quarantine
+        event inside the window still alerts at 0.6 — and clears once
+        the event ages past the window."""
+        st = TimeSeriesStore()
+        st.append_snapshot(_fleet_snap(live=3), ts=T0)
+        st.append_snapshot(
+            _snap(counters={"fleet_quarantines_total":
+                            [({"replica": "r0"}, 0.0)]}), ts=T0)
+        st.append_snapshot(
+            _snap(counters={"fleet_quarantines_total":
+                            [({"replica": "r0"}, 1.0)]}), ts=T0 + 5)
+        f = check_fleet_availability(st, 30, now=T0 + 6)
+        assert f and f[0]["severity"] == pytest.approx(0.6)
+        assert f[0]["evidence"]["quarantine_events_in_window"] == 1
+        # 31 s later the event is outside the window: healthy
+        assert check_fleet_availability(st, 30, now=T0 + 36) == []
+
+    def test_doctor_window_report_is_tagged(self):
+        st = TimeSeriesStore()
+        st.append_snapshot(_fleet_snap(live=3), ts=T0)
+        report = profiler.doctor_window(st, 10.0, now=T0 + 1)
+        assert report["inputs"]["snapshot"] == "window:10s"
+        assert "findings" in report and "healthy" in report
+
+
+class TestBurnRates:
+    def _ttft_store(self, short_bad, long_bad):
+        """serve_ttft_seconds against edges (1, 2, 4, inf); 10% of the
+        short window's 100 obs exceed 4 s when short_bad; the long
+        window gets 900 extra clean obs when not long_bad."""
+        st = TimeSeriesStore()
+        edges = [1.0, 2.0, 4.0, float("inf")]
+
+        def point(dt, c, cums):
+            st.append_snapshot(_snap(histograms={
+                "serve_ttft_seconds":
+                    [({}, (c, 0.0, [[e, x] for e, x in zip(edges, cums)]))]}),
+                ts=T0 + dt)
+        point(-35, 0, [0, 0, 0, 0])
+        base = 0 if long_bad else 900
+        if not long_bad:
+            point(-30, 900, [900, 900, 900, 900])       # clean history
+        bad = 10 if short_bad else 0
+        point(0, base + 100,
+              [base + 100 - bad] * 3 + [base + 100])
+        return st
+
+    def test_ttft_burn_fires_on_both_windows(self):
+        st = self._ttft_store(short_bad=True, long_bad=True)
+        out = check_slo_burn(st, 10, now=T0, ttft_p99_ms=4000.0,
+                             error_rate=0.0, burn_threshold=2.0)
+        assert [f["category"] for f in out] == ["slo_ttft_burn"]
+        # 10% violations / 1% allowed = 10x in both windows
+        assert out[0]["evidence"]["burn_short"] == pytest.approx(10.0)
+        assert out[0]["evidence"]["burn_long"] == pytest.approx(10.0)
+        assert out[0]["severity"] >= 0.5
+
+    def test_ttft_burn_needs_the_long_window_too(self):
+        st = self._ttft_store(short_bad=True, long_bad=False)
+        # short window burns 10x, but 900 clean obs dilute the long
+        # window to 1x (< 2x threshold): one bad scrape is not an SLO burn
+        assert check_slo_burn(st, 10, now=T0, ttft_p99_ms=4000.0,
+                              error_rate=0.0, burn_threshold=2.0) == []
+
+    def test_error_burn_arithmetic_excludes_cancels(self):
+        st = TimeSeriesStore()
+
+        def point(dt, done, rejected, cancelled):
+            st.append_snapshot(_snap(counters={"serve_requests_total": [
+                ({"status": "done"}, float(done)),
+                ({"status": "rejected"}, float(rejected)),
+                ({"status": "cancelled"}, float(cancelled))]}), ts=T0 + dt)
+        point(-35, 0, 0, 0)
+        point(-5, 50, 0, 500)
+        point(0, 90, 10, 1000)
+        out = check_slo_burn(st, 10, now=T0, ttft_p99_ms=0.0,
+                             error_rate=0.02, burn_threshold=2.0)
+        assert [f["category"] for f in out] == ["slo_error_burn"]
+        # 10 errors / 100 terminal = 10% vs 2% allowed = 5x burn; the
+        # 1000 client cancels are the client's choice, not failures
+        assert out[0]["evidence"]["burn_short"] == pytest.approx(5.0)
+        assert out[0]["evidence"]["burn_long"] == pytest.approx(5.0)
+
+    def test_unset_slos_never_fire(self):
+        st = self._ttft_store(short_bad=True, long_bad=True)
+        assert check_slo_burn(st, 10, now=T0, ttft_p99_ms=0.0,
+                              error_rate=0.0) == []
+
+
+# ---------------------------------------------------------------------------
+# metrics HTTP surfaces: /healthz, /doctor, 404, no stderr spam
+# ---------------------------------------------------------------------------
+
+class TestHTTPSurfaces:
+    @pytest.fixture()
+    def srv(self):
+        server = hvd.metrics_http(0)
+        yield server
+        server.stop()
+
+    def _get(self, srv, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=5) as r:
+                return r.status, r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    def test_healthz_200_then_503_then_recovers(self, srv):
+        code, body = self._get(srv, "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+        metrics.gauge("alert_active", finding="boom").set(0.9)
+        code, body = self._get(srv, "/healthz")
+        doc = json.loads(body)
+        assert code == 503 and doc["ok"] is False
+        assert doc["alerts"][0] == {"finding": "boom", "severity": 0.9}
+        metrics.gauge("alert_active", finding="boom").set(0.0)
+        assert self._get(srv, "/healthz")[0] == 200
+
+    def test_low_severity_alert_keeps_healthz_200(self, srv):
+        metrics.gauge("alert_active", finding="meh").set(0.3)
+        code, body = self._get(srv, "/healthz")
+        doc = json.loads(body)
+        assert code == 200 and doc["ok"] is True
+        assert doc["alerts"][0]["finding"] == "meh"   # visible, not fatal
+
+    def test_doctor_endpoint_serves_ranked_findings(self, srv):
+        code, body = self._get(srv, "/doctor")
+        assert code == 200
+        report = json.loads(body)
+        assert "findings" in report and "healthy" in report
+
+    def test_doctor_endpoint_prefers_windowed_report(self, srv, tmp_path):
+        st = TimeSeriesStore()
+        st.append_snapshot(_fleet_snap(live=3), ts=T0)
+        doc = ContinuousDoctor(st, interval_s=60, window_s=12.5,
+                               fire_n=2, clear_m=2, sample_local=False,
+                               alerts_path=str(tmp_path / "a.jsonl"))
+        doc.start()           # registers as the process doctor
+        doc.evaluate_once(now=T0 + 1)
+        doc.stop()
+        code, body = self._get(srv, "/doctor")
+        assert code == 200
+        assert json.loads(body)["window_seconds"] == 12.5
+
+    def test_unknown_path_404_and_no_stderr_spam(self, srv, capfd):
+        assert self._get(srv, "/nope")[0] == 404
+        assert self._get(srv, "/healthz")[0] == 200
+        metrics.gauge("alert_active", finding="x").set(0.9)
+        assert self._get(srv, "/healthz")[0] == 503
+        err = capfd.readouterr().err
+        assert "GET" not in err and "404" not in err and "503" not in err
+
+    def test_metrics_json_roundtrips_into_store(self, srv):
+        metrics.counter("c_total", widget="a").inc(3)
+        code, body = self._get(srv, "/metrics.json")
+        assert code == 200
+        snap = json.loads(body)
+        st = TimeSeriesStore()
+        st.append_snapshot(snap, ts=snap["timestamp"] - 10,
+                           labels={"replica": "r0", "attempt": 0})
+        metrics.counter("c_total", widget="a").inc(4)
+        _, body = self._get(srv, "/metrics.json")
+        snap = json.loads(body)
+        st.append_snapshot(snap, ts=snap["timestamp"],
+                           labels={"replica": "r0", "attempt": 0})
+        assert st.delta("c_total", 60, now=snap["timestamp"]) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# thread lifecycle: shared atexit drain, double-start refusal
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_collector_double_start_refused(self, tmp_path, caplog):
+        c = FleetCollector(str(tmp_path / "members.json"), interval_s=30)
+        c.start()
+        try:
+            with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+                assert c.start() is c
+            assert "double start refused" in caplog.text
+        finally:
+            c.stop()
+        assert c._thread is None
+
+    def test_doctor_double_start_refused(self, tmp_path, caplog):
+        d = ContinuousDoctor(TimeSeriesStore(), interval_s=30,
+                             sample_local=False,
+                             alerts_path=str(tmp_path / "a.jsonl"))
+        d.start()
+        try:
+            with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+                assert d.start() is d
+            assert "double" in caplog.text
+        finally:
+            d.stop()
+
+    def test_started_threads_register_the_shared_atexit_drain(self,
+                                                              tmp_path):
+        c = FleetCollector(str(tmp_path / "members.json"), interval_s=30)
+        c.start()
+        try:
+            assert health._drain_health_at_exit in metrics._ATEXIT_DRAINS
+            # idempotent: a second registration does not duplicate
+            metrics.register_atexit_drain(health._drain_health_at_exit)
+            assert metrics._ATEXIT_DRAINS.count(
+                health._drain_health_at_exit) == 1
+        finally:
+            c.stop()
+
+    def test_stop_all_drains_every_started_thread(self, tmp_path):
+        c = FleetCollector(str(tmp_path / "members.json"), interval_s=30)
+        d = ContinuousDoctor(TimeSeriesStore(), interval_s=30,
+                             sample_local=False,
+                             alerts_path=str(tmp_path / "a.jsonl"))
+        c.start()
+        d.start()
+        health.stop_all()
+        assert c._thread is None and d._thread is None
+
+    def test_collector_scrapes_unreadable_membership_quietly(self,
+                                                             tmp_path):
+        c = FleetCollector(str(tmp_path / "nope.json"))
+        assert c.members() == []
+        assert c.scrape_once() == 0
+        (tmp_path / "m.json").write_text(json.dumps({"replicas": [
+            {"name": "r0", "host": "127.0.0.1", "port": 1,
+             "metrics_port": 0, "attempt": 0},      # no metrics endpoint
+            {"name": "r1", "host": "127.0.0.1", "port": 1,
+             "metrics_port": 1, "attempt": 2}]}))   # unreachable
+        c2 = FleetCollector(str(tmp_path / "m.json"), scrape_timeout_s=0.1)
+        assert [m["name"] for m in c2.members()] == ["r1"]
+        assert c2.scrape_once() == 0
+        assert c2.scrape_errors == 1
+
+
+# ---------------------------------------------------------------------------
+# hvd.top rendering + CLI
+# ---------------------------------------------------------------------------
+
+class TestTop:
+    def _store(self):
+        st = TimeSeriesStore()
+        for dt, v in [(0, 0), (10, 50)]:
+            st.append_snapshot(
+                _snap(counters={"serve_requests_total": [({}, v)]},
+                      gauges={"serve_slots_active": [({}, 3.0)],
+                              "serve_blocks_in_use": [({}, 12.0)]}),
+                ts=T0 + dt, labels={"replica": "r9", "attempt": 1})
+        return st
+
+    def test_frame_renders_replica_row(self):
+        snap = _snap(gauges={"circuit_state":
+                             [({"replica": "r9"}, 0.0)]})
+        frame = render_top(self._store(), window_s=20, now=T0 + 10,
+                           local_snap=snap, stale_s=10.0)
+        assert "REPLICA" in frame and "TTFT_P99_MS" in frame
+        row = [ln for ln in frame.splitlines()
+               if ln.startswith("r9")][0]
+        assert "2.50" in row        # 50 requests / 20 s window
+        assert "closed" in row
+        assert "no active alerts" in frame
+
+    def test_frame_marks_stale_replicas_and_alerts(self):
+        metrics.gauge("alert_active", finding="boom").set(0.7)
+        frame = render_top(self._store(), window_s=20, now=T0 + 100,
+                           local_snap=_snap(), stale_s=5.0)
+        assert "stale" in frame
+        assert "ALERT [0.70] boom" in frame
+
+    def test_top_once_samples_local_registry(self, capsys):
+        metrics.gauge("fleet_target_replicas").set(1.0)
+        frame = hvd.top(once=True, window_s=5.0)
+        assert frame and "hvd.top" in frame
+        assert frame in capsys.readouterr().out
+
+    def test_fleet_top_cli_once(self, capsys):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import fleet_top
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        assert fleet_top.main(["--once"]) == 0
+        assert "hvd.top" in capsys.readouterr().out

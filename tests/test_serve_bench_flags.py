@@ -80,3 +80,12 @@ class TestServeLineEmits:
             assert rec[field]["p50"] is not None, (field, rec)
         assert rec["decode_compiles"] == 1
         assert rec["blocks_peak"] <= rec["dense_equivalent_blocks"]
+        # SLO summary rides every line: observed TTFT p99 / error rate
+        # vs the declared HOROVOD_SLO_* targets (unset here -> no
+        # pass/fail verdict, but the observations are recorded).
+        assert rec["slo_ttft_p99_ms"] == 0.0
+        assert rec["slo_error_rate"] == 0.0
+        assert rec["slo"]["ttft_p99_ms"] > 0
+        assert rec["slo"]["error_rate"] == 0.0
+        assert rec["slo"]["ttft_p99_ms_target"] is None
+        assert rec["slo"]["ttft_ok"] is None and rec["slo"]["errors_ok"] is None

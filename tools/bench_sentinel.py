@@ -42,6 +42,7 @@ SETTINGS_KEYS = (
     "allreduce_alg", "wire", "topology", "mesh", "overlap_chunks",
     "payload_mb", "world", "batch", "seq_len", "steps",
     "prefix_overlap", "prefix_cache", "spec_k", "request_trace",
+    "slo_ttft_p99_ms", "slo_error_rate",
 )
 
 

@@ -33,18 +33,34 @@ snapshot, not log scraping:
 3. every request — including those submitted DURING the rolling
    restart — reaches a typed terminal state and completes;
 4. ``hvd.doctor()`` ranks the quarantine as a ``fleet_quarantine``
-   finding.
+   finding;
+5. the fleet HEALTH PLANE rides along: every replica serves
+   ``/metrics.json`` on an ephemeral port (``HOROVOD_METRICS_PORT=auto``
+   → discovered via the status RPC → published in the membership file),
+   a ``FleetCollector`` scrapes them into one windowed store, and a
+   fast ``ContinuousDoctor`` must FIRE the ``fleet_availability`` alert
+   through its hysteresis gate during the crash-loop churn (observed
+   live as ``/healthz`` 503 and an ``ALERT`` line in the ``hvd.top``
+   frame, persisted to ``alerts.jsonl``) and CLEAR it once promotion
+   restores capacity and the quarantine event ages out of the window —
+   with every scraped rate staying reset-safe across r1's two restarts
+   (each attempt is a fresh ``{replica, attempt}`` series).
 
 Exit status 0 = all checks pass. Wired as ``make fleet-smoke`` and as
 tier-1 ``tests/test_fleet.py::TestFleetSmoke``.
 """
 
+import json
 import os
 import sys
 import tempfile
 import textwrap
 import threading
 import time
+import urllib.error
+import urllib.request
+
+import smoke_util
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -110,7 +126,7 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     """One attempt: returns ``(rc, failure_text)``; rendezvous-flavored
     failure text gets the attempt retried by ``smoke_util``."""
     sys.path.insert(0, REPO)
-    from horovod_tpu import metrics, profiler
+    from horovod_tpu import health, metrics, profiler, timeseries
     from horovod_tpu.serving.fleet import FleetSupervisor, ProcessLauncher
     from horovod_tpu.serving.transport import RemoteDispatcher
 
@@ -118,7 +134,11 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     root = os.path.join(workdir, "fleet-root")
     os.makedirs(root, exist_ok=True)
     membership = os.path.join(root, "membership.json")
-    env = dict(os.environ, HOROVOD_FAULT_PLAN=FAULT_PLAN)
+    # auto: each worker binds an ephemeral metrics port and advertises it
+    # via the status RPC — co-hosted replicas never collide on a base.
+    env = smoke_util.jit_cache_env()
+    env.update(HOROVOD_FAULT_PLAN=FAULT_PLAN,
+               HOROVOD_METRICS_PORT="auto")
     fleet = FleetSupervisor(
         ProcessLauncher(WORKER, root, env=env), target=3, spares=1,
         membership_path=membership, probe_seconds=0.25,
@@ -128,8 +148,14 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
         # above what 2 s of failed 0.25 s-cadence probes can reach.
         unreachable_probes=40, probe_rpc_timeout=1.0)
     deadline = time.monotonic() + timeout_s
+    cleanup = []                 # health-plane threads/servers to stop
 
     def fail(msg):
+        for fn in cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
         print(f"fleet-smoke FAIL: {msg}", file=sys.stderr)
         print(f"fleet status: {fleet.status()}", file=sys.stderr)
         texts = [msg]
@@ -155,6 +181,33 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     except TimeoutError as e:
         return fail(f"initial fleet never reached target: {e}")
 
+    # Health plane rides the smoke: the collector follows the membership
+    # file into a windowed store, and a fast continuous doctor (0.25 s
+    # tick, 6 s window, fire after 2 bad ticks, clear after 2 good) is
+    # routed to alert ONLY on the availability category — diagnostic
+    # findings (open breakers on dying replicas are expected here) stay
+    # visible in /doctor without holding /healthz at 503.
+    alerts_path = os.path.join(root, "alerts.jsonl")
+    store = timeseries.TimeSeriesStore()
+    collector = health.FleetCollector(membership, store=store,
+                                      interval_s=0.25).start()
+    doc = health.ContinuousDoctor(store, interval_s=0.25, window_s=6.0,
+                                  fire_n=2, clear_m=2,
+                                  alerts_path=alerts_path,
+                                  categories={"fleet_availability"}).start()
+    health_srv = metrics.metrics_http(0)   # this process's /healthz, /doctor
+    cleanup += [collector.stop, doc.stop, health_srv.stop]
+    hz_url = f"http://127.0.0.1:{health_srv.port}/healthz"
+
+    def healthz_code():
+        try:
+            with urllib.request.urlopen(hz_url, timeout=0.5) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+        except Exception:
+            return None
+
     disp = RemoteDispatcher(membership=membership, rpc_timeout=1.0,
                             max_retries=2, hedge_ms=400.0)
 
@@ -175,9 +228,19 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     # 3. the fleet must converge: r0 quarantined (crash loop), spare
     #    promoted in its place, r1 back at attempt 2 after two deaths,
     #    r2 healed from its partition — 3 live serving replicas.
+    #    While waiting, watch the health plane live: the availability
+    #    alert must flip /healthz to 503 at some point during the churn
+    #    (the quarantine event keeps it bad for a full window, so the
+    #    0.25 s poll cannot miss it) — grab an hvd.top frame the moment
+    #    it does.
+    saw_503 = False
+    alert_frame = ""
     while time.monotonic() < deadline:
         st = fleet.status()
         by_name = {s["name"]: s for s in st["slots"]}
+        if not saw_503 and healthz_code() == 503:
+            saw_503 = True
+            alert_frame = health.render_top(store, window_s=6.0)
         if (by_name["r0"]["state"] == "quarantined"
                 and by_name["r1"]["state"] == "live"
                 and by_name["r1"]["attempt"] >= 2
@@ -186,6 +249,15 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
         time.sleep(0.25)
     else:
         return fail(f"fleet never converged: {fleet.status()}")
+    # The fire may land just after convergence: give the hysteresis gate
+    # (2 ticks past the quarantine sample) a bounded grace window.
+    hz_grace = time.monotonic() + 10.0
+    while not saw_503 and time.monotonic() < hz_grace:
+        if healthz_code() == 503:
+            saw_503 = True
+            alert_frame = health.render_top(store, window_s=6.0)
+            break
+        time.sleep(0.2)
 
     for h in handles:
         disp.wait(h)
@@ -221,6 +293,74 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     reason = fleet.slot("r0").quarantine_reason or ""
     if "crash_loop" not in reason:
         return fail(f"r0 quarantine reason not typed: {reason!r}")
+
+    # 4b. the health plane saw the whole alert lifecycle. FIRED during
+    #     the churn (caught live above as /healthz 503 + an ALERT line
+    #     in the hvd.top frame), and must now CLEAR: capacity is back
+    #     at target and the quarantine event ages past the 6 s window.
+    if not saw_503:
+        return fail("health plane never turned /healthz 503 during the "
+                    f"crash-loop churn; alerts={doc.active_alerts()}")
+    if "ALERT" not in alert_frame \
+            or "fleet_availability" not in alert_frame:
+        return fail(f"hvd.top frame missing the ALERT line:\n{alert_frame}")
+    clear_deadline = time.monotonic() + 20.0
+    while time.monotonic() < clear_deadline:
+        if not doc.active_alerts() and healthz_code() == 200:
+            break
+        time.sleep(0.25)
+    else:
+        return fail(f"availability alert never cleared on the healed "
+                    f"fleet: {doc.active_alerts()}, "
+                    f"healthz={healthz_code()}")
+    with open(alerts_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    fired = [e for e in events if e["event"] == "fire"
+             and e["finding"] == "fleet_availability"]
+    cleared = [e for e in events if e["event"] == "clear"
+               and e["finding"] == "fleet_availability"]
+    if not fired or not cleared:
+        return fail(f"alerts.jsonl missing the fire/clear lifecycle: "
+                    f"{events}")
+    snap = metrics.snapshot()
+    if _counter_sum(snap, "alerts_total",
+                    finding="fleet_availability") < 1:
+        return fail("alerts_total never counted the availability fire")
+    if _gauge(snap, "alert_active", finding="fleet_availability") != 0.0:
+        return fail("alert_active gauge not zeroed after clear")
+    # Scraped series re-key per attempt: r1 died twice, so the store
+    # holds >= 2 distinct {replica=r1, attempt} identities — and the
+    # windowed rate across that restart seam must be reset-safe (the
+    # fresh attempt's counters restart at zero; a naive delta would
+    # read a negative spike, the store must never).
+    r1_attempts = {ls.get("attempt") for ls in store.label_sets()
+                   if ls.get("replica") == "r1"}
+    if len(r1_attempts) < 2:
+        return fail(f"expected >= 2 scraped attempts for r1, saw "
+                    f"{sorted(r1_attempts)} "
+                    f"(label sets: {store.label_sets()})")
+    r1_qps = store.rate("serve_requests_total", 60.0,
+                        labels={"replica": "r1"})
+    if r1_qps < 0:
+        return fail(f"reset-unsafe rate across r1's restarts: {r1_qps}")
+    # /doctor serves the windowed report; the healed hvd.top --once
+    # frame lists the serving fleet with no ALERT lines.
+    with urllib.request.urlopen(
+            hz_url.replace("/healthz", "/doctor"), timeout=1.0) as resp:
+        doc_report = json.loads(resp.read().decode("utf-8"))
+    if doc_report.get("window_seconds") != 6.0:
+        return fail(f"/doctor did not serve the windowed report: "
+                    f"{list(doc_report)}")
+    top_frame = health.top(membership, once=True, window_s=6.0,
+                           store=store)
+    if "r1" not in top_frame or "no active alerts" not in top_frame:
+        return fail(f"healed hvd.top frame wrong:\n{top_frame}")
+    # Stop the plane before the rolling restart: deliberate, supervised
+    # restarts are not an availability incident, and phase 5's contract
+    # is zero drops, not alert traffic.
+    doc.stop()
+    collector.stop()
+    health_srv.stop()
 
     # 5. rolling restart mid-load: a background submitter keeps traffic
     #    flowing while every live replica is drained and replaced, one
@@ -288,7 +428,10 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
           f"SIGKILLs, a partition, a crash-loop quarantine "
           f"({reason!r}), 1 spare promotion, and a 3-replica rolling "
           f"restart in {result['seconds']:.1f}s; doctor finding "
-          f"#{quar_findings[0]['rank']}: {quar_findings[0]['title']}")
+          f"#{quar_findings[0]['rank']}: {quar_findings[0]['title']}; "
+          f"health plane fired+cleared fleet_availability "
+          f"({len(events)} alerts.jsonl events, {len(r1_attempts)} "
+          f"scraped attempts for r1)")
     fleet.stop()
     return 0, ""
 
@@ -302,7 +445,6 @@ def _attempt():
 
 def main() -> int:
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    import smoke_util
     return smoke_util.main_with_retry(_attempt, name="fleet-smoke")
 
 

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Live per-replica fleet dashboard (the ``top(1)`` of the serving
+fleet; library form: ``hvd.top()``).
+
+Follows the fleet supervisor's membership file, scrapes every member's
+``/metrics.json`` endpoint into a windowed time-series store
+(``horovod_tpu.timeseries``), and redraws one frame per interval:
+liveness, QPS (reset-aware windowed rate — a restarted replica never
+shows a negative spike), TTFT p99 from per-window histogram bucket
+deltas, slot/block occupancy, breaker state, and the continuous
+doctor's active alerts.
+
+    python tools/fleet_top.py --membership /run/fleet/members.json
+    python tools/fleet_top.py --membership m.json --once   # one frame (CI)
+
+``--once`` renders a single frame and exits 0 — what the fleet smoke
+and tests call. Without ``--membership`` the local process registry is
+sampled instead (useful next to an in-process engine).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--membership", default=None,
+                   help="fleet membership JSON (the supervisor's "
+                        "membership_path); omit to sample the local "
+                        "registry")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="scrape + redraw period, seconds (default 2)")
+    p.add_argument("--window", type=float, default=10.0,
+                   help="rate/quantile window, seconds (default 10)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (tests / CI)")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from horovod_tpu import health
+
+    health.top(args.membership, once=args.once,
+               interval_s=args.interval, window_s=args.window)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

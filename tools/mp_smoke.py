@@ -28,6 +28,8 @@ import socket
 import subprocess
 import sys
 import tempfile
+
+import smoke_util
 import textwrap
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -171,7 +173,8 @@ def run_smoke(timeout_s: float = 420.0):
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, "-c", WORKER, str(pid), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=smoke_util.jit_cache_env())
         for pid in range(2)]
     outs = [p.communicate(timeout=timeout_s)[0] for p in procs]
     for p, out in zip(procs, outs):
@@ -185,7 +188,6 @@ def run_smoke(timeout_s: float = 420.0):
 
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import smoke_util
     with tempfile.TemporaryDirectory():
         return smoke_util.main_with_retry(run_smoke, name="mp-smoke")
 

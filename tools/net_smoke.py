@@ -49,6 +49,8 @@ import textwrap
 import threading
 import time
 
+import smoke_util
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 N_REQUESTS = 20
@@ -105,7 +107,8 @@ def run_smoke(workdir: str, timeout_s: float = 300.0):
     metrics.reset_metrics()
     root = os.path.join(workdir, "net-root")
     os.makedirs(root, exist_ok=True)
-    env = dict(os.environ, HOROVOD_FAULT_PLAN=FAULT_PLAN)
+    env = smoke_util.jit_cache_env()
+    env.update(HOROVOD_FAULT_PLAN=FAULT_PLAN)
     procs = [subprocess.Popen(
         [sys.executable, "-c", WORKER, str(rank), root],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -292,7 +295,7 @@ def run_stream_smoke(workdir: str, timeout_s: float = 300.0):
     metrics.reset_metrics()
     root = os.path.join(workdir, "stream-root")
     os.makedirs(root, exist_ok=True)
-    env = dict(os.environ)
+    env = smoke_util.jit_cache_env()
     env.pop("HOROVOD_FAULT_PLAN", None)    # this scenario kills by hand
     procs = [subprocess.Popen(
         [sys.executable, "-c", WORKER, str(rank), root],
@@ -478,7 +481,6 @@ def _attempt_stream():
 
 def main() -> int:
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    import smoke_util
     rc = smoke_util.main_with_retry(_attempt, name="net-smoke")
     if rc != 0:
         return rc

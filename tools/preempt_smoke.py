@@ -40,6 +40,8 @@ import sys
 import tempfile
 import time
 
+import smoke_util
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TOTAL, KILL = 8, 5
@@ -224,8 +226,9 @@ def run_smoke(bench_out=None, timeout_s: float = 240.0):
     """One attempt: (rc, failure_text) for smoke_util's flake retry."""
     sys.path.insert(0, REPO)
     from horovod_tpu.runner.launcher import run_elastic
-    env = {"PYTHONPATH": REPO,
-           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env = smoke_util.jit_cache_env(
+        {"PYTHONPATH": REPO,
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
     cmd = [sys.executable, "-c", WORKER]
     with tempfile.TemporaryDirectory(prefix="hvd_preempt_") as work:
         golden_dir = os.path.join(work, "golden")
@@ -330,7 +333,6 @@ def main() -> int:
                     help="append a recovery-time JSON line here")
     args = ap.parse_args()
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import smoke_util
     return smoke_util.main_with_retry(
         lambda: run_smoke(bench_out=args.bench_out), name="preempt-smoke")
 

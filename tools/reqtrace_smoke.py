@@ -48,6 +48,8 @@ import textwrap
 import time
 import urllib.request
 
+import smoke_util
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TRACED_PROMPT = [5, 17, 42, 9]
@@ -152,8 +154,8 @@ def run_smoke(workdir: str, timeout_s: float = 300.0):
     metrics.reset_metrics()
     reqtrace.reset()
     mport_base = _free_port()
-    env = dict(os.environ,
-               HOROVOD_FAULT_PLAN=FAULT_PLAN,
+    env = smoke_util.jit_cache_env()
+    env.update(HOROVOD_FAULT_PLAN=FAULT_PLAN,
                HOROVOD_METRICS_PORT=str(mport_base))
     procs = []
     for rank in (0, 1):
@@ -377,7 +379,6 @@ def _attempt():
 
 def main() -> int:
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    import smoke_util
     return smoke_util.main_with_retry(_attempt, name="reqtrace-smoke")
 
 

@@ -257,6 +257,32 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
         "prefill_compiles": eng.prefill_compiles,
         "request_trace": trace_on,
     }
+    # SLO summary: compare the run's observed TTFT p99 / error fraction
+    # against the targets the fleet health plane alerts on
+    # (HOROVOD_SLO_TTFT_P99_MS / HOROVOD_SLO_ERROR_RATE), so a bench line
+    # records pass/fail against the same budgets the continuous doctor
+    # burns against.
+    from horovod_tpu import config as _hvd_config
+    _cfg = _hvd_config.get_config()
+    ttft_sum = rec["ttft_s"] or {}
+    obs_ttft_p99_ms = (round(ttft_sum["p99"] * 1000.0, 3)
+                       if ttft_sum.get("p99") is not None else None)
+    errors = sum(1 for o in outs
+                 if o["status"] in ("rejected", "expired", "failed"))
+    obs_err = round(errors / max(1, len(outs)), 4)
+    rec["slo_ttft_p99_ms"] = _cfg.slo_ttft_p99_ms
+    rec["slo_error_rate"] = _cfg.slo_error_rate
+    rec["slo"] = {
+        "ttft_p99_ms_target": _cfg.slo_ttft_p99_ms or None,
+        "ttft_p99_ms": obs_ttft_p99_ms,
+        "ttft_ok": (None if not _cfg.slo_ttft_p99_ms
+                    or obs_ttft_p99_ms is None
+                    else obs_ttft_p99_ms <= _cfg.slo_ttft_p99_ms),
+        "error_rate_target": _cfg.slo_error_rate or None,
+        "error_rate": obs_err,
+        "errors_ok": (None if not _cfg.slo_error_rate
+                      else obs_err <= _cfg.slo_error_rate),
+    }
     if trace_on:
         from horovod_tpu.trace_merge import request_report
         mean = request_report(

@@ -16,8 +16,30 @@ The tools run this module as a sibling import (``sys.path[0]`` is
 end as subprocesses, so the retry rides along.
 """
 
+import os
 import re
 import sys
+import tempfile
+
+
+def jit_cache_env(env=None):
+    """Worker env with a persistent XLA compilation-cache dir defaulted.
+
+    The smokes respawn workers that compile the SAME tiny programs —
+    every crash-loop attempt, rolling restart, and golden-then-faulted
+    rerun pays a multi-second jit compile for an executable an earlier
+    worker already built. Pointing every subprocess at one shared cache
+    (entries are keyed on HLO + jax version, so staleness is impossible)
+    makes only the first compile pay. ``setdefault`` keeps an inherited
+    dir — under pytest, tests/conftest.py exports one for the whole
+    suite so the cache is ALSO shared across smokes.
+    """
+    env = dict(os.environ if env is None else env)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "hvd_tpu_jit_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    return env
 
 #: failure-output signatures of a rendezvous/TCP-layer flake, not a code
 #: bug: gloo/coordination-service connect errors, the distributed-init
