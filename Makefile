@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
 	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
-	mp-smoke reqtrace-smoke fleet-top bench-sentinel test native
+	mp-smoke reqtrace-smoke fleet-top postmortem bench-sentinel test \
+	native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -127,6 +128,15 @@ reqtrace-smoke:
 # refreshing dashboard.
 fleet-top:
 	$(PY) tools/fleet_top.py --once $(if $(MEMBERS),--membership $(MEMBERS))
+
+# Offline root-cause analysis of the newest flight-recorder bundle
+# (HOROVOD_BLACKBOX): ranked findings from the crash-time events ring,
+# the bundled metrics window (offline doctor), the pre-death alert tail
+# and the queue trend. Pass BUNDLE=/path/to/postmortem-... to analyze a
+# specific bundle, DIR=/path/to/blackbox to search elsewhere. Exit 2
+# means a confident root cause was identified.
+postmortem:
+	$(PY) tools/postmortem.py $(BUNDLE) $(if $(DIR),--dir $(DIR))
 
 # Regression sentinel over BENCH_SELF.jsonl: exit 2 when any proxy
 # metric's newest line degrades >10% vs the latest prior line at equal

@@ -56,6 +56,14 @@ from horovod_tpu.metrics import metrics_http, reset_metrics  # noqa: F401
 from horovod_tpu import timeseries  # noqa: F401
 from horovod_tpu import health  # noqa: F401
 from horovod_tpu.health import top  # noqa: F401
+# Flight recorder & postmortem plane (docs/OBSERVABILITY.md "Postmortem
+# bundles"): an always-on black box of bounded rings (HOROVOD_BLACKBOX),
+# crash-time forensic bundles (hvd.dump_postmortem), and the offline
+# root-cause analyzer (hvd.postmortem_report; CLI: tools/postmortem.py).
+from horovod_tpu import blackbox  # noqa: F401
+from horovod_tpu.blackbox import (  # noqa: F401
+    dump_postmortem, postmortem_report,
+)
 from horovod_tpu.optimizer import (  # noqa: F401
     AutotunedStep, DistributedOptimizer, DistributedGradientTape,
     ErrorFeedbackState, accumulation_has_updated, reset_error_feedback,

@@ -219,6 +219,24 @@ class Config:
     slo_ttft_p99_ms: float = 0.0
     slo_error_rate: float = 0.0
     slo_burn_threshold: float = 2.0
+    # Flight recorder (blackbox.py, docs/OBSERVABILITY.md "Postmortem
+    # bundles"): HOROVOD_BLACKBOX=1 arms the always-on black box —
+    # bounded rings of the last HOROVOD_BLACKBOX_SECONDS of timeline
+    # events, registry snapshots, alerts, fault injections and fleet
+    # transitions. Bundles publish into HOROVOD_BLACKBOX_DIR (default
+    # <tmpdir>/horovod_blackbox) as postmortem-<label>-<ts>/ dirs,
+    # keeping at most HOROVOD_BLACKBOX_MAX_BUNDLES (oldest evicted
+    # first). HOROVOD_BLACKBOX_DUMP_ON picks which AUTOMATIC triggers
+    # publish (comma list of signal,stall,alert,engine,fault; "none"
+    # leaves only explicit hvd.dump_postmortem() and the fleet 'dump'
+    # RPC). HOROVOD_FAULTHANDLER=0 opts out of the stdlib faulthandler
+    # init() points at the blackbox dir for native-crash stacks.
+    blackbox: bool = False
+    blackbox_seconds: float = 120.0
+    blackbox_dir: Optional[str] = None
+    blackbox_max_bundles: int = 8
+    blackbox_dump_on: str = "signal,stall,alert,engine,fault"
+    faulthandler_enable: bool = True
     # Elastic (runner/elastic): rendezvous/restart timeout.
     elastic_timeout_seconds: float = 600.0
     # Preemption tolerance (checkpoint_sharded.py / faults.py /
@@ -427,6 +445,24 @@ def _env_metrics_port() -> int:
     return n
 
 
+_DUMP_ON_TOKENS = ("signal", "stall", "alert", "engine", "fault")
+
+
+def _env_dump_on() -> str:
+    v = os.environ.get("HOROVOD_BLACKBOX_DUMP_ON")
+    if v is None or not v.strip():
+        return ",".join(_DUMP_ON_TOKENS)
+    if v.strip().lower() in ("none", "off"):
+        return ""
+    toks = [t.strip().lower() for t in v.split(",") if t.strip()]
+    bad = sorted(set(toks) - set(_DUMP_ON_TOKENS))
+    if bad:
+        raise ValueError(
+            f"HOROVOD_BLACKBOX_DUMP_ON: unknown trigger(s) {bad}; "
+            f"choose from {', '.join(_DUMP_ON_TOKENS)} (or 'none')")
+    return ",".join(dict.fromkeys(toks))
+
+
 def _env_fault_plan() -> str:
     v = os.environ.get("HOROVOD_FAULT_PLAN", "").strip()
     if v:
@@ -528,6 +564,13 @@ def refresh() -> Config:
         slo_ttft_p99_ms=_env_nonneg_float("HOROVOD_SLO_TTFT_P99_MS", 0.0),
         slo_error_rate=_env_nonneg_float("HOROVOD_SLO_ERROR_RATE", 0.0),
         slo_burn_threshold=_env_posfloat("HOROVOD_SLO_BURN_THRESHOLD", 2.0),
+        blackbox=_env_bool("HOROVOD_BLACKBOX"),
+        blackbox_seconds=_env_posfloat("HOROVOD_BLACKBOX_SECONDS", 120.0),
+        blackbox_dir=os.environ.get("HOROVOD_BLACKBOX_DIR") or None,
+        blackbox_max_bundles=_env_posint(
+            "HOROVOD_BLACKBOX_MAX_BUNDLES", 8),
+        blackbox_dump_on=_env_dump_on(),
+        faulthandler_enable=_env_bool("HOROVOD_FAULTHANDLER", True),
         elastic_timeout_seconds=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
         preemption_notice_seconds=max(
             0.0, _env_float("HOROVOD_PREEMPTION_NOTICE", 30.0)),

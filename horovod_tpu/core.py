@@ -244,6 +244,12 @@ def init(devices: Optional[Sequence] = None, axis_name: str = AXIS_NAME,
         from horovod_tpu import metrics as _metrics
         _metrics.on_init(cfg, init_seconds=_time.perf_counter() - t0,
                          world=len(devs))
+        # Flight recorder (HOROVOD_BLACKBOX): arm the black-box rings,
+        # install the fatal-signal/excepthook dump triggers, and point
+        # the stdlib faulthandler (HOROVOD_FAULTHANDLER=0 opts out) at
+        # the blackbox dir for native-crash stacks.
+        from horovod_tpu import blackbox as _blackbox
+        _blackbox.on_init(cfg)
         # Resolved comm-knob gauges (hvd.metrics()-visible): the algorithm
         # as an info-style labeled gauge, chunk depth and whether the
         # latency-hiding flags actually applied (False on CPU runs or
@@ -299,6 +305,10 @@ def shutdown() -> None:
         # not runtime state.
         from horovod_tpu import metrics as _metrics
         _metrics.on_shutdown()
+        # Stop the recorder's feeds; its rings survive like metric
+        # values do — a post-shutdown dump_postmortem() still works.
+        from horovod_tpu import blackbox as _blackbox
+        _blackbox.on_shutdown()
 
 
 def is_initialized() -> bool:

@@ -345,6 +345,25 @@ def partitioned(rank: int) -> bool:
     return int((now - start) / period) % 2 == 0
 
 
+def _flush_evidence(action: FaultAction) -> None:
+    """Best-effort forensics before a SIGKILL: flush the timeline shard
+    (salvageable; the survivors' merge shows where the victim went dark)
+    and publish a flight-recorder bundle — SIGKILL runs no atexit and no
+    signal handler, so this is the black box's only chance."""
+    try:
+        from horovod_tpu import timeline as _tl
+        t = _tl.get_timeline()
+        if t is not None:
+            t.flush()
+    except Exception:
+        pass
+    try:
+        from horovod_tpu import blackbox
+        blackbox.dump_postmortem(trigger="fault", note=action.describe())
+    except Exception:
+        pass
+
+
 def _fire(action: FaultAction) -> None:
     from horovod_tpu import metrics as _metrics
     _metrics.counter("fault_injected_total", kind=action.kind).inc()
@@ -352,32 +371,25 @@ def _fire(action: FaultAction) -> None:
                               kind=action.kind, rank=action.rank,
                               step=action.step,
                               seconds=action.seconds)
+    try:
+        from horovod_tpu import blackbox
+        blackbox.note_fault(action.kind, rank=action.rank,
+                            step=action.step, detail=action.describe())
+    except Exception:
+        pass
     logger.warning("horovod_tpu.faults: injecting %s", action.describe())
     if action.kind == "crash_loop":
         # Die only while the fleet restart attempt is below `count`:
         # the supervisor either out-waits the loop (count < its
         # quarantine threshold) or must quarantine (count above it).
         if _restart_count() < action.count:
-            try:
-                from horovod_tpu import timeline as _tl
-                t = _tl.get_timeline()
-                if t is not None:
-                    t.flush()
-            except Exception:
-                pass
+            _flush_evidence(action)
             os.kill(os.getpid(), signal.SIGKILL)
         return
     if action.kind == "kill":
-        # Flush what we can — the timeline shard stays salvageable and the
-        # survivors' merge shows where the victim went dark — then die the
-        # way a preempted TPU-VM dies: no atexit, no finally blocks.
-        try:
-            from horovod_tpu import timeline as _tl
-            t = _tl.get_timeline()
-            if t is not None:
-                t.flush()
-        except Exception:
-            pass
+        # Die the way a preempted TPU-VM dies: no atexit, no finally
+        # blocks — only the pre-kill evidence flush above survives.
+        _flush_evidence(action)
         os.kill(os.getpid(), signal.SIGKILL)
     elif action.kind == "stall":
         time.sleep(action.seconds)

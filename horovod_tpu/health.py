@@ -23,7 +23,8 @@ before any actuator can judge a knob change:
   (``HOROVOD_HEALTH_FIRE_N`` consecutive bad windows to fire,
   ``HOROVOD_HEALTH_CLEAR_M`` good ones to clear):
   ``alerts_total{finding,severity}``, ``alert_active{finding}``,
-  ``ALERT`` timeline markers, and an append-only ``alerts.jsonl``.
+  ``ALERT`` timeline markers, and a size-rotated ``alerts.jsonl``
+  (``ALERTS_ROTATE_BYTES``; base + one ``.1`` generation kept).
 * Surfaces — ``hvd.metrics_http()`` serves ``/doctor`` (ranked findings
   from :func:`last_report`) and ``/healthz`` (200/503 from the
   ``alert_active`` gauges); :func:`top` / ``tools/fleet_top.py`` render
@@ -63,6 +64,11 @@ __all__ = ["FleetCollector", "ContinuousDoctor", "active_alerts",
 #: (quarantine is sticky by design) — shown in ``/doctor``, never alerted:
 #: the windowed ``fleet_availability`` finding carries their alert.
 STICKY_CATEGORIES = frozenset({"fleet_quarantine"})
+
+#: rotate alerts.jsonl past this size (base + one .1 generation kept —
+#: blackbox.read_alerts_tail reads both, so rotation never truncates a
+#: postmortem bundle's alerts tail mid-lifecycle).
+ALERTS_ROTATE_BYTES = 1 << 20
 
 #: terminal request statuses that count against HOROVOD_SLO_ERROR_RATE.
 ERROR_STATUSES = ("rejected", "expired", "failed")
@@ -466,10 +472,12 @@ class ContinuousDoctor:
                                  title=finding["title"])
         logger.warning("health: ALERT fired: %s [%.2f] %s",
                        cat, sev, finding["title"])
-        self._append_alert({"ts": ts, "event": "fire", "finding": cat,
-                            "severity": sev, "title": finding["title"],
-                            "detail": finding.get("detail", ""),
-                            "suggestion": finding.get("suggestion", "")})
+        rec = {"ts": ts, "event": "fire", "finding": cat,
+               "severity": sev, "title": finding["title"],
+               "detail": finding.get("detail", ""),
+               "suggestion": finding.get("suggestion", "")}
+        self._append_alert(rec)
+        self._notify_blackbox(rec)
 
     def _clear(self, cat: str, ts: float) -> None:
         rec = self._active.pop(cat)
@@ -479,14 +487,39 @@ class ContinuousDoctor:
                                  active_s=round(ts - rec["since"], 3))
         logger.warning("health: alert cleared: %s (active %.1fs)",
                        cat, ts - rec["since"])
-        self._append_alert({"ts": ts, "event": "clear", "finding": cat,
-                            "severity": rec["severity"],
-                            "active_seconds": round(ts - rec["since"], 3)})
+        out = {"ts": ts, "event": "clear", "finding": cat,
+               "severity": rec["severity"],
+               "active_seconds": round(ts - rec["since"], 3)}
+        self._append_alert(out)
+        self._notify_blackbox(out)
+
+    @staticmethod
+    def _notify_blackbox(rec: Dict[str, Any]) -> None:
+        # Flight-recorder feed (blackbox.py): rings the lifecycle record
+        # and dumps a bundle on a fire above its severity threshold.
+        # Independent of alerts_path — the black box wants the alert
+        # even when nothing persists it to disk.
+        try:
+            from horovod_tpu import blackbox
+            blackbox.on_alert(rec)
+        except Exception:
+            pass
 
     def _append_alert(self, rec: Dict[str, Any]) -> None:
         if not self.alerts_path:
             return
         try:
+            # Size-based rotation: the alert log is append-only forever
+            # otherwise (a flapping fleet writes two records per
+            # hysteresis cycle, indefinitely). Keep 2 generations —
+            # base + .1 — mirrored by blackbox.read_alerts_tail, which
+            # reads .1 then base so a bundle's alerts tail spans the
+            # rotation boundary.
+            try:
+                if os.path.getsize(self.alerts_path) >= ALERTS_ROTATE_BYTES:
+                    os.replace(self.alerts_path, self.alerts_path + ".1")
+            except OSError:
+                pass
             with open(self.alerts_path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
                 f.flush()

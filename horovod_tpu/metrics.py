@@ -847,6 +847,13 @@ class StallWatchdog:
                 f"stall_{report['kind']}_{report['tensor']}")
         except Exception:
             pass
+        # Flight recorder (blackbox.py): ring the stall and publish a
+        # postmortem bundle (HOROVOD_BLACKBOX_DUMP_ON gates, debounced).
+        try:
+            from horovod_tpu import blackbox as _blackbox
+            _blackbox.on_stall(report)
+        except Exception:
+            pass
 
     def _loop(self) -> None:
         while not self._stop.wait(self._poll_s):

@@ -74,6 +74,17 @@ QUARANTINED = "quarantined"
 SPARE = "spare"            # display state: live but held out of serving
 
 
+def _note_fleet(event: str, **fields: Any) -> None:
+    """Mirror a FLEET transition into the flight recorder's events ring
+    (blackbox.py; no-op unless HOROVOD_BLACKBOX) — the supervisor's own
+    postmortem bundle then carries the slot state machine's history."""
+    try:
+        from horovod_tpu import blackbox
+        blackbox.note_fleet(event, **fields)
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # process launcher (fleet_smoke / production); tests inject their own
 # ---------------------------------------------------------------------------
@@ -476,6 +487,8 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="live", replica=slot.name,
                                  attempt=slot.attempt, was=was)
+        _note_fleet("live", replica=slot.name, attempt=slot.attempt,
+                    was=was)
         # refresh gauges at the transition, not just on the next poll
         # tick — rolling_restart returns the instant the last replica
         # is admitted, and callers snapshot right away (the stream
@@ -483,11 +496,27 @@ class FleetSupervisor:
         # to hide this staleness)
         self._update_gauges()
 
+    def _request_dump(self, slot: ReplicaSlot, reason: str) -> None:
+        """Best-effort pre-kill forensics: ask the replica to publish
+        its flight-recorder bundle over the ``dump`` RPC before we
+        destroy the process (blackbox.py; no-op replies when the
+        replica runs without HOROVOD_BLACKBOX)."""
+        if slot.client is None:
+            return
+        try:
+            slot.client.dump(label=slot.name, note=reason)
+        except TransportError:
+            pass            # dead or dark: its own death path dumped
+
     def _on_death(self, slot: ReplicaSlot, reason: str) -> None:
         if slot.rolling:
             return     # rolling_restart owns this slot's stop/respawn
         now = time.monotonic()
         slot.died_at = now
+        if reason != "exit":
+            # Alive-but-dark (unreachable): one dump attempt before the
+            # kill — an exit()ed process has nobody left to answer.
+            self._request_dump(slot, reason)
         if slot.handle is not None:
             try:
                 slot.handle.kill()
@@ -499,6 +528,8 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="death", replica=slot.name,
                                  reason=reason, attempt=slot.attempt)
+        _note_fleet("death", replica=slot.name, reason=reason,
+                    attempt=slot.attempt)
         was_serving = slot.role == "serving" and slot.state == LIVE
         slot.state = RESTARTING
         self._member_remove(slot)
@@ -543,6 +574,7 @@ class FleetSupervisor:
                 metrics._timeline_marker(
                     "FLEET", category="fleet", event="promote",
                     spare=spare.name, into=dead.name, seconds=dt)
+                _note_fleet("promote", spare=spare.name, into=dead.name)
                 return
 
     def _quarantine(self, slot: ReplicaSlot, reason: str) -> None:
@@ -558,7 +590,53 @@ class FleetSupervisor:
         metrics._timeline_marker("FLEET", category="fleet",
                                  event="quarantine", replica=slot.name,
                                  reason=reason)
+        _note_fleet("quarantine", replica=slot.name, reason=reason)
+        # Parking a replica is the supervisor's strongest diagnosis —
+        # fold every bundle published so far (the quarantined replica's
+        # crash-time dumps included; workers share HOROVOD_BLACKBOX_DIR)
+        # into one fleet bundle next to them.
+        self.collect_postmortems(label=f"fleet-{slot.name}", reason=reason)
         self._update_gauges()
+
+    def collect_postmortems(self, label: str = "fleet",
+                            reason: str = "") -> Optional[str]:
+        """Gather the per-replica ``postmortem-*`` bundles from the
+        shared blackbox dir into one ``postmortem-<label>-<ts>/`` fleet
+        bundle whose ``fleet.json`` records every slot's state — the one
+        artifact to grab after a bad episode. No-op (``None``) unless
+        this process runs with ``HOROVOD_BLACKBOX``."""
+        try:
+            from horovod_tpu import blackbox
+            rec = blackbox.ensure()
+            if rec is None:
+                return None
+            with self._lock:
+                slots = [{"replica": s.name, "state": s.display_state(),
+                          "role": s.role, "attempt": s.attempt,
+                          "restarts": s.restarts,
+                          "quarantine_reason": s.quarantine_reason}
+                         for s in self._slots]
+            # Snapshot the member bundles BEFORE dumping our own (the
+            # supervisor bundle lands beside the copies, not inside).
+            members = [b for b in blackbox.find_bundles(rec.root)
+                       if "-fleet" not in os.path.basename(b)]
+            bundle = rec.dump(trigger="fleet", label=label, note=reason)
+            if bundle is None:
+                return None
+            with open(os.path.join(bundle, "fleet.json"), "w") as f:
+                json.dump({"reason": reason, "slots": slots,
+                           "members": [os.path.basename(b)
+                                       for b in members]}, f)
+            import shutil
+            for b in members:
+                dst = os.path.join(bundle, os.path.basename(b))
+                try:
+                    shutil.copytree(b, dst)
+                except OSError:
+                    continue
+            return bundle
+        except Exception:
+            return None
 
     def _update_gauges(self) -> None:
         counts = {LIVE: 0, STARTING: 0, RESTARTING: 0, QUARANTINED: 0,
@@ -627,6 +705,10 @@ class FleetSupervisor:
             except TransportError:
                 break                  # unreachable: nothing to wait on
             time.sleep(min(0.1, self.probe_s))
+        # Forensics before the stop, same as before a kill: a rolling
+        # restart that later turns out to have masked a real failure
+        # still left a bundle to audit.
+        self._request_dump(slot, "rolling_restart")
         if slot.handle is not None:
             try:
                 slot.handle.stop()

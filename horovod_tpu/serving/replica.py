@@ -492,6 +492,15 @@ class ReplicaServer:
             pass
         metrics.event("serve_replica_retired", rank=self.rank,
                       reason=self.engine.failed or "engine stopped")
+        # Flight recorder: an engine death is exactly the moment the
+        # black box exists for — publish the forensic bundle before the
+        # server loop winds down (no-op unless HOROVOD_BLACKBOX).
+        try:
+            from horovod_tpu import blackbox
+            blackbox.on_engine_death(
+                self.engine.failed or "engine stopped", rank=self.rank)
+        except Exception:
+            pass
         self._stop.set()
 
     def poll_once(self) -> None:

@@ -551,6 +551,14 @@ class SocketReplicaServer:
         self._rpc_seq = itertools.count(1)
         self.served_rpcs = 0
         self._metrics_srv: Optional[Any] = None
+        # Arm the flight recorder as soon as the replica front exists
+        # (fleet workers never run hvd.init(), so this is where their
+        # black box starts recording) — no-op unless HOROVOD_BLACKBOX.
+        try:
+            from horovod_tpu import blackbox
+            blackbox.ensure(rank=self.rank)
+        except Exception:
+            pass
 
     # -- request registry -------------------------------------------------
 
@@ -788,9 +796,25 @@ class SocketReplicaServer:
                          daemon=True).start()
         return {"ok": True, "draining": True, "rank": self.rank}
 
+    def _do_dump(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        # Fleet forensics: the supervisor requests a flight-recorder
+        # bundle BEFORE killing/quarantining this replica (blackbox.py).
+        # Answers the published path — None when the recorder is off
+        # (HOROVOD_BLACKBOX unset) or a dump is already in flight.
+        try:
+            from horovod_tpu import blackbox
+            blackbox.set_identity(rank=self.rank)
+            bundle = blackbox.dump_postmortem(
+                label=str(p.get("label") or f"rank{self.rank}"),
+                trigger="fleet", note=str(p.get("note") or ""))
+        except Exception as e:              # noqa: BLE001 — typed reply
+            return {"ok": False, "error": f"dump failed: {e!r}",
+                    "retryable": False}
+        return {"ok": True, "rank": self.rank, "bundle": bundle}
+
     _METHODS = {"submit": _do_submit, "poll": _do_poll,
                 "cancel": _do_cancel, "status": _do_status,
-                "drain": _do_drain}
+                "drain": _do_drain, "dump": _do_dump}
 
     # -- connection handling ----------------------------------------------
 
@@ -858,7 +882,11 @@ class SocketReplicaServer:
             if directives["drop"]:
                 return                     # served, never answered
             _send_frame(conn, resp)
-            if method != "status":
+            # Out-of-band methods (probes, forensics) are excluded from
+            # seq: a prober watching it measures request progress, and
+            # the fault plan's per-RPC step counter must not shift when
+            # the supervisor asks for a pre-kill dump.
+            if method not in ("status", "dump"):
                 with self._lock:
                     self.served_rpcs += 1
         except (OSError, ValueError, ConnectionError, TransportError):
@@ -972,7 +1000,7 @@ class SocketReplicaServer:
             _send_frame2(conn, wlock, sid, OP_RESPONSE, resp)
         except (OSError, TransportError):
             return
-        if method != "status":
+        if method not in ("status", "dump"):
             with self._lock:
                 self.served_rpcs += 1
 
@@ -1435,6 +1463,19 @@ class RemoteClient:
 
     def drain(self, timeout: float = 60.0) -> Dict[str, Any]:
         return self.call("drain", {"timeout": float(timeout)},
+                         deadline=time.monotonic() + self.rpc_timeout,
+                         retry=False)
+
+    def dump(self, *, label: Optional[str] = None,
+             note: Optional[str] = None) -> Dict[str, Any]:
+        """Ask the replica to publish a flight-recorder bundle
+        (pre-kill/pre-quarantine forensics); answers its path."""
+        params: Dict[str, Any] = {}
+        if label:
+            params["label"] = label
+        if note:
+            params["note"] = note
+        return self.call("dump", params,
                          deadline=time.monotonic() + self.rpc_timeout,
                          retry=False)
 

@@ -23,10 +23,32 @@ from typing import Optional
 
 __all__ = ["Timeline", "init_timeline", "get_timeline", "shutdown_timeline",
            "start_timeline", "stop_timeline", "shard_path",
-           "emit_clock_anchor", "merge_timelines"]
+           "emit_clock_anchor", "merge_timelines", "add_tap", "remove_tap"]
 
 _LOCK = threading.Lock()
 _TIMELINE: Optional["Timeline"] = None
+
+# Event taps: callables fed every emitted event dict (the flight
+# recorder's black-box ring rides here — blackbox.py). Module-level, not
+# per-Timeline, so a tap survives timeline re-init (elastic re-mesh
+# rebuilds the Timeline object). A tap must be cheap and must never
+# emit timeline events itself.
+_TAPS: list = []
+
+
+def add_tap(fn) -> None:
+    """Register ``fn(event_dict)`` to observe every emitted event."""
+    with _LOCK:
+        if fn not in _TAPS:
+            _TAPS.append(fn)
+
+
+def remove_tap(fn) -> None:
+    with _LOCK:
+        try:
+            _TAPS.remove(fn)
+        except ValueError:
+            pass
 
 
 class Timeline:
@@ -108,6 +130,13 @@ class Timeline:
             if ph == "i":
                 ev["s"] = "g"
             self._events.append(ev)
+        # Taps run OUTSIDE the event lock (they take their own) and can
+        # never disable the timeline by raising.
+        for tap in list(_TAPS):
+            try:
+                tap(ev)
+            except Exception:
+                pass
 
     def marker(self, name: str, category: str = "marker", **args) -> None:
         self._emit(name, category, "i", self._now_us(), 0.0, 0, args)

@@ -413,18 +413,29 @@ class LocalSampler:
     of the health plane; peers arrive via ``health.FleetCollector``)."""
 
     def __init__(self, store: TimeSeriesStore, interval_s: float = 2.0,
-                 labels: Optional[Dict[str, Any]] = None):
+                 labels: Optional[Dict[str, Any]] = None,
+                 on_sample: Optional[Any] = None):
         self.store = store
         self.interval_s = max(0.05, float(interval_s))
         self.labels = dict(labels or {})
+        # Optional per-tick observer fed (snapshot, ts) — the flight
+        # recorder rings the RAW snapshot from the same tick the store
+        # ingests, so bundle trends and window deltas line up exactly.
+        self.on_sample = on_sample
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def sample_once(self, ts: Optional[float] = None) -> None:
         from horovod_tpu import metrics
-        self.store.append_snapshot(metrics.snapshot(), ts=ts,
-                                   labels=self.labels)
+        snap = metrics.snapshot()
+        ts = time.time() if ts is None else float(ts)
+        self.store.append_snapshot(snap, ts=ts, labels=self.labels)
         self.store.expire()
+        if self.on_sample is not None:
+            try:
+                self.on_sample(snap, ts)
+            except Exception:
+                pass
 
     def start(self) -> "LocalSampler":
         if self._thread is not None:

@@ -1,0 +1,245 @@
+"""Flight recorder & postmortem plane (ISSUE 17): byte/age-bounded
+rings under event storms, dump re-entrancy/debounce/token gating,
+signal-safe dumps while another thread holds the metrics registry lock,
+atomic bundle publication + oldest-first retention, alerts.jsonl size
+rotation mirrored by the bundle's tail reader, and the offline
+root-cause analyzer. No sleeps on the hot paths — rings take canned
+timestamps."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import blackbox, config, health, metrics, timeline
+from horovod_tpu.blackbox import FlightRecorder, Ring
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics.reset_metrics()
+    blackbox.reset()
+    yield
+    blackbox.reset()
+    for k in list(os.environ):
+        if k.startswith("HOROVOD_BLACKBOX") or k == "HOROVOD_FAULTHANDLER":
+            del os.environ[k]
+    config.refresh()
+    metrics.reset_metrics()
+
+
+def _arm(tmp_path, **env):
+    """Arm the process recorder onto a test-owned dir."""
+    os.environ["HOROVOD_BLACKBOX"] = "1"
+    os.environ["HOROVOD_BLACKBOX_DIR"] = str(tmp_path)
+    for k, v in env.items():
+        os.environ[k] = v
+    config.refresh()
+    rec = blackbox.ensure(rank=0, world=2)
+    assert rec is not None
+    return rec
+
+
+class TestRing:
+    def test_byte_bound_holds_under_storm(self):
+        ring = Ring(max_bytes=1024, max_age_s=3600.0)
+        for i in range(5000):
+            ring.append("x" * 64, ts=1000.0 + i * 0.001)
+        assert ring.nbytes <= 1024
+        assert len(ring) == 1024 // 64
+        assert ring.dropped == 5000 - 1024 // 64
+
+    def test_eviction_is_strict_oldest_first(self):
+        ring = Ring(max_bytes=10 * 8, max_age_s=3600.0)
+        for i in range(100):
+            ring.append(f"{i:08d}", ts=1000.0 + i)
+        assert ring.items(now=1100.0) == [f"{i:08d}" for i in range(90, 100)]
+
+    def test_age_bound_prunes_on_append_and_read(self):
+        ring = Ring(max_bytes=1 << 20, max_age_s=10.0)
+        ring.append({"i": 0}, ts=1000.0)
+        ring.append({"i": 1}, ts=1009.0)
+        ring.append({"i": 2}, ts=1012.0)   # i=0 is now 12s old
+        assert [e["i"] for e in ring.items(now=1012.0)] == [1, 2]
+        # a quiet ring drains to nothing: items() prunes age too
+        assert ring.items(now=1050.0) == []
+        assert ring.nbytes == 0
+
+
+class TestDump:
+    def test_dump_during_dump_refused_not_queued(self, tmp_path):
+        rec = _arm(tmp_path)
+        assert rec._dump_gate.acquire(blocking=False)
+        try:
+            assert rec.dump(trigger="manual") is None
+        finally:
+            rec._dump_gate.release()
+        assert rec.dump(trigger="manual") is not None
+
+    def test_auto_triggers_debounced_manual_not(self, tmp_path):
+        rec = _arm(tmp_path)
+        assert rec.dump(trigger="alert") is not None
+        assert rec.dump(trigger="alert") is None      # < min interval
+        assert rec.dump(trigger="manual") is not None  # forced
+
+    def test_dump_on_token_gating(self, tmp_path):
+        rec = _arm(tmp_path, HOROVOD_BLACKBOX_DUMP_ON="signal")
+        assert rec.dump(trigger="alert") is None       # token off
+        assert rec.dump(trigger="manual") is not None  # always allowed
+
+    def test_dump_on_rejects_unknown_tokens(self):
+        os.environ["HOROVOD_BLACKBOX_DUMP_ON"] = "signal,bogus"
+        with pytest.raises(ValueError, match="bogus"):
+            config.refresh()
+
+    def test_dump_completes_with_registry_lock_held(self, tmp_path):
+        """The signal-handler contract: a dump fired while ANOTHER
+        thread holds the metrics registry lock must still publish a
+        bundle — skipping the final live sample, deferring the
+        dumps-total bump, and capturing every thread's stack."""
+        rec = _arm(tmp_path)
+        metrics.counter("probe_total").inc(3)
+        rec.sampler.sample_once()                 # pre-sampled evidence
+        acquired, release = threading.Event(), threading.Event()
+
+        def hog():
+            with metrics.registry._lock:
+                acquired.set()
+                release.wait(10.0)
+
+        t = threading.Thread(target=hog, daemon=True)
+        t.start()
+        assert acquired.wait(5.0)
+        try:
+            bundle = rec.dump(trigger="signal")
+        finally:
+            release.set()
+            t.join(5.0)
+        assert bundle is not None and os.path.isdir(bundle)
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["sampled_final"] is False
+        stacks = open(os.path.join(bundle, "stacks.txt")).read()
+        assert "hog" in stacks       # the lock holder's stack is there
+        # the counter bump was deferred to a daemon thread, not dropped
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            snap = metrics.snapshot()
+            series = snap.get("counters", {}).get("blackbox_dumps_total", [])
+            if sum(s["value"] for s in series
+                   if s.get("labels", {}).get("trigger") == "signal") >= 1:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("deferred blackbox_dumps_total bump never landed")
+
+    def test_retention_evicts_oldest_first(self, tmp_path):
+        rec = _arm(tmp_path, HOROVOD_BLACKBOX_MAX_BUNDLES="2")
+        bundles = [rec.dump(trigger="manual", label=f"b{i}")
+                   for i in range(3)]
+        assert all(bundles)
+        assert not os.path.isdir(bundles[0])
+        assert os.path.isdir(bundles[1]) and os.path.isdir(bundles[2])
+
+    def test_timeline_tap_installed_and_removed(self, tmp_path):
+        rec = _arm(tmp_path)
+        assert rec._tap_timeline in timeline._TAPS
+        blackbox.reset()
+        assert rec._tap_timeline not in timeline._TAPS
+
+    def test_disabled_is_a_total_noop(self):
+        assert blackbox.ensure() is None
+        assert blackbox.dump_postmortem() is None
+        blackbox.note_fault("kill", rank=0, step=1)       # must not raise
+        blackbox.on_alert({"event": "fire", "severity": 1.0})
+
+
+class TestAlertsRotation:
+    def test_rotation_keeps_two_generations(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(health, "ALERTS_ROTATE_BYTES", 256)
+        path = str(tmp_path / "alerts.jsonl")
+        doc = health.ContinuousDoctor(alerts_path=path, sample_local=False)
+        for i in range(40):
+            doc._append_alert({"event": "fire", "finding": f"f{i}",
+                               "severity": 0.5, "ts": 1000.0 + i})
+        assert os.path.isfile(path + ".1")
+        assert os.path.getsize(path) < 256 + 128       # base stays small
+        assert not os.path.exists(path + ".2")         # only 2 generations
+        # the bundle's tail reader spans the rotation boundary: the
+        # records it returns are contiguous and end with the newest.
+        tail = blackbox.read_alerts_tail(path)
+        ids = [int(r["finding"][1:]) for r in tail]
+        assert ids == list(range(ids[0], 40))
+        assert len(ids) > sum(1 for _ in open(path))   # crossed into .1
+
+
+class TestPostmortemReport:
+    def test_crash_loop_ranked_first_with_blamed_rank(self, tmp_path):
+        rec = _arm(tmp_path)
+        metrics.counter("serve_requests_total").inc(5)
+        rec.sampler.sample_once()
+        blackbox.note_fault("crash_loop", rank=3, step=7,
+                            detail="FAULT crash_loop@rank=3,step=7")
+        blackbox.note_fleet("quarantine", replica="r3",
+                            reason="crash_loop: 3 deaths in 120s")
+        blackbox.on_alert({"event": "fire", "finding": "fleet_availability",
+                           "severity": 0.6, "title": "fleet below target",
+                           "ts": time.time()})
+        bundle = blackbox.dump_postmortem(trigger="fault",
+                                          note="FAULT crash_loop@rank=3")
+        report = blackbox.postmortem_report(bundle)
+        cause = report["cause"]
+        assert cause["category"] == "crash_loop"
+        assert "rank 3" in cause["title"]
+        assert report["findings"][0]["rank"] == 1
+        # ground truth supersedes the alert-before-death hypothesis:
+        # no speculative alert finding when the fault event IS the cause,
+        # but the alert record still rides in the bundle's events ring.
+        assert all(f["category"] != "fleet_availability"
+                   for f in report["findings"])
+        events = [json.loads(line) for line in
+                  open(os.path.join(bundle, "events.jsonl"))]
+        assert any(e["type"] == "alert"
+                   and e.get("finding") == "fleet_availability"
+                   for e in events)
+        assert report["stacks_present"]
+        text = blackbox.format_postmortem(report)
+        assert "root cause" in text and "crash_loop" in text
+
+    def test_default_report_picks_newest_bundle(self, tmp_path):
+        rec = _arm(tmp_path)
+        rec.dump(trigger="manual", label="old")
+        time.sleep(0.05)
+        newest = rec.dump(trigger="manual", label="new")
+        report = blackbox.postmortem_report(root=str(tmp_path))
+        assert report["bundle"] == newest
+
+    def test_no_bundles_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            blackbox.postmortem_report(root=str(tmp_path))
+
+
+class TestBundleContents:
+    def test_trace_tail_merges_and_window_feeds_doctor(self, tmp_path):
+        rec = _arm(tmp_path)
+        metrics.counter("demo_total").inc()
+        rec.sampler.sample_once()
+        rec._tap_timeline({"name": "allreduce", "ph": "X",
+                           "ts": time.time() * 1e6, "dur": 10,
+                           "pid": 0, "tid": 1, "args": {}})
+        bundle = rec.dump(trigger="manual")
+        # the trace dir is a valid shard set for the merger
+        from horovod_tpu.timeline import merge_timelines
+        merged = merge_timelines([os.path.join(bundle, "trace")],
+                                 output=os.path.join(str(tmp_path),
+                                                     "merged.json"))
+        names = {e.get("name") for e in merged["traceEvents"]}
+        assert "allreduce" in names
+        # metrics.window.json is registry-snapshot-shaped: the offline
+        # doctor accepts it unchanged
+        window = json.load(open(os.path.join(bundle,
+                                             "metrics.window.json")))
+        from horovod_tpu import profiler
+        report = profiler.doctor(snapshot=window, trace=None, programs={})
+        assert "findings" in report

@@ -53,7 +53,6 @@ tier-1 ``tests/test_fleet.py::TestFleetSmoke``.
 import json
 import os
 import sys
-import tempfile
 import textwrap
 import threading
 import time
@@ -134,6 +133,19 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     root = os.path.join(workdir, "fleet-root")
     os.makedirs(root, exist_ok=True)
     membership = os.path.join(root, "membership.json")
+    # Flight recorder rides the smoke: every worker AND the supervisor
+    # share one blackbox dir under the workdir, so the crash-looped
+    # replica's fault-path bundle, the supervisor's pre-stop dump-RPC
+    # bundles, and the quarantine-time fleet bundle all land together
+    # (and smoke_util.run_smoke harvests them on failure). Set in
+    # os.environ BEFORE jit_cache_env() copies it for the workers.
+    blackbox_dir = os.path.join(root, "blackbox")
+    os.environ["HOROVOD_BLACKBOX"] = "1"
+    os.environ["HOROVOD_BLACKBOX_DIR"] = blackbox_dir
+    os.environ["HOROVOD_BLACKBOX_MAX_BUNDLES"] = "16"
+    from horovod_tpu import blackbox, config
+    config.refresh()
+    blackbox.reset()     # a retry must re-arm onto the fresh dir
     # auto: each worker binds an ephemeral metrics port and advertises it
     # via the status RPC — co-hosted replicas never collide on a base.
     env = smoke_util.jit_cache_env()
@@ -294,6 +306,41 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     if "crash_loop" not in reason:
         return fail(f"r0 quarantine reason not typed: {reason!r}")
 
+    # 4a. flight recorder: the crash-looped replica published a forensic
+    #     bundle in the instants before each SIGKILL (the fault path
+    #     flushes evidence first), and the offline analyzer blames the
+    #     injected crash_loop on rank 0 — with thread stacks captured.
+    r0_bundles = [b for b in blackbox.find_bundles(blackbox_dir)
+                  if os.path.basename(b).startswith("postmortem-rank0-")]
+    if not r0_bundles:
+        have = (sorted(os.listdir(blackbox_dir))
+                if os.path.isdir(blackbox_dir) else "<missing dir>")
+        return fail(f"crash-looped r0 left no postmortem bundle; "
+                    f"blackbox dir holds {have}")
+    pm = blackbox.postmortem_report(r0_bundles[0])
+    cause = pm.get("cause") or {}
+    if cause.get("category") != "crash_loop" \
+            or "rank 0" not in cause.get("title", ""):
+        return fail(f"postmortem_report did not blame rank 0's "
+                    f"crash_loop: cause={cause!r} findings="
+                    f"{[f['category'] for f in pm['findings']]}")
+    if not pm.get("stacks_present"):
+        return fail(f"bundle {r0_bundles[0]} captured no thread stacks")
+    # The quarantine also triggered the supervisor's fleet-wide bundle
+    # (dump RPC fan-out + member collection under one manifest).
+    fleet_bundles = [b for b in blackbox.find_bundles(blackbox_dir)
+                     if os.path.basename(b).startswith(
+                         "postmortem-fleet-r0-")]
+    if not fleet_bundles:
+        return fail("quarantine did not publish the supervisor's fleet "
+                    "bundle (postmortem-fleet-r0-*)")
+    with open(os.path.join(fleet_bundles[0], "fleet.json")) as f:
+        fleet_manifest = json.load(f)
+    if not any("rank0" in os.path.basename(m)
+               for m in fleet_manifest.get("members", [])):
+        return fail(f"fleet bundle did not collect r0's member bundle: "
+                    f"{fleet_manifest.get('members')}")
+
     # 4b. the health plane saw the whole alert lifecycle. FIRED during
     #     the churn (caught live above as /healthz 503 + an ALERT line
     #     in the hvd.top frame), and must now CLEAR: capacity is back
@@ -436,16 +483,13 @@ def run_smoke(workdir: str, timeout_s: float = 420.0):
     return 0, ""
 
 
-def _attempt():
-    # Fresh workdir per attempt: a retry must not reuse the failed
-    # attempt's ports/membership/state files.
-    with tempfile.TemporaryDirectory(prefix="hvd_fleet_smoke_") as td:
-        return run_smoke(td)
-
-
 def main() -> int:
+    # smoke_util.run_smoke owns a fresh workdir per attempt (a retry
+    # must not reuse the failed attempt's ports/membership/state files)
+    # and harvests the failure tail + any postmortem-* bundles into the
+    # artifact dir before the workdir is torn down.
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    return smoke_util.main_with_retry(_attempt, name="fleet-smoke")
+    return smoke_util.run_smoke(run_smoke, name="fleet-smoke")
 
 
 if __name__ == "__main__":

@@ -57,6 +57,9 @@ MODULES = [
     "horovod_tpu.models.convert",
     "horovod_tpu.models.generate",
     "horovod_tpu.profiler",
+    "horovod_tpu.timeseries",
+    "horovod_tpu.health",
+    "horovod_tpu.blackbox",
     "horovod_tpu.serving",
     "horovod_tpu.serving.cache",
     "horovod_tpu.serving.scheduler",

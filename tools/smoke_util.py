@@ -66,6 +66,72 @@ def is_rendezvous_flake(text: str) -> bool:
     return bool(text) and _RENDEZVOUS_RE.search(text) is not None
 
 
+#: tail of a failed attempt's collected output kept as evidence.
+FAILURE_TAIL_LINES = 200
+
+
+def _artifact_root() -> str:
+    return os.environ.get(
+        "HOROVOD_SMOKE_ARTIFACTS",
+        os.path.join(tempfile.gettempdir(), "hvd_smoke_artifacts"))
+
+
+def harvest_evidence(name: str, attempt: int, workdir: str,
+                     failure_text: str) -> str:
+    """Preserve a failed attempt's evidence before its workdir is
+    destroyed: the collected worker/driver output tail plus any
+    flight-recorder ``postmortem-*`` bundles published under the
+    workdir (``HOROVOD_BLACKBOX``). A gloo-flake retry then no longer
+    erases what the first attempt left behind. Returns the artifact
+    dir."""
+    import glob
+    import shutil
+    dst = os.path.join(_artifact_root(), name, f"attempt{attempt}")
+    shutil.rmtree(dst, ignore_errors=True)
+    os.makedirs(dst, exist_ok=True)
+    tail = "\n".join(failure_text.splitlines()[-FAILURE_TAIL_LINES:])
+    with open(os.path.join(dst, "failure.txt"), "w") as f:
+        f.write(tail + "\n")
+    for b in sorted(glob.glob(os.path.join(workdir, "**", "postmortem-*"),
+                              recursive=True)):
+        if not os.path.isdir(b):
+            continue
+        try:
+            shutil.copytree(b, os.path.join(dst, os.path.basename(b)),
+                            dirs_exist_ok=True)
+        except OSError:
+            continue
+    return dst
+
+
+def run_smoke(attempt_fn, name: str = "smoke", attempts: int = 2) -> int:
+    """Run ``attempt_fn(workdir) -> (rc, failure_text)`` with the same
+    rendezvous-flake retry policy as :func:`main_with_retry`, owning a
+    fresh temporary ``workdir`` per attempt — and, on ANY failure,
+    harvesting the attempt's evidence (output tail + postmortem
+    bundles) into the artifact dir before the workdir is torn down."""
+    rc, text = 1, ""
+    for attempt in range(max(1, attempts)):
+        with tempfile.TemporaryDirectory() as workdir:
+            rc, text = attempt_fn(workdir)
+            if rc != 0:
+                where = harvest_evidence(name, attempt, workdir, text)
+                print(f"{name}: attempt {attempt} failed; evidence "
+                      f"saved to {where}", file=sys.stderr)
+        if rc == 0:
+            if attempt:
+                print(f"{name}: passed on retry after a rendezvous flake",
+                      file=sys.stderr)
+            return 0
+        if attempt + 1 < attempts and is_rendezvous_flake(text):
+            print(f"{name}: rendezvous flake detected "
+                  "(gloo TCP rendezvous failed); retrying once on a "
+                  "fresh port", file=sys.stderr)
+            continue
+        break
+    return rc
+
+
 def main_with_retry(run, name: str = "smoke", attempts: int = 2) -> int:
     """Run ``run() -> (rc, failure_text)`` with one rendezvous retry.
 
