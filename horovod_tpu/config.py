@@ -163,6 +163,20 @@ class Config:
     serve_spec_proposer: str = "ngram"
     serve_heartbeat_seconds: float = 2.0
     serve_rpc_timeout_seconds: float = 5.0
+    # Disaggregated serving (serving/disagg.py, docs/SERVING.md
+    # "Disaggregated serving"): HOROVOD_SERVE_ROLE splits replica duties
+    # — "prefill" runs chunked prefill only and exports the KV blocks
+    # for migration, "decode" (and the default "both") serves full
+    # requests; HOROVOD_SERVE_KV_WIRE picks the migration wire format
+    # ("" follows the pool storage dtype; fp32/bf16 raw; int8/fp8 via
+    # the EQuARX block formats with per-(token,head) scales — ~4x
+    # cheaper transfer); HOROVOD_SERVE_AFFINITY routes by prompt-prefix
+    # fingerprint (consistent hash over the decode pool) so shared
+    # preambles keep hitting the replica whose radix index owns them
+    # ("auto" = on whenever role pools exist, "on"/"off" force it).
+    serve_role: str = "both"
+    serve_kv_wire: str = ""
+    serve_affinity: str = "auto"
     serve_transport: str = "stream"
     serve_auth_token: str = ""
     serve_max_retries: int = 3
@@ -176,7 +190,12 @@ class Config:
     # HOROVOD_SERVE_FLEET_CRASH_LOOP_WINDOW seconds that quarantine a
     # crash-looping replica, HOROVOD_SERVE_FLEET_PROBE supervision poll
     # period, HOROVOD_SERVE_FLEET_SPARES warm spare engines held for
-    # promotion into a dead rank's slot.
+    # promotion into a dead rank's slot. Disaggregated fleets:
+    # HOROVOD_SERVE_FLEET_PREFILL carves that many of the serving slots
+    # into a prefill pool (the rest decode; 0 = monolithic "both"
+    # fleet), and HOROVOD_SERVE_FLEET_PREFILL_SPARES says how many of
+    # the warm spares are prefill-roled — spares promote same-pool
+    # only, so each pool's capacity heals independently.
     serve_fleet_restart_budget: int = 5
     serve_fleet_backoff_seconds: float = 0.5
     serve_fleet_backoff_cap_seconds: float = 10.0
@@ -184,6 +203,8 @@ class Config:
     serve_fleet_crash_loop_window_seconds: float = 30.0
     serve_fleet_probe_seconds: float = 0.5
     serve_fleet_spares: int = 0
+    serve_fleet_prefill: int = 0
+    serve_fleet_prefill_spares: int = 0
     # Request tracing (serving/reqtrace.py): HOROVOD_REQUEST_TRACE=1 turns
     # on the per-request span layer (trace context minted at dispatcher
     # submit, spans at every hop); HOROVOD_REQUEST_TRACE_DIR is where each
@@ -407,6 +428,45 @@ def _env_spec_proposer() -> str:
     return v
 
 
+_SERVE_ROLES = ("prefill", "decode", "both")
+
+#: migration wire formats — "" follows the pool storage dtype.
+_KV_WIRE_FORMATS = ("", "fp32", "bf16", "int8", "fp8")
+
+
+def _env_serve_role() -> str:
+    v = (os.environ.get("HOROVOD_SERVE_ROLE", "both").strip().lower()
+         or "both")
+    if v not in _SERVE_ROLES:
+        raise ValueError(f"HOROVOD_SERVE_ROLE={v!r}: expected one of "
+                         f"{_SERVE_ROLES}")
+    return v
+
+
+def _env_kv_wire() -> str:
+    v = os.environ.get("HOROVOD_SERVE_KV_WIRE", "").strip().lower()
+    if v in ("", "none", "off", "0"):
+        return ""
+    if v not in _KV_WIRE_FORMATS:
+        raise ValueError(f"HOROVOD_SERVE_KV_WIRE={v!r}: expected one of "
+                         f"'fp32', 'bf16', 'int8', 'fp8', or unset "
+                         f"(follow the KV pool's storage dtype)")
+    return v
+
+
+def _env_serve_affinity() -> str:
+    v = (os.environ.get("HOROVOD_SERVE_AFFINITY", "auto").strip().lower()
+         or "auto")
+    if v in ("1", "true", "yes"):
+        v = "on"
+    elif v in ("0", "false", "no"):
+        v = "off"
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"HOROVOD_SERVE_AFFINITY={v!r}: expected "
+                         f"'auto', 'on', or 'off'")
+    return v
+
+
 def _env_serve_transport() -> str:
     v = (os.environ.get("HOROVOD_SERVE_TRANSPORT", "stream")
          .strip().lower() or "stream")
@@ -523,6 +583,9 @@ def refresh() -> Config:
             0.1, _env_float("HOROVOD_SERVE_HEARTBEAT", 2.0)),
         serve_rpc_timeout_seconds=_env_posfloat(
             "HOROVOD_SERVE_RPC_TIMEOUT", 5.0),
+        serve_role=_env_serve_role(),
+        serve_kv_wire=_env_kv_wire(),
+        serve_affinity=_env_serve_affinity(),
         serve_transport=_env_serve_transport(),
         serve_auth_token=_env_auth_token(),
         serve_max_retries=_env_nonneg_int(
@@ -546,6 +609,10 @@ def refresh() -> Config:
             "HOROVOD_SERVE_FLEET_PROBE", 0.5),
         serve_fleet_spares=_env_nonneg_int(
             "HOROVOD_SERVE_FLEET_SPARES", 0),
+        serve_fleet_prefill=_env_nonneg_int(
+            "HOROVOD_SERVE_FLEET_PREFILL", 0),
+        serve_fleet_prefill_spares=_env_nonneg_int(
+            "HOROVOD_SERVE_FLEET_PREFILL_SPARES", 0),
         request_trace=_env_bool("HOROVOD_REQUEST_TRACE"),
         request_trace_dir=os.environ.get("HOROVOD_REQUEST_TRACE_DIR")
         or None,

@@ -635,7 +635,17 @@ def render_top(store: TimeSeriesStore, *, window_s: float = 10.0,
             continue
         by_rep.setdefault(rep, []).append(labels.get("attempt", "0"))
 
-    header = (f"{'REPLICA':<10}{'ATT':>4}{'UP':>6}{'QPS':>8}"
+    # Serving role per replica (disaggregated fleets): the serve_role
+    # gauge is a one-hot {engine, role} series; scraped through the
+    # collector it also carries the member's replica label.
+    role_by_rep: Dict[str, str] = {}
+    for labels in store.label_sets(name="serve_role",
+                                   keys=("replica", "role")):
+        rep = labels.get("replica")
+        if rep is not None and labels.get("role"):
+            role_by_rep[rep] = labels["role"]
+
+    header = (f"{'REPLICA':<10}{'ATT':>4}{'ROLE':>9}{'UP':>6}{'QPS':>8}"
               f"{'TTFT_P99_MS':>13}{'SLOTS':>7}{'BLOCKS':>8}{'BREAKER':>9}")
     lines = [f"hvd.top — fleet health plane "
              f"(window {window_s:g}s, {len(by_rep)} replica(s))",
@@ -654,8 +664,9 @@ def render_top(store: TimeSeriesStore, *, window_s: float = 10.0,
         brk = breaker_by_rep.get(rep)
         brk_s = {0.0: "closed", 0.5: "half", 1.0: "open"}.get(brk, "-") \
             if brk is not None else "-"
+        role = role_by_rep.get(rep, "-")
         lines.append(
-            f"{rep:<10}{attempt:>4}{up:>6}{qps:>8.2f}"
+            f"{rep:<10}{attempt:>4}{role:>9}{up:>6}{qps:>8.2f}"
             f"{_fmt(None if p99 is None else p99 * 1e3):>13}"
             f"{_fmt(slots, '{:.0f}'):>7}{_fmt(blocks, '{:.0f}'):>8}"
             f"{brk_s:>9}")

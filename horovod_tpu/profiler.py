@@ -1472,9 +1472,15 @@ def _check_prefix(snap) -> List[Dict]:
     overlap = {s.get("labels", {}).get("engine", "?"):
                float(s.get("value", 0))
                for s in _series(snap, "gauges", "serve_prompt_overlap_rate")}
+    # The hit-rate gauge also carries scope="local"/"fleet" series
+    # (disaggregated serving: grafted-in KV counts as a fleet hit);
+    # this check reads the unscoped per-engine series only — the
+    # always-on fleet series would otherwise clobber it with 0.0 on
+    # engines whose local cache is off.
     hits = {s.get("labels", {}).get("engine", "?"):
             float(s.get("value", 0))
-            for s in _series(snap, "gauges", "prefix_cache_hit_rate")}
+            for s in _series(snap, "gauges", "prefix_cache_hit_rate")
+            if "scope" not in s.get("labels", {})}
     evics = {s.get("labels", {}).get("engine", "?"):
              float(s.get("value", 0))
              for s in _series(snap, "gauges", "prefix_cache_evictions")}
@@ -1689,6 +1695,123 @@ def _check_fleet(snap) -> List[Dict]:
     return out
 
 
+def _check_roles(snap) -> List[Dict]:
+    """Disaggregated-fleet role balance: with prefill and decode pools
+    split (serving/disagg.py), capacity planned for one pool cannot
+    help the other — a saturated prefill pool next to an idle decode
+    pool (or the reverse) means the split itself is mis-sized, not the
+    fleet. Quiet unless prefill-roled engines exist. Knob names match
+    ``config.py``: HOROVOD_SERVE_ROLE, HOROVOD_SERVE_FLEET_PREFILL,
+    HOROVOD_SERVE_FLEET_PREFILL_SPARES."""
+    roles = {}
+    for s in _series(snap, "gauges", "serve_role"):
+        labels = s.get("labels", {})
+        if float(s.get("value", 0)) >= 1.0:
+            roles[labels.get("engine", "?")] = labels.get("role", "both")
+    if "prefill" not in roles.values():
+        return []                      # monolithic fleet: nothing to say
+    active = {s.get("labels", {}).get("engine", "?"):
+              float(s.get("value", 0))
+              for s in _series(snap, "gauges", "serve_slots_active")}
+    total = {s.get("labels", {}).get("engine", "?"):
+             float(s.get("value", 0))
+             for s in _series(snap, "gauges", "serve_slots_total")}
+    queued = {s.get("labels", {}).get("engine", "?"):
+              float(s.get("value", 0))
+              for s in _series(snap, "gauges", "serve_queue_depth")}
+
+    def _pool(role_pred):
+        engines = [e for e, r in roles.items() if role_pred(r)]
+        act = sum(active.get(e, 0.0) for e in engines)
+        tot = sum(total.get(e, 0.0) for e in engines)
+        return {"engines": engines,
+                "util": (act / tot) if tot > 0 else 0.0,
+                "queued": sum(queued.get(e, 0.0) for e in engines)}
+
+    pre = _pool(lambda r: r == "prefill")
+    dec = _pool(lambda r: r in ("decode", "both"))
+    out = []
+    pre_hot = pre["util"] >= 0.85 or pre["queued"] > 0
+    dec_hot = dec["util"] >= 0.85 or dec["queued"] > 0
+    pre_idle = pre["util"] <= 0.25 and pre["queued"] == 0
+    dec_idle = dec["util"] <= 0.25 and dec["queued"] == 0
+    if pre_hot and dec_idle and dec["engines"]:
+        out.append(_finding(
+            "role_imbalance", 0.55,
+            f"prefill pool saturated ({pre['util']:.0%} slots, "
+            f"{int(pre['queued'])} queued) while the decode pool idles "
+            f"({dec['util']:.0%})",
+            "new prompts queue for a prefill slot while decode "
+            "replicas sit underused — TTFT degrades even though the "
+            "fleet as a whole has capacity; the prefill/decode split "
+            "is under-provisioned on the prefill side",
+            "move a decode replica over (restart it with "
+            "HOROVOD_SERVE_ROLE=prefill), or grow the pool at the "
+            "fleet level: raise HOROVOD_SERVE_FLEET_PREFILL and keep "
+            "a prefill-warmed spare (HOROVOD_SERVE_FLEET_PREFILL_"
+            "SPARES) so the pool heals same-role.",
+            prefill_util=pre["util"], decode_util=dec["util"],
+            prefill_queued=int(pre["queued"])))
+    elif dec_hot and pre_idle and pre["engines"]:
+        out.append(_finding(
+            "role_imbalance", 0.55,
+            f"decode pool saturated ({dec['util']:.0%} slots, "
+            f"{int(dec['queued'])} queued) while the prefill pool "
+            f"idles ({pre['util']:.0%})",
+            "migrated requests queue for a decode slot while prefill "
+            "replicas sit underused — TPOT and queue wait degrade on "
+            "the decode side; the split is over-provisioned on the "
+            "prefill side",
+            "move a prefill replica over (restart it with "
+            "HOROVOD_SERVE_ROLE=decode), or lower "
+            "HOROVOD_SERVE_FLEET_PREFILL so more of the fleet target "
+            "decodes; shift spare budget with "
+            "HOROVOD_SERVE_FLEET_PREFILL_SPARES to match.",
+            prefill_util=pre["util"], decode_util=dec["util"],
+            decode_queued=int(dec["queued"])))
+    # A pool with zero LIVE members is worse than imbalance: every
+    # request degrades to the monolithic path (no_prefill_pool) or,
+    # with no decode pool, cannot finish at all.
+    live_by_role = {}
+    for s in _series(snap, "gauges", "fleet_role_replicas"):
+        labels = s.get("labels", {})
+        if labels.get("state") == "live":
+            live_by_role[labels.get("role", "?")] = float(
+                s.get("value", 0))
+    if live_by_role:
+        pre_live = live_by_role.get("prefill", 0.0)
+        dec_live = (live_by_role.get("decode", 0.0)
+                    + live_by_role.get("both", 0.0))
+        if pre_live == 0 and dec_live > 0:
+            out.append(_finding(
+                "role_imbalance", 0.8,
+                "prefill pool has no live replicas",
+                "every new prompt now degrades to a monolithic "
+                "prefill on the decode pool "
+                "(serve_kv_migrations_total{outcome=no_prefill_pool}) "
+                "— correct but with the TTFT isolation the split "
+                "existed for gone",
+                "check fleet quarantines for the dead prefill "
+                "replicas and keep at least one prefill-warmed spare "
+                "(HOROVOD_SERVE_FLEET_PREFILL_SPARES>=1) so the pool "
+                "heals by promotion instead of a cold spawn.",
+                prefill_live=int(pre_live), decode_live=int(dec_live)))
+        elif dec_live == 0 and pre_live > 0:
+            out.append(_finding(
+                "role_imbalance", 0.9,
+                "decode pool has no live replicas",
+                "prefill replicas cannot finish a request on their "
+                "own (prefill-role engines bounce non-prefill "
+                "submits), so the fleet is effectively down for "
+                "generation despite live capacity",
+                "restart a prefill replica with "
+                "HOROVOD_SERVE_ROLE=decode (or =both) immediately, "
+                "then rebalance HOROVOD_SERVE_FLEET_PREFILL and the "
+                "spare split.",
+                prefill_live=int(pre_live), decode_live=int(dec_live)))
+    return out
+
+
 def _check_memory(snap) -> List[Dict]:
     n = _sum_counter(snap, "memory_pressure_total")
     if n <= 0:
@@ -1795,6 +1918,7 @@ def doctor(snapshot=None, trace=None, programs=None) -> Dict[str, Any]:
     findings += _check_prefix(snap)
     findings += _check_transport(snap)
     findings += _check_fleet(snap)
+    findings += _check_roles(snap)
     findings += _check_mfu(progs, snap)
     findings += _check_overlap(snap, report)
     findings += _check_fusion(snap)

@@ -31,6 +31,13 @@ ROADMAP's "heavy traffic from millions of users" north star needs:
   per-replica circuit breakers, optional tail-latency hedging, and
   typed overload shedding. The filesystem spool above stays as the
   test/CI backend behind the same submit/poll semantics.
+* :mod:`~horovod_tpu.serving.disagg` — disaggregated prefill/decode
+  serving: the wire codec that frames exported KV blocks (fp32/bf16/
+  int8/fp8 with per-vector scales), the prompt-prefix fingerprint and
+  rendezvous-hash affinity ranking the dispatcher routes by, so a
+  prefill pool can chunk-prefill a prompt, ship its KV to a decode
+  pool over the transport, and the decode replica continues without
+  re-prefilling (``decode_compiles == 1`` survives the handoff).
 * :mod:`~horovod_tpu.serving.fleet` — the self-healing layer above the
   transport: :class:`~horovod_tpu.serving.fleet.FleetSupervisor`
   restarts crashed replicas with jittered backoff, quarantines crash
@@ -50,7 +57,7 @@ with a TTFT breakdown report. See docs/SERVING.md and
 docs/OBSERVABILITY.md "Request tracing".
 """
 
-from horovod_tpu.serving import reqtrace  # noqa: F401
+from horovod_tpu.serving import disagg, reqtrace  # noqa: F401
 from horovod_tpu.serving.cache import BlockManager, PagedKVCache  # noqa: F401
 from horovod_tpu.serving.engine import InferenceEngine  # noqa: F401
 from horovod_tpu.serving.scheduler import (  # noqa: F401
@@ -77,5 +84,5 @@ __all__ = [
     "backoff_delays",
     "FleetSupervisor", "ProcessLauncher", "ProcessReplica",
     "ReplicaSlot",
-    "reqtrace",
+    "disagg", "reqtrace",
 ]

@@ -234,6 +234,50 @@ class PagedKVCache:
             return self.replace(kp=kp, vp=vp, ks=ks, vs=vs)
         return self.replace(kp=kp, vp=vp)
 
+    # -- KV migration (serving/disagg.py) ---------------------------------
+
+    def export_blocks(self, blocks):
+        """Host fp32 copy of the named pool rows for KV migration:
+        returns ``(k, v)`` as ``(L, len(blocks), block_size, Hkv, hd)``
+        numpy arrays, dequantized through the pool's own per-(token,
+        head) scales — the exact values :meth:`view` would gather, so a
+        graft on the receiving replica reproduces a local prefill up to
+        the wire format's rounding."""
+        blocks = jnp.asarray(blocks, jnp.int32)
+        ck = self.kp[:, blocks]
+        cv = self.vp[:, blocks]
+        if self.quant:
+            hd = ck.shape[-1]
+            ck = dequantize_blocks(ck, self.ks[:, blocks][..., None],
+                                   block=hd)
+            cv = dequantize_blocks(cv, self.vs[:, blocks][..., None],
+                                   block=hd)
+        return (np.asarray(ck, np.float32), np.asarray(cv, np.float32))
+
+    def import_blocks(self, blocks, k, v) -> "PagedKVCache":
+        """Graft migrated KV data into the named pool rows. ``k``/``v``
+        are fp32 ``(L, len(blocks), block_size, Hkv, hd)`` arrays (the
+        :meth:`export_blocks` shape); a quantized pool re-quantizes them
+        through its own per-(token, head) scales exactly like
+        :meth:`update` does for a locally computed write. Host-side
+        one-shot scatter (``.at[].set``), never part of the jitted step
+        — migration lands between dispatches."""
+        blocks = jnp.asarray(blocks, jnp.int32)
+        k = jnp.asarray(k, jnp.float32)
+        v = jnp.asarray(v, jnp.float32)
+        if self.quant:
+            hd = k.shape[-1]
+            kq, ksc = quantize_blocks(k, wire=self.quant, block=hd)
+            vq, vsc = quantize_blocks(v, wire=self.quant, block=hd)
+            kp = self.kp.at[:, blocks].set(kq.astype(self.kp.dtype))
+            vp = self.vp.at[:, blocks].set(vq.astype(self.vp.dtype))
+            ks = self.ks.at[:, blocks].set(ksc[..., 0])
+            vs = self.vs.at[:, blocks].set(vsc[..., 0])
+            return self.replace(kp=kp, vp=vp, ks=ks, vs=vs)
+        kp = self.kp.at[:, blocks].set(k.astype(self.kp.dtype))
+        vp = self.vp.at[:, blocks].set(v.astype(self.vp.dtype))
+        return self.replace(kp=kp, vp=vp)
+
     # -- pytree plumbing --------------------------------------------------
 
     def tree_flatten(self):
@@ -589,6 +633,35 @@ class BlockManager:
             self.cow_copies += 1
             self._dirty = True
             return cur, blk
+
+    # -- KV migration (serving/disagg.py) ---------------------------------
+
+    def prompt_blocks(self, slot: int, n_tokens: int) -> List[int]:
+        """The pool blocks mapping positions ``[0, n_tokens)`` of
+        ``slot``, in prompt order — the export chain for KV migration.
+        Raises if any covered position is still unmapped (prefill not
+        finished)."""
+        with self._lock:
+            blocks = [int(self.table[slot, b])
+                      for b in range(self.blocks_for(n_tokens))]
+        if TRASH_BLOCK in blocks:
+            raise RuntimeError(
+                f"prompt_blocks(slot={slot}, n_tokens={n_tokens}): "
+                f"position range not fully prefilled")
+        return blocks
+
+    def map_prefix_blocks(self, slot: int, n_tokens: int) -> List[int]:
+        """Allocate and map fresh PRIVATE blocks covering positions
+        ``[0, n_tokens)`` of an admitted slot, returning them in prompt
+        order — the graft target for migrated KV data. Counts against
+        the slot's reservation exactly like lazy first-touch allocation
+        would, so the admission-safety invariant is untouched."""
+        blocks = []
+        for b in range(self.blocks_for(n_tokens)):
+            self.ensure(slot, b * self.block_size)
+            with self._lock:
+                blocks.append(int(self.table[slot, b]))
+        return blocks
 
     def register_prefix(self, slot: int, tokens) -> int:
         """Publish ``slot``'s fully-prefilled prompt into the index so

@@ -1,0 +1,267 @@
+"""Disaggregated prefill/decode serving: KV migration + fleet affinity.
+
+A monolithic replica caps both phases of generation at once: one long
+prompt's chunked prefill stalls every in-flight decode on the same
+engine (PR 4's interleaving only bounds the stall at a chunk), and the
+radix prefix cache (PR 12) dies at the replica boundary — least-loaded
+routing scatters repeats of the same preamble across the fleet, so no
+replica's index ever gets hot. This module is the glue for splitting
+the fleet instead (``HOROVOD_SERVE_ROLE=prefill|decode|both``):
+
+* **KV wire codec** — :func:`encode_kv` / :func:`decode_kv` turn a
+  prefilled request's fp32 K/V export (``(L, T, Hkv, hd)``, the shape
+  :meth:`~horovod_tpu.serving.cache.PagedKVCache.export_blocks`
+  produces) into a JSON header plus length-framed per-block binary
+  payloads for the transport-v2 stream wire (opcode ``OP_KV``). The
+  wire format rides the public EQuARX block formats of
+  :mod:`horovod_tpu.ops.quantized`: ``int8``/``fp8`` quantize with one
+  fp32 scale per (token, head) vector (``block=head_dim``) for a ~4x
+  cheaper transfer than fp32; ``bf16`` halves it losslessly for bf16
+  models; ``fp32`` is exact. ``HOROVOD_SERVE_KV_WIRE`` picks, ""
+  follows the pool's own storage format (:func:`default_wire`).
+* **Prefix affinity** — :func:`prefix_fingerprint` hashes a prompt's
+  leading tokens and :func:`rank_by_affinity` rendezvous-hashes that
+  fingerprint over the decode pool, so every prompt sharing a preamble
+  lands on the SAME replica — whose radix index then serves the repeat
+  from blocks instead of re-prefilling. Rendezvous (highest random
+  weight) hashing keeps the mapping consistent under membership churn:
+  a replica's death only remaps ITS fingerprints, everyone else's
+  affinity survives. The dispatcher falls back to least-loaded when
+  the affinity target is down or overloaded.
+* **In-process migration** — :func:`migrate_local` grafts a
+  prefill-only request from one engine into another through the same
+  encode/decode path the socket wire ships, for benches and tests
+  that measure the serving architecture without TCP in the loop.
+
+The migration contract (pinned by ``tests/test_disagg.py``): the
+decode-side graft re-feeds the LAST prompt token (``n_fed =
+len(prompt) - 1``, exactly the capped full-prompt prefix match the
+engine already supports), so its first commit runs the normal
+first-token path — TTFT observed where the token is produced,
+``register_prefix`` publishing the migrated prompt into the decode
+replica's OWN radix index (which is what makes the prefix cache
+fleet-global), and ``decode_compiles == 1`` preserved because a graft
+is host bookkeeping between dispatches, never a new program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from horovod_tpu.ops.quantized import quantize_blocks, dequantize_blocks
+
+__all__ = ["ROLES", "KV_WIRE_FORMATS", "default_wire", "encode_kv",
+           "decode_kv", "prefix_fingerprint", "rank_by_affinity",
+           "migrate_local"]
+
+#: replica duties — "prefill" runs chunked prefill and exports KV,
+#: "decode" serves decode (and, as the migration-kill fallback, whole
+#: requests), "both" is the monolithic default.
+ROLES = ("prefill", "decode", "both")
+
+#: migration wire formats, cheapest-first is int8/fp8 (1 byte + one
+#: fp32 scale per (token, head) vector).
+KV_WIRE_FORMATS = ("fp32", "bf16", "int8", "fp8")
+
+_WIRE_VERSION = 1
+
+
+def default_wire(kv_quant, dtype) -> str:
+    """The wire format "" resolves to: ship what the pool stores — a
+    quantized pool's rounding already happened, so re-quantizing on the
+    wire costs nothing new; an unquantized pool ships its dtype."""
+    if kv_quant in ("int8", "fp8"):
+        return kv_quant
+    if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16):
+        return "bf16"
+    return "fp32"
+
+
+def _bf16():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _fp8():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def _encode_chunk(k: np.ndarray, v: np.ndarray, wire: str) -> bytes:
+    """One frame's payload: the K then V tokens of one block-sized
+    chunk, plus (for quantized wires) their fp32 per-(token, head)
+    scales. ``k``/``v`` are fp32 ``(L, t, Hkv, hd)``."""
+    if wire == "fp32":
+        return (np.ascontiguousarray(k, "<f4").tobytes()
+                + np.ascontiguousarray(v, "<f4").tobytes())
+    if wire == "bf16":
+        bf = _bf16()
+        return (np.ascontiguousarray(k.astype(bf)).tobytes()
+                + np.ascontiguousarray(v.astype(bf)).tobytes())
+    hd = k.shape[-1]
+    out = []
+    for x in (k, v):
+        q, scale = quantize_blocks(jnp.asarray(x, jnp.float32),
+                                   wire=wire, block=hd)
+        out.append(np.ascontiguousarray(np.asarray(q)).tobytes())
+        out.append(np.ascontiguousarray(
+            np.asarray(scale, "<f4")).tobytes())
+    return b"".join(out)
+
+
+def _decode_chunk(blob: bytes, wire: str, L: int, t: int, H: int,
+                  hd: int) -> Tuple[np.ndarray, np.ndarray]:
+    shape = (L, t, H, hd)
+    n = L * t * H * hd
+    if wire == "fp32":
+        if len(blob) != 8 * n:
+            raise ValueError(f"kv frame: {len(blob)} bytes for fp32 "
+                             f"chunk of {n} elements")
+        k = np.frombuffer(blob[:4 * n], "<f4").reshape(shape)
+        v = np.frombuffer(blob[4 * n:], "<f4").reshape(shape)
+        return k.astype(np.float32), v.astype(np.float32)
+    if wire == "bf16":
+        if len(blob) != 4 * n:
+            raise ValueError(f"kv frame: {len(blob)} bytes for bf16 "
+                             f"chunk of {n} elements")
+        bf = _bf16()
+        k = np.frombuffer(blob[:2 * n], bf).reshape(shape)
+        v = np.frombuffer(blob[2 * n:], bf).reshape(shape)
+        return k.astype(np.float32), v.astype(np.float32)
+    ns = L * t * H                         # one fp32 scale per vector
+    half = n + 4 * ns
+    if len(blob) != 2 * half:
+        raise ValueError(f"kv frame: {len(blob)} bytes for {wire} "
+                         f"chunk ({2 * half} expected)")
+    qdt = np.int8 if wire == "int8" else _fp8()
+    out = []
+    for off in (0, half):
+        q = np.frombuffer(blob[off:off + n], qdt).reshape(shape)
+        scale = np.frombuffer(blob[off + n:off + half],
+                              "<f4").reshape(L, t, H, 1)
+        deq = dequantize_blocks(jnp.asarray(q), jnp.asarray(scale),
+                                block=hd)
+        out.append(np.asarray(deq, np.float32))
+    return out[0], out[1]
+
+
+def encode_kv(k: np.ndarray, v: np.ndarray, *, wire: str,
+              frame_tokens: int) -> Tuple[Dict[str, Any], List[bytes]]:
+    """Wire-encode one request's prompt KV for migration.
+
+    ``k``/``v`` are fp32 ``(L, T, Hkv, hd)`` (token-major — block
+    geometry is deliberately NOT on the wire, so prefill and decode
+    replicas may disagree on ``block_size``). Returns ``(header,
+    frames)``: a JSON-safe header describing shapes/format, and one
+    length-framed binary payload per ``frame_tokens``-token chunk (the
+    sender's pool block size — each frame is one block's worth of
+    tokens, ragged tail included)."""
+    if wire not in KV_WIRE_FORMATS:
+        raise ValueError(f"kv wire {wire!r}: expected one of "
+                         f"{KV_WIRE_FORMATS}")
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    if k.ndim != 4 or k.shape != v.shape:
+        raise ValueError(f"encode_kv expects matching (L, T, Hkv, hd) "
+                         f"arrays, got {k.shape} / {v.shape}")
+    L, T, H, hd = k.shape
+    ft = max(1, int(frame_tokens))
+    frames = [_encode_chunk(k[:, t0:t0 + ft], v[:, t0:t0 + ft], wire)
+              for t0 in range(0, T, ft)]
+    header = {"v": _WIRE_VERSION, "wire": wire, "layers": L,
+              "tokens": T, "kv_heads": H, "head_dim": hd,
+              "frame_tokens": ft, "frames": len(frames),
+              "bytes": sum(len(f) for f in frames)}
+    return header, frames
+
+
+def decode_kv(header: Dict[str, Any],
+              frames: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`encode_kv`: fp32 ``(L, T, Hkv, hd)`` K/V out.
+    Strict on structure — a frame-count or byte-length mismatch raises
+    instead of grafting garbage into a pool."""
+    if int(header.get("v", 0)) != _WIRE_VERSION:
+        raise ValueError(f"kv wire version {header.get('v')!r} "
+                         f"(this build speaks {_WIRE_VERSION})")
+    wire = header["wire"]
+    if wire not in KV_WIRE_FORMATS:
+        raise ValueError(f"kv header: unknown wire {wire!r}")
+    L, T = int(header["layers"]), int(header["tokens"])
+    H, hd = int(header["kv_heads"]), int(header["head_dim"])
+    ft = int(header["frame_tokens"])
+    if L < 1 or T < 1 or H < 1 or hd < 1 or ft < 1:
+        raise ValueError(f"kv header: bad geometry {header!r}")
+    want = -(-T // ft)
+    if len(frames) != want or int(header["frames"]) != want:
+        raise ValueError(f"kv header: {len(frames)} frames for "
+                         f"{T} tokens at {ft}/frame ({want} expected)")
+    ks, vs = [], []
+    for i, blob in enumerate(frames):
+        t = min(ft, T - i * ft)
+        kc, vc = _decode_chunk(blob, wire, L, t, H, hd)
+        ks.append(kc)
+        vs.append(vc)
+    return (np.concatenate(ks, axis=1), np.concatenate(vs, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# fleet-global prefix affinity
+# ---------------------------------------------------------------------------
+
+#: leading prompt tokens hashed into the routing fingerprint. Fixed and
+#: engine-agnostic on purpose: the dispatcher does not know each
+#: engine's block_size, and any stable preamble-length works — two
+#: prompts sharing FINGERPRINT_TOKENS tokens share at least one radix
+#: chunk for every block_size <= FINGERPRINT_TOKENS.
+FINGERPRINT_TOKENS = 16
+
+
+def prefix_fingerprint(prompt, width: int = FINGERPRINT_TOKENS) -> str:
+    """Stable cross-process fingerprint of a prompt's leading tokens
+    (sha1 over the token ids, NOT Python ``hash`` — dispatchers in
+    different processes must agree)."""
+    toks = np.asarray([int(t) for t in list(prompt)[:width]], "<i8")
+    return hashlib.sha1(toks.tobytes()).hexdigest()[:16]
+
+
+def rank_by_affinity(fingerprint: str,
+                     names: Sequence[str]) -> List[str]:
+    """Rendezvous-hash (highest random weight) ordering of ``names``
+    for one fingerprint: every dispatcher computes the same preference
+    list, the winner only changes for fingerprints the dead replica
+    owned, and the runner-up is the deterministic failover target."""
+    return sorted(
+        names,
+        key=lambda n: hashlib.sha1(
+            f"{fingerprint}|{n}".encode()).digest(),
+        reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# in-process migration (benches, tests)
+# ---------------------------------------------------------------------------
+
+def migrate_local(req, dst_engine, *, wire: str = "",
+                  frame_tokens: int = 0, **kw):
+    """Graft a prefill-only request (terminal, ``reason="prefilled"``,
+    carrying ``req.kv_export``) into ``dst_engine`` through the full
+    wire codec — the socket path minus the socket. Returns the decode
+    request ``dst_engine.admit_prefilled`` minted."""
+    export = getattr(req, "kv_export", None)
+    if export is None:
+        raise ValueError(f"request {req.id}: no KV export to migrate "
+                         f"(submit with prefill_only=True first)")
+    k, v = export
+    wire = wire or default_wire(dst_engine.kv_quant,
+                                dst_engine.cfg.dtype)
+    header, frames = encode_kv(
+        k, v, wire=wire,
+        frame_tokens=frame_tokens or dst_engine.block_size)
+    k2, v2 = decode_kv(header, frames)
+    return dst_engine.admit_prefilled(
+        [int(t) for t in req.prompt], req.max_new_tokens, k2, v2, **kw)

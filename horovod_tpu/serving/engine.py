@@ -112,6 +112,7 @@ class InferenceEngine:
                  prefix_cache: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_proposer: Optional[str] = None,
+                 role: Optional[str] = None,
                  name: str = "engine0"):
         from horovod_tpu.config import get_config
         hcfg = get_config()
@@ -174,6 +175,32 @@ class InferenceEngine:
         if self.spec_k > 0 and self.spec_proposer != "ngram":
             raise ValueError(f"unknown spec proposer "
                              f"{self.spec_proposer!r}; known: ('ngram',)")
+        # Disaggregated serving (serving/disagg.py): "prefill" engines
+        # accept only prefill_only requests (run the chunked-prefill
+        # program, export the prompt KV, finish DONE/"prefilled"
+        # without committing a token); "decode" engines accept grafts
+        # via admit_prefilled plus whole requests (the migration-kill
+        # fallback re-prefills on a survivor). "both" is monolithic.
+        # Role splitting is gated like prefix sharing: T5's decoder KV
+        # depends on the per-request encoder output, and migration of
+        # an mp-stacked pool is not implemented — refuse loudly rather
+        # than serve a role the engine can't honour.
+        self.role = str(role if role is not None
+                        else hcfg.serve_role).lower()
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown serve role {self.role!r}; "
+                             f"known: ('prefill', 'decode', 'both')")
+        if self.role != "both" and self.family.name == "t5":
+            raise NotImplementedError(
+                "disaggregated prefill/decode is not supported for t5 "
+                "(decoder KV depends on the per-request encoder "
+                "output, so prompt KV cannot be migrated); run t5 "
+                "replicas with HOROVOD_SERVE_ROLE=both")
+        if self.role != "both" and self._mp > 1:
+            raise NotImplementedError(
+                "KV migration of an mp-stacked pool is not "
+                "implemented; run tensor-parallel engines with "
+                "HOROVOD_SERVE_ROLE=both")
         queue_limit = int(queue_limit if queue_limit is not None
                           else hcfg.serve_queue_limit)
         if self.slots < 1 or self.max_len < 2 or self.block_size < 1 \
@@ -262,6 +289,12 @@ class InferenceEngine:
         self._overlap_seen: set = set()
         self._overlap_hits = 0
         self._overlap_total = 0
+        # Migration counters: grafts feed the FLEET-scope prefix hit
+        # rate — a grafted admission is a request whose prefill ran on
+        # another replica, i.e. a cache hit at fleet scope even though
+        # the local radix index never saw the prompt.
+        self._graft_admissions = 0
+        self._prefill_exports = 0
         self._span = tracing.mint_span("serve_engine", tensor=name,
                                        traced=True)
 
@@ -410,7 +443,46 @@ class InferenceEngine:
         """Enqueue one request; returns immediately with a handle whose
         ``result()`` blocks for the tokens. Over-long and malformed
         requests are rejected here, a full queue rejects with
-        backpressure — the status/reason is always on the handle."""
+        backpressure — the status/reason is always on the handle.
+
+        ``prefill_only=True`` asks for the migration half-request: the
+        engine prefills the prompt into its pool, exports the KV as
+        fp32 host arrays on ``req.kv_export``, and finishes
+        DONE/``"prefilled"`` without generating — the decode side
+        grafts via :meth:`admit_prefilled`."""
+        prefill_only = bool(kw.pop("prefill_only", False))
+        if prefill_only and self.family.name == "t5":
+            req = Request(prompt if prompt is not None else [],
+                          max_new_tokens, **kw)
+            req._finish(RequestStatus.REJECTED,
+                        "prefill_only is not supported for t5 "
+                        "(decoder KV depends on the per-request "
+                        "encoder output)")
+            return self._count_reject(req)
+        if prefill_only and self._mp > 1:
+            req = Request(prompt if prompt is not None else [0],
+                          max_new_tokens, **kw)
+            req._finish(RequestStatus.REJECTED,
+                        "KV export from a tensor-parallel engine is "
+                        "not implemented")
+            return self._count_reject(req)
+        if self.role == "prefill" and not prefill_only:
+            # Retryable: the dispatcher mis-routed — a decode/both
+            # replica can serve this request unchanged.
+            req = Request(prompt if prompt is not None else [0],
+                          max_new_tokens, **kw)
+            req.retryable = True
+            req._finish(RequestStatus.REJECTED,
+                        "prefill-role engine accepts only "
+                        "prefill_only requests")
+            return self._count_reject(req)
+        if prefill_only and self.role == "decode":
+            req = Request(prompt if prompt is not None else [0],
+                          max_new_tokens, **kw)
+            req.retryable = True
+            req._finish(RequestStatus.REJECTED,
+                        "decode-role engine does not prefill")
+            return self._count_reject(req)
         src = kw.get("src")
         if self.family.name == "t5":
             if src is None:
@@ -437,6 +509,7 @@ class InferenceEngine:
                             "prompt")
                 return self._count_reject(req)
             req = Request(prompt, max_new_tokens, **kw)
+        req.prefill_only = prefill_only
         if req.max_new_tokens < 1:
             req._finish(RequestStatus.REJECTED,
                         "max_new_tokens must be >= 1")
@@ -540,6 +613,168 @@ class InferenceEngine:
                         status="rejected").inc()
         metrics.event("serve_reject", engine=self.name, request=req.id,
                       reason=req.reason)
+        return req
+
+    # ------------------------------------------------------------------
+    # KV migration (serving/disagg.py rides these)
+    # ------------------------------------------------------------------
+
+    def export_kv(self, slot: int,
+                  n_tokens: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Token-major fp32 ``(L, n_tokens, Hkv, hd)`` K/V snapshot of
+        the slot's first ``n_tokens`` positions, dequantized through
+        the pool's own scales. Token-major on purpose: block geometry
+        is a LOCAL pool decision, so the wire never carries it and the
+        two sides of a migration may disagree on ``block_size``."""
+        if self._mp > 1:
+            raise NotImplementedError(
+                "KV export from a tensor-parallel engine is not "
+                "implemented")
+        blocks = self.manager.prompt_blocks(slot, n_tokens)
+        k, v = self._cache.export_blocks(blocks)
+        L, nb, bs, H, hd = k.shape
+        k = k.reshape(L, nb * bs, H, hd)[:, :n_tokens]
+        v = v.reshape(L, nb * bs, H, hd)[:, :n_tokens]
+        return np.ascontiguousarray(k), np.ascontiguousarray(v)
+
+    def admit_prefilled(self, prompt, max_new_tokens: int, k, v,
+                        **kw) -> Request:
+        """Graft a migrated prompt's KV into the local pool and enter
+        decode directly — no queue, no re-prefill. ``k``/``v`` are the
+        fp32 token-major arrays :meth:`export_kv` produced (already
+        wire-decoded). The slot starts at ``n_fed = len(prompt) - 1``:
+        the LAST prompt token is re-fed through the normal decode step
+        (exactly the capped full-prompt prefix-match path), so the
+        first token commits here — TTFT observed where the token is
+        produced, the migrated prompt registered into THIS replica's
+        radix index, and ``decode_compiles == 1`` untouched because a
+        graft is host bookkeeping between dispatches.
+
+        Pool pressure rejects with ``retryable=True`` so the caller
+        can fall back to re-prefilling on a survivor; geometry
+        mismatches raise (a wrong-model graft must never be silently
+        decoded)."""
+        if self.family.name == "t5":
+            raise NotImplementedError(
+                "KV migration is not supported for t5 (decoder KV "
+                "depends on the per-request encoder output)")
+        if self._mp > 1:
+            raise NotImplementedError(
+                "KV graft into a tensor-parallel engine is not "
+                "implemented")
+        if self.role == "prefill":
+            raise ValueError(
+                "prefill-role engine cannot accept KV grafts; route "
+                "grafts to a decode or both replica")
+        kw.pop("prefill_only", None)
+        k = np.asarray(k, np.float32)
+        v = np.asarray(v, np.float32)
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        layers = self.family.num_layers(self.cfg)
+        H = self.family.kv_heads(self.cfg)
+        hd = self.family.head_dim(self.cfg)
+        want = (layers, len(prompt), H, hd)
+        if k.shape != want or v.shape != want:
+            raise ValueError(
+                f"migrated KV shape {k.shape}/{v.shape} does not "
+                f"match this engine's geometry {want} "
+                f"(layers, prompt_tokens, kv_heads, head_dim)")
+        req = Request(prompt, max_new_tokens, **kw)
+        req.prefill_only = False
+        if len(prompt) == 0:
+            req._finish(RequestStatus.REJECTED,
+                        "grafts need a non-empty prompt")
+            return self._count_reject(req)
+        if req.max_new_tokens < 1:
+            req._finish(RequestStatus.REJECTED,
+                        "max_new_tokens must be >= 1")
+            return self._count_reject(req)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_len:
+            req._finish(RequestStatus.REJECTED,
+                        f"prompt {len(req.prompt)} + "
+                        f"{req.max_new_tokens} new tokens exceeds "
+                        f"max_len={self.max_len}")
+            return self._count_reject(req)
+        if self.manager.blocks_for(total) > self.manager.capacity:
+            req._finish(RequestStatus.REJECTED,
+                        f"request needs "
+                        f"{self.manager.blocks_for(total)} KV blocks "
+                        f"but the pool holds {self.manager.capacity}")
+            return self._count_reject(req)
+        if req.temperature < 0 or (req.top_k is not None and not
+                                   1 <= req.top_k <= self.cfg.vocab_size):
+            req._finish(RequestStatus.REJECTED,
+                        "bad sampling parameters for graft")
+            return self._count_reject(req)
+        req._on_terminal = self._request_terminal
+        with self._lock:
+            if self.failed or self._stop.is_set() or self._draining:
+                req.retryable = True
+                req._finish(RequestStatus.REJECTED, "engine not serving")
+                return self._count_reject(req)
+            if self._slot_pool.free_count == 0 or \
+                    not self.manager.can_admit(total, 0, []):
+                # A busy decode pool is a transient: the dispatcher
+                # retries another decode replica or falls back to a
+                # full re-prefill on a survivor.
+                req.retryable = True
+                req._finish(RequestStatus.REJECTED,
+                            "no free slot/blocks for graft")
+                return self._count_reject(req)
+            metrics.counter("serve_requests_total", engine=self.name,
+                            status="submitted").inc()
+            if not req.start_running():
+                return req
+            now = time.monotonic()
+            slot = self._slot_pool.acquire()
+            self.manager.admit(slot, total, 0, [])
+            try:
+                blocks = self.manager.map_prefix_blocks(
+                    slot, len(prompt))
+                bs = self.block_size
+                nb = len(blocks)
+                pad = nb * bs - len(prompt)
+                if pad:
+                    zk = np.zeros((layers, pad, H, hd), np.float32)
+                    k = np.concatenate([k, zk], axis=1)
+                    v = np.concatenate([v, zk], axis=1)
+                self._cache = self._cache.import_blocks(
+                    blocks,
+                    k.reshape(layers, nb, bs, H, hd),
+                    v.reshape(layers, nb, bs, H, hd))
+            except Exception:
+                self.manager.release(slot)
+                self._slot_pool.release(slot)
+                raise
+            span = tracing.mint_span("serve_request", tensor=req.id,
+                                     traced=True)
+            st = _SlotState(req, slot, span)
+            st.n_fed = len(prompt) - 1
+            self._states[slot] = st
+            req.t_admit = now
+            req.served_by = self.name
+            req.prefix_tokens = 0
+            key = tuple(int(t) for t in req.prompt[:self.block_size])
+            self._overlap_total += 1
+            if key in self._overlap_seen:
+                self._overlap_hits += 1
+            elif len(self._overlap_seen) < 8192:
+                self._overlap_seen.add(key)
+            self._graft_admissions += 1
+            metrics.counter("serve_kv_grafts_total",
+                            engine=self.name).inc()
+            metrics.histogram("serve_queue_wait_seconds",
+                              engine=self.name).observe(req.queue_wait)
+            metrics.event("serve_kv_graft", engine=self.name,
+                          request=req.id, slot=slot,
+                          prompt_len=len(req.prompt), op_id=span.op_id)
+            if req.trace is not None and reqtrace.enabled():
+                reqtrace.instant("KV_GRAFT", req.trace,
+                                 engine=self.name, request=req.id,
+                                 slot=slot, tokens=len(prompt))
+            self._update_gauges()
+        self._work.set()
         return req
 
     # ------------------------------------------------------------------
@@ -960,6 +1195,29 @@ class InferenceEngine:
         land at positions >= len(prompt))."""
         req = st.request
         first = req.t_first is None
+        if first and getattr(req, "prefill_only", False):
+            # Prefill-phase terminal: reaching the first-token point
+            # means every prompt position is written, so snapshot the
+            # KV for migration and finish WITHOUT committing — the
+            # decode side re-feeds the LAST prompt token and produces
+            # t0 itself (its own TTFT, its own prefix registration),
+            # which is what keeps token parity and decode_compiles==1
+            # on the engine that actually generates.
+            if self.prefix_enabled:
+                self.manager.register_prefix(slot, req.prompt)
+            req.kv_export = self.export_kv(slot, len(req.prompt))
+            self._prefill_exports += 1
+            metrics.counter("serve_kv_exports_total",
+                            engine=self.name).inc()
+            metrics.event("serve_kv_export", engine=self.name,
+                          request=req.id, tokens=len(req.prompt),
+                          op_id=st.span.op_id)
+            if req.trace is not None and reqtrace.enabled():
+                reqtrace.instant("KV_EXPORT", req.trace,
+                                 engine=self.name, request=req.id,
+                                 tokens=len(req.prompt))
+            req._finish(RequestStatus.DONE, "prefilled")
+            return True
         req._commit(token)
         if first:
             metrics.histogram("serve_ttft_seconds",
@@ -1166,18 +1424,37 @@ class InferenceEngine:
         metrics.gauge("serve_kv_quant_enabled", engine=self.name).set(
             1 if self.kv_quant else 0)
         metrics.gauge("serve_mp_degree", engine=self.name).set(self._mp)
+        # Role + capacity gauges: the doctor's _check_roles and hvd.top
+        # read these to see the two pools — slots_total alongside
+        # slots_active gives saturation without config access.
+        metrics.gauge("serve_slots_total", engine=self.name).set(
+            self.slots)
+        metrics.gauge("serve_role", engine=self.name,
+                      role=self.role).set(1)
         if self._overlap_total:
             metrics.gauge("serve_prompt_overlap_rate",
                           engine=self.name).set(
                 self._overlap_hits / self._overlap_total)
+        ps = self.manager.prefix_stats()
         if self.prefix_enabled:
-            ps = self.manager.prefix_stats()
             metrics.gauge("prefix_cache_hit_rate", engine=self.name).set(
                 ps["hit_rate"])
+            metrics.gauge("prefix_cache_hit_rate", engine=self.name,
+                          scope="local").set(ps["hit_rate"])
             metrics.gauge("prefix_cache_evictions", engine=self.name).set(
                 ps["evictions"])
             metrics.gauge("kv_blocks_shared", engine=self.name).set(
                 self.manager.shared_block_count())
+        # Fleet-scope hit rate: a graft IS a prefix hit at fleet scope
+        # (the prefill ran on another replica). Emitted even with the
+        # local cache off and disagg off — a monolithic fleet's fleet
+        # rate equals its local rate (grafts == 0), which is exactly
+        # the baseline the doctor compares affinity routing against.
+        fleet_den = ps["lookups"] + self._graft_admissions
+        metrics.gauge("prefix_cache_hit_rate", engine=self.name,
+                      scope="fleet").set(
+            (ps["hits"] + self._graft_admissions) / fleet_den
+            if fleet_den else 0.0)
         if self.spec_k > 0 and self._spec_proposed:
             metrics.gauge("spec_acceptance_rate", engine=self.name).set(
                 self._spec_accepted / self._spec_proposed)
@@ -1186,6 +1463,9 @@ class InferenceEngine:
         with self._lock:
             return {
                 "engine": self.name, "alive": self.alive,
+                "role": self.role,
+                "kv_grafts": self._graft_admissions,
+                "kv_exports": self._prefill_exports,
                 "slots": self.slots, "active": len(self._states),
                 "queued": self.queue.depth(),
                 "steps": self.step_count,

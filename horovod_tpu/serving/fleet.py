@@ -147,8 +147,10 @@ class ProcessLauncher:
         self.root = root
         self.env = dict(env if env is not None else os.environ)
 
-    def __call__(self, name: str, rank: int, attempt: int) -> ProcessReplica:
-        env = dict(self.env, HVD_TPU_FLEET_RESTART=str(attempt))
+    def __call__(self, name: str, rank: int, attempt: int,
+                 role: str = "both") -> ProcessReplica:
+        env = dict(self.env, HVD_TPU_FLEET_RESTART=str(attempt),
+                   HOROVOD_SERVE_ROLE=str(role))
         proc = subprocess.Popen(
             [sys.executable, "-c", self.worker_src, str(rank), self.root],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -165,10 +167,12 @@ class ReplicaSlot:
     handle, lifecycle state, and the death/restart bookkeeping the
     crash-loop detector reads."""
 
-    def __init__(self, name: str, rank: int, role: str):
+    def __init__(self, name: str, rank: int, role: str,
+                 serve_role: str = "both"):
         self.name = name
         self.rank = int(rank)
         self.role = role               # "serving" | "spare"
+        self.serve_role = serve_role   # "prefill" | "decode" | "both"
         self.state = STARTING
         self.handle: Any = None
         self.attempt = 0
@@ -190,6 +194,7 @@ class ReplicaSlot:
 
     def describe(self) -> Dict[str, Any]:
         return {"name": self.name, "rank": self.rank, "role": self.role,
+                "serve_role": self.serve_role,
                 "state": self.display_state(), "attempt": self.attempt,
                 "restarts": self.restarts,
                 "quarantine_reason": self.quarantine_reason,
@@ -211,6 +216,8 @@ class FleetSupervisor:
 
     def __init__(self, launcher: Callable[[str, int, int], Any],
                  target: int, *, spares: Optional[int] = None,
+                 prefill: Optional[int] = None,
+                 prefill_spares: Optional[int] = None,
                  membership_path: Optional[str] = None,
                  probe_seconds: Optional[float] = None,
                  restart_budget: Optional[int] = None,
@@ -229,6 +236,22 @@ class FleetSupervisor:
         self.target = int(target)
         self.spares = int(cfg.serve_fleet_spares if spares is None
                           else spares)
+        self.prefill = int(cfg.serve_fleet_prefill if prefill is None
+                           else prefill)
+        self.prefill_spares = int(cfg.serve_fleet_prefill_spares
+                                  if prefill_spares is None
+                                  else prefill_spares)
+        if self.prefill >= self.target and self.prefill > 0:
+            raise ValueError(
+                f"prefill pool ({self.prefill}) must leave at least one "
+                f"decode replica (target={self.target}); set "
+                "HOROVOD_SERVE_FLEET_PREFILL below the fleet target")
+        if self.prefill_spares > self.spares:
+            raise ValueError(
+                f"prefill spares ({self.prefill_spares}) exceed total "
+                f"spares ({self.spares}); raise "
+                "HOROVOD_SERVE_FLEET_SPARES or lower "
+                "HOROVOD_SERVE_FLEET_PREFILL_SPARES")
         self.membership_path = membership_path
         self.probe_s = float(cfg.serve_fleet_probe_seconds
                              if probe_seconds is None else probe_seconds)
@@ -250,12 +273,40 @@ class FleetSupervisor:
         self.unreachable_probes = int(unreachable_probes)
         self.probe_rpc_timeout = float(probe_rpc_timeout)
         self._rng = rng or random.Random()
+        # With a prefill pool carved out, the first `prefill` serving
+        # ranks prefill and the rest decode; a monolithic fleet
+        # (prefill=0) keeps every replica "both". Spares mirror the
+        # split: the first `prefill_spares` heal the prefill pool, the
+        # rest the decode pool — promotion is same-pool only, so a
+        # decode death can never silently shrink prefill capacity.
+        def _serving_role(i: int) -> str:
+            if self.prefill <= 0:
+                return "both"
+            return "prefill" if i < self.prefill else "decode"
+
+        def _spare_role(i: int) -> str:
+            if self.prefill <= 0:
+                return "both"
+            return ("prefill" if i < self.prefill_spares else "decode")
+
         self._slots: List[ReplicaSlot] = []
         for i in range(self.target):
-            self._slots.append(ReplicaSlot(f"r{i}", i, "serving"))
+            self._slots.append(
+                ReplicaSlot(f"r{i}", i, "serving",
+                            serve_role=_serving_role(i)))
         for i in range(self.spares):
             self._slots.append(
-                ReplicaSlot(f"s{i}", self.target + i, "spare"))
+                ReplicaSlot(f"s{i}", self.target + i, "spare",
+                            serve_role=_spare_role(i)))
+        import inspect
+        try:
+            params = inspect.signature(self.launcher).parameters
+            self._launcher_takes_role = (
+                "role" in params
+                or any(p.kind == inspect.Parameter.VAR_KEYWORD
+                       for p in params.values()))
+        except (TypeError, ValueError):
+            self._launcher_takes_role = False
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -302,7 +353,8 @@ class FleetSupervisor:
             self._members[slot.name] = {
                 "name": slot.name, "host": slot.address[0],
                 "port": slot.address[1], "attempt": slot.attempt,
-                "metrics_port": slot.metrics_port}
+                "metrics_port": slot.metrics_port,
+                "role": slot.serve_role}
         self._publish_membership()
 
     def _member_remove(self, slot: ReplicaSlot) -> None:
@@ -418,7 +470,13 @@ class FleetSupervisor:
     # -- supervision ------------------------------------------------------
 
     def _launch(self, slot: ReplicaSlot) -> None:
-        slot.handle = self.launcher(slot.name, slot.rank, slot.attempt)
+        if self._launcher_takes_role:
+            slot.handle = self.launcher(slot.name, slot.rank,
+                                        slot.attempt,
+                                        role=slot.serve_role)
+        else:
+            slot.handle = self.launcher(slot.name, slot.rank,
+                                        slot.attempt)
         slot.state = STARTING if slot.restarts == 0 else RESTARTING
         slot.address = None
         slot.client = None
@@ -565,17 +623,29 @@ class FleetSupervisor:
         promotion is a membership write, not a process spawn. The dead
         slot rebuilds in the background as the new spare."""
         t0 = time.monotonic()
-        for spare in self._slots:
-            if spare.role == "spare" and spare.state == LIVE:
-                spare.role, dead.role = "serving", "spare"
-                self._member_add(spare)
-                dt = time.monotonic() - t0
-                metrics.histogram("fleet_promotion_seconds").observe(dt)
-                metrics._timeline_marker(
-                    "FLEET", category="fleet", event="promote",
-                    spare=spare.name, into=dead.name, seconds=dt)
-                _note_fleet("promote", spare=spare.name, into=dead.name)
-                return
+        # Same-pool first: a dead prefill replica must be healed by a
+        # prefill-warmed spare (and decode by decode) so the split the
+        # dispatcher routes by survives the promotion; a "both" spare
+        # can stand in anywhere as a last resort.
+        ranked = [s for s in self._slots
+                  if s.role == "spare" and s.state == LIVE
+                  and s.serve_role == dead.serve_role]
+        ranked += [s for s in self._slots
+                   if s.role == "spare" and s.state == LIVE
+                   and s.serve_role == "both"
+                   and s.serve_role != dead.serve_role]
+        for spare in ranked:
+            spare.role, dead.role = "serving", "spare"
+            self._member_add(spare)
+            dt = time.monotonic() - t0
+            metrics.histogram("fleet_promotion_seconds").observe(dt)
+            metrics._timeline_marker(
+                "FLEET", category="fleet", event="promote",
+                spare=spare.name, into=dead.name,
+                pool=spare.serve_role, seconds=dt)
+            _note_fleet("promote", spare=spare.name, into=dead.name,
+                        pool=spare.serve_role)
+            return
 
     def _quarantine(self, slot: ReplicaSlot, reason: str) -> None:
         slot.state = QUARANTINED
@@ -641,12 +711,24 @@ class FleetSupervisor:
     def _update_gauges(self) -> None:
         counts = {LIVE: 0, STARTING: 0, RESTARTING: 0, QUARANTINED: 0,
                   SPARE: 0}
+        by_role: Dict[Tuple[str, str], int] = {}
         with self._lock:
             for slot in self._slots:
-                counts[slot.display_state()] = \
-                    counts.get(slot.display_state(), 0) + 1
+                st = slot.display_state()
+                counts[st] = counts.get(st, 0) + 1
+                key = (slot.serve_role, st)
+                by_role[key] = by_role.get(key, 0) + 1
         for state, n in counts.items():
             metrics.gauge("fleet_replicas", state=state).set(float(n))
+        # Per-pool capacity for the health plane and hvd.top: a
+        # disaggregated fleet is healthy only when BOTH pools hold
+        # their share of the target.
+        for role in ("prefill", "decode", "both"):
+            for state in (LIVE, STARTING, RESTARTING, QUARANTINED,
+                          SPARE):
+                metrics.gauge("fleet_role_replicas", role=role,
+                              state=state).set(
+                    float(by_role.get((role, state), 0)))
 
     # -- rolling restart --------------------------------------------------
 

@@ -20,7 +20,14 @@ module is the per-request correlation layer:
   ``BREAKER_WAIT``/``CLIENT_FIRST_TOKEN``, server-side ``QUEUE``/
   ``ADMIT``/``PREFILL`` (one per chunk)/``DECODE`` (sampled every
   ``HOROVOD_REQUEST_TRACE_DECODE_EVERY`` steps)/``COW``/
-  ``FIRST_TOKEN``/``PUSH_DELIVERY``.
+  ``FIRST_TOKEN``/``PUSH_DELIVERY``. Disaggregated serving
+  (serving/disagg.py) adds the migration legs: ``KV_EXPORT`` (prefill
+  engine writes the request's KV onto the export hook) and
+  ``KV_GRAFT`` (decode engine imports it) as server-side instants,
+  plus the dispatcher-side ``MIGRATE`` span (fetch + graft, with
+  ``src``/``dst``/``bytes``/``frames`` args) and the
+  ``MIGRATE_FALLBACK`` instant when a lost leg downgrades the request
+  to a monolithic re-prefill.
 * :func:`flush` writes the buffer as a Chrome-trace shard
   (``reqtrace.<label>.<pid>.json`` under
   ``HOROVOD_REQUEST_TRACE_DIR``) whose ``shard_meta`` carries
@@ -60,9 +67,9 @@ __all__ = ["TraceContext", "mint_context", "enabled", "span", "emit",
 #: the span taxonomy, for docs and tooling (client side, then server side)
 SPAN_KINDS = (
     "SUBMIT", "ATTEMPT", "RETRY", "HEDGE", "HEDGE_WIN", "BREAKER_WAIT",
-    "CLIENT_FIRST_TOKEN",
+    "CLIENT_FIRST_TOKEN", "MIGRATE", "MIGRATE_FALLBACK",
     "QUEUE", "ADMIT", "PREFILL", "DECODE", "COW", "FIRST_TOKEN",
-    "PUSH_DELIVERY",
+    "PUSH_DELIVERY", "KV_EXPORT", "KV_GRAFT",
 )
 
 #: bounded span buffer cap — ~16k spans is minutes of traced serving;

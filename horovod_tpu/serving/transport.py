@@ -202,11 +202,16 @@ OP_REQUEST = 0x10          # client -> server: {method, params}
 OP_RESPONSE = 0x11         # server -> client: the RPC reply
 OP_TOKEN = 0x12            # server -> client push: {id, i, tok}
 OP_TERMINAL = 0x13         # server -> client push: terminal state dict
+OP_KV = 0x14               # either direction: RAW binary KV payload
+                           # (one length-framed block of a migration —
+                           # serving/disagg.py encodes/decodes; the only
+                           # non-JSON opcode on the wire)
 
 _OPCODE_NAMES = {OP_CHALLENGE: "challenge", OP_HELLO: "hello",
                  OP_HELLO_OK: "hello_ok", OP_HELLO_ERR: "hello_err",
                  OP_REQUEST: "request", OP_RESPONSE: "response",
-                 OP_TOKEN: "token", OP_TERMINAL: "terminal"}
+                 OP_TOKEN: "token", OP_TERMINAL: "terminal",
+                 OP_KV: "kv"}
 
 
 def _hmac_hello(token: str, nonce: str, hello: Dict[str, Any]) -> str:
@@ -223,6 +228,22 @@ def _send_frame2(sock: socket.socket, lock: threading.Lock,
                  stream_id: int, opcode: int,
                  payload: Dict[str, Any]) -> None:
     data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if len(data) + 5 > _MAX_FRAME:
+        raise TransportError("protocol",
+                             f"v2 frame of {len(data)} bytes exceeds "
+                             f"{_MAX_FRAME}", retryable=False)
+    frame = struct.pack(">IIB", len(data) + 5, int(stream_id),
+                        int(opcode)) + data
+    with lock:
+        sock.sendall(frame)
+    metrics.counter("transport_frames_total",
+                    opcode=_OPCODE_NAMES.get(opcode, str(opcode)),
+                    dir="tx").inc()
+
+
+def _send_frame2_raw(sock: socket.socket, lock: threading.Lock,
+                     stream_id: int, opcode: int, data: bytes) -> None:
+    """A v2 frame whose payload is raw bytes, not JSON (``OP_KV``)."""
     if len(data) + 5 > _MAX_FRAME:
         raise TransportError("protocol",
                              f"v2 frame of {len(data)} bytes exceeds "
@@ -264,6 +285,12 @@ class _FrameReader:
         raw = bytes(self.buf[4:4 + n])
         del self.buf[:4 + n]
         sid, op = struct.unpack(">IB", raw[:5])
+        if op == OP_KV:
+            # KV frames are raw binary (quantized block payloads), the
+            # one opcode whose payload is NOT JSON.
+            metrics.counter("transport_frames_total", opcode="kv",
+                            dir="rx").inc()
+            return (int(sid), int(op), raw[5:])
         payload: Dict[str, Any] = {}
         if len(raw) > 5:
             try:
@@ -519,6 +546,36 @@ class _ServerSink:
         self.pump.send(self.sid, OP_TERMINAL, state)
 
 
+class _KVCollector:
+    """Server-side accumulator for one graft's inbound ``OP_KV`` frames.
+
+    The connection's read loop owns the socket, so the graft handler
+    thread can't read its own frames — the loop routes each raw KV
+    payload here by stream id and the handler blocks on :meth:`wait`
+    until the announced count arrived (or the connection died)."""
+
+    def __init__(self, expected: int):
+        self.expected = max(0, int(expected))
+        self.frames: List[bytes] = []
+        self._done = threading.Event()
+        self.failed: Optional[str] = None
+        if self.expected == 0:
+            self._done.set()
+
+    def add(self, blob: bytes) -> None:
+        self.frames.append(bytes(blob))
+        if len(self.frames) >= self.expected:
+            self._done.set()
+
+    def fail(self, reason: str) -> None:
+        self.failed = reason
+        self._done.set()
+
+    def wait(self, timeout: float) -> bool:
+        return (self._done.wait(timeout) and self.failed is None
+                and len(self.frames) >= self.expected)
+
+
 class SocketReplicaServer:
     """One replica's RPC front: a listener over an
     :class:`~horovod_tpu.serving.engine.InferenceEngine`.
@@ -550,6 +607,11 @@ class SocketReplicaServer:
         self._sinks: Dict[str, _ServerSink] = {}
         self._rpc_seq = itertools.count(1)
         self.served_rpcs = 0
+        # Last fault-plan step consumed (every inbound OP_REQUEST — status
+        # probes included — advances it). Reported by _do_status so a
+        # fault-injection harness can align a fixed-step kill with the
+        # RPC it wants to hit; plain int store, no lock needed.
+        self.fault_step = 0
         self._metrics_srv: Optional[Any] = None
         # Arm the flight recorder as soon as the replica front exists
         # (fleet workers never run hvd.init(), so this is where their
@@ -640,6 +702,8 @@ class SocketReplicaServer:
                 kw["deadline_s"] = float(p["deadline_s"])
             if isinstance(p.get("trace"), dict):
                 kw["trace"] = p["trace"]
+            if p.get("prefill_only"):
+                kw["prefill_only"] = True
             if sink is not None:
                 # Register the sink BEFORE engine.submit so tokens
                 # committed while submit is still returning get pushed.
@@ -744,6 +808,109 @@ class SocketReplicaServer:
             req.reason = f"overloaded: {req.reason}"
         return req
 
+    # -- KV migration (disaggregated serving) ------------------------------
+
+    def _do_fetch_kv(self, p: Dict[str, Any]) -> \
+            Tuple[Dict[str, Any], Optional[List[bytes]]]:
+        """Wire-encode a prefilled request's exported KV. Returns the
+        JSON response plus the binary frames the caller must push as
+        ``OP_KV`` on the same stream id (v2 only — the legacy wire has
+        no binary lane)."""
+        rid = p.get("id", "")
+        with self._lock:
+            req = self._requests.get(rid)
+        if req is None:
+            return ({"ok": False, "error": f"unknown id {rid!r}",
+                     "retryable": False}, None)
+        export = getattr(req, "kv_export", None)
+        if export is None or req.reason != "prefilled":
+            return ({"ok": False, "error": f"request {rid!r} has no "
+                     "prefilled KV to fetch", "retryable": False}, None)
+        from horovod_tpu.config import get_config
+        from horovod_tpu.serving import disagg
+        wire = p.get("wire") or get_config().serve_kv_wire or \
+            disagg.default_wire(getattr(self.engine, "kv_quant", None),
+                                getattr(getattr(self.engine, "cfg", None),
+                                        "dtype", "float32"))
+        k, v = export
+        header, frames = disagg.encode_kv(
+            k, v, wire=wire,
+            frame_tokens=int(getattr(self.engine, "block_size", 16)))
+        metrics.counter("serve_kv_migrated_bytes_total", side="server",
+                        replica=self.name).inc(header["bytes"])
+        return ({"ok": True, "id": rid, "kv": header}, frames)
+
+    def _do_graft(self, p: Dict[str, Any], sink: Optional[_ServerSink],
+                  collector: Optional[_KVCollector]) -> Dict[str, Any]:
+        """Admit a migrated request: decode the KV frames the read loop
+        collected and graft them into the engine's pool via
+        ``admit_prefilled``. Same id-dedup discipline as submit — a
+        graft replay re-attaches its sink instead of double-serving."""
+        rid = p.get("request_id")
+        if not rid:
+            return {"ok": False, "error": "graft needs request_id "
+                    "(idempotency key)", "retryable": False}
+        header = p.get("kv")
+        if not isinstance(header, dict):
+            return {"ok": False, "error": "graft needs a kv header",
+                    "retryable": False}
+        if collector is None:
+            return {"ok": False, "error": "graft needs transport v2 "
+                    "(binary kv frames)", "retryable": False}
+        while True:
+            with self._lock:
+                existing = self._requests.get(rid)
+                if existing is not None \
+                        and not self._readmittable(existing):
+                    break
+                existing = None
+                mine = self._inflight.get(rid)
+                if mine is None:
+                    mine = threading.Event()
+                    self._inflight[rid] = mine
+                    break
+            if not mine.wait(timeout=30.0):
+                return {"ok": False, "error": f"graft {rid!r} still "
+                        "in flight", "retryable": True}
+        if existing is not None:
+            if sink is not None:
+                self._attach_stream(existing, sink)
+            return self._state(existing)
+        try:
+            budget = min(30.0, float(p.get("deadline_s") or 30.0))
+            if not collector.wait(budget):
+                return {"ok": False,
+                        "error": f"kv frames incomplete "
+                        f"({len(collector.frames)}/{collector.expected}"
+                        f"{'; ' + collector.failed if collector.failed else ''})",
+                        "retryable": True}
+            from horovod_tpu.serving import disagg
+            k, v = disagg.decode_kv(header, collector.frames)
+            kw: Dict[str, Any] = {"priority": int(p.get("priority", 0)),
+                                  "request_id": rid}
+            if p.get("eos_id") is not None:
+                kw["eos_id"] = int(p["eos_id"])
+            if p.get("deadline_s") is not None:
+                kw["deadline_s"] = float(p["deadline_s"])
+            if isinstance(p.get("trace"), dict):
+                kw["trace"] = p["trace"]
+            if sink is not None:
+                with self._lock:
+                    self._sinks[rid] = sink
+                kw["on_token"] = self._make_on_token(rid)
+            req = self.engine.admit_prefilled(
+                list(map(int, p.get("prompt") or [])),
+                int(p.get("max_new_tokens", 1)), k, v, **kw)
+            if not self._readmittable(req):
+                self._remember(req)
+            if sink is not None:
+                self._attach_stream(req, sink)
+            return self._state(req)
+        finally:
+            with self._lock:
+                self._inflight.pop(rid, None)
+            mine.set()
+
     def _do_poll(self, p: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
             req = self._requests.get(p.get("id", ""))
@@ -773,12 +940,19 @@ class SocketReplicaServer:
         srv = getattr(self, "_metrics_srv", None)
         return {"ok": True, "rank": self.rank, "alive": self.engine.alive,
                 "load": self.engine.load(), "slots": self.engine.slots,
+                # Disaggregated serving: the dispatcher's role map falls
+                # back to this when the membership file predates roles.
+                "role": getattr(self.engine, "role", "both"),
                 "queue_depth": self.engine.queue.depth(),
                 "draining": bool(getattr(self.engine, "_draining", False)),
                 # scrape discovery: the fleet supervisor copies this into
                 # the membership file so health.FleetCollector knows where
                 # this replica's /metrics.json lives (0 = not exposed)
                 "metrics_port": int(srv.port) if srv is not None else 0,
+                # fault-plan step position (counts EVERY inbound request,
+                # status probes included) — lets a fault harness aim a
+                # fixed-step kill at a specific upcoming RPC.
+                "fault_step": int(self.fault_step),
                 "seq": seq}
 
     def _do_drain(self, p: Dict[str, Any]) -> Dict[str, Any]:
@@ -840,6 +1014,7 @@ class SocketReplicaServer:
     def _handle_legacy_conn(self, conn: socket.socket,
                             first: bytes) -> None:
         seq = next(self._rpc_seq)
+        self.fault_step = seq
         try:
             # Fault points first: a partition in force (or fired AT this
             # rpc) closes the connection unread — the client sees a
@@ -932,6 +1107,12 @@ class SocketReplicaServer:
             _send_frame2(conn, wlock, 0, OP_HELLO_OK,
                          {"server": self.name, "rank": self.rank})
             pump = _PushPump(conn, wlock, self.name)
+            # Inbound KV frames (grafts) are routed by stream id to the
+            # collector the graft's OP_REQUEST registered — the handler
+            # thread blocks on the collector while this loop keeps
+            # reading, so a multi-frame migration never wedges other
+            # streams on the connection.
+            collectors: Dict[int, _KVCollector] = {}
             # 0.5s read ticks: each timeout re-checks stop/partition, so
             # an in-force partition SEVERS the established stream (the
             # legacy wire only had new connections to refuse).
@@ -945,19 +1126,46 @@ class SocketReplicaServer:
                     continue
                 if faults.partitioned(self.rank):
                     return
+                if op == OP_KV:
+                    coll = collectors.get(sid)
+                    if coll is not None:
+                        coll.add(payload)
+                    continue
                 if op != OP_REQUEST:
                     continue               # pushes only flow server->client
                 seq = next(self._rpc_seq)
+                self.fault_step = seq
                 directives = faults.net_fault(seq, self.rank)
                 if faults.partitioned(self.rank):
                     return                 # partition fired AT this frame
-                threading.Thread(
-                    target=self._serve_stream_request,
-                    args=(conn, wlock, pump, sid, payload, directives),
-                    daemon=True).start()
+                collector = None
+                if payload.get("method") == "graft":
+                    try:
+                        want = int(((payload.get("params") or {})
+                                    .get("kv") or {}).get("frames", 0))
+                    except (TypeError, ValueError):
+                        want = 0
+                    collector = _KVCollector(want)
+                    collectors[sid] = collector
+
+                def _serve(sid=sid, payload=payload,
+                           directives=directives, collector=collector):
+                    try:
+                        self._serve_stream_request(
+                            conn, wlock, pump, sid, payload, directives,
+                            collector=collector)
+                    finally:
+                        collectors.pop(sid, None)
+
+                threading.Thread(target=_serve, daemon=True).start()
         except (OSError, ValueError, ConnectionError, TransportError):
             pass                           # peer gone; client reconnects
         finally:
+            try:
+                for coll in list(collectors.values()):
+                    coll.fail("connection lost")
+            except NameError:
+                pass                       # died before the loop set up
             if pump is not None:
                 pump.close()
             with self._lock:
@@ -973,32 +1181,50 @@ class SocketReplicaServer:
     def _serve_stream_request(self, conn: socket.socket,
                               wlock: threading.Lock, pump: _PushPump,
                               sid: int, msg: Dict[str, Any],
-                              directives: Dict[str, Any]) -> None:
+                              directives: Dict[str, Any],
+                              collector: Optional[_KVCollector] = None,
+                              ) -> None:
         method = msg.get("method", "")
         params = msg.get("params") or {}
-        handler = self._METHODS.get(method)
-        if handler is None:
-            resp: Dict[str, Any] = {
-                "ok": False, "error": f"unknown method {method!r}",
-                "retryable": False}
-        else:
-            try:
-                if method == "submit" and params.get("stream"):
-                    resp = self._do_submit(
-                        params,
-                        sink=_ServerSink(self, conn, wlock, sid, pump))
-                else:
-                    resp = handler(self, params)
-            except Exception as e:          # noqa: BLE001 — typed reply
-                resp = {"ok": False, "error": f"server error: {e!r}",
-                        "retryable": True}
+        kv_frames: Optional[List[bytes]] = None
+        try:
+            if method == "submit" and params.get("stream"):
+                resp: Dict[str, Any] = self._do_submit(
+                    params,
+                    sink=_ServerSink(self, conn, wlock, sid, pump))
+            elif method == "graft":
+                sink = (_ServerSink(self, conn, wlock, sid, pump)
+                        if params.get("stream") else None)
+                resp = self._do_graft(params, sink, collector)
+            elif method == "fetch_kv":
+                resp, kv_frames = self._do_fetch_kv(params)
+            elif method in self._METHODS:
+                resp = self._METHODS[method](self, params)
+            else:
+                resp = {"ok": False,
+                        "error": f"unknown method {method!r}",
+                        "retryable": False}
+        except Exception as e:              # noqa: BLE001 — typed reply
+            resp = {"ok": False, "error": f"server error: {e!r}",
+                    "retryable": True}
         if directives["delay_s"] > 0:
             time.sleep(directives["delay_s"])
         if directives["drop"]:
             return                         # served, never answered
         try:
             _send_frame2(conn, wlock, sid, OP_RESPONSE, resp)
-        except (OSError, TransportError):
+            if kv_frames is not None:
+                # Binary payloads on the response's own stream id, then
+                # a terminal so the client can release the stream — the
+                # server->client half of a migration.
+                for blob in kv_frames:
+                    if faults.partitioned(self.rank):
+                        raise ConnectionError("partitioned mid-migration")
+                    _send_frame2_raw(conn, wlock, sid, OP_KV, blob)
+                _send_frame2(conn, wlock, sid, OP_TERMINAL,
+                             {"ok": True, "id": params.get("id"),
+                              "status": "done", "frames": len(kv_frames)})
+        except (OSError, ConnectionError, TransportError):
             return
         if method not in ("status", "dump"):
             with self._lock:
@@ -1159,7 +1385,9 @@ class _StreamConn:
                                  f"opcode {op}", retryable=True)
 
     def request(self, method: str, params: Dict[str, Any],
-                timeout: float, sink=None) -> Dict[str, Any]:
+                timeout: float, sink=None,
+                frames: Optional[Sequence[bytes]] = None,
+                ) -> Dict[str, Any]:
         sid = next(self._sid)
         st = _StreamState(sink)
         with self._slock:
@@ -1169,6 +1397,12 @@ class _StreamConn:
         try:
             _send_frame2(self.sock, self._wlock, sid, OP_REQUEST,
                          {"method": method, "params": params})
+            # Binary rider frames (a graft's KV payload) follow the
+            # request on the SAME stream id — the server's read loop
+            # routes them to the collector the request registered.
+            for blob in (frames or ()):
+                _send_frame2_raw(self.sock, self._wlock, sid, OP_KV,
+                                 blob)
         except OSError as e:
             self._fail(TransportError("connect",
                                       f"send to {self.name} failed: "
@@ -1206,8 +1440,15 @@ class _StreamConn:
                 return
             self._dispatch(frame)
 
-    def _dispatch(self, frame: Tuple[int, int, Dict[str, Any]]) -> None:
+    def _dispatch(self, frame: Tuple[int, int, Any]) -> None:
         sid, op, payload = frame
+        if op == OP_KV:
+            with self._slock:
+                st = self._streams.get(sid)
+            if st is not None and st.sink is not None \
+                    and hasattr(st.sink, "push_kv"):
+                st.sink.push_kv(payload)
+            return
         if op == OP_RESPONSE:
             with self._slock:
                 st = self._streams.get(sid)
@@ -1327,10 +1568,16 @@ class RemoteClient:
             return conn
 
     def _rpc_once(self, method: str, params: Dict[str, Any],
-                  timeout: float, sink=None) -> Dict[str, Any]:
+                  timeout: float, sink=None,
+                  frames: Optional[Sequence[bytes]] = None,
+                  ) -> Dict[str, Any]:
         if self.transport == "stream":
             return self._ensure_conn(timeout).request(
-                method, params, timeout, sink=sink)
+                method, params, timeout, sink=sink, frames=frames)
+        if frames:
+            raise TransportError(
+                "protocol", f"{method} to {self.name}: binary kv "
+                "frames need transport v2 (stream)", retryable=False)
         with socket.create_connection(self.address,
                                       timeout=timeout) as sock:
             sock.settimeout(timeout)
@@ -1339,7 +1586,8 @@ class RemoteClient:
 
     def call(self, method: str, params: Optional[Dict[str, Any]] = None,
              *, deadline: Optional[float] = None,
-             retry: bool = True, sink=None) -> Dict[str, Any]:
+             retry: bool = True, sink=None,
+             frames: Optional[Sequence[bytes]] = None) -> Dict[str, Any]:
         """One RPC with the full robustness stack; ``deadline`` is
         absolute ``time.monotonic()``. Raises :class:`TransportError`
         (typed, with ``retryable``) instead of ever hanging."""
@@ -1369,7 +1617,7 @@ class RemoteClient:
             t0 = time.perf_counter()
             try:
                 resp = self._rpc_once(method, params, per_try,
-                                      sink=sink)
+                                      sink=sink, frames=frames)
             except (OSError, ValueError, ConnectionError) as e:
                 outcome = ("timeout" if isinstance(e, socket.timeout)
                            else "connect")
@@ -1442,6 +1690,64 @@ class RemoteClient:
                 self._gauge_state = None
         if conn is not None:
             conn.close()
+
+    def fetch_kv(self, request_id: str, *, wire: Optional[str] = None,
+                 deadline: Optional[float] = None,
+                 ) -> Tuple[Dict[str, Any], List[bytes]]:
+        """Pull a prefilled request's wire-encoded KV off its prefill
+        replica: the JSON header plus the raw block frames
+        ``serving/disagg.decode_kv`` reverses. v2-only, no same-replica
+        retry — a failed fetch is the dispatcher's cue to fall back to
+        re-prefilling elsewhere, not to hammer a dying replica."""
+        if self.transport != "stream":
+            raise TransportError(
+                "protocol", f"fetch_kv from {self.name} needs "
+                "transport v2 (stream)", retryable=False)
+        if deadline is None:
+            deadline = time.monotonic() + 4 * self.rpc_timeout
+        sink = _KVSink()
+        params: Dict[str, Any] = {"id": request_id}
+        if wire:
+            params["wire"] = wire
+        resp = self.call("fetch_kv", params, deadline=deadline,
+                         retry=False, sink=sink)
+        header = resp.get("kv") or {}
+        if not sink.done.wait(max(0.1, deadline - time.monotonic())):
+            raise TransportError(
+                "timeout", f"kv stream from {self.name}: "
+                f"{len(sink.frames)}/{header.get('frames')} frames",
+                retryable=True)
+        if sink.error is not None:
+            raise TransportError(
+                "connect", f"kv stream from {self.name} lost: "
+                f"{sink.error}", retryable=True)
+        if len(sink.frames) != int(header.get("frames", -1)):
+            raise TransportError(
+                "protocol", f"kv stream from {self.name}: got "
+                f"{len(sink.frames)} frames, header says "
+                f"{header.get('frames')}", retryable=True)
+        return header, sink.frames
+
+    def graft(self, spec: Dict[str, Any], header: Dict[str, Any],
+              frames: Sequence[bytes], *, sink,
+              deadline: Optional[float] = None) -> Dict[str, Any]:
+        """Push a migrated request onto this (decode) replica: the
+        request spec + kv header ride the ``graft`` RPC, the binary
+        frames follow on the same stream id, and token/terminal pushes
+        stream into ``sink`` exactly like ``submit_stream``."""
+        if self.transport != "stream":
+            raise TransportError(
+                "protocol", f"graft to {self.name} needs transport "
+                "v2 (stream)", retryable=False)
+        params = dict(spec)
+        params.pop("prefill_only", None)
+        params["kv"] = dict(header)
+        params["stream"] = True
+        if deadline is not None:
+            params["deadline_s"] = max(0.0,
+                                       deadline - time.monotonic())
+        return self.call("graft", params, deadline=deadline,
+                         retry=False, sink=sink, frames=list(frames))
 
     def poll(self, request_id: str, *,
              deadline: Optional[float] = None) -> Dict[str, Any]:
@@ -1517,6 +1823,12 @@ class RemoteHandle:
         self.t_submit = time.monotonic()
         self.on_token: Optional[Callable[[int, int], None]] = None
         self.ttft_client: Optional[float] = None
+        #: disaggregated routing state: "direct" rides the classic
+        #: path; "prefill" means the current placement is the
+        #: prefill-only half of a migration (the dispatcher completes
+        #: it in wait()); "decode" means the request was grafted.
+        self.phase: str = "direct"
+        self._prefill_client: Optional["RemoteClient"] = None
         self._hlock = threading.Lock()
         self._wake = threading.Event()     # pushes nudge wait() awake
         self._streamed_upto = 0            # next on_token index to fire
@@ -1636,6 +1948,31 @@ class _HandleSink:
 
     def push_lost(self) -> None:
         self.handle._owner_lost(self.client)
+
+
+class _KVSink:
+    """Client-side collector for one ``fetch_kv`` call's pushed binary
+    frames: the response header announces the count, the reader thread
+    appends each ``OP_KV`` payload here, and the server's trailing
+    terminal (or a connection loss) releases the waiter."""
+
+    def __init__(self):
+        self.frames: List[bytes] = []
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+
+    def push_kv(self, blob: bytes) -> None:
+        self.frames.append(bytes(blob))
+
+    def push_token(self, i: int, tok: int) -> None:
+        pass                               # fetch streams carry no tokens
+
+    def push_terminal(self, st: Dict[str, Any]) -> None:
+        self.done.set()
+
+    def push_lost(self) -> None:
+        self.error = "connection lost"
+        self.done.set()
 
 
 class _StateBus:
@@ -1784,6 +2121,11 @@ class RemoteDispatcher:
         self.hedge_s = (cfg.serve_hedge_ms if hedge_ms is None
                         else float(hedge_ms)) / 1000.0
         self._status: Dict[str, Tuple[float, float]] = {}  # name->(ts,load)
+        # Replica serving roles (prefill/decode/both), learned from
+        # membership records and refreshed from status probes. Drives
+        # disaggregated routing: with both pools present, fresh prompts
+        # prefill on one pool and the KV migrates to the other.
+        self._roles: Dict[str, str] = {}
         self._lock = threading.Lock()
         # State bus rides the membership file unless pointed elsewhere;
         # with neither there is no peer to gossip with.
@@ -1816,6 +2158,10 @@ class RemoteDispatcher:
             name = rep.get("name")
             if not name:
                 continue
+            role = rep.get("role")
+            if role:
+                with self._lock:
+                    self._roles[name] = str(role)
             self.add_replica(name, (rep.get("host", "127.0.0.1"),
                                     int(rep.get("port", 0))),
                              attempt=int(rep.get("attempt", 0)))
@@ -1866,6 +2212,7 @@ class RemoteDispatcher:
             self.clients = [c for c in self.clients if c.name != name]
             self._attempts.pop(name, None)
             self._status.pop(name, None)
+            self._roles.pop(name, None)
             removed = len(self.clients) != before
         if removed:
             # A retired replica has no circuit to be open: zero its
@@ -1904,6 +2251,10 @@ class RemoteDispatcher:
             st = client.status()
             load = (float(st.get("load", 0))
                     if st.get("alive", True) else float("inf"))
+            role = st.get("role")
+            if role:
+                with self._lock:
+                    self._roles[client.name] = str(role)
             if self.bus is not None:
                 self.bus.publish(client.name, load=load,
                                  version=self._member_version)
@@ -1930,6 +2281,78 @@ class RemoteDispatcher:
                   for i, c in enumerate(candidates) if c not in exclude]
         scored.sort(key=lambda t: (t[0], t[1]))
         return [c for load, _, c in scored if load != float("inf")]
+
+    # -- disaggregated prefill/decode routing -----------------------------
+
+    def _role_of(self, client) -> str:
+        with self._lock:
+            return self._roles.get(getattr(client, "name", ""), "both")
+
+    def _disagg_active(self) -> bool:
+        """Both pools present and reachable over transport v2 (KV
+        frames are a v2-only opcode): fresh prompts take the
+        prefill→migrate→decode path instead of a monolithic submit."""
+        with self._lock:
+            clients = list(self.clients)
+            roles = {c.name: self._roles.get(c.name, "both")
+                     for c in clients}
+        pre = [c for c in clients
+               if roles[c.name] == "prefill" and self._is_stream(c)]
+        dec = [c for c in clients
+               if roles[c.name] in ("decode", "both")
+               and self._is_stream(c)]
+        return bool(pre) and bool(dec)
+
+    def _affinity_enabled(self) -> bool:
+        from horovod_tpu.config import get_config
+        knob = getattr(get_config(), "serve_affinity", "auto")
+        if knob == "off":
+            return False
+        if knob == "on":
+            return True
+        return self._disagg_active()
+
+    def _init_phase(self, handle: RemoteHandle) -> None:
+        spec = handle.spec
+        if spec.get("src") is None and spec.get("prompt") \
+                and self._disagg_active():
+            handle.phase = "prefill"
+
+    def _order_by_affinity(self, handle: RemoteHandle,
+                           candidates: List[RemoteClient]) -> \
+            List[RemoteClient]:
+        """Reorder decode candidates so the rendezvous-hash favourite
+        for this prompt's prefix fingerprint comes first — repeats of a
+        shared prefix land on the replica that already holds its radix
+        nodes, which is what makes the FLEET hit rate track the local
+        one. Load still wins ties downstream: a candidate that rejects
+        retryable is simply skipped."""
+        prompt = handle.spec.get("prompt")
+        if len(candidates) < 2 or not prompt \
+                or not self._affinity_enabled():
+            return candidates
+        from horovod_tpu.serving import disagg
+        fp = disagg.prefix_fingerprint(prompt)
+        order = {n: i for i, n in enumerate(
+            disagg.rank_by_affinity(fp, [c.name for c in candidates]))}
+        ranked = sorted(candidates,
+                        key=lambda c: order.get(c.name, len(order)))
+        handle._affinity_target = ranked[0].name
+        return ranked
+
+    def _filter_for(self, handle: RemoteHandle,
+                    candidates: List[RemoteClient]) -> \
+            List[RemoteClient]:
+        """Keep only candidates whose role can serve this handle's
+        phase. Prefill-role replicas bounce ordinary submits
+        (retryable), so excluding them here saves a guaranteed
+        rejection round-trip; decode placement gets affinity order."""
+        if handle.phase == "prefill":
+            return [c for c in candidates
+                    if self._role_of(c) == "prefill"]
+        kept = [c for c in candidates
+                if self._role_of(c) != "prefill"]
+        return self._order_by_affinity(handle, kept)
 
     # -- submit/wait ------------------------------------------------------
 
@@ -1962,10 +2385,12 @@ class RemoteDispatcher:
             ctx = reqtrace.mint_context()
             spec["trace"] = ctx.wire()
             handle = RemoteHandle(spec, deadline)
+            self._init_phase(handle)
             with reqtrace.span("SUBMIT", ctx, request=rid):
                 self._place(handle)
             return handle
         handle = RemoteHandle(spec, deadline)
+        self._init_phase(handle)
         self._place(handle)
         return handle
 
@@ -1976,17 +2401,26 @@ class RemoteDispatcher:
         return (getattr(client, "transport", "legacy") == "stream"
                 and hasattr(client, "submit_stream"))
 
+    def _spec_for(self, handle: RemoteHandle) -> Dict[str, Any]:
+        # The prefill phase rides the ordinary submit spec plus the
+        # prefill_only flag: the engine stops at the first-token point,
+        # exports the KV, and finishes DONE/"prefilled".
+        if handle.phase == "prefill":
+            return {**handle.spec, "prefill_only": True}
+        return handle.spec
+
     def _submit_to(self, client, handle: RemoteHandle) -> Dict[str, Any]:
         """Submit over the client's native wire: stream clients attach a
         push sink (tokens/terminal arrive without polling); legacy
         clients and duck-typed stubs take the plain submit."""
         tr = handle.spec.get("trace")
+        spec = self._spec_for(handle)
         if tr is None or not reqtrace.enabled():
             if self._is_stream(client):
                 return client.submit_stream(
-                    handle.spec, sink=_HandleSink(handle, client),
+                    spec, sink=_HandleSink(handle, client),
                     deadline=handle.deadline)
-            return client.submit(handle.spec, deadline=handle.deadline)
+            return client.submit(spec, deadline=handle.deadline)
         # Traced: each placement target is one ATTEMPT child span — a
         # hedge produces a second ATTEMPT under the same trace_id, and
         # the first-terminal-wins HEDGE_WIN instant names the winner.
@@ -1995,10 +2429,10 @@ class RemoteDispatcher:
         try:
             if self._is_stream(client):
                 st = client.submit_stream(
-                    handle.spec, sink=_HandleSink(handle, client),
+                    spec, sink=_HandleSink(handle, client),
                     deadline=handle.deadline)
             else:
-                st = client.submit(handle.spec, deadline=handle.deadline)
+                st = client.submit(spec, deadline=handle.deadline)
             outcome = st.get("status", "ok")
             return st
         finally:
@@ -2014,7 +2448,7 @@ class RemoteDispatcher:
         retryable rejection — wait() keeps re-placing until the
         deadline, because a partition can heal."""
         last_reason = "no live replicas"
-        candidates = self._ranked(exclude=exclude)
+        candidates = self._filter_for(handle, self._ranked(exclude=exclude))
         if not candidates:
             # Nobody LOOKS live (status probes failing, breakers open).
             # Looking dead is not being dead — a replica mid-compile
@@ -2026,6 +2460,7 @@ class RemoteDispatcher:
             with self._lock:
                 candidates = [c for c in self.clients
                               if c not in exclude]
+            candidates = self._filter_for(handle, candidates)
         for client in candidates:
             try:
                 st = self._submit_to(client, handle)
@@ -2039,6 +2474,17 @@ class RemoteDispatcher:
                 last_reason = st.get("reason") or last_reason
                 continue                   # overloaded etc: next replica
             handle._apply(st, client)
+            if handle.phase == "prefill":
+                # Remembered for the fetch leg: the terminal can arrive
+                # in this very response, before any owner is recorded.
+                handle._prefill_client = client
+            target = getattr(handle, "_affinity_target", None)
+            if target is not None:
+                metrics.counter(
+                    "serve_affinity_routed_total",
+                    outcome=("affinity" if client.name == target
+                             else "fallback")).inc()
+                handle._affinity_target = None
             if not handle.terminal:
                 handle.owners.append(client)
                 if handle.resubmits:
@@ -2048,18 +2494,31 @@ class RemoteDispatcher:
                         event="failover", request=handle.id,
                         target=client.name)
             return True
+        if handle.phase == "prefill":
+            # The whole prefill pool is unreachable or rejecting
+            # (drained, dead, or never there): degrade to a monolithic
+            # placement on the decode pool — slower TTFT, same tokens.
+            handle.phase = "direct"
+            metrics.counter("serve_kv_migrations_total",
+                            outcome="no_prefill_pool").inc()
+            return self._place(handle, exclude=exclude)
         handle.status = "rejected"
         handle.reason = last_reason
         handle.retryable = True
         return False
 
     def _maybe_hedge(self, handle: RemoteHandle) -> None:
+        # Never hedge the prefill half of a migration: two prefill
+        # replicas exporting the same request would race the fetch leg
+        # for no TTFT win (the decode graft is the long pole).
         if (self.hedge_s <= 0 or handle.hedged
+                or handle.phase == "prefill"
                 or len(handle.owners) != 1
                 or handle.status != "queued"
                 or time.monotonic() - handle.t_submit < self.hedge_s):
             return
-        backups = self._ranked(exclude=handle.owners)
+        backups = [c for c in self._ranked(exclude=handle.owners)
+                   if self._role_of(c) != "prefill"]
         if not backups:
             return
         tr = handle.spec.get("trace")
@@ -2103,6 +2562,124 @@ class RemoteDispatcher:
                     self._trace_hedge_win(handle, client)
                 self._cancel_others(handle, keep=client)
 
+    def _decode_targets(self, handle: RemoteHandle,
+                        exclude: Sequence[RemoteClient] = ()) -> \
+            List[RemoteClient]:
+        cands = [c for c in self._ranked(exclude=exclude)
+                 if self._role_of(c) != "prefill"
+                 and self._is_stream(c)]
+        return self._order_by_affinity(handle, cands)
+
+    def _advance_migration(self, handle: RemoteHandle,
+                           deadline: float) -> None:
+        """Complete a prefill→decode migration: pull the exported KV
+        off the prefill replica (length-framed OP_KV stream), then
+        graft it onto a decode replica — affinity favourite first, the
+        rest of the pool as fallbacks. The handle is reset to a live
+        queued state BEFORE the graft so pushed tokens from the decode
+        side stream straight in. Any failed leg (prefill replica died
+        mid-transfer, no decode replica accepts) downgrades to a
+        monolithic re-prefill on a survivor: slower, same tokens."""
+        src = handle.owners[0] if handle.owners else handle._prefill_client
+        tr = handle.spec.get("trace")
+        t0 = time.time()
+        last_reason = "no decode replica accepted the graft"
+        try:
+            if src is None:
+                raise TransportError(
+                    "error", "prefill terminal without a known source",
+                    retryable=True)
+            header, frames = src.fetch_kv(handle.id, deadline=deadline)
+        except TransportError as e:
+            self._migration_fallback(handle, src,
+                                     "kv fetch failed: %s" % e)
+            return
+        for tgt in self._decode_targets(handle, exclude=(src,)):
+            # Go live before the graft lands: the decode replica
+            # starts pushing the moment admit_prefilled commits, and a
+            # handle still terminal-"prefilled" would drop the tokens.
+            with handle._hlock:
+                handle.status, handle.reason = "queued", None
+                handle.retryable = False
+                handle._terminal_push = None
+                handle._lost.clear()
+            try:
+                st = tgt.graft(handle.spec, header, frames,
+                               sink=_HandleSink(handle, tgt),
+                               deadline=deadline)
+            except TransportError as e:
+                last_reason = str(e)
+                if e.retryable:
+                    continue
+                self._migration_fallback(handle, src,
+                                         "graft failed: %s" % e)
+                return
+            if st.get("status") == "rejected":
+                last_reason = st.get("reason") or last_reason
+                continue               # pool full there: next target
+            target = getattr(handle, "_affinity_target", None)
+            if target is not None:
+                metrics.counter(
+                    "serve_affinity_routed_total",
+                    outcome=("affinity" if tgt.name == target
+                             else "fallback")).inc()
+                handle._affinity_target = None
+            handle._apply(st, tgt)
+            handle.phase = "decode"
+            if not handle.terminal:
+                handle.owners = [tgt]
+            n_bytes = int(header.get("bytes", 0))
+            metrics.counter("serve_kv_migrations_total",
+                            outcome="ok").inc()
+            metrics.counter("serve_kv_migrated_bytes_total",
+                            side="client",
+                            replica=getattr(src, "name", "?")).inc(n_bytes)
+            metrics._timeline_marker(
+                "TRANSPORT", category="transport", event="kv_migrate",
+                request=handle.id, src=getattr(src, "name", "?"),
+                dst=tgt.name, bytes=n_bytes)
+            if tr is not None and reqtrace.enabled():
+                reqtrace.emit("MIGRATE", tr, t0, time.time() - t0,
+                              request=handle.id,
+                              src=getattr(src, "name", "?"),
+                              dst=tgt.name, outcome="ok",
+                              bytes=n_bytes, frames=len(frames))
+            return
+        # Leave the handle terminal-"prefilled" for the fallback path
+        # (it resets state itself before re-placing).
+        with handle._hlock:
+            handle.status, handle.reason = "done", "prefilled"
+        self._migration_fallback(handle, src, last_reason)
+
+    def _migration_fallback(self, handle: RemoteHandle,
+                            src: Optional[RemoteClient],
+                            reason: str) -> None:
+        """Migration lost a leg: re-run the request monolithically on
+        a replica that can decode, excluding the prefill source (the
+        usual trigger is that replica dying mid-transfer). Greedy
+        decode + the untouched request id keep the replay
+        byte-identical with the offline answer."""
+        handle.phase = "direct"
+        with handle._hlock:
+            handle.status, handle.reason = "queued", None
+            handle.retryable = False
+            handle._terminal_push = None
+            handle._lost.clear()
+            handle.tokens = []     # prefill committed nothing
+        handle.owners = []
+        metrics.counter("serve_kv_migrations_total",
+                        outcome="fallback").inc()
+        metrics._timeline_marker(
+            "TRANSPORT", category="transport", event="kv_fallback",
+            request=handle.id, reason=str(reason)[:120])
+        tr = handle.spec.get("trace")
+        if tr is not None and reqtrace.enabled():
+            reqtrace.instant("MIGRATE_FALLBACK", tr, request=handle.id,
+                             reason=str(reason)[:200])
+        exclude = [src] if src is not None else []
+        if self._place(handle, exclude=exclude):
+            handle.resubmits += 1
+
     def wait(self, handle: RemoteHandle,
              timeout: Optional[float] = None) -> RemoteHandle:
         """Block until the request is terminal — NEVER past its deadline.
@@ -2122,6 +2699,22 @@ class RemoteDispatcher:
         while True:
             handle._wake.clear()
             self._drain_push_state(handle)
+            if handle.phase == "prefill" and handle.terminal:
+                # The prefill half landed: a DONE/"prefilled" terminal
+                # means the KV is exported and waiting — complete the
+                # migration (fetch → graft → decode pool). A hard
+                # failure falls back to a monolithic re-prefill on a
+                # survivor. Retryable rejections skip this hook and
+                # ride the ordinary re-place loop below.
+                if handle.status == "done" \
+                        and handle.reason == "prefilled":
+                    self._advance_migration(handle, deadline)
+                elif not (handle.status == "rejected"
+                          and handle.retryable):
+                    if handle.status == "failed":
+                        self._migration_fallback(
+                            handle, handle._prefill_client,
+                            "prefill failed: %s" % handle.reason)
             if handle.terminal:
                 if not (handle.status == "rejected" and handle.retryable
                         and time.monotonic() < deadline):

@@ -1,0 +1,603 @@
+"""hvd.disagg: KV wire codec, prefix affinity, migration, role plumbing.
+
+Acceptance pins (ISSUE 19):
+
+* wire codec roundtrips fp32 exactly and bf16/int8/fp8 within their
+  format error, including ragged tails (T not a multiple of the frame
+  size) — and the header is strict: version, frame-count and
+  byte-length mismatches raise instead of grafting garbage;
+* a prompt prefilled on a prefill-role engine and grafted into a
+  decode-role engine (through the full encode/decode wire, with the
+  two pools on DIFFERENT block sizes) produces tokens identical to
+  offline ``generate()``, with ``decode_compiles == 0`` on the prefill
+  side and ``== 1`` on the decode side — for GPT-2 and Llama (GQA);
+  T5 is refused loudly at both ends;
+* shared (refcount > 1) source blocks export correctly and both pools
+  come out leak-free (``BlockManager.check()``);
+* the doctor's role-imbalance check fires on canned snapshots and is
+  QUIET on healthy/monolithic fleets;
+* FleetSupervisor validates the prefill/spare split, assigns roles in
+  rank order, and heals same-pool first.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.generate import generate
+from horovod_tpu.serving import disagg
+from horovod_tpu.serving.disagg import (
+    KV_WIRE_FORMATS, decode_kv, default_wire, encode_kv, migrate_local,
+    prefix_fingerprint, rank_by_affinity,
+)
+from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.fleet import LIVE, FleetSupervisor, ReplicaSlot
+from horovod_tpu.serving.scheduler import RequestStatus
+
+
+# ---------------------------------------------------------------------------
+# shared models (module scope: init once, reuse across engines)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    from horovod_tpu.models.llama import Llama, LlamaConfig
+    cfg = LlamaConfig.tiny(num_kv_heads=2, dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def t5_setup():
+    from horovod_tpu.models.t5 import T5, T5Config
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    model = T5(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 6), jnp.int32),
+                        jnp.zeros((1, 1), jnp.int32))["params"]
+    return model, params, cfg
+
+
+# ---------------------------------------------------------------------------
+# wire codec (pure numpy/jax, no engine)
+# ---------------------------------------------------------------------------
+
+def _rand_kv(rng, L=2, T=13, H=2, hd=8):
+    k = rng.standard_normal((L, T, H, hd)).astype(np.float32)
+    v = rng.standard_normal((L, T, H, hd)).astype(np.float32)
+    return k, v
+
+
+class TestKVWireCodec:
+    def test_fp32_roundtrip_exact_ragged(self, rng):
+        # 13 tokens at 8/frame: one full frame + a 5-token tail.
+        k, v = _rand_kv(rng, T=13)
+        header, frames = encode_kv(k, v, wire="fp32", frame_tokens=8)
+        assert header["frames"] == len(frames) == 2
+        k2, v2 = decode_kv(header, frames)
+        assert np.array_equal(k2, k) and np.array_equal(v2, v)
+
+    @pytest.mark.parametrize("wire,rms_tol", [
+        ("bf16", 0.01), ("int8", 0.02), ("fp8", 0.08)])
+    def test_lossy_roundtrip_within_format_error(self, rng, wire,
+                                                 rms_tol):
+        k, v = _rand_kv(rng, T=13)
+        header, frames = encode_kv(k, v, wire=wire, frame_tokens=8)
+        k2, v2 = decode_kv(header, frames)
+        for a, b in ((k, k2), (v, v2)):
+            rms = float(np.sqrt(np.mean((a - b) ** 2))
+                        / np.sqrt(np.mean(a ** 2)))
+            assert rms < rms_tol, f"{wire}: relative RMS {rms:.4f}"
+        assert k2.dtype == np.float32 and k2.shape == k.shape
+
+    @pytest.mark.parametrize("T,ft", [(1, 8), (8, 8), (9, 8), (13, 1),
+                                      (5, 64)])
+    def test_frame_geometry(self, rng, T, ft):
+        k, v = _rand_kv(rng, T=T)
+        header, frames = encode_kv(k, v, wire="fp32", frame_tokens=ft)
+        assert len(frames) == -(-T // ft) == header["frames"]
+        assert header["tokens"] == T
+        assert header["bytes"] == sum(len(f) for f in frames)
+        k2, v2 = decode_kv(header, frames)
+        assert np.array_equal(k2, k) and np.array_equal(v2, v)
+
+    def test_header_fields(self, rng):
+        k, v = _rand_kv(rng, L=3, T=10, H=2, hd=4)
+        header, _ = encode_kv(k, v, wire="bf16", frame_tokens=4)
+        assert header["v"] == 1
+        assert header["wire"] == "bf16"
+        assert (header["layers"], header["kv_heads"],
+                header["head_dim"]) == (3, 2, 4)
+        assert header["frame_tokens"] == 4
+
+    def test_strictness(self, rng):
+        k, v = _rand_kv(rng, T=9)
+        header, frames = encode_kv(k, v, wire="fp32", frame_tokens=4)
+        with pytest.raises(ValueError, match="version"):
+            decode_kv(dict(header, v=99), frames)
+        with pytest.raises(ValueError, match="frames"):
+            decode_kv(header, frames[:-1])
+        with pytest.raises(ValueError, match="bytes"):
+            decode_kv(header, [frames[0][:-8]] + list(frames[1:]))
+        with pytest.raises(ValueError, match="wire"):
+            decode_kv(dict(header, wire="fp64"), frames)
+        with pytest.raises(ValueError, match="wire"):
+            encode_kv(k, v, wire="fp64", frame_tokens=4)
+        with pytest.raises(ValueError, match="matching"):
+            encode_kv(k, v[:, :5], wire="fp32", frame_tokens=4)
+
+    def test_default_wire_follows_pool(self):
+        assert default_wire("int8", jnp.float32) == "int8"
+        assert default_wire("fp8", jnp.bfloat16) == "fp8"
+        assert default_wire(None, jnp.bfloat16) == "bf16"
+        assert default_wire(None, jnp.float32) == "fp32"
+        assert default_wire("", jnp.float32) == "fp32"
+        assert set(KV_WIRE_FORMATS) == {"fp32", "bf16", "int8", "fp8"}
+
+
+# ---------------------------------------------------------------------------
+# fleet-global prefix affinity (pure hashing)
+# ---------------------------------------------------------------------------
+
+class TestPrefixAffinity:
+    def test_fingerprint_width(self):
+        base = list(range(100, 130))
+        fp = prefix_fingerprint(base)
+        assert fp == prefix_fingerprint(base) and len(fp) == 16
+        # Divergence past FINGERPRINT_TOKENS does not change routing...
+        tail = base[:20] + [999]
+        assert prefix_fingerprint(tail) == fp
+        # ...but divergence inside the window does.
+        assert prefix_fingerprint([999] + base[1:]) != fp
+        # Short prompts fingerprint what they have.
+        assert prefix_fingerprint(base[:3]) != fp
+
+    def test_rendezvous_deterministic_failover(self):
+        names = ["r0", "r1", "r2", "r3"]
+        fps = [prefix_fingerprint([seed, seed + 1, seed + 2])
+               for seed in range(64)]
+        winners = set()
+        for fp in fps:
+            ranked = rank_by_affinity(fp, names)
+            assert sorted(ranked) == sorted(names)
+            assert ranked == rank_by_affinity(fp, names)  # stable
+            winners.add(ranked[0])
+            # Rendezvous property: removing the winner promotes the
+            # runner-up and leaves everyone else's order unchanged.
+            survivors = [n for n in names if n != ranked[0]]
+            assert rank_by_affinity(fp, survivors) == ranked[1:]
+        # 64 fingerprints over 4 replicas: every replica owns some.
+        assert winners == set(names)
+
+    def test_dead_replica_only_remaps_its_own_fingerprints(self):
+        names = ["r0", "r1", "r2", "r3"]
+        fps = [prefix_fingerprint([seed, 7, 11]) for seed in range(64)]
+        dead = "r2"
+        survivors = [n for n in names if n != dead]
+        for fp in fps:
+            before = rank_by_affinity(fp, names)[0]
+            after = rank_by_affinity(fp, survivors)[0]
+            if before != dead:
+                assert after == before
+
+
+# ---------------------------------------------------------------------------
+# prefill -> decode migration (in-process, full wire codec)
+# ---------------------------------------------------------------------------
+
+def _pool(model, params, *, pre_bs=4, dec_bs=8, prefix_cache=False,
+          dec_quant=None):
+    """A 1x1 disaggregated pool on deliberately DIFFERENT block sizes:
+    the wire is token-major, so geometry never has to agree."""
+    pre = InferenceEngine(model, params, slots=2, max_len=48,
+                          block_size=pre_bs, prefill_chunk=4,
+                          role="prefill", prefix_cache=prefix_cache,
+                          name="pre0")
+    dec = InferenceEngine(model, params, slots=2, max_len=48,
+                          block_size=dec_bs, prefill_chunk=4,
+                          role="decode", kv_quant=dec_quant,
+                          name="dec0")
+    return pre, dec
+
+
+class TestMigration:
+    def test_gpt2_parity_and_single_decode_compile(self, gpt2_setup,
+                                                   rng):
+        model, params, cfg = gpt2_setup
+        pre, dec = _pool(model, params)
+        # Chunk-aligned on the prefill side (12 % 4 == 0: the decode
+        # program is never traced there), ragged against the decode
+        # pool's block_size=8 (12 = 8 + 4: the graft pads a tail block).
+        prompt = list(rng.integers(1, cfg.vocab_size, 12))
+        want = np.asarray(generate(
+            model, params, jnp.asarray([prompt], jnp.int32), 6))[0, 12:]
+
+        r1 = pre.submit(prompt, 6, prefill_only=True)
+        pre.run_until_idle()
+        assert r1.status == RequestStatus.DONE
+        assert r1.reason == "prefilled"
+        assert r1.tokens == []                 # no token generated here
+        assert r1.kv_export is not None
+        k, v = r1.kv_export
+        layers = pre.family.num_layers(cfg)
+        assert k.shape == (layers, 12, pre.family.kv_heads(cfg),
+                           pre.family.head_dim(cfg))
+        assert pre.decode_compiles == 0        # prefill program only
+        assert pre.stats()["kv_exports"] == 1
+
+        r2 = migrate_local(r1, dec, wire="fp32")
+        dec.run_until_idle()
+        assert r2.result(1) == list(want)      # token parity vs offline
+        assert r2.served_by == "dec0"
+        assert dec.decode_compiles == 1
+        assert dec.prefill_compiles == 0       # never re-prefilled
+        assert dec.stats()["kv_grafts"] == 1
+        pre.manager.check()
+        dec.manager.check()
+        assert dec.manager.blocks_in_use == 0
+
+    def test_llama_gqa_parity(self, llama_setup, rng):
+        model, params, cfg = llama_setup
+        pre, dec = _pool(model, params)
+        prompt = list(rng.integers(1, cfg.vocab_size, 11))
+        want = np.asarray(generate(
+            model, params, jnp.asarray([prompt], jnp.int32), 5))[0, 11:]
+        r1 = pre.submit(prompt, 5, prefill_only=True)
+        pre.run_until_idle()
+        assert r1.status == RequestStatus.DONE and r1.kv_export
+        assert r1.kv_export[0].shape[2] == cfg.num_kv_heads  # GQA export
+        r2 = migrate_local(r1, dec, wire="fp32")
+        dec.run_until_idle()
+        assert r2.result(1) == list(want)
+        assert dec.decode_compiles == 1
+        pre.manager.check()
+        dec.manager.check()
+
+    @pytest.mark.parametrize("wire", ["bf16", "int8", "fp8"])
+    def test_lossy_wires_serve(self, gpt2_setup, rng, wire):
+        """Quantized wires trade exactness for bytes — the graft must
+        still decode to completion with in-vocab tokens."""
+        model, params, cfg = gpt2_setup
+        pre, dec = _pool(model, params)
+        prompt = list(rng.integers(1, cfg.vocab_size, 9))
+        r1 = pre.submit(prompt, 6, prefill_only=True)
+        pre.run_until_idle()
+        r2 = migrate_local(r1, dec, wire=wire)
+        dec.run_until_idle()
+        assert r2.status == RequestStatus.DONE
+        assert len(r2.tokens) == 6
+        assert all(0 <= t < cfg.vocab_size for t in r2.tokens)
+        dec.manager.check()
+
+    def test_default_wire_from_quantized_dst_pool(self, gpt2_setup,
+                                                  rng):
+        """wire="" resolves off the destination pool: an int8 pool's
+        rounding already happened, so the wire quantizes too."""
+        model, params, cfg = gpt2_setup
+        pre, dec = _pool(model, params, dec_quant="int8")
+        prompt = list(rng.integers(1, cfg.vocab_size, 8))
+        r1 = pre.submit(prompt, 4, prefill_only=True)
+        pre.run_until_idle()
+        r2 = migrate_local(r1, dec)            # wire="" -> int8
+        dec.run_until_idle()
+        assert r2.status == RequestStatus.DONE and len(r2.tokens) == 4
+
+    def test_shared_prefix_source_blocks_export_leak_free(
+            self, gpt2_setup, rng):
+        """Two prefill_only prompts sharing a 2-block preamble: the
+        second prefix-hits, so its export reads blocks held by BOTH the
+        radix index and the slot table (refcount > 1) — and the grafted
+        result still matches offline generate()."""
+        model, params, cfg = gpt2_setup
+        pre, dec = _pool(model, params, prefix_cache=True)
+        pre_toks = list(rng.integers(1, cfg.vocab_size, 8))  # 2 blocks
+        prompt_a = pre_toks + list(rng.integers(1, cfg.vocab_size, 3))
+        prompt_b = pre_toks + list(rng.integers(1, cfg.vocab_size, 5))
+
+        ra = pre.submit(prompt_a, 4, prefill_only=True)
+        pre.run_until_idle()                   # registers the preamble
+        assert ra.status == RequestStatus.DONE
+
+        rb = pre.submit(prompt_b, 4, prefill_only=True)
+        pre.step_once()                        # admit: prefix-hit maps
+        assert pre.manager.shared_block_count() > 0, \
+            "second prompt should share the preamble blocks"
+        pre.run_until_idle()
+        assert rb.status == RequestStatus.DONE
+        assert pre.manager.prefix_stats()["hits"] >= 1
+
+        for r, prompt in ((ra, prompt_a), (rb, prompt_b)):
+            want = np.asarray(generate(
+                model, params, jnp.asarray([prompt], jnp.int32),
+                4))[0, len(prompt):]
+            r2 = migrate_local(r, dec, wire="fp32")
+            dec.run_until_idle()
+            assert r2.result(1) == list(want)
+        pre.manager.check()                    # shared refcounts intact
+        dec.manager.check()
+        assert dec.manager.blocks_in_use == 0
+
+    def test_t5_refused_loudly(self, t5_setup, rng):
+        model, params, cfg = t5_setup
+        eng = InferenceEngine(model, params, slots=1, max_len=16,
+                              block_size=4, prefill_chunk=2,
+                              max_src_len=6)
+        r = eng.submit(None, 4, src=[2, 3, 4], prefill_only=True)
+        assert r.status == RequestStatus.REJECTED
+        assert "t5" in r.reason
+        assert eng.decode_compiles == 0
+        with pytest.raises(NotImplementedError, match="t5"):
+            eng.admit_prefilled([1, 2], 4,
+                                np.zeros((1, 2, 1, 4), np.float32),
+                                np.zeros((1, 2, 1, 4), np.float32))
+
+    def test_role_gates_are_retryable(self, gpt2_setup):
+        model, params, _ = gpt2_setup
+        pre, dec = _pool(model, params)
+        # A prefill-role engine bounces normal requests back to the
+        # dispatcher (mis-route, not a dead letter)...
+        r = pre.submit([1, 2, 3], 4)
+        assert r.status == RequestStatus.REJECTED and r.retryable
+        assert "prefill-role" in r.reason
+        # ...and a decode-role engine bounces prefill_only the same way.
+        r = dec.submit([1, 2, 3], 4, prefill_only=True)
+        assert r.status == RequestStatus.REJECTED and r.retryable
+        assert "does not prefill" in r.reason
+        # Grafting INTO a prefill-role engine is a routing bug: raise.
+        with pytest.raises(ValueError, match="prefill-role"):
+            pre.admit_prefilled([1, 2], 4,
+                                np.zeros((2, 2, 2, 8), np.float32),
+                                np.zeros((2, 2, 2, 8), np.float32))
+
+    def test_geometry_mismatch_raises(self, gpt2_setup, rng):
+        """A wrong-model graft must never be silently decoded."""
+        model, params, cfg = gpt2_setup
+        _, dec = _pool(model, params)
+        prompt = [1, 2, 3, 4]
+        bad = np.zeros((99, len(prompt), 1, 4), np.float32)
+        with pytest.raises(ValueError, match="geometry"):
+            dec.admit_prefilled(prompt, 4, bad, bad)
+
+    def test_graft_pool_pressure_rejects_retryable(self, gpt2_setup,
+                                                   rng):
+        model, params, cfg = gpt2_setup
+        pre, _ = _pool(model, params)
+        dec = InferenceEngine(model, params, slots=1, max_len=48,
+                              block_size=8, prefill_chunk=4,
+                              role="decode", name="dec1")
+        prompts = [list(rng.integers(1, cfg.vocab_size, 6))
+                   for _ in range(2)]
+        handles = []
+        for p in prompts:
+            r = pre.submit(p, 4, prefill_only=True)
+            pre.run_until_idle()
+            handles.append(r)
+        first = migrate_local(handles[0], dec, wire="fp32")
+        assert first.status == RequestStatus.RUNNING
+        # The single slot is taken synchronously — the second graft
+        # bounces retryable so the dispatcher can re-place it.
+        second = migrate_local(handles[1], dec, wire="fp32")
+        assert second.status == RequestStatus.REJECTED
+        assert second.retryable and "graft" in second.reason
+        dec.run_until_idle()
+        assert first.status == RequestStatus.DONE
+        dec.manager.check()
+
+    def test_migrate_requires_export(self, gpt2_setup):
+        model, params, _ = gpt2_setup
+        _, dec = _pool(model, params)
+
+        class _Handle:
+            id = "req-x"
+            prompt = [1, 2]
+            max_new_tokens = 4
+        with pytest.raises(ValueError, match="prefill_only"):
+            migrate_local(_Handle(), dec)
+
+
+# ---------------------------------------------------------------------------
+# doctor: role-imbalance findings on canned snapshots
+# ---------------------------------------------------------------------------
+
+def _role_snap(pools, fleet_live=None):
+    """Canned metrics snapshot: ``pools`` maps engine name to
+    ``(role, active, total, queued)``; ``fleet_live`` maps serve_role
+    to live replica count for the dead-pool checks."""
+    gauges = {
+        "serve_role": [
+            {"labels": {"engine": e, "role": p[0]}, "value": 1.0}
+            for e, p in pools.items()],
+        "serve_slots_active": [
+            {"labels": {"engine": e}, "value": float(p[1])}
+            for e, p in pools.items()],
+        "serve_slots_total": [
+            {"labels": {"engine": e}, "value": float(p[2])}
+            for e, p in pools.items()],
+        "serve_queue_depth": [
+            {"labels": {"engine": e}, "value": float(p[3])}
+            for e, p in pools.items()],
+    }
+    if fleet_live is not None:
+        gauges["fleet_role_replicas"] = [
+            {"labels": {"role": r, "state": "live"}, "value": float(n)}
+            for r, n in fleet_live.items()]
+    return {"gauges": gauges}
+
+
+class TestDoctorRoleImbalance:
+    def _check(self, snap):
+        from horovod_tpu.profiler import _check_roles
+        return _check_roles(snap)
+
+    def test_healthy_split_is_quiet(self):
+        snap = _role_snap({"pre0": ("prefill", 2, 4, 0),
+                           "dec0": ("decode", 2, 4, 0),
+                           "dec1": ("decode", 1, 4, 0)},
+                          fleet_live={"prefill": 1, "decode": 2})
+        assert self._check(snap) == []
+
+    def test_monolithic_fleet_is_quiet_even_when_hot(self):
+        snap = _role_snap({"e0": ("both", 4, 4, 9),
+                           "e1": ("both", 4, 4, 12)})
+        assert self._check(snap) == []
+
+    def test_prefill_saturated_decode_idle(self):
+        snap = _role_snap({"pre0": ("prefill", 4, 4, 3),
+                           "dec0": ("decode", 0, 4, 0)})
+        out = self._check(snap)
+        assert len(out) == 1
+        f = out[0]
+        assert f["category"] == "role_imbalance"
+        assert f["severity"] == 0.55
+        assert "prefill pool saturated" in f["title"]
+        assert "HOROVOD_SERVE_FLEET_PREFILL" in f["suggestion"]
+        assert f["evidence"]["prefill_queued"] == 3
+
+    def test_decode_saturated_prefill_idle(self):
+        snap = _role_snap({"pre0": ("prefill", 0, 4, 0),
+                           "dec0": ("decode", 4, 4, 5)})
+        out = self._check(snap)
+        assert len(out) == 1
+        assert out[0]["severity"] == 0.55
+        assert "decode pool saturated" in out[0]["title"]
+        assert "HOROVOD_SERVE_ROLE=decode" in out[0]["suggestion"]
+
+    def test_dead_prefill_pool(self):
+        snap = _role_snap({"pre0": ("prefill", 2, 4, 0),
+                           "dec0": ("decode", 2, 4, 0)},
+                          fleet_live={"prefill": 0, "decode": 2})
+        out = self._check(snap)
+        assert len(out) == 1
+        assert out[0]["severity"] == 0.8
+        assert "prefill pool has no live replicas" in out[0]["title"]
+        assert "no_prefill_pool" in out[0]["detail"]
+
+    def test_dead_decode_pool_is_worst(self):
+        snap = _role_snap({"pre0": ("prefill", 2, 4, 0),
+                           "dec0": ("decode", 2, 4, 0)},
+                          fleet_live={"prefill": 2, "decode": 0,
+                                      "both": 0})
+        out = self._check(snap)
+        assert len(out) == 1
+        assert out[0]["severity"] == 0.9
+        assert "decode pool has no live replicas" in out[0]["title"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: role-aware slots, spare split, same-pool healing
+# ---------------------------------------------------------------------------
+
+def _stub_launcher(name, rank, attempt, role="both"):
+    raise AssertionError("tests never spawn")
+
+
+class TestFleetRoles:
+    def test_prefill_must_leave_a_decode_replica(self):
+        with pytest.raises(ValueError, match="at least one decode"):
+            FleetSupervisor(_stub_launcher, 2, spares=0, prefill=2,
+                            prefill_spares=0)
+        with pytest.raises(ValueError, match="at least one decode"):
+            FleetSupervisor(_stub_launcher, 1, spares=0, prefill=3,
+                            prefill_spares=0)
+
+    def test_prefill_spares_bounded_by_spares(self):
+        with pytest.raises(ValueError, match="exceed total"):
+            FleetSupervisor(_stub_launcher, 4, spares=1, prefill=1,
+                            prefill_spares=2)
+
+    def test_role_assignment_order(self):
+        sup = FleetSupervisor(_stub_launcher, 4, spares=2, prefill=1,
+                              prefill_spares=1)
+        serving = [s for s in sup._slots if s.role == "serving"]
+        spares = [s for s in sup._slots if s.role == "spare"]
+        assert [s.serve_role for s in serving] == \
+            ["prefill", "decode", "decode", "decode"]
+        assert [s.serve_role for s in spares] == ["prefill", "decode"]
+
+    def test_monolithic_fleet_all_both(self):
+        sup = FleetSupervisor(_stub_launcher, 3, spares=1, prefill=0,
+                              prefill_spares=0)
+        assert all(s.serve_role == "both" for s in sup._slots)
+
+    def test_launcher_role_introspection(self):
+        sup = FleetSupervisor(_stub_launcher, 2, spares=0, prefill=1,
+                              prefill_spares=0)
+        assert sup._launcher_takes_role       # explicit role kwarg
+        sup2 = FleetSupervisor(lambda name, rank, attempt: None, 2,
+                               spares=0, prefill=0, prefill_spares=0)
+        assert not sup2._launcher_takes_role  # legacy launcher
+        sup3 = FleetSupervisor(lambda **kw: None, 2, spares=0,
+                               prefill=0, prefill_spares=0)
+        assert sup3._launcher_takes_role      # VAR_KEYWORD passthrough
+
+    def test_membership_carries_role(self):
+        sup = FleetSupervisor(_stub_launcher, 2, spares=0, prefill=1,
+                              prefill_spares=0)
+        slot = sup._slots[0]
+        slot.address = ("127.0.0.1", 9999)
+        sup._member_add(slot)
+        assert sup._members[slot.name]["role"] == "prefill"
+
+    def test_promote_spare_same_pool_first(self):
+        sup = FleetSupervisor(_stub_launcher, 3, spares=2, prefill=1,
+                              prefill_spares=1)
+        for s in sup._slots:
+            s.state = LIVE
+        dead = sup._slots[0]                  # serving, prefill
+        assert dead.serve_role == "prefill"
+        pre_spare = next(s for s in sup._slots
+                         if s.role == "spare"
+                         and s.serve_role == "prefill")
+        dec_spare = next(s for s in sup._slots
+                         if s.role == "spare"
+                         and s.serve_role == "decode")
+        sup._promote_spare(dead)
+        assert pre_spare.role == "serving"    # same-pool spare won
+        assert dec_spare.role == "spare"      # decode spare untouched
+        assert dead.role == "spare"           # dead rank rebuilds spare
+
+    def test_promote_spare_never_crosses_pools(self):
+        """With only a decode-warmed spare, a dead prefill replica must
+        NOT be healed cross-pool — a 'both' spare is the only fallback."""
+        sup = FleetSupervisor(_stub_launcher, 3, spares=1, prefill=1,
+                              prefill_spares=0)
+        for s in sup._slots:
+            s.state = LIVE
+        dead = sup._slots[0]
+        spare = next(s for s in sup._slots if s.role == "spare")
+        assert spare.serve_role == "decode"
+        sup._promote_spare(dead)
+        assert spare.role == "spare" and dead.role == "serving"
+        spare.serve_role = "both"             # now it may stand in
+        sup._promote_spare(dead)
+        assert spare.role == "serving" and dead.role == "spare"
+
+    def test_role_gauges_cover_both_pools(self):
+        from horovod_tpu import metrics
+        sup = FleetSupervisor(_stub_launcher, 3, spares=1, prefill=1,
+                              prefill_spares=1)
+        for s in sup._slots:
+            s.state = LIVE
+        sup._update_gauges()
+        snap = metrics.snapshot()
+        series = {(tuple(sorted(s.get("labels", {}).items())),
+                   s["value"])
+                  for s in snap.get("gauges", {}).get(
+                      "fleet_role_replicas", [])}
+        assert ((("role", "prefill"), ("state", "live")), 1.0) in series
+        assert ((("role", "decode"), ("state", "live")), 2.0) in series
+        assert ((("role", "prefill"), ("state", "spare")), 1.0) in series

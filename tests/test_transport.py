@@ -1068,3 +1068,15 @@ class TestNetSmoke:
         # rendezvous-flake retry in tools/smoke_util.py.
         rc, text = net_smoke.run_smoke(str(tmp_path))
         assert rc == 0, text
+
+    def test_migration_kill_falls_back_to_survivor(self, tmp_path):
+        # Disaggregated pools with the prefill replica SIGKILLed at
+        # exactly request 2's KV-fetch RPC: the request must re-prefill
+        # on the survivor and stay byte-identical to offline generate().
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import net_smoke
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        rc, text = net_smoke.run_migration_smoke(str(tmp_path))
+        assert rc == 0, text

@@ -43,6 +43,7 @@ SETTINGS_KEYS = (
     "payload_mb", "world", "batch", "seq_len", "steps",
     "prefix_overlap", "prefix_cache", "spec_k", "request_trace",
     "slo_ttft_p99_ms", "slo_error_rate",
+    "serve_role", "kv_wire", "affinity",
 )
 
 

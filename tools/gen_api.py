@@ -64,6 +64,7 @@ MODULES = [
     "horovod_tpu.serving.cache",
     "horovod_tpu.serving.scheduler",
     "horovod_tpu.serving.engine",
+    "horovod_tpu.serving.disagg",
     "horovod_tpu.serving.replica",
     "horovod_tpu.serving.transport",
     "horovod_tpu.serving.fleet",

@@ -36,7 +36,20 @@ bus: dispatcher B — a fresh frontend whose breakers never saw the kill
 — routes its first request around the dead replica purely from
 dispatcher A's gossiped down mark, without spending a probe on it.
 
-Exit status 0 = all checks pass. Wired as ``make net-smoke`` (both
+A third scenario (:func:`run_migration_smoke`) covers disaggregated
+serving: a prefill-roled replica and a decode-roled replica. Request 1
+rides the full migration path (prefill on rank 0, KV frames over the
+wire, decode on rank 1) and must match offline ``generate()`` exactly.
+Then the prefill replica's fault plan (``kill@rank=0,step=K,
+space=net`` — stamped into rank 0's environment only) SIGKILLs it at
+exactly the KV-fetch RPC of request 2: the smoke reads the replica's
+``fault_step`` position from status, aligns it to ``K-3`` with probe
+spam, and lands submit and fetch on steps ``K-1``/``K``. The
+dispatcher must fall back — re-prefill request 2 monolithically on the
+survivor — and still return tokens byte-identical to offline
+``generate()``, typed-terminal within the deadline.
+
+Exit status 0 = all checks pass. Wired as ``make net-smoke`` (all
 scenarios) and as tier-1 ``tests/test_transport.py::TestNetSmoke``.
 """
 
@@ -94,6 +107,42 @@ WORKER = textwrap.dedent("""
 """).format(repo=REPO)
 
 _TYPED = {"done", "rejected", "expired", "cancelled", "failed"}
+
+# Role-stamped worker for the disaggregated-serving scenario: argv[3]
+# carries the serve role (prefill|decode), stamped into the environment
+# BEFORE the horovod_tpu import (exactly how fleet.ProcessLauncher
+# delivers it) and passed to the engine explicitly. The warm-up matches
+# the role: a prefill replica only ever runs prefill_only requests (it
+# must never compile decode), a decode replica warms the full
+# prefill+decode pair so a migration fallback costs no compile.
+MIGRATION_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, root, role = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["HOROVOD_SERVE_ROLE"] = role
+    sys.path.insert(0, {repo!r})
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.engine import InferenceEngine
+    from horovod_tpu.serving.transport import SocketReplicaServer
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    eng = InferenceEngine(model, params, slots=2, max_len=64,
+                          block_size=8, prefill_chunk=4, role=role,
+                          name=f"rank{{rank}}")
+    eng.submit([1, 2, 3, 4, 5], 2,
+               prefill_only=(role == "prefill"))
+    eng.run_until_idle()
+    srv = SocketReplicaServer(eng, rank).start()
+    with open(os.path.join(root, f"port.rank{{rank}}"), "w") as f:
+        f.write(str(srv.port))
+    open(os.path.join(root, f"ready.rank{{rank}}"), "w").close()
+    while True:                       # killed (rank 0) or terminated
+        time.sleep(0.1)
+""").format(repo=REPO)
 
 
 def run_smoke(workdir: str, timeout_s: float = 300.0):
@@ -467,6 +516,227 @@ def run_stream_smoke(workdir: str, timeout_s: float = 300.0):
     return 0, ""
 
 
+# ---------------------------------------------------------------------------
+# scenario 3: disaggregated prefill/decode with a mid-migration SIGKILL
+# ---------------------------------------------------------------------------
+
+MIG_PROMPT_A = [5, 17, 42, 9]
+MIG_PROMPT_B = [7, 3, 99, 12, 31]
+MIG_MAX_NEW = 16
+# Rank 0 — the prefill replica — SIGKILLs itself at its 24th inbound
+# RPC. The step is aimed at request 2's KV-fetch by the alignment loop
+# in run_migration_smoke: status probes count as steps AND report the
+# replica's position (``fault_step``), so the client walks the counter
+# to exactly K-2, pins the dispatcher's status cache (no ranking probe
+# can slip in), and submits — the submit RPC lands on K-1 and the
+# migration KV-fetch on K.
+MIG_KILL_STEP = 24
+MIG_FAULT_PLAN = f"kill@rank=0,step={MIG_KILL_STEP},space=net"
+
+
+def run_migration_smoke(workdir: str, timeout_s: float = 300.0):
+    """Disaggregated serving under fire: rank 0 serves prefill only,
+    rank 1 decode only. Asserts:
+
+    1. request 1 migrates (prefill on rank 0 → KV frames over the wire
+       → decode on rank 1) and its tokens are byte-identical to offline
+       greedy ``generate()`` — the KV graft is lossless;
+    2. rank 0's fault plan SIGKILLs it at exactly request 2's KV-fetch
+       RPC (mid-migration); the dispatcher falls back to a monolithic
+       re-prefill on the survivor, and request 2 still goes
+       typed-terminal ``done`` within its deadline with tokens
+       byte-identical to offline ``generate()``;
+    3. both migration outcomes are counted
+       (``serve_kv_migrations_total{outcome=ok|fallback}``) and the
+       prefill replica is really dead.
+    """
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu import metrics
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.transport import (
+        RemoteClient, RemoteDispatcher, TransportError)
+
+    metrics.reset_metrics()
+    root = os.path.join(workdir, "mig-root")
+    os.makedirs(root, exist_ok=True)
+    base_env = smoke_util.jit_cache_env()
+    base_env.pop("HOROVOD_FAULT_PLAN", None)
+    env0 = dict(base_env, HOROVOD_FAULT_PLAN=MIG_FAULT_PLAN)
+    roles = {0: "prefill", 1: "decode"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", MIGRATION_WORKER,
+         str(rank), root, roles[rank]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=(env0 if rank == 0 else base_env))
+        for rank in (0, 1)]
+    deadline = time.monotonic() + timeout_s
+
+    def fail(msg):
+        print(f"net-smoke-migration FAIL: {msg}", file=sys.stderr)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        texts = [msg]
+        for i, p in enumerate(procs):
+            try:
+                out = p.communicate(timeout=10)[0]
+            except subprocess.TimeoutExpired:
+                out = "<no output>"
+            print(f"--- replica {i} output ---\n{out}", file=sys.stderr)
+            texts.append(out or "")
+        return 1, "\n".join(texts)
+
+    # Offline greedy references with the SAME seeded params the workers
+    # build: both the migrated and the fallback request must match.
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+
+    def offline(prompt):
+        return [int(t) for t in np.asarray(generate(
+            model, params, jnp.asarray([prompt], jnp.int32),
+            MIG_MAX_NEW))[0, len(prompt):]]
+
+    want_a, want_b = offline(MIG_PROMPT_A), offline(MIG_PROMPT_B)
+
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(root, f"ready.rank{r}"))
+               for r in (0, 1)):
+            break
+        if any(p.poll() is not None for p in procs):
+            return fail("a replica exited during startup")
+        time.sleep(0.1)
+    else:
+        return fail("replicas not ready in time")
+
+    addresses = []
+    for r in (0, 1):
+        with open(os.path.join(root, f"port.rank{r}")) as f:
+            addresses.append(("127.0.0.1", int(f.read().strip())))
+
+    clients = [RemoteClient(addresses[r], name=f"rank{r}",
+                            rpc_timeout=2.0, max_retries=2)
+               for r in (0, 1)]
+    disp = RemoteDispatcher(clients=clients, hedge_ms=0.0)
+
+    # Learn the pools: one ranking pass reads both replicas' roles off
+    # status. Disagg routing must light up before anything is submitted.
+    disp._ranked()
+    if not disp._disagg_active():
+        return fail("dispatcher did not learn the prefill/decode pools "
+                    f"from status (roles={disp._roles})")
+
+    # 1. the happy migration: prefill on rank 0, decode on rank 1.
+    h1 = disp.submit(list(MIG_PROMPT_A), MIG_MAX_NEW, deadline_s=240.0,
+                     request_id="mig-0")
+    t0 = time.monotonic()
+    disp.wait(h1)
+    if time.monotonic() - t0 > 240.0 + 5.0:
+        return fail("request 1 overran its deadline")
+    if h1.status != "done":
+        return fail(f"migrated request ended {h1.status} ({h1.reason})")
+    if h1.phase != "decode":
+        return fail(f"request 1 finished in phase {h1.phase!r}, "
+                    "expected 'decode' (migration did not happen)")
+    if h1.served_by != "rank1":
+        return fail(f"request 1 served by {h1.served_by}, expected the "
+                    "decode replica rank1")
+    if h1.tokens != want_a:
+        return fail(f"migrated tokens diverge from offline generate(): "
+                    f"{h1.tokens[:8]}... vs {want_a[:8]}...")
+
+    # 2. align rank 0's fault-step counter so the submit and the
+    #    KV-fetch land on steps K-1 and K. Every status call consumes
+    #    one step and reports the new position.
+    target = MIG_KILL_STEP - 2
+    c_pre = disp.clients[0]
+    pos = -1
+    for _ in range(MIG_KILL_STEP * 2):
+        try:
+            st = c_pre.status(retry=False)
+        except TransportError as e:
+            return fail(f"prefill replica unreachable during fault-step "
+                        f"alignment at position {pos}: {e}")
+        pos = int(st.get("fault_step", -1))
+        if pos >= target:
+            break
+    if pos != target:
+        return fail(f"could not align the fault step: at {pos}, "
+                    f"wanted exactly {target} (kill step "
+                    f"{MIG_KILL_STEP})")
+
+    # Pin the dispatcher's status cache for rank 0 as freshly-probed
+    # and idle, so placement ranks off the cache instead of spending a
+    # probe (whether the 0.25s TTL has lapsed is a race we must not
+    # depend on). Rank 0's next two inbound RPCs are then exactly the
+    # submit (K-1) and the migration KV-fetch (K) — where the SIGKILL
+    # fires, mid-transfer.
+    with disp._lock:
+        disp._status[c_pre.name] = (time.monotonic(), 0.0)
+    h2 = disp.submit(list(MIG_PROMPT_B), MIG_MAX_NEW, deadline_s=240.0,
+                     request_id="mig-1")
+    t0 = time.monotonic()
+    disp.wait(h2)
+    if time.monotonic() - t0 > 240.0 + 5.0:
+        return fail("request 2 overran its deadline")
+    if h2.status not in _TYPED:
+        return fail(f"request 2 ended untyped: {h2.status}")
+    if h2.status != "done":
+        return fail(f"fallback request ended {h2.status} ({h2.reason})")
+    if h2.phase != "direct":
+        return fail(f"request 2 finished in phase {h2.phase!r}, "
+                    "expected 'direct' (no fallback happened — did the "
+                    "kill fire?)")
+    if h2.served_by != "rank1":
+        return fail(f"request 2 served by {h2.served_by}, expected the "
+                    "survivor rank1")
+    if h2.resubmits < 1:
+        return fail("the migration fallback did not count a resubmit")
+    if h2.tokens != want_b:
+        return fail(f"fallback tokens diverge from offline generate(): "
+                    f"{h2.tokens[:8]}... vs {want_b[:8]}...")
+
+    # 3. the prefill replica is dead, and both outcomes were counted.
+    try:
+        procs[0].wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        return fail(f"rank 0 survived its kill@step={MIG_KILL_STEP}")
+    snap = metrics.snapshot()
+
+    def outcome(kind):
+        return sum(s.get("value", 0) for s in
+                   snap.get("counters", {}).get(
+                       "serve_kv_migrations_total", [])
+                   if s.get("labels", {}).get("outcome") == kind)
+
+    if outcome("ok") < 1:
+        return fail("serve_kv_migrations_total{outcome=ok} never "
+                    "incremented despite a completed migration")
+    if outcome("fallback") < 1:
+        return fail("serve_kv_migrations_total{outcome=fallback} never "
+                    "incremented despite the mid-transfer kill")
+
+    print(f"net-smoke-migration OK: request 1 migrated "
+          f"prefill(rank0)->decode(rank1) byte-identical to offline "
+          f"generate(); rank0 SIGKILLed at its KV-fetch RPC "
+          f"(step {MIG_KILL_STEP}), request 2 fell back to a "
+          f"monolithic re-prefill on rank1 ({h2.resubmits} "
+          f"resubmit(s)), tokens still byte-identical")
+    disp.close()
+    if procs[1].poll() is None:
+        procs[1].terminate()
+        try:
+            procs[1].wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            procs[1].kill()
+    return 0, ""
+
+
 def _attempt():
     # Fresh workdir per attempt: a retry must not reuse the failed
     # attempt's ports/state files.
@@ -479,13 +749,22 @@ def _attempt_stream():
         return run_stream_smoke(td)
 
 
+def _attempt_migration():
+    with tempfile.TemporaryDirectory(prefix="hvd_net_smoke_mig_") as td:
+        return run_migration_smoke(td)
+
+
 def main() -> int:
     sys.path.insert(0, os.path.join(REPO, "tools"))
     rc = smoke_util.main_with_retry(_attempt, name="net-smoke")
     if rc != 0:
         return rc
-    return smoke_util.main_with_retry(_attempt_stream,
-                                      name="net-smoke-stream")
+    rc = smoke_util.main_with_retry(_attempt_stream,
+                                    name="net-smoke-stream")
+    if rc != 0:
+        return rc
+    return smoke_util.main_with_retry(_attempt_migration,
+                                      name="net-smoke-migration")
 
 
 if __name__ == "__main__":

@@ -293,6 +293,250 @@ def run_bench(*, requests: int = 32, rate: float = 50.0,
     return rec
 
 
+def run_storm_bench(*, roles: str = "1x2", requests: int = 32,
+                    rate: float = 30.0, slots: int = 2,
+                    max_len: int = 160, block_size: int = 16,
+                    prefill_chunk: int = 8, kv_quant=None,
+                    wire: str = "", seed: int = 0,
+                    prefix_overlap: float = 0.6,
+                    affinity: bool = True) -> list:
+    """Prefill-storm comparison: the SAME seeded workload served by a
+    monolithic pool of P+D ``both`` engines and by a disaggregated
+    P-prefill/D-decode split (``roles="PxD"``), in-process via
+    :func:`horovod_tpu.serving.disagg.migrate_local` — the full wire
+    codec minus the socket.
+
+    The workload is the shape disaggregation exists for: roughly half
+    the arrivals are "storm" requests (a long shared preamble + tail,
+    tiny decode budget — pure prefill pressure), interleaved with chat
+    requests (short prompt, long decode). Monolithically, every chunked
+    prefill steals decode steps from in-flight chats, showing up as
+    TPOT tail latency; split, the decode pool never runs a prefill and
+    the storm only costs the chats their migration hop.
+
+    Emits three records: one per mode (``serve_storm_tokens_per_sec``
+    with TTFT/TPOT percentile summaries, distinguished by the
+    ``serve_role`` settings field the sentinel keys on) plus a
+    mono-over-disagg p99-TPOT ratio line (higher is better; >= 1.0
+    means the decode tail was no worse under disaggregation). The
+    disagg record also carries the prefix-cache hit rates: ``local``
+    (what the prefill engines actually observed) vs ``fleet`` (the
+    oracle rate a single fleet-wide cache would have seen) — with
+    affinity routing on, local ~= fleet is the whole point.
+    """
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving import disagg
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    try:
+        n_pre, n_dec = (int(x) for x in roles.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"--roles must look like PxD, got {roles!r}")
+    if n_pre < 1 or n_dec < 1:
+        raise ValueError(f"--roles needs at least 1x1, got {roles!r}")
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    max_len = min(max_len, cfg.max_seq_len)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(seed)
+
+    # The seeded workload, fixed across both modes. Storm prompts share
+    # one of a few long preambles (prefix_overlap of them), so the
+    # prefix cache has something to reuse and affinity routing has
+    # something to concentrate.
+    pre_len = min(3 * block_size,
+                  max(1, (max_len - 16 - 32) // block_size) * block_size)
+    preambles = [[int(t) for t in
+                  rng.integers(1, cfg.vocab_size - 1, pre_len)]
+                 for _ in range(3)]
+    gaps = rng.exponential(1.0 / rate, size=requests)
+    work = []
+    for i in range(requests):
+        tail = [int(t) for t in rng.integers(1, cfg.vocab_size - 1,
+                                             int(rng.integers(4, 10)))]
+        if rng.random() < 0.5:         # storm: long prompt, short decode
+            if rng.random() < prefix_overlap:
+                prompt = preambles[int(rng.integers(len(preambles)))] \
+                    + tail
+            else:
+                prompt = [int(t) for t in
+                          rng.integers(1, cfg.vocab_size - 1,
+                                       pre_len)] + tail
+            budget = int(rng.integers(4, 9))
+            kind = "storm"
+        else:                          # chat: short prompt, long decode
+            prompt = tail
+            budget = int(rng.integers(16, 25))
+            kind = "chat"
+        work.append((float(gaps[i]), prompt, budget,
+                     disagg.prefix_fingerprint(prompt), kind))
+
+    # Oracle fleet hit rate: the rate ONE fleet-wide cache would see —
+    # every arrival whose fingerprint any earlier arrival already
+    # carried. Affinity routing exists to make the observed local rate
+    # approach this.
+    seen = set()
+    fleet_hits = 0
+    for _, prompt, _, fp, _ in work:
+        if len(prompt) >= block_size:
+            if fp in seen:
+                fleet_hits += 1
+            seen.add(fp)
+    fleet_rate = round(fleet_hits / max(1, len(work)), 4)
+
+    def _mk_engine(role, name):
+        eng = InferenceEngine(
+            model, params, slots=slots, max_len=max_len,
+            block_size=block_size, prefill_chunk=prefill_chunk,
+            kv_quant=kv_quant, queue_limit=max(64, 4 * requests),
+            prefix_cache=True, role=role, name=name)
+        eng.start()
+        warm = eng.submit([1, 2, 3, 4, 5], 4,
+                          prefill_only=(role == "prefill"))
+        warm.result(timeout=600)
+        return eng
+
+    def _route(engines, fp):
+        if affinity and fp is not None:
+            by_name = {e.name: e for e in engines}
+            order = disagg.rank_by_affinity(fp, sorted(by_name))
+            return by_name[order[0]]
+        return min(engines, key=lambda e: e.load())
+
+    def _drive(mode):
+        if mode == "mono":
+            pool = [_mk_engine("both", f"mono{i}")
+                    for i in range(n_pre + n_dec)]
+            pre_pool, dec_pool = pool, pool
+        else:
+            pre_pool = [_mk_engine("prefill", f"pre{i}")
+                        for i in range(n_pre)]
+            dec_pool = [_mk_engine("decode", f"dec{i}")
+                        for i in range(n_dec)]
+            pool = pre_pool + dec_pool
+        outs = [None] * len(work)
+        threads = []
+
+        def _serve_one(i, prompt, budget, fp, kind, t_arr):
+            try:
+                if mode == "mono":
+                    r = _route(pre_pool, fp).submit(list(prompt), budget)
+                    r.result(timeout=600)
+                else:
+                    r1 = _route(pre_pool, fp).submit(
+                        list(prompt), budget, prefill_only=True)
+                    r1.result(timeout=600)
+                    if r1.status.value != "done":
+                        outs[i] = {"status": r1.status.value,
+                                   "tokens": 0, "ttft": None,
+                                   "tpot": None}
+                        return
+                    # Pool pressure rejects the graft retryable — spin
+                    # on the least-loaded decode engine until a slot
+                    # frees, the in-process analogue of the
+                    # dispatcher's re-place loop.
+                    give_up = time.monotonic() + 600
+                    while True:
+                        dst = min(dec_pool, key=lambda e: e.load())
+                        r = disagg.migrate_local(r1, dst, wire=wire)
+                        if r.status.value != "rejected" \
+                                or time.monotonic() >= give_up:
+                            break
+                        time.sleep(0.005)
+                    r.result(timeout=600)
+                outs[i] = {
+                    "status": r.status.value, "tokens": len(r.tokens),
+                    "kind": kind,
+                    "ttft": (r.t_first - t_arr
+                             if r.t_first is not None else None),
+                    "tpot": r.tpot}
+            except Exception as e:          # noqa: BLE001 - record it
+                outs[i] = {"status": f"error: {e}", "tokens": 0,
+                           "kind": kind, "ttft": None, "tpot": None}
+
+        t0 = time.perf_counter()
+        for i, (gap, prompt, budget, fp, kind) in enumerate(work):
+            time.sleep(gap)
+            t = threading.Thread(
+                target=_serve_one,
+                args=(i, prompt, budget, fp, kind, time.monotonic()),
+                daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+
+        pstats = [e.manager.prefix_stats() for e in pre_pool]
+        lookups = sum(p["lookups"] for p in pstats)
+        hits = sum(p["hits"] for p in pstats)
+        for e in pool:
+            e.stop()
+        done = [o for o in outs if o and o["status"] == "done"]
+        ttfts = [o["ttft"] for o in done if o["ttft"] is not None]
+        # The storm's victim metric is the CHAT decode tail: storm
+        # requests barely decode (tiny budgets), so folding them in
+        # would dilute exactly the interleave tax disaggregation
+        # removes. tpot_s is chats-only; tpot_all_s keeps everything.
+        tpots = [o["tpot"] for o in done
+                 if o["tpot"] is not None and o["kind"] == "chat"]
+        tpots_all = [o["tpot"] for o in done if o["tpot"] is not None]
+        return {
+            "metric": "serve_storm_tokens_per_sec",
+            "value": round(sum(o["tokens"] for o in done) / wall, 2),
+            "unit": "tokens/sec", "vs_baseline": None, "proxy": True,
+            "transport": "none",
+            "serve_role": ("both" if mode == "mono"
+                           else f"{n_pre}x{n_dec}"),
+            "kv_wire": ("" if mode == "mono" else
+                        (wire or disagg.default_wire(kv_quant,
+                                                     cfg.dtype))),
+            "requests": requests, "completed": len(done),
+            "arrival_rate_hz": rate, "wall_s": round(wall, 3),
+            "slots": slots, "max_len": max_len,
+            "block_size": block_size, "prefill_chunk": prefill_chunk,
+            "kv_quant": kv_quant, "model": "gpt2-tiny",
+            "prefix_overlap": prefix_overlap, "prefix_cache": True,
+            "affinity": affinity,
+            "prefix_hit_rate_local": round(hits / max(1, lookups), 4),
+            "prefix_hit_rate_fleet": fleet_rate,
+            "ttft_s": _summary(ttfts),
+            "tpot_s": _summary(tpots),
+            "tpot_all_s": _summary(tpots_all),
+        }
+
+    mono = _drive("mono")
+    split = _drive("disagg")
+    recs = [mono, split]
+    mono_p99 = (mono["tpot_s"] or {}).get("p99")
+    split_p99 = (split["tpot_s"] or {}).get("p99")
+    if mono_p99 and split_p99:
+        recs.append({
+            "metric": "serve_storm_tpot_mono_over_disagg",
+            "value": round(mono_p99 / split_p99, 4), "unit": "x",
+            "vs_baseline": None, "proxy": True,
+            "serve_role": f"{n_pre}x{n_dec}",
+            "kv_wire": split["kv_wire"], "requests": requests,
+            "arrival_rate_hz": rate, "slots": slots,
+            "max_len": max_len, "block_size": block_size,
+            "prefill_chunk": prefill_chunk, "kv_quant": kv_quant,
+            "model": "gpt2-tiny", "prefix_overlap": prefix_overlap,
+            "affinity": affinity,
+            "tpot_p99_mono_s": mono_p99,
+            "tpot_p99_disagg_s": split_p99,
+        })
+    for r in recs:
+        print(json.dumps(r), flush=True)
+    return recs
+
+
 def _build_parser():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=32)
@@ -322,6 +566,22 @@ def _build_parser():
     p.add_argument("--prefix-compare", action="store_true",
                    help="run the same workload with prefix cache off then "
                    "on and append gated hit-rate / TTFT-speedup lines")
+    p.add_argument("--prefill-storm", action="store_true",
+                   help="run the prefill-storm workload monolithically "
+                   "AND disaggregated (--roles) and append comparable "
+                   "TTFT/TPOT lines plus a p99-TPOT ratio line")
+    p.add_argument("--roles", default="1x2",
+                   help="disaggregated pool shape PxD for "
+                   "--prefill-storm (default 1x2: one prefill, two "
+                   "decode replicas)")
+    p.add_argument("--kv-wire", default="",
+                   choices=["", "fp32", "bf16", "int8", "fp8"],
+                   help="KV migration wire format for --prefill-storm "
+                   "(default: engine dtype/quant decides)")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="scatter requests least-loaded instead of "
+                   "routing by prompt-prefix fingerprint "
+                   "(--prefill-storm only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None,
                    help="append the JSON record to this file")
@@ -338,6 +598,21 @@ def main() -> int:
         transport=args.transport, seed=args.seed,
         prefix_overlap=args.prefix_overlap, spec_k=args.spec_k)
     recs = []
+    if args.prefill_storm:
+        recs = run_storm_bench(
+            roles=args.roles, requests=args.requests, rate=args.rate,
+            slots=args.slots, max_len=args.max_len,
+            block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant,
+            wire=args.kv_wire, seed=args.seed,
+            prefix_overlap=(args.prefix_overlap
+                            if args.prefix_overlap > 0 else 0.6),
+            affinity=not args.no_affinity)
+        if args.out:
+            with open(args.out, "a") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+        return 0
     if args.prefix_compare:
         off = run_bench(prefix_cache=False, **kw)
         on = run_bench(prefix_cache=True, **kw)
