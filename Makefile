@@ -4,8 +4,8 @@ PY ?= python
 
 .PHONY: trace-smoke overlap-smoke serve-smoke doctor-smoke quant-smoke \
 	preempt-smoke topo-smoke net-smoke fleet-smoke prefix-smoke \
-	mp-smoke reqtrace-smoke fleet-top postmortem bench-sentinel test \
-	native
+	mp-smoke reqtrace-smoke config-smoke fleet-top postmortem \
+	bench-sentinel test native
 
 # Cross-rank tracing smoke: 2 CPU processes with HOROVOD_TIMELINE shards,
 # merged via hvd.merge_timelines; exits nonzero if the merged trace is
@@ -119,6 +119,18 @@ mp-smoke:
 # tier-1 as tests/test_reqtrace.py::TestReqtraceSmoke.
 reqtrace-smoke:
 	$(PY) tools/reqtrace_smoke.py
+
+# Config-bus smoke: 2 socket replicas under a FleetSupervisor with a
+# shared auth token. apply_config(HEDGE_MS) must fan out fleet-wide
+# with the driver and both replica audit ledgers agreeing on the epoch;
+# a shape-affecting SERVE_SLOTS mutation is refused with a typed
+# reason; an injected bad RPC_TIMEOUT mutation spikes retries, is
+# measured `regressed`, auto-reverted (revert guard), and fires the
+# doctor's config_regression alert — with decode_compiles==1 and token
+# parity vs offline generate() held across all mutations. Also runs in
+# tier-1 as tests/test_confbus.py::TestConfigSmoke.
+config-smoke:
+	$(PY) tools/config_smoke.py
 
 # One frame of the fleet health dashboard (hvd.top): per-replica
 # UP/QPS/TTFT_P99/SLOTS/BLOCKS/BREAKER from scraped /metrics.json

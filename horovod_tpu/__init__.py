@@ -56,6 +56,13 @@ from horovod_tpu.metrics import metrics_http, reset_metrics  # noqa: F401
 from horovod_tpu import timeseries  # noqa: F401
 from horovod_tpu import health  # noqa: F401
 from horovod_tpu.health import top  # noqa: F401
+# Observable runtime config (docs/OBSERVABILITY.md "Config plane"): the
+# fleet-wide knob mutation bus — typed mutable-knob registry over
+# config.py, hvd.set_config() with a JSONL audit ledger + config_epoch,
+# measured-effect experiment windows with revert-on-regression, and the
+# auth-gated set_config RPC / POST /config surfaces.
+from horovod_tpu import confbus  # noqa: F401
+from horovod_tpu.confbus import set_config  # noqa: F401
 # Flight recorder & postmortem plane (docs/OBSERVABILITY.md "Postmortem
 # bundles"): an always-on black box of bounded rings (HOROVOD_BLACKBOX),
 # crash-time forensic bundles (hvd.dump_postmortem), and the offline

@@ -72,7 +72,8 @@ logger = logging.getLogger("horovod_tpu.blackbox")
 
 __all__ = [
     "FlightRecorder", "Ring", "get", "ensure", "set_identity",
-    "on_init", "on_shutdown", "note_fault", "note_fleet", "on_alert",
+    "on_init", "on_shutdown", "note_fault", "note_fleet", "note_config",
+    "on_alert",
     "on_stall", "on_engine_death", "dump_postmortem", "read_alerts_tail",
     "find_bundles", "postmortem_report", "format_postmortem",
 ]
@@ -650,6 +651,14 @@ def note_fleet(event: str, **fields: Any) -> None:
     rec = ensure()
     if rec is not None:
         rec.note("fleet", event=event, **fields)
+
+
+def note_config(event: str, **fields: Any) -> None:
+    """Record one config-bus event (``confbus``: mutation, experiment
+    verdict, auto-revert) — postmortems show the config trajectory."""
+    rec = ensure()
+    if rec is not None:
+        rec.note("config", event=event, **fields)
 
 
 def on_alert(rec_dict: Dict[str, Any]) -> None:

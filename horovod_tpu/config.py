@@ -274,6 +274,15 @@ class Config:
     # Subset-barrier wait (collective.barrier on a process set); its own
     # knob so tuning elastic failover never shortens unrelated barriers.
     barrier_timeout_seconds: float = 600.0
+    # Config bus (confbus.py, docs/OBSERVABILITY.md "Config plane"):
+    # HOROVOD_CONFIG_LEDGER is the JSONL audit-ledger path (unset =
+    # in-memory ring only), HOROVOD_CONFIG_EXPERIMENT_WINDOW the
+    # measured-effect window seconds each mutation observes its target
+    # metric over, HOROVOD_CONFIG_REVERT_ON_REGRESSION=1 opts into
+    # auto-reverting a mutation whose experiment verdict is `regressed`.
+    config_ledger_file: Optional[str] = None
+    config_experiment_window_seconds: float = 10.0
+    config_revert_on_regression: bool = False
     # NOTE: HOROVOD_HIERARCHICAL_ALLREDUCE is deliberately NOT mirrored
     # here — collective.py/adasum.py read it at call time so tests and
     # scripts can toggle it between collectives without a refresh().
@@ -644,11 +653,27 @@ def refresh() -> Config:
         fault_plan=_env_fault_plan(),
         barrier_timeout_seconds=max(
             1.0, _env_float("HOROVOD_BARRIER_TIMEOUT", 600.0)),
+        config_ledger_file=os.environ.get("HOROVOD_CONFIG_LEDGER") or None,
+        config_experiment_window_seconds=_env_posfloat(
+            "HOROVOD_CONFIG_EXPERIMENT_WINDOW", 10.0),
+        config_revert_on_regression=_env_bool(
+            "HOROVOD_CONFIG_REVERT_ON_REGRESSION"),
         log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
         inert={k: reason for k, reason in _INERT_VARS.items()
                if os.environ.get(k)},
     )
-    _CONFIG = cfg
+    prev, _CONFIG = _CONFIG, cfg
+
+    if prev is not None:
+        # A refresh() after init must not silently change resolved
+        # values: route every knob diff through the config bus so env
+        # mutations and hvd.set_config share one audit trail (WARN +
+        # config_epoch bump + ledger entry per changed knob).
+        try:
+            from horovod_tpu import confbus
+            confbus.note_refresh(prev, cfg)
+        except Exception:
+            pass   # auditing must never turn refresh() into a crash
 
     import logging
     level = {"trace": logging.DEBUG, "debug": logging.DEBUG,

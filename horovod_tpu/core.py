@@ -488,4 +488,27 @@ def build_info() -> dict:
         "serve_fleet_crash_loop_k": cfg.serve_fleet_crash_loop_k,
         "serve_fleet_spares": cfg.serve_fleet_spares,
         "inert_env": dict(cfg.inert),
+        # Config bus (confbus.py): the mutation epoch plus the FULL
+        # resolved env->value registry view — the doc/code drift test
+        # holds the documented knob tables to this surface. The auth
+        # token appears only as the serve_auth_enabled boolean above;
+        # confbus.resolved_values() masks it the same way.
+        "config_epoch": _confbus_epoch(),
+        "config": _confbus_values(),
     }
+
+
+def _confbus_epoch() -> int:
+    try:
+        from horovod_tpu import confbus
+        return confbus.epoch()
+    except Exception:
+        return 0
+
+
+def _confbus_values() -> dict:
+    try:
+        from horovod_tpu import confbus
+        return confbus.resolved_values()
+    except Exception:
+        return {}

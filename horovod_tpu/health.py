@@ -58,7 +58,8 @@ from horovod_tpu.timeseries import LocalSampler, TimeSeriesStore
 logger = logging.getLogger("horovod_tpu")
 
 __all__ = ["FleetCollector", "ContinuousDoctor", "active_alerts",
-           "last_report", "healthz", "top", "render_top", "stop_all"]
+           "last_report", "healthz", "top", "render_top", "stop_all",
+           "check_config_regression"]
 
 #: doctor categories that are true for as long as their cause persists
 #: (quarantine is sticky by design) — shown in ``/doctor``, never alerted:
@@ -352,6 +353,51 @@ def check_slo_burn(store: TimeSeriesStore, window_s: float, *,
     return out
 
 
+def check_config_regression(window_s: float, *,
+                            now: Optional[float] = None) -> List[Dict]:
+    """Config-bus regressions: a knob mutation whose measured-effect
+    window came back ``regressed`` inside this window (confbus.py). A
+    reverted one still surfaces — the operator must learn the mutation
+    was bad even when the guard already undid it."""
+    try:
+        from horovod_tpu import confbus
+        regs = confbus.recent_regressions(window_s, now=now)
+    except Exception:
+        return []
+    out: List[Dict] = []
+    for r in regs:
+        knob, metric = r.get("knob"), r.get("metric")
+        reverted = bool(r.get("reverted"))
+        out.append({
+            "category": "config_regression",
+            "severity": 0.6 if reverted else 0.8,
+            "title": f"config mutation regressed {metric}: {knob}"
+                     + (" (auto-reverted)" if reverted else ""),
+            "detail": f"the experiment window for {knob} (epoch "
+                      f"{r.get('epoch')}) measured {metric} going "
+                      f"{r.get('before')} -> {r.get('after')} — a "
+                      f"{abs(float(r.get('effect') or 0.0)):.0%} move in "
+                      f"the wrong direction"
+                      + ("; the revert guard restored the prior value"
+                         if reverted else ""),
+            "suggestion": "the config ledger entry carries who/why; "
+                          + ("nothing to undo — "
+                             if reverted else
+                             "revert via hvd.set_config or enable "
+                             "HOROVOD_CONFIG_REVERT_ON_REGRESSION=1; ")
+                          + "re-mutate with a longer "
+                          "HOROVOD_CONFIG_EXPERIMENT_WINDOW if the "
+                          "verdict looks like noise.",
+            "evidence": {"knob": knob, "metric": metric,
+                         "before": r.get("before"),
+                         "after": r.get("after"),
+                         "effect": r.get("effect"),
+                         "epoch": r.get("epoch"),
+                         "reverted": reverted},
+        })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # continuous doctor with alert lifecycle
 # ---------------------------------------------------------------------------
@@ -396,6 +442,13 @@ class ContinuousDoctor:
         self.categories = frozenset(categories) if categories else None
         self._sampler = (LocalSampler(self.store, self.interval_s)
                          if sample_local else None)
+        # The config bus measures its experiment windows against this
+        # doctor's store (the doctor tick is what evaluates them).
+        try:
+            from horovod_tpu import confbus
+            confbus.bind_store(self.store)
+        except Exception:
+            pass
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -418,11 +471,20 @@ class ContinuousDoctor:
                 self._sampler.sample_once(ts=ts)
             except Exception:
                 pass
+        # Settle the config bus's due experiment windows on the doctor
+        # tick — the verdict (and any auto-revert) lands before the
+        # finding walk below ranks config regressions.
+        try:
+            from horovod_tpu import confbus
+            confbus.poll_experiments(now=ts)
+        except Exception:
+            pass
         report = profiler.doctor_window(self.store, self.window_s, now=ts)
         findings = report["findings"]
         findings += check_fleet_availability(self.store, self.window_s,
                                              now=ts)
         findings += check_slo_burn(self.store, self.window_s, now=ts)
+        findings += check_config_regression(self.window_s, now=ts)
         findings.sort(key=lambda f: (-f["severity"], f["category"],
                                      f["title"]))
         for i, f in enumerate(findings):
@@ -646,7 +708,8 @@ def render_top(store: TimeSeriesStore, *, window_s: float = 10.0,
             role_by_rep[rep] = labels["role"]
 
     header = (f"{'REPLICA':<10}{'ATT':>4}{'ROLE':>9}{'UP':>6}{'QPS':>8}"
-              f"{'TTFT_P99_MS':>13}{'SLOTS':>7}{'BLOCKS':>8}{'BREAKER':>9}")
+              f"{'TTFT_P99_MS':>13}{'SLOTS':>7}{'BLOCKS':>8}{'BREAKER':>9}"
+              f"{'CFG':>7}")
     lines = [f"hvd.top — fleet health plane "
              f"(window {window_s:g}s, {len(by_rep)} replica(s))",
              header]
@@ -665,11 +728,30 @@ def render_top(store: TimeSeriesStore, *, window_s: float = 10.0,
         brk_s = {0.0: "closed", 0.5: "half", 1.0: "open"}.get(brk, "-") \
             if brk is not None else "-"
         role = role_by_rep.get(rep, "-")
+        # Config-bus epoch per replica: a member whose CFG@ lags the
+        # others missed a fan-out — the drift is visible at a glance.
+        cfg_ep = store.latest("config_epoch", labels=sel)
+        cfg_s = f"@{int(cfg_ep)}" if cfg_ep is not None else "-"
         lines.append(
             f"{rep:<10}{attempt:>4}{role:>9}{up:>6}{qps:>8.2f}"
             f"{_fmt(None if p99 is None else p99 * 1e3):>13}"
             f"{_fmt(slots, '{:.0f}'):>7}{_fmt(blocks, '{:.0f}'):>8}"
-            f"{brk_s:>9}")
+            f"{brk_s:>9}{cfg_s:>7}")
+
+    # Active non-default knob overrides (this process's resolved view).
+    try:
+        from horovod_tpu import confbus
+        ovr = confbus.overrides()
+    except Exception:
+        ovr = {}
+    if ovr:
+        from horovod_tpu import confbus
+        lines.append("")
+        lines.append(f"config overrides ({len(ovr)}, local epoch "
+                     f"@{confbus.epoch()}):")
+        for env, d in sorted(ovr.items()):
+            lines.append(f"  {env}={d['value']!r} "
+                         f"(default {d['default']!r})")
 
     acts = healthz()["alerts"]
     if acts:

@@ -946,8 +946,12 @@ class MetricsHTTPServer:
     windowed report (falling back to a one-shot ``hvd.doctor()`` when
     none runs); ``GET /healthz`` answers 200/503 from the
     ``alert_active`` severities — the load-balancer / probe view of the
-    alert lifecycle. Unknown paths 404. Serves on a daemon thread;
-    :meth:`stop` shuts it down."""
+    alert lifecycle. ``GET /config`` serves the config bus's view
+    (resolved values, epoch, overrides, pending experiments, ledger
+    tail); ``POST /config`` applies one ``confbus.set_config`` mutation,
+    gated on the transport auth token (403 with no token configured,
+    401 on mismatch — the token value is never echoed). Unknown paths
+    404. Serves on a daemon thread; :meth:`stop` shuts it down."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         import http.server
@@ -992,11 +996,71 @@ class MetricsHTTPServer:
                     code = 200 if verdict.get("ok", True) else 503
                     body = json.dumps(verdict, default=str).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/config":
+                    try:
+                        from horovod_tpu import confbus
+                        view = confbus.config_view()
+                    except Exception:
+                        view = {"epoch": 0, "values": {}}
+                    body = json.dumps(view, default=str).encode("utf-8")
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:          # noqa: N802 — stdlib API
+                path = self.path.split("?", 1)[0]
+                if path != "/config":
+                    self.send_error(404)
+                    return
+                # Mutations over HTTP are gated on the transport's
+                # shared secret: no token configured means the write
+                # surface is OFF (403), and a mismatched token is 401.
+                # The token value itself is never echoed in any reply.
+                import hmac as _hmac
+                from horovod_tpu.config import get_config as _get_config
+                token = _get_config().serve_auth_token
+                if not token:
+                    self._reply(403, {
+                        "ok": False,
+                        "error": "POST /config disabled: no "
+                                 "HOROVOD_SERVE_AUTH_TOKEN configured"})
+                    return
+                got = self.headers.get("X-Auth-Token", "")
+                if not _hmac.compare_digest(got, token):
+                    self._reply(401, {"ok": False,
+                                      "error": "bad auth token"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError):
+                    self._reply(400, {"ok": False,
+                                      "error": "malformed JSON body"})
+                    return
+                try:
+                    from horovod_tpu import confbus
+                    res = confbus.set_config(
+                        str(req.get("name")), req.get("value"),
+                        reason=str(req.get("reason") or ""),
+                        origin="http")
+                except Exception as e:   # noqa: BLE001 — typed reply
+                    self._reply(500, {"ok": False,
+                                      "error": f"set_config: {e!r}"})
+                    return
+                # Refusals/rejections are 200s with the typed result —
+                # policy answers, not HTTP failures.
+                self._reply(200, res)
+
+            def _reply(self, code: int, doc) -> None:
+                body = json.dumps(doc, default=str).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -1287,10 +1287,33 @@ class InferenceEngine:
             steps += 1
         raise RuntimeError(f"engine did not go idle in {max_steps} steps")
 
+    def _on_config(self, env: str, old: Any, new: Any, ep: int) -> None:
+        """Config-bus subscriber (confbus.py): live-retarget the engine
+        knobs that are safe without a retrace. Prefix caching can turn
+        OFF any time (admission just stops matching); it can turn ON
+        only when the pool was BUILT with a radix index — otherwise the
+        mutation applies fleet-wide but this engine stays off (logged),
+        because the index must exist from construction."""
+        if env == "HOROVOD_SERVE_PREFIX_CACHE":
+            want = bool(new) and self.family.name != "t5"
+            if want and self.manager.prefix is None:
+                import logging
+                logging.getLogger("horovod_tpu").warning(
+                    "serve[%s]: HOROVOD_SERVE_PREFIX_CACHE=1 ignored: "
+                    "pool was built without a prefix index; restart the "
+                    "replica to enable prefix caching", self.name)
+                return
+            self.prefix_enabled = want
+
     def start(self) -> "InferenceEngine":
         """Background serving thread (the replica servers use this)."""
         if self._thread is not None:
             return self
+        try:
+            from horovod_tpu import confbus
+            confbus.subscribe(self._on_config)
+        except Exception:
+            pass
         self._stop.clear()
 
         def loop():
@@ -1312,6 +1335,11 @@ class InferenceEngine:
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
+        try:
+            from horovod_tpu import confbus
+            confbus.unsubscribe(self._on_config)
+        except Exception:
+            pass
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None

@@ -255,6 +255,10 @@ class FleetSupervisor:
         self.membership_path = membership_path
         self.probe_s = float(cfg.serve_fleet_probe_seconds
                              if probe_seconds is None else probe_seconds)
+        # An explicit probe_seconds pins the poll period; otherwise the
+        # config-bus subscriber (start()) re-reads the knob on mutation.
+        self._probe_pinned = probe_seconds is not None
+        self._confbus_sub: Optional[Callable] = None
         self.restart_budget = int(cfg.serve_fleet_restart_budget
                                   if restart_budget is None
                                   else restart_budget)
@@ -382,6 +386,18 @@ class FleetSupervisor:
                                  event="start", target=self.target,
                                  spares=self.spares)
         self._start_metrics_http()
+        if self._confbus_sub is None and not self._probe_pinned:
+            # Re-read the probe period when the config bus mutates it —
+            # the _run loop waits `self.probe_s` per tick, so the new
+            # cadence takes effect on the next sweep.
+            def _on_knob(env, old, new, ep):
+                if env == "HOROVOD_SERVE_FLEET_PROBE":
+                    self.probe_s = float(new)
+            try:
+                from horovod_tpu import confbus
+                self._confbus_sub = confbus.subscribe(_on_knob)
+            except Exception:
+                self._confbus_sub = None
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._run, name="hvd-fleet", daemon=True)
@@ -420,6 +436,13 @@ class FleetSupervisor:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._confbus_sub is not None:
+            try:
+                from horovod_tpu import confbus
+                confbus.unsubscribe(self._confbus_sub)
+            except Exception:
+                pass
+            self._confbus_sub = None
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
@@ -466,6 +489,52 @@ class FleetSupervisor:
             return {"target": self.target,
                     "live": self.live_serving_count(),
                     "slots": [s.describe() for s in self._slots]}
+
+    def apply_config(self, name: str, value: Any, *,
+                     reason: str = "") -> Dict[str, Any]:
+        """Fan one config-bus mutation out fleet-wide: apply locally
+        via ``confbus.set_config`` (the supervisor's own ledger/epoch),
+        then push the same mutation over the auth-gated ``set_config``
+        RPC to every live serving replica. A local refusal/rejection
+        stops the fan-out — the fleet never diverges on a knob the bus
+        won't accept. Any member failure is itself a ledger entry plus
+        ``config_mutations_total{knob,outcome=partial}`` so drift is
+        observable (the ``hvd.top`` CFG column shows which replica
+        missed it); returns ``{result, applied, failed, epoch}``."""
+        from horovod_tpu import confbus
+        local = confbus.set_config(name, value, reason=reason,
+                                   origin="fleet")
+        if not local.get("ok"):
+            return {"result": local, "applied": [], "failed": [],
+                    "epoch": local.get("epoch")}
+        with self._lock:
+            targets = [(s.name, s.client) for s in self._slots
+                       if s.role == "serving" and s.state == LIVE
+                       and s.client is not None]
+        applied, failed = [], []
+        for rep, client in targets:
+            try:
+                res = client.set_config(name, value, reason=reason)
+                sub = res.get("result", {}) if isinstance(res, dict) else {}
+                if sub.get("ok"):
+                    applied.append(rep)
+                else:
+                    failed.append(rep)
+            except TransportError:
+                failed.append(rep)
+        if failed:
+            knob = local.get("knob", str(name))
+            metrics.counter("config_mutations_total", knob=knob,
+                            outcome="partial").inc()
+            confbus._append_ledger(
+                {"ts": time.time(), "event": "fanout", "knob": knob,
+                 "outcome": "partial", "applied": applied,
+                 "failed": failed, "epoch": local.get("epoch"),
+                 "who": f"fleet:pid{os.getpid()}", "reason": reason})
+            _note_fleet("config_fanout_partial", knob=knob,
+                        failed=failed)
+        return {"result": local, "applied": applied, "failed": failed,
+                "epoch": local.get("epoch")}
 
     # -- supervision ------------------------------------------------------
 

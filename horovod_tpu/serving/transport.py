@@ -986,9 +986,27 @@ class SocketReplicaServer:
                     "retryable": False}
         return {"ok": True, "rank": self.rank, "bundle": bundle}
 
+    def _do_set_config(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        # Config-bus fan-out target (confbus.py): apply one knob
+        # mutation on THIS replica through the full observable path
+        # (epoch bump, ledger, marker, subscribers). Refusals and
+        # validator rejections come back typed inside an ok=True
+        # envelope — a shape-affecting knob is a policy answer, not a
+        # transport failure, so the client must not retry it.
+        try:
+            from horovod_tpu import confbus
+            res = confbus.set_config(
+                str(p.get("name")), p.get("value"),
+                reason=str(p.get("reason") or ""), origin="rpc")
+        except Exception as e:              # noqa: BLE001 — typed reply
+            return {"ok": False, "error": f"set_config failed: {e!r}",
+                    "retryable": False}
+        return {"ok": True, "rank": self.rank, "result": res}
+
     _METHODS = {"submit": _do_submit, "poll": _do_poll,
                 "cancel": _do_cancel, "status": _do_status,
-                "drain": _do_drain, "dump": _do_dump}
+                "drain": _do_drain, "dump": _do_dump,
+                "set_config": _do_set_config}
 
     # -- connection handling ----------------------------------------------
 
@@ -1057,11 +1075,12 @@ class SocketReplicaServer:
             if directives["drop"]:
                 return                     # served, never answered
             _send_frame(conn, resp)
-            # Out-of-band methods (probes, forensics) are excluded from
-            # seq: a prober watching it measures request progress, and
-            # the fault plan's per-RPC step counter must not shift when
-            # the supervisor asks for a pre-kill dump.
-            if method not in ("status", "dump"):
+            # Out-of-band methods (probes, forensics, config fan-out)
+            # are excluded from seq: a prober watching it measures
+            # request progress, and the fault plan's per-RPC step
+            # counter must not shift when the supervisor asks for a
+            # pre-kill dump or pushes a knob mutation.
+            if method not in ("status", "dump", "set_config"):
                 with self._lock:
                     self.served_rpcs += 1
         except (OSError, ValueError, ConnectionError, TransportError):
@@ -1226,7 +1245,7 @@ class SocketReplicaServer:
                               "status": "done", "frames": len(kv_frames)})
         except (OSError, ConnectionError, TransportError):
             return
-        if method not in ("status", "dump"):
+        if method not in ("status", "dump", "set_config"):
             with self._lock:
                 self.served_rpcs += 1
 
@@ -1526,10 +1545,14 @@ class RemoteClient:
         cfg = get_config()
         self.address = (address[0], int(address[1]))
         self.name = name or f"{address[0]}:{address[1]}"
-        self.rpc_timeout = float(rpc_timeout if rpc_timeout is not None
-                                 else cfg.serve_rpc_timeout_seconds)
-        self.max_retries = int(max_retries if max_retries is not None
-                               else cfg.serve_max_retries)
+        # None = follow the live Config knob (the config bus can mutate
+        # serve_rpc_timeout_seconds / serve_max_retries at runtime and
+        # every deferring client sees the new value on its next call);
+        # an explicit constructor value pins the client, as before.
+        self._rpc_timeout_override = (None if rpc_timeout is None
+                                      else float(rpc_timeout))
+        self._max_retries_override = (None if max_retries is None
+                                      else int(max_retries))
         self.breaker = breaker or CircuitBreaker(self.name)
         self._rng = rng or random.Random()
         self.transport = (transport if transport is not None
@@ -1538,6 +1561,28 @@ class RemoteClient:
         self._conn: Optional[_StreamConn] = None
         self._conn_lock = threading.Lock()
         self._gauge_state: Optional[str] = None
+
+    @property
+    def rpc_timeout(self) -> float:
+        if self._rpc_timeout_override is not None:
+            return self._rpc_timeout_override
+        from horovod_tpu.config import get_config
+        return float(get_config().serve_rpc_timeout_seconds)
+
+    @rpc_timeout.setter
+    def rpc_timeout(self, v: float) -> None:
+        self._rpc_timeout_override = float(v)
+
+    @property
+    def max_retries(self) -> int:
+        if self._max_retries_override is not None:
+            return self._max_retries_override
+        from horovod_tpu.config import get_config
+        return int(get_config().serve_max_retries)
+
+    @max_retries.setter
+    def max_retries(self, v: int) -> None:
+        self._max_retries_override = int(v)
 
     def _ensure_conn(self, timeout: float) -> _StreamConn:
         with self._conn_lock:
@@ -1782,6 +1827,17 @@ class RemoteClient:
         if note:
             params["note"] = note
         return self.call("dump", params,
+                         deadline=time.monotonic() + self.rpc_timeout,
+                         retry=False)
+
+    def set_config(self, name: str, value: Any, *,
+                   reason: str = "") -> Dict[str, Any]:
+        """Push one config-bus mutation to the replica (confbus.py).
+        The reply embeds the replica's typed ``confbus.set_config``
+        result — refusals/rejections are answers, so no retry."""
+        return self.call("set_config",
+                         {"name": str(name), "value": value,
+                          "reason": str(reason)},
                          deadline=time.monotonic() + self.rpc_timeout,
                          retry=False)
 
@@ -2101,8 +2157,6 @@ class RemoteDispatcher:
                  max_retries: Optional[int] = None,
                  membership: Optional[str] = None,
                  state_bus: Optional[str] = None):
-        from horovod_tpu.config import get_config
-        cfg = get_config()
         self._rpc_timeout = rpc_timeout
         self._max_retries = max_retries
         if clients is not None:
@@ -2118,8 +2172,10 @@ class RemoteDispatcher:
         self._attempts: Dict[str, int] = {}
         if not self.clients and membership is None:
             raise ValueError("need at least one replica address")
-        self.hedge_s = (cfg.serve_hedge_ms if hedge_ms is None
-                        else float(hedge_ms)) / 1000.0
+        # None = follow the live serve_hedge_ms knob (config-bus
+        # mutable); an explicit hedge_ms pins this dispatcher.
+        self._hedge_override = (None if hedge_ms is None
+                                else float(hedge_ms) / 1000.0)
         self._status: Dict[str, Tuple[float, float]] = {}  # name->(ts,load)
         # Replica serving roles (prefill/decode/both), learned from
         # membership records and refreshed from status probes. Drives
@@ -2133,6 +2189,17 @@ class RemoteDispatcher:
         self.bus = _StateBus(bus_path) if bus_path else None
         if membership is not None:
             self._refresh_membership(force=True)
+
+    @property
+    def hedge_s(self) -> float:
+        if self._hedge_override is not None:
+            return self._hedge_override
+        from horovod_tpu.config import get_config
+        return float(get_config().serve_hedge_ms) / 1000.0
+
+    @hedge_s.setter
+    def hedge_s(self, v: float) -> None:
+        self._hedge_override = float(v)
 
     # -- dynamic membership ----------------------------------------------
 

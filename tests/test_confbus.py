@@ -1,0 +1,345 @@
+"""hvd.confbus — the observable config mutation bus: typed registry,
+epoch/ledger auditing, refresh-diff regression coverage, measured-effect
+experiment windows with the revert guard, and the HTTP/transport
+surfaces' masking contract."""
+
+import json
+import os
+import sys
+import types
+import urllib.request
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import confbus, health, metrics, timeseries
+from horovod_tpu import config as hconfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bus(monkeypatch, tmp_path):
+    """Fresh bus: a tmp ledger file, epoch 0, clean metrics. Restores
+    the environment and the resolved config afterwards."""
+    ledger = tmp_path / "ledger.jsonl"
+    env_before = dict(os.environ)   # set_config writes os.environ directly
+    monkeypatch.setenv("HOROVOD_CONFIG_LEDGER", str(ledger))
+    hconfig.refresh()
+    confbus.reset()
+    metrics.reset_metrics()
+    # refresh() itself audits the ledger-path change into the new file;
+    # start each test from an empty ledger at epoch 0.
+    if ledger.exists():
+        ledger.unlink()
+    yield types.SimpleNamespace(ledger=ledger, monkeypatch=monkeypatch)
+    confbus.reset()
+    os.environ.clear()
+    os.environ.update(env_before)
+    monkeypatch.undo()
+    hconfig.refresh()
+    confbus.reset()
+
+
+def _lines(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+class TestMutationPath:
+    def test_applied_mutation_is_fully_audited(self, bus):
+        res = hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 25,
+                             reason="tail experiment")
+        assert res["ok"] and res["outcome"] == "applied"
+        assert res["epoch"] == 1 and res["scope"] == "fleet"
+        cfg = hconfig.get_config()
+        assert cfg.serve_hedge_ms == 25.0
+        assert os.environ["HOROVOD_SERVE_HEDGE_MS"] == "25"
+        assert confbus.epoch() == 1
+        snap = metrics.snapshot()
+        [g] = snap["gauges"]["config_epoch"]
+        assert g["value"] == 1.0
+        applied = [c for c in snap["counters"]["config_mutations_total"]
+                   if c["labels"] == {"knob": "HOROVOD_SERVE_HEDGE_MS",
+                                      "outcome": "applied"}]
+        assert applied and applied[0]["value"] == 1.0
+        [rec] = _lines(bus.ledger)
+        assert rec["knob"] == "HOROVOD_SERVE_HEDGE_MS"
+        assert rec["old"] == 0.0 and rec["new"] == 25.0
+        assert rec["reason"] == "tail experiment"
+        assert rec["epoch"] == 1 and rec["origin"] == "api"
+        assert f"pid{os.getpid()}" in rec["who"]
+        # field-name aliasing hits the same knob; a later refresh()
+        # re-resolves the same value and audits NO further diff
+        res2 = hvd.set_config("serve_hedge_ms", 30)
+        assert res2["ok"] and res2["epoch"] == 2
+        hconfig.refresh()
+        assert confbus.epoch() == 2
+        assert hconfig.get_config().serve_hedge_ms == 30.0
+
+    def test_refusals_are_typed_and_bump_nothing(self, bus):
+        cases = [("HOROVOD_SERVE_SLOTS", "refused", "shape_affecting"),
+                 ("HOROVOD_SERVE_AUTH_TOKEN", "refused", "secret"),
+                 ("HOROVOD_TIMELINE", "refused", "immutable"),
+                 ("HOROVOD_NO_SUCH_KNOB", "unknown", "unknown")]
+        for knob, outcome, code in cases:
+            res = hvd.set_config(knob, 1)
+            assert not res["ok"]
+            assert (res["outcome"], res["code"]) == (outcome, code), knob
+            assert res["error"]
+        assert "decode_compiles" in \
+            hvd.set_config("HOROVOD_SERVE_SLOTS", 4)["error"]
+        assert confbus.epoch() == 0
+        recs = _lines(bus.ledger)
+        assert [r["outcome"] for r in recs] == \
+            ["refused", "refused", "refused", "unknown", "refused"]
+
+    def test_rejected_value_restores_environment(self, bus):
+        assert hvd.set_config("HOROVOD_SERVE_RPC_TIMEOUT", 2.5)["ok"]
+        res = hvd.set_config("HOROVOD_SERVE_RPC_TIMEOUT", -1)
+        assert (res["outcome"], res["code"]) == ("rejected", "invalid")
+        assert os.environ["HOROVOD_SERVE_RPC_TIMEOUT"] == "2.5"
+        assert hconfig.get_config().serve_rpc_timeout_seconds == 2.5
+        assert confbus.epoch() == 1
+
+    def test_ledger_rotation(self, bus):
+        bus.ledger.write_text("x" * confbus.LEDGER_ROTATE_BYTES)
+        hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 10)
+        rotated = str(bus.ledger) + ".1"
+        assert os.path.exists(rotated)
+        assert os.path.getsize(rotated) >= confbus.LEDGER_ROTATE_BYTES
+        [rec] = _lines(bus.ledger)
+        assert rec["knob"] == "HOROVOD_SERVE_HEDGE_MS"
+
+    def test_subscribers_notified_and_isolated(self, bus):
+        seen = []
+        confbus.subscribe(lambda env, old, new, ep:
+                          seen.append((env, old, new, ep)))
+
+        def boom(env, old, new, ep):
+            raise RuntimeError("subscriber bug")
+        confbus.subscribe(boom)
+        assert hvd.set_config("HOROVOD_SERVE_MAX_RETRIES", 7)["ok"]
+        assert seen == [("HOROVOD_SERVE_MAX_RETRIES", 3, 7, 1)]
+        confbus.unsubscribe(boom)
+        hvd.set_config("HOROVOD_SERVE_MAX_RETRIES", 2)
+        assert len(seen) == 2
+
+    def test_refresh_diff_is_warned_and_ledgered(self, bus, caplog):
+        """Satellite regression test: a post-init env change surfaces
+        through refresh() as a WARN diff + an audited epoch bump."""
+        bus.monkeypatch.setenv("HOROVOD_SERVE_MAX_RETRIES", "7")
+        with caplog.at_level("WARNING", logger="horovod_tpu"):
+            hconfig.refresh()
+        assert confbus.epoch() == 1
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("refresh() changed HOROVOD_SERVE_MAX_RETRIES "
+                   "(serve_max_retries): 3 -> 7" in m for m in msgs)
+        recs = [r for r in _lines(bus.ledger)
+                if r["knob"] == "HOROVOD_SERVE_MAX_RETRIES"]
+        assert recs and recs[0]["origin"] == "env-refresh"
+        assert recs[0]["old"] == 3 and recs[0]["new"] == 7
+        assert recs[0]["epoch"] == 1
+
+
+class TestSecretMasking:
+    def test_token_value_never_exported(self, bus, caplog):
+        token = "hunter2hunter2"
+        bus.monkeypatch.setenv("HOROVOD_SERVE_AUTH_TOKEN", token)
+        with caplog.at_level("WARNING", logger="horovod_tpu"):
+            hconfig.refresh()
+        assert confbus.resolved_values()["HOROVOD_SERVE_AUTH_TOKEN"] is True
+        ov = confbus.overrides()["HOROVOD_SERVE_AUTH_TOKEN"]
+        assert ov == {"value": True, "default": False}
+        blob = json.dumps(confbus.config_view())
+        blob += json.dumps(_lines(bus.ledger))
+        blob += json.dumps(hvd.build_info(), default=str)
+        blob += "".join(r.getMessage() for r in caplog.records)
+        assert token not in blob
+        assert "<set>" in "".join(r.getMessage() for r in caplog.records)
+
+
+class TestExperiments:
+    def _seed(self, store, t0, values):
+        for dt, v in values:
+            store.append_snapshot(
+                {"counters": {"transport_retries_total":
+                              [{"labels": {}, "value": v}]}},
+                ts=t0 + dt)
+
+    def _freeze(self, monkeypatch, t):
+        monkeypatch.setattr(confbus.time, "time", lambda: t)
+
+    def test_regression_verdict_and_revert_guard(self, bus):
+        assert hvd.set_config("HOROVOD_CONFIG_REVERT_ON_REGRESSION",
+                              1)["ok"]
+        assert hvd.set_config("HOROVOD_CONFIG_EXPERIMENT_WINDOW",
+                              5)["ok"]
+        store = timeseries.TimeSeriesStore()
+        confbus.bind_store(store)
+        t0 = 1_000_000.0
+        self._seed(store, t0, [(-4.5, 0.0), (-0.1, 2.0)])   # ~0.4/s
+        self._freeze(bus.monkeypatch, t0)
+        res = hvd.set_config("HOROVOD_SERVE_RPC_TIMEOUT", 0.05,
+                             reason="bad idea")
+        assert res["ok"] and res["experiment"]
+        assert [e["knob"] for e in confbus.pending_experiments()] == \
+            ["HOROVOD_SERVE_RPC_TIMEOUT"]
+        self._seed(store, t0, [(0.1, 3.0), (4.9, 104.0)])   # ~20/s
+        done = confbus.poll_experiments(now=t0 + 5.0)
+        assert [d["verdict"] for d in done] == ["regressed"]
+        assert done[0]["effect"] < -confbus.EFFECT_THRESHOLD
+        assert not confbus.pending_experiments()
+        # the guard reverted: env + live config restored, one more epoch
+        assert hconfig.get_config().serve_rpc_timeout_seconds == 5.0
+        assert os.environ["HOROVOD_SERVE_RPC_TIMEOUT"] == "5.0"
+        regs = confbus.recent_regressions(60.0, now=t0 + 5.0)
+        assert regs and regs[0]["reverted"]
+        rev = [r for r in _lines(bus.ledger)
+               if r.get("origin") == "revert"]
+        assert rev and rev[0]["new"] == 5.0
+        snap = metrics.snapshot()
+        [g] = [g for g in snap["gauges"]["config_experiment_effect"]
+               if g["labels"]["knob"] == "HOROVOD_SERVE_RPC_TIMEOUT"]
+        assert g["value"] < 0
+        # ...and the doctor ranks it (typed, softened because reverted)
+        findings = health.check_config_regression(60.0, now=t0 + 5.0)
+        assert findings[0]["category"] == "config_regression"
+        assert findings[0]["severity"] == 0.6
+        assert "(auto-reverted)" in findings[0]["title"]
+
+    def test_improvement_and_no_revert_without_guard(self, bus):
+        assert hvd.set_config("HOROVOD_CONFIG_EXPERIMENT_WINDOW",
+                              5)["ok"]
+        store = timeseries.TimeSeriesStore()
+        confbus.bind_store(store)
+        t0 = 2_000_000.0
+        self._seed(store, t0, [(-4.5, 0.0), (-0.1, 10.0)])  # ~2/s before
+        self._freeze(bus.monkeypatch, t0)
+        assert hvd.set_config("HOROVOD_SERVE_RPC_TIMEOUT", 8.0)["ok"]
+        self._seed(store, t0, [(0.1, 10.0), (4.9, 10.5)])   # ~0.1/s after
+        done = confbus.poll_experiments(now=t0 + 5.0)
+        assert [d["verdict"] for d in done] == ["improved"]
+        assert done[0]["effect"] > confbus.EFFECT_THRESHOLD
+        assert hconfig.get_config().serve_rpc_timeout_seconds == 8.0
+        # now a regression WITHOUT the guard: recorded, not reverted
+        self._seed(store, t0, [(5.1, 11.0)])
+        self._freeze(bus.monkeypatch, t0 + 5.2)
+        assert hvd.set_config("HOROVOD_SERVE_RPC_TIMEOUT", 0.05)["ok"]
+        self._seed(store, t0, [(5.5, 12.0), (9.9, 150.0)])
+        done = confbus.poll_experiments(now=t0 + 10.2)
+        assert [d["verdict"] for d in done] == ["regressed"]
+        regs = confbus.recent_regressions(60.0, now=t0 + 10.2)
+        assert regs and not regs[-1]["reverted"]
+        assert hconfig.get_config().serve_rpc_timeout_seconds == 0.05
+        assert health.check_config_regression(
+            60.0, now=t0 + 10.2)[0]["severity"] == 0.8
+
+    def test_remutation_supersedes_open_window(self, bus):
+        store = timeseries.TimeSeriesStore()
+        confbus.bind_store(store)
+        assert hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 25)["ok"]
+        assert hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 50)["ok"]
+        pend = confbus.pending_experiments()
+        assert len(pend) == 1 and pend[0]["epoch"] == 2
+        sup = [r for r in _lines(bus.ledger)
+               if r.get("verdict") == "superseded"]
+        assert sup and sup[0]["epoch"] == 1
+
+    def test_no_store_is_inconclusive(self, bus):
+        assert hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 25)["ok"]
+        done = confbus.poll_experiments(now=confbus.time.time() + 1e6)
+        assert [d["verdict"] for d in done] == ["inconclusive"]
+        assert not confbus.recent_regressions(1e9)
+
+
+class TestViewsAndHttp:
+    def test_config_view_shape(self, bus):
+        hvd.set_config("HOROVOD_SERVE_HEDGE_MS", 25)
+        view = confbus.config_view()
+        assert view["epoch"] == 1
+        assert view["values"]["HOROVOD_SERVE_HEDGE_MS"] == 25.0
+        assert "HOROVOD_SERVE_HEDGE_MS" in view["overrides"]
+        assert "HOROVOD_SERVE_RPC_TIMEOUT" in view["mutable"]
+        assert "HOROVOD_SERVE_SLOTS" in view["shape_affecting"]
+        assert view["ledger_tail"][-1]["epoch"] == 1
+        assert hvd.build_info()["config_epoch"] == 1
+
+    def test_http_get_and_gated_post(self, bus):
+        bus.monkeypatch.setenv("HOROVOD_SERVE_AUTH_TOKEN",
+                               "hunter2hunter2")
+        hconfig.refresh()
+        confbus.reset()
+        srv = metrics.metrics_http(0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/config", timeout=5).read())
+            assert doc["epoch"] == 0
+            assert doc["values"]["HOROVOD_SERVE_AUTH_TOKEN"] is True
+
+            def post(token):
+                req = urllib.request.Request(
+                    f"{base}/config",
+                    data=json.dumps({"name": "HOROVOD_SERVE_HEDGE_MS",
+                                     "value": 25,
+                                     "reason": "via http"}).encode(),
+                    headers=({"X-Auth-Token": token} if token else {}),
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+            code, _ = post(None)
+            assert code == 401
+            code, _ = post("wrong-token-00")
+            assert code == 401
+            code, body = post("hunter2hunter2")
+            assert code == 200 and body["ok"] and body["epoch"] == 1
+            assert hconfig.get_config().serve_hedge_ms == 25.0
+            # refusals are typed 200s, not transport errors
+            req = urllib.request.Request(
+                f"{base}/config",
+                data=json.dumps({"name": "HOROVOD_SERVE_SLOTS",
+                                 "value": 4}).encode(),
+                headers={"X-Auth-Token": "hunter2hunter2"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                body = json.loads(r.read())
+                assert r.status == 200
+            assert body["outcome"] == "refused"
+            assert body["code"] == "shape_affecting"
+            assert "hunter2" not in json.dumps(body)
+        finally:
+            srv.stop()
+
+    def test_http_post_without_token_configured_is_403(self, bus):
+        srv = metrics.metrics_http(0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/config",
+                data=json.dumps({"name": "HOROVOD_SERVE_HEDGE_MS",
+                                 "value": 1}).encode(), method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 403
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# three-process lifecycle smoke (make config-smoke)
+# ---------------------------------------------------------------------------
+
+class TestConfigSmoke:
+    def test_fleet_config_lifecycle(self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import config_smoke
+        finally:
+            sys.path.remove(os.path.join(_REPO, "tools"))
+        rc, text = config_smoke.run_smoke(str(tmp_path))
+        assert rc == 0, text

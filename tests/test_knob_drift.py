@@ -1,0 +1,86 @@
+"""Doc/code knob drift: the env-var tables in docs/OBSERVABILITY.md and
+docs/SERVING.md versus the config-bus registry (which is itself built
+from ``config.refresh()``'s parsers).
+
+Three invariants, so a knob can never be added, renamed, or removed on
+one side only:
+
+* every ``HOROVOD_*`` documented in the tables is KNOWN to the registry
+  (a Config-backed knob, a call-site env, or an accepted-but-inert
+  upstream variable);
+* every runtime-mutable knob (``confbus.mutable_knobs()``) is
+  documented — an operator cannot be offered a ``set_config`` surface
+  the docs don't explain;
+* the registry itself cannot drift from ``config.py``: every
+  Config-backed spec names a real dataclass field, and the resolved
+  view (``build_info()["config"]``) covers exactly those knobs.
+"""
+
+import dataclasses
+import os
+import re
+
+from horovod_tpu import confbus
+from horovod_tpu import config as hconfig
+from horovod_tpu import core
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DOCS = ("docs/OBSERVABILITY.md", "docs/SERVING.md")
+
+
+def documented_envs():
+    """``HOROVOD_*`` names from the FIRST cell of markdown table rows
+    (the env tables key rows by variable; prose mentions don't count),
+    mapped to the docs that carry them."""
+    out = {}
+    for doc in _DOCS:
+        with open(os.path.join(_REPO, doc)) as f:
+            for line in f:
+                if not line.startswith("|"):
+                    continue
+                cells = line.split("|")
+                if len(cells) < 3:
+                    continue
+                for env in re.findall(r"HOROVOD_\w+", cells[1]):
+                    out.setdefault(env, set()).add(doc)
+    return out
+
+
+class TestKnobDrift:
+    def test_documented_knobs_are_known(self):
+        stale = sorted(set(documented_envs()) - confbus.KNOWN_ENV)
+        assert not stale, (
+            f"documented in {_DOCS} but unknown to the config registry "
+            f"(rename/removal drift, or register it in confbus.py): "
+            f"{stale}")
+
+    def test_mutable_knobs_are_documented(self):
+        missing = sorted(set(confbus.mutable_knobs())
+                         - set(documented_envs()))
+        assert not missing, (
+            f"runtime-mutable via hvd.set_config but absent from the "
+            f"{_DOCS} env tables: {missing}")
+
+    def test_registry_fields_exist_on_config(self):
+        fields = {f.name for f in dataclasses.fields(hconfig.Config)}
+        ghost = sorted(f"{s.env} -> {s.field}"
+                       for s in confbus.registry().values()
+                       if s.field is not None and s.field not in fields)
+        assert not ghost, f"registry names non-Config fields: {ghost}"
+
+    def test_build_info_covers_registry(self):
+        info = core.build_info()
+        resolved = confbus.resolved_values()
+        assert set(info["config"]) == set(resolved)
+        backed = {env for env, s in confbus.registry().items()
+                  if s.field is not None}
+        assert set(resolved) == backed
+        # the secret stays a boolean everywhere it is exported
+        assert isinstance(info["config"]["HOROVOD_SERVE_AUTH_TOKEN"],
+                          bool)
+
+    def test_shape_affecting_disjoint_from_mutable(self):
+        reg = confbus.registry()
+        both = sorted(e for e in confbus.mutable_knobs()
+                      if reg[e].shape_affecting)
+        assert not both, f"mutable AND shape-affecting: {both}"

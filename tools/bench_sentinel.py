@@ -44,6 +44,7 @@ SETTINGS_KEYS = (
     "prefix_overlap", "prefix_cache", "spec_k", "request_trace",
     "slo_ttft_p99_ms", "slo_error_rate",
     "serve_role", "kv_wire", "affinity",
+    "config_epoch",
 )
 
 

@@ -1,0 +1,473 @@
+#!/usr/bin/env python
+"""Config-bus smoke: the full observable-config lifecycle against a
+live two-replica fleet.
+
+Two socket replicas under a :class:`~horovod_tpu.serving.fleet.
+FleetSupervisor` (shared ``HOROVOD_SERVE_AUTH_TOKEN`` — the
+``set_config`` RPC only exists behind the stream handshake), a
+``RemoteDispatcher`` following the membership file, and a fast local
+:class:`~horovod_tpu.health.ContinuousDoctor` whose store the config
+bus measures experiment windows against. Every process appends to its
+own JSONL audit ledger (``HOROVOD_CONFIG_LEDGER``).
+
+Walks the lifecycle the docs promise (docs/OBSERVABILITY.md "Config
+plane"):
+
+1. ``supervisor.apply_config("HOROVOD_SERVE_HEDGE_MS", 25)`` fans out
+   fleet-wide synchronously (well within one probe tick): the driver
+   ledger, BOTH replica ledgers, the ``config_epoch`` gauge, and the
+   ``CONFIG`` timeline marker all agree on epoch 1, and each replica's
+   exit stats prove the live ``serve_hedge_ms`` actually moved.
+2. A shape-affecting ``HOROVOD_SERVE_SLOTS`` mutation is REFUSED with a
+   typed reason naming the ``decode_compiles == 1`` contract — no epoch
+   bump, no fan-out.
+3. An injected BAD local mutation — ``HOROVOD_SERVE_RPC_TIMEOUT``
+   lowered to 50 ms while a live-knob client hammers a black-hole
+   endpoint — spikes ``transport_retries_total``; the measured-effect
+   window comes back ``regressed``, the revert guard
+   (``HOROVOD_CONFIG_REVERT_ON_REGRESSION=1``) restores the prior
+   value, and the continuous doctor fires a ``config_regression``
+   alert (persisted to ``alerts.jsonl``).
+4. Greedy tokens stay byte-identical to offline ``generate()`` across
+   ALL of it (baseline / post-fan-out / post-revert rounds — the
+   serving dispatcher pins its own timeouts, so the bad knob never
+   touches fleet traffic), and both replicas exit with
+   ``decode_compiles == 1``: no mutation ever retraced a program.
+
+Exit status 0 = all checks pass. Wired as ``make config-smoke`` and as
+tier-1 ``tests/test_confbus.py::TestConfigSmoke``.
+"""
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import smoke_util
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAX_NEW = 12
+PROMPTS = [[5, 17, 42, 9], [2, 3, 4], [11, 7, 200, 31, 8]]
+AUTH_TOKEN = "config-smoke-secret"
+
+# fleet_smoke's worker plus: a per-rank config ledger (set before any
+# horovod_tpu import resolves config) and a SIGTERM handler recording
+# the facts the driver asserts post-stop — decode_compiles, the local
+# config epoch, and the live serve_hedge_ms the fan-out mutated.
+WORKER = textwrap.dedent("""
+    import json, os, signal, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, root = int(sys.argv[1]), sys.argv[2]
+    attempt = os.environ.get("HVD_TPU_FLEET_RESTART", "0")
+    os.environ["HOROVOD_CONFIG_LEDGER"] = os.path.join(
+        root, f"ledger.rank{{rank}}.jsonl")
+    sys.path.insert(0, {repo!r})
+    import jax.numpy as jnp
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    from horovod_tpu.serving.engine import InferenceEngine
+    from horovod_tpu.serving.transport import SocketReplicaServer
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    eng = InferenceEngine(model, params, slots=2, max_len=64,
+                          block_size=8, prefill_chunk=4,
+                          name=f"rank{{rank}}")
+    eng.submit([1, 2, 3, 4, 5], 2)
+    eng.run_until_idle()
+    srv = SocketReplicaServer(eng, rank).start()
+    tag = f"rank{{rank}}.a{{attempt}}"
+    with open(os.path.join(root, f"port.{{tag}}"), "w") as f:
+        f.write(str(srv.port))
+
+    def _term(*_a):
+        from horovod_tpu import confbus
+        from horovod_tpu.config import get_config
+        with open(os.path.join(root, f"stats.rank{{rank}}"), "w") as f:
+            json.dump({{"decode_compiles": eng.decode_compiles,
+                        "epoch": confbus.epoch(),
+                        "hedge_ms": get_config().serve_hedge_ms}}, f)
+        sys.exit(0)
+    signal.signal(signal.SIGTERM, _term)
+    open(os.path.join(root, f"ready.{{tag}}"), "w").close()
+    while True:
+        time.sleep(0.1)
+""").format(repo=REPO)
+
+
+def _read_ledger(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def _applied(ledger, knob):
+    return [r for r in ledger if r.get("event") == "mutation"
+            and r.get("knob") == knob and r.get("outcome") == "applied"]
+
+
+def run_smoke(workdir: str, timeout_s: float = 420.0):
+    """One attempt: returns ``(rc, failure_text)``."""
+    sys.path.insert(0, REPO)
+    root = os.path.join(workdir, "config-root")
+    os.makedirs(root, exist_ok=True)
+    membership = os.path.join(root, "membership.json")
+    driver_ledger = os.path.join(root, "ledger.driver.jsonl")
+    alerts_path = os.path.join(root, "alerts.jsonl")
+    timeline_path = os.path.join(root, "timeline.json")
+
+    # Driver env BEFORE jit_cache_env() copies it for the workers: the
+    # shared auth token gates the set_config RPC; the revert guard and a
+    # short experiment window arm the measured-effect loop. Workers
+    # override the ledger path per-rank in the WORKER source.
+    os.environ["HOROVOD_SERVE_AUTH_TOKEN"] = AUTH_TOKEN
+    os.environ["HOROVOD_CONFIG_LEDGER"] = driver_ledger
+    os.environ["HOROVOD_CONFIG_REVERT_ON_REGRESSION"] = "1"
+    os.environ["HOROVOD_CONFIG_EXPERIMENT_WINDOW"] = "3"
+    os.environ.pop("HOROVOD_FAULT_PLAN", None)
+    from horovod_tpu import config, health, metrics, timeseries
+    from horovod_tpu import confbus
+    from horovod_tpu.serving.fleet import FleetSupervisor, ProcessLauncher
+    from horovod_tpu.serving.transport import (CircuitBreaker,
+                                               RemoteClient,
+                                               RemoteDispatcher)
+    from horovod_tpu.timeline import start_timeline, stop_timeline
+
+    config.refresh()
+    confbus.reset()          # a retry attempt restarts at epoch 0
+    metrics.reset_metrics()
+    start_timeline(timeline_path)
+
+    env = smoke_util.jit_cache_env()
+    fleet = FleetSupervisor(
+        ProcessLauncher(WORKER, root, env=env), target=2, spares=0,
+        membership_path=membership, probe_seconds=0.25,
+        restart_budget=2, unreachable_probes=40, probe_rpc_timeout=1.0)
+    deadline = time.monotonic() + timeout_s
+    stop_evt = threading.Event()
+    cleanup = []
+
+    def fail(msg):
+        stop_evt.set()
+        for fn in cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
+        print(f"config-smoke FAIL: {msg}", file=sys.stderr)
+        texts = [msg]
+        for slot in fleet.slots():
+            proc = getattr(slot.handle, "proc", None)
+            if proc is None:
+                continue
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                out = proc.communicate(timeout=10)[0]
+            except Exception:
+                out = "<no output>"
+            print(f"--- {slot.name} ---\n{out}", file=sys.stderr)
+            texts.append(out or "")
+        print(f"driver ledger: {_read_ledger(driver_ledger)}",
+              file=sys.stderr)
+        fleet.stop()
+        try:
+            stop_timeline()
+        except Exception:
+            pass
+        return 1, "\n".join(texts)
+
+    try:
+        fleet.start(wait_live_s=timeout_s / 2)
+    except TimeoutError as e:
+        return fail(f"fleet never reached target: {e}")
+
+    # The doctor's tick evaluates the bus's experiment windows against
+    # its (locally-sampled) store; alert routing is confined to the
+    # config_regression category so the injected retry storm's other
+    # findings cannot page.
+    store = timeseries.TimeSeriesStore()
+    doc = health.ContinuousDoctor(store, interval_s=0.25, window_s=6.0,
+                                  fire_n=2, clear_m=2,
+                                  alerts_path=alerts_path,
+                                  categories={"config_regression"}).start()
+    cleanup.append(doc.stop)
+
+    # The serving dispatcher PINS its knobs (explicit values override
+    # the live config reads): fleet traffic must ride out the
+    # deliberately-bad RPC_TIMEOUT mutation untouched, and the pinned
+    # hedge keeps driver traffic out of the HEDGE_MS experiment window.
+    disp = RemoteDispatcher(membership=membership, rpc_timeout=5.0,
+                            max_retries=2, hedge_ms=400.0)
+    cleanup.append(disp.close)
+
+    # Offline greedy reference: tokens must match byte-for-byte in
+    # every round, across every mutation.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models.generate import generate
+    from horovod_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    want = [[int(t) for t in np.asarray(generate(
+                model, params, jnp.asarray([p], jnp.int32),
+                MAX_NEW))[0, len(p):]]
+            for p in PROMPTS]
+
+    def run_round(tag):
+        handles = [disp.submit(list(p), MAX_NEW, deadline_s=180.0,
+                               request_id=f"{tag}-{i}")
+                   for i, p in enumerate(PROMPTS)]
+        for h in handles:
+            disp.wait(h)
+        for i, h in enumerate(handles):
+            if h.status != "done":
+                return f"[{tag}] request {i} ended {h.status} ({h.reason})"
+            if h.tokens != want[i]:
+                return (f"[{tag}] request {i} tokens diverge from "
+                        f"offline generate(): {h.tokens[:6]}... vs "
+                        f"{want[i][:6]}...")
+        return None
+
+    err = run_round("baseline")
+    if err:
+        return fail(err)
+
+    # 1. fleet-wide fan-out: driver + both replicas land on epoch 1.
+    res = fleet.apply_config("HOROVOD_SERVE_HEDGE_MS", 25,
+                             reason="smoke: tighten hedge fleet-wide")
+    local = res.get("result", {})
+    if not local.get("ok") or local.get("epoch") != 1:
+        return fail(f"apply_config(HEDGE_MS) did not apply at epoch 1: "
+                    f"{res}")
+    if res.get("failed") or sorted(res.get("applied", [])) != ["r0", "r1"]:
+        return fail(f"fan-out did not reach both replicas: {res}")
+    if confbus.epoch() != 1:
+        return fail(f"driver epoch {confbus.epoch()} != 1 after fan-out")
+    drv = _applied(_read_ledger(driver_ledger), "HOROVOD_SERVE_HEDGE_MS")
+    if not drv or drv[-1]["epoch"] != 1 or drv[-1]["origin"] != "fleet":
+        return fail(f"driver ledger missing the fleet HEDGE_MS entry: "
+                    f"{drv}")
+    # The RPC applied synchronously, so the replica ledgers agree on
+    # the epoch well within one probe tick; the file write itself gets
+    # a short grace window.
+    grace = time.monotonic() + 5.0
+    rep_epochs = {}
+    while time.monotonic() < grace and len(rep_epochs) < 2:
+        for r in (0, 1):
+            recs = _applied(
+                _read_ledger(os.path.join(root, f"ledger.rank{r}.jsonl")),
+                "HOROVOD_SERVE_HEDGE_MS")
+            if recs:
+                rep_epochs[r] = recs[-1]["epoch"]
+        time.sleep(0.1)
+    if rep_epochs != {0: 1, 1: 1}:
+        return fail(f"replica ledgers disagree with the driver on the "
+                    f"fan-out epoch: {rep_epochs} (driver epoch 1)")
+    # Let the HEDGE_MS experiment window resolve with no driver traffic
+    # in it (quiet window -> inconclusive) before the next mutation, so
+    # the epochs below stay deterministic.
+    quiet = time.monotonic() + 20.0
+    while time.monotonic() < quiet and any(
+            e["knob"] == "HOROVOD_SERVE_HEDGE_MS"
+            for e in confbus.pending_experiments()):
+        time.sleep(0.2)
+    if confbus.epoch() != 1:
+        return fail(f"quiet HEDGE_MS window moved the epoch to "
+                    f"{confbus.epoch()}: {confbus.ledger_tail(10)}")
+    err = run_round("post-hedge")
+    if err:
+        return fail(err)
+
+    # 2. shape-affecting mutation: refused, typed, no epoch bump.
+    res = fleet.apply_config("HOROVOD_SERVE_SLOTS", 4,
+                             reason="smoke: must refuse")
+    ref = res.get("result", {})
+    if ref.get("outcome") != "refused" or ref.get("code") != \
+            "shape_affecting":
+        return fail(f"SERVE_SLOTS mutation not refused as "
+                    f"shape_affecting: {ref}")
+    if "decode_compiles" not in ref.get("error", ""):
+        return fail(f"refusal reason does not name the compile "
+                    f"contract: {ref.get('error')!r}")
+    if res.get("applied") or res.get("failed") or confbus.epoch() != 1:
+        return fail(f"refused mutation leaked: {res}, "
+                    f"epoch={confbus.epoch()}")
+
+    # 3. injected bad mutation: a live-knob client against a black-hole
+    #    endpoint turns the 50 ms RPC_TIMEOUT into a retry storm; the
+    #    experiment window must call it regressed and the guard revert.
+    sink = socket.socket()
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(64)
+    held = []
+
+    def _swallow():
+        while not stop_evt.is_set():
+            try:
+                c, _ = sink.accept()
+                held.append(c)      # accept, never answer the handshake
+            except OSError:
+                return
+    threading.Thread(target=_swallow, daemon=True).start()
+    cleanup.append(sink.close)
+
+    # Live-read timeout/retries; a breaker that never opens keeps the
+    # retry stream flowing for the whole measurement window (status()
+    # defaults retry=False, so hammer through the retried call() path).
+    victim = RemoteClient(("127.0.0.1", sink.getsockname()[1]),
+                          name="blackhole",
+                          breaker=CircuitBreaker("blackhole",
+                                                 failures=1_000_000))
+
+    def _hammer():
+        while not stop_evt.is_set():
+            try:
+                victim.call("status", {}, retry=True)
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    bad = confbus.set_config("HOROVOD_SERVE_RPC_TIMEOUT", 0.05,
+                             reason="smoke: injected bad mutation")
+    if not bad.get("ok") or bad.get("epoch") != 2 \
+            or not bad.get("experiment"):
+        return fail(f"bad mutation did not open an experiment at "
+                    f"epoch 2: {bad}")
+    threading.Thread(target=_hammer, daemon=True).start()
+
+    reverted = None
+    while time.monotonic() < deadline:
+        regs = [r for r in confbus.recent_regressions(120.0)
+                if r["knob"] == "HOROVOD_SERVE_RPC_TIMEOUT"]
+        if regs:
+            reverted = regs[-1]
+            break
+        time.sleep(0.2)
+    stop_evt.set()
+    if reverted is None:
+        return fail("the doctor never judged the bad mutation "
+                    "regressed (no recent_regressions entry)")
+    if not reverted.get("reverted"):
+        return fail(f"regression was not auto-reverted: {reverted}")
+    live_cfg = config.get_config()
+    if live_cfg.serve_rpc_timeout_seconds != 5.0 \
+            or os.environ.get("HOROVOD_SERVE_RPC_TIMEOUT") != "5.0":
+        return fail(f"revert did not restore RPC_TIMEOUT: cfg="
+                    f"{live_cfg.serve_rpc_timeout_seconds} env="
+                    f"{os.environ.get('HOROVOD_SERVE_RPC_TIMEOUT')!r}")
+    if confbus.epoch() != 3:
+        return fail(f"epoch after revert is {confbus.epoch()}, "
+                    f"expected 3 (fan-out, bad mutation, revert)")
+    ledger = _read_ledger(driver_ledger)
+    verdicts = [r for r in ledger if r.get("event") == "experiment"
+                and r.get("knob") == "HOROVOD_SERVE_RPC_TIMEOUT"]
+    if not verdicts or verdicts[-1].get("verdict") != "regressed":
+        return fail(f"ledger carries no regressed verdict: {verdicts}")
+    rev = _applied(ledger, "HOROVOD_SERVE_RPC_TIMEOUT")
+    if not rev or rev[-1].get("origin") != "revert" \
+            or rev[-1]["epoch"] != 3:
+        return fail(f"ledger missing the revert mutation: {rev}")
+    snap = metrics.snapshot()
+    effect = None
+    for s in snap.get("gauges", {}).get("config_experiment_effect", []):
+        if s.get("labels", {}).get("knob") == "HOROVOD_SERVE_RPC_TIMEOUT":
+            effect = float(s.get("value"))
+    if effect is None or effect >= 0:
+        return fail(f"config_experiment_effect gauge not negative: "
+                    f"{effect}")
+    findings = health.check_config_regression(120.0)
+    if not findings or findings[0]["category"] != "config_regression" \
+            or "(auto-reverted)" not in findings[0]["title"]:
+        return fail(f"doctor finding missing/untyped: {findings}")
+    fired = time.monotonic() + 15.0
+    while time.monotonic() < fired:
+        alerts = _read_ledger(alerts_path)
+        if any(a.get("finding") == "config_regression"
+               and a.get("event") == "fire" for a in alerts):
+            break
+        time.sleep(0.2)
+    else:
+        return fail(f"continuous doctor never FIRED config_regression: "
+                    f"{_read_ledger(alerts_path)}")
+
+    # 4. parity after the storm, then stop: replica exit stats must show
+    #    exactly one decode compile each, the fan-out epoch, and the
+    #    mutated hedge actually live.
+    err = run_round("post-revert")
+    if err:
+        return fail(err)
+
+    g_epoch = None
+    for s in metrics.snapshot().get("gauges", {}).get("config_epoch", []):
+        g_epoch = float(s.get("value"))
+    if g_epoch != 3.0:
+        return fail(f"config_epoch gauge {g_epoch} != 3.0")
+    stop_timeline()
+    with open(timeline_path) as f:
+        tl = json.load(f)
+    cfg_marks = [e for e in tl.get("traceEvents", [])
+                 if e.get("name") == "CONFIG"]
+    mark_epochs = {e.get("args", {}).get("epoch") for e in cfg_marks
+                   if e.get("args", {}).get("event") == "mutation"
+                   and e.get("args", {}).get("epoch") is not None}
+    if not {1, 2, 3} <= mark_epochs:
+        return fail(f"CONFIG timeline markers missing epochs: "
+                    f"{sorted(mark_epochs)} (have {len(cfg_marks)} "
+                    f"markers)")
+
+    disp.close()
+    fleet.stop()
+    for r in (0, 1):
+        spath = os.path.join(root, f"stats.rank{r}")
+        if not os.path.exists(spath):
+            return fail(f"replica {r} wrote no exit stats")
+        with open(spath) as f:
+            stats = json.load(f)
+        if stats["decode_compiles"] != 1:
+            return fail(f"replica {r} decode_compiles == "
+                        f"{stats['decode_compiles']} across the "
+                        f"mutations (expected exactly 1)")
+        if stats["epoch"] != 1:
+            return fail(f"replica {r} exit epoch {stats['epoch']} != 1 "
+                        f"(the driver-local bad mutation must not fan "
+                        f"out)")
+        if stats["hedge_ms"] != 25.0:
+            return fail(f"replica {r} serve_hedge_ms "
+                        f"{stats['hedge_ms']} != 25.0: the fan-out "
+                        f"never took effect")
+    doc.stop()
+
+    print(f"config-smoke OK: HEDGE_MS fan-out agreed at epoch 1 across "
+          f"driver+2 replica ledgers; SERVE_SLOTS refused "
+          f"(shape_affecting); bad RPC_TIMEOUT regressed "
+          f"(effect {effect:.3g}) and auto-reverted at epoch 3 with a "
+          f"config_regression alert; tokens matched offline generate() "
+          f"in all rounds and decode_compiles==1 on both replicas")
+    return 0, ""
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    return smoke_util.run_smoke(run_smoke, name="config-smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

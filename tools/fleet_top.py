@@ -7,8 +7,10 @@ Follows the fleet supervisor's membership file, scrapes every member's
 (``horovod_tpu.timeseries``), and redraws one frame per interval:
 liveness, QPS (reset-aware windowed rate — a restarted replica never
 shows a negative spike), TTFT p99 from per-window histogram bucket
-deltas, slot/block occupancy, breaker state, and the continuous
-doctor's active alerts.
+deltas, slot/block occupancy, breaker state, the per-replica config-bus
+epoch (``CFG`` column — a member whose ``@N`` lags the fleet missed a
+``set_config`` fan-out), a footer listing active non-default knob
+overrides, and the continuous doctor's active alerts.
 
     python tools/fleet_top.py --membership /run/fleet/members.json
     python tools/fleet_top.py --membership m.json --once   # one frame (CI)

@@ -60,6 +60,7 @@ MODULES = [
     "horovod_tpu.timeseries",
     "horovod_tpu.health",
     "horovod_tpu.blackbox",
+    "horovod_tpu.confbus",
     "horovod_tpu.serving",
     "horovod_tpu.serving.cache",
     "horovod_tpu.serving.scheduler",
